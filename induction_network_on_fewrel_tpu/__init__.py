@@ -1,0 +1,22 @@
+"""induction_network_on_fewrel_tpu — TPU-native few-shot relation classification.
+
+A from-scratch JAX/XLA/Flax framework with the capability surface of the
+reference PyTorch repo ``wws0815/Induction-Network-on-FewRel`` (see
+/root/repo/SURVEY.md — the reference mount was empty, so parity is pinned to
+SURVEY.md §0/§2 capability rows rather than file:line citations):
+
+* Sentence encoders: CNN, BiLSTM + structured self-attention, BERT-base.
+* Induction module: squash + dynamic-routing (fixed-trip ``lax.fori_loop``).
+* Relation module: neural-tensor network scorer.
+* Episodic N-way K-shot sampling with NA/NOTA mixing (FewRel 2.0).
+* Training framework: jit + vmap-over-episodes on one chip, data-parallel
+  ``shard_map``/NamedSharding over a ``jax.sharding.Mesh`` across chips.
+
+Everything is designed TPU-first: static shapes, batched einsums onto the MXU,
+``lax.scan``/``fori_loop`` control flow, XLA collectives over ICI — no CUDA,
+no DataParallel, no NCCL.
+"""
+
+__version__ = "0.1.0"
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig  # noqa: F401

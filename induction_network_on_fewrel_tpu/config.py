@@ -1,0 +1,92 @@
+"""Frozen experiment configuration.
+
+The reference drives everything through argparse flags on ``train.py`` /
+``test.py`` (SURVEY.md §5.6). Here the same knobs live in one frozen
+dataclass: hashable (so it can be a static arg under ``jax.jit``),
+serializable (saved into the checkpoint directory), and constructible from
+the reference-compatible CLI in ``cli.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    # --- episode geometry (reference flags --trainN/--N/--K/--Q) ---
+    train_n: int = 5          # N-way during training (can exceed eval N)
+    n: int = 5                # N-way at eval
+    k: int = 5                # K-shot
+    q: int = 5                # queries per class per episode
+    na_rate: int = 0          # NOTA: na_rate*Q extra none-of-the-above queries
+    batch_size: int = 4       # episodes per optimizer step (vmapped in-device)
+
+    # --- tokenization / embedding ---
+    max_length: int = 40      # tokens per sentence (fixed; static shapes)
+    word_dim: int = 50        # GloVe 6B.50d
+    pos_dim: int = 5          # each of the two position embeddings
+    vocab_size: int = 400002  # GloVe 400k + [UNK] + [BLANK]; synthetic is small
+
+    # --- encoder ---
+    encoder: str = "bilstm"   # cnn | bilstm | bert
+    hidden_size: int = 230    # CNN filters / 2*lstm_hidden for bilstm output
+    lstm_hidden: int = 128    # per direction
+    att_dim: int = 64         # structured self-attention projection dim
+    # BERT (built from scratch in models/bert.py; random-init unless weights
+    # are found on disk — this sandbox has no network):
+    bert_layers: int = 12
+    bert_hidden: int = 768
+    bert_heads: int = 12
+    bert_intermediate: int = 3072
+    bert_frozen: bool = True  # frozen -> fine-tuned regime (reference config 4)
+
+    # --- induction + relation modules ---
+    induction_dim: int = 100  # class-vector dim C after the squash transform
+    routing_iters: int = 3    # fixed trip count -> jit-exact fori_loop
+    ntn_slices: int = 100     # h tensor slices in the NTN scorer
+
+    # --- optimization ---
+    loss: str = "mse"         # mse (paper §3.4) | ce (toolkit forks)
+    optimizer: str = "adam"   # adam | sgd
+    lr: float = 1e-3
+    weight_decay: float = 1e-5
+    lr_step_size: int = 2000  # StepLR-style decay interval
+    lr_gamma: float = 0.5
+    grad_clip: float = 10.0
+    train_iter: int = 10000
+    val_iter: int = 1000
+    val_step: int = 1000
+    test_iter: int = 3000
+
+    # --- numerics / device ---
+    device: str = "tpu"       # tpu | cpu  (reference-mandated new flag)
+    compute_dtype: str = "bfloat16"  # matmul dtype on the MXU
+    param_dtype: str = "float32"
+    seed: int = 0
+
+    # --- parallelism ---
+    dp: int = 1               # data-parallel mesh axis (episodes sharded)
+    tp: int = 1               # tensor-parallel mesh axis (NTN slices / hidden)
+
+    @property
+    def total_q(self) -> int:
+        """Queries per episode including NOTA negatives (static shape)."""
+        return self.n * self.q + self.na_rate * self.q
+
+    @property
+    def num_classes(self) -> int:
+        """Logit width: N, plus one 'none' class when NOTA is active."""
+        return self.n + (1 if self.na_rate > 0 else 0)
+
+    def replace(self, **kw: Any) -> "ExperimentConfig":
+        return dataclasses.replace(self, **kw)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "ExperimentConfig":
+        return cls(**json.loads(s))

@@ -1,0 +1,16 @@
+from induction_network_on_fewrel_tpu.models.embedding import Embedding  # noqa: F401
+from induction_network_on_fewrel_tpu.models.encoders import (  # noqa: F401
+    BiLSTMSelfAttnEncoder,
+    CNNEncoder,
+)
+from induction_network_on_fewrel_tpu.models.induction import (  # noqa: F401
+    Induction,
+    InductionNetwork,
+    RelationNTN,
+)
+from induction_network_on_fewrel_tpu.models.losses import (  # noqa: F401
+    accuracy,
+    cross_entropy_loss,
+    mse_onehot_loss,
+)
+from induction_network_on_fewrel_tpu.models.build import build_model  # noqa: F401

@@ -1,0 +1,88 @@
+"""Model factory: ExperimentConfig -> InductionNetwork instance."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.models.embedding import Embedding
+from induction_network_on_fewrel_tpu.models.encoders import (
+    BiLSTMSelfAttnEncoder,
+    CNNEncoder,
+)
+from induction_network_on_fewrel_tpu.models.induction import InductionNetwork
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+
+
+def build_model(
+    cfg: ExperimentConfig, glove_init: np.ndarray | None = None
+) -> InductionNetwork:
+    dtype = _DTYPES[cfg.compute_dtype]
+    if cfg.encoder == "bert":
+        try:
+            from induction_network_on_fewrel_tpu.models.bert import (
+                BertEmbeddingPassthrough,
+                BertEncoder,
+            )
+        except ImportError as e:
+            raise NotImplementedError(
+                "bert encoder module not available yet"
+            ) from e
+
+        embedding = BertEmbeddingPassthrough()
+        encoder = BertEncoder(
+            num_layers=cfg.bert_layers,
+            hidden_size=cfg.bert_hidden,
+            num_heads=cfg.bert_heads,
+            intermediate_size=cfg.bert_intermediate,
+            vocab_size=cfg.vocab_size,
+            max_length=cfg.max_length,
+            frozen=cfg.bert_frozen,
+            compute_dtype=dtype,
+        )
+    else:
+        embedding = Embedding(
+            vocab_size=cfg.vocab_size,
+            word_dim=cfg.word_dim,
+            pos_dim=cfg.pos_dim,
+            max_length=cfg.max_length,
+            glove_init=glove_init,
+            compute_dtype=dtype,
+        )
+        if cfg.encoder == "cnn":
+            encoder = CNNEncoder(hidden_size=cfg.hidden_size, compute_dtype=dtype)
+        elif cfg.encoder == "bilstm":
+            encoder = BiLSTMSelfAttnEncoder(
+                lstm_hidden=cfg.lstm_hidden, att_dim=cfg.att_dim, compute_dtype=dtype
+            )
+        else:
+            raise ValueError(f"unknown encoder {cfg.encoder!r}")
+
+    return InductionNetwork(
+        embedding=embedding,
+        encoder=encoder,
+        induction_dim=cfg.induction_dim,
+        routing_iters=cfg.routing_iters,
+        ntn_slices=cfg.ntn_slices,
+        nota=cfg.na_rate > 0,
+        compute_dtype=dtype,
+    )
+
+
+def batch_to_model_inputs(batch) -> tuple[dict, dict, jnp.ndarray]:
+    """EpisodeBatch (numpy) -> (support dict, query dict, label) for the model."""
+    support = {
+        "word": batch.support_word,
+        "pos1": batch.support_pos1,
+        "pos2": batch.support_pos2,
+        "mask": batch.support_mask,
+    }
+    query = {
+        "word": batch.query_word,
+        "pos1": batch.query_pos1,
+        "pos2": batch.query_pos2,
+        "mask": batch.query_mask,
+    }
+    return support, query, batch.label

@@ -1,0 +1,53 @@
+"""Token embedding: GloVe word vectors ⧺ two entity-position embeddings.
+
+Reference behavior (SURVEY.md §2.1 "Embedding"): word embedding initialized
+from the GloVe 50-d matrix (+2 rows UNK/BLANK), concatenated with two
+``Embedding(2*max_length, pos_dim)`` lookups of the head/tail offsets,
+yielding (word_dim + 2*pos_dim)-d token vectors.
+
+Gathers are HBM-bandwidth ops, not MXU ops; XLA fuses the three gathers and
+the concat into the consumer, so no custom kernel is warranted here.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class Embedding(nn.Module):
+    vocab_size: int
+    word_dim: int = 50
+    pos_dim: int = 5
+    max_length: int = 40
+    glove_init: np.ndarray | None = None  # [vocab_size, word_dim] or None
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, word: jnp.ndarray, pos1: jnp.ndarray, pos2: jnp.ndarray) -> jnp.ndarray:
+        """[..., L] int32 ids -> [..., L, word_dim + 2*pos_dim]."""
+        if self.glove_init is not None:
+            if self.glove_init.shape != (self.vocab_size, self.word_dim):
+                raise ValueError(
+                    f"glove_init {self.glove_init.shape} != "
+                    f"({self.vocab_size}, {self.word_dim})"
+                )
+            init = lambda *_: jnp.asarray(self.glove_init, jnp.float32)
+        else:
+            init = nn.initializers.normal(0.1)
+        word_table = self.param("word_embedding", init, (self.vocab_size, self.word_dim))
+        pos1_table = self.param(
+            "pos1_embedding", nn.initializers.normal(0.1), (2 * self.max_length, self.pos_dim)
+        )
+        pos2_table = self.param(
+            "pos2_embedding", nn.initializers.normal(0.1), (2 * self.max_length, self.pos_dim)
+        )
+        out = jnp.concatenate(
+            [word_table[word], pos1_table[pos1], pos2_table[pos2]], axis=-1
+        )
+        return out.astype(self.compute_dtype)
+
+    @property
+    def output_dim(self) -> int:
+        return self.word_dim + 2 * self.pos_dim

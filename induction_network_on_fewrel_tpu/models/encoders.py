@@ -1,0 +1,85 @@
+"""Sentence encoders: CNN and BiLSTM + structured self-attention.
+
+Contract (SURVEY.md §1 L4): ``(embedded tokens [M, L, D], mask [M, L]) ->
+sentence vector [M, H]``. The leading axis M flattens (batch, N, K|Q) — the
+encoders are oblivious to episode structure, which keeps their matmuls large
+and MXU-shaped.
+
+* CNN (SURVEY.md §2.1): Conv1d(hidden filters, window 3) + ReLU + masked
+  max-pool over time — thunlp defaults, hidden=230.
+* BiLSTM + self-attention (paper §3.1): bidirectional LSTM, then structured
+  self-attention ``a = softmax(w2 · tanh(W1 · Hᵀ))``, sentence vector
+  ``e = Σ aₜ hₜ``. The scan serializes over L (≤128 tokens, SURVEY.md §7
+  "hard parts") but each scan step is a fused 4-gate matmul on the MXU; both
+  directions run in a single scan over a stacked/flipped copy so the weights
+  are shared-shape and the kernel count halves.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from induction_network_on_fewrel_tpu.ops import masked_max, masked_softmax
+
+
+class CNNEncoder(nn.Module):
+    hidden_size: int = 230
+    window: int = 3
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, emb: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        x = nn.Conv(
+            self.hidden_size,
+            kernel_size=(self.window,),
+            padding="SAME",
+            dtype=self.compute_dtype,
+            param_dtype=jnp.float32,
+        )(emb)
+        x = nn.relu(x)
+        return masked_max(x, mask[..., None], axis=-2).astype(self.compute_dtype)
+
+    @property
+    def output_dim(self) -> int:
+        return self.hidden_size
+
+
+class BiLSTMSelfAttnEncoder(nn.Module):
+    lstm_hidden: int = 128   # per direction; output dim is 2*lstm_hidden
+    att_dim: int = 64
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, emb: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        M, L, D = emb.shape
+        emb = emb.astype(self.compute_dtype)
+
+        # Stack forward and time-reversed sequences along the batch axis and
+        # run ONE scan: same cell weights serve both directions, and the
+        # per-step gate matmul is twice as tall — friendlier to the MXU than
+        # two half-size scans.
+        rev = jnp.flip(emb, axis=1)
+        both = jnp.concatenate([emb, rev], axis=0)  # [2M, L, D]
+        cell = nn.OptimizedLSTMCell(
+            self.lstm_hidden, dtype=self.compute_dtype, param_dtype=jnp.float32
+        )
+        # nn.RNN is flax's lifted lax.scan over the time axis.
+        hs = nn.RNN(cell)(both)                        # [2M, L, u]
+        h_fwd, h_bwd = hs[:M], jnp.flip(hs[M:], axis=1)
+        H = jnp.concatenate([h_fwd, h_bwd], axis=-1)   # [M, L, 2u]
+
+        # Structured self-attention (Lin et al. 2017 form used by the paper):
+        # scores = w2 · tanh(W1 hᵀ), masked softmax over L.
+        proj = nn.Dense(
+            self.att_dim, use_bias=False, dtype=self.compute_dtype, param_dtype=jnp.float32
+        )(H)
+        scores = nn.Dense(
+            1, use_bias=False, dtype=self.compute_dtype, param_dtype=jnp.float32
+        )(jnp.tanh(proj))[..., 0]                      # [M, L]
+        att = masked_softmax(scores.astype(jnp.float32), mask, axis=-1)
+        return jnp.einsum("ml,mlh->mh", att.astype(self.compute_dtype), H)
+
+    @property
+    def output_dim(self) -> int:
+        return 2 * self.lstm_hidden

@@ -1,0 +1,138 @@
+"""Induction module (dynamic routing) + neural-tensor Relation scorer + the
+full InductionNetwork model.
+
+Math (Geng et al. 2019, SURVEY.md §2.1 / §3.2):
+
+* Induction, per class i with K support vectors e_ij:
+    ê_ij = squash(W_s e_ij + b_s)          (shared transform)
+    b_ij = 0
+    repeat `iters` times (fixed trip count -> ``lax.fori_loop``, jit-exact):
+        d_i  = softmax(b_i)                 (over the K shots)
+        ĉ_i  = Σ_j d_ij ê_ij
+        c_i  = squash(ĉ_i)
+        b_ij += ê_ij · c_i
+* Relation (NTN): v_iq = relu(c_iᵀ M^[1:h] e_q)  (h bilinear slices),
+  score r_iq = σ(w_vᵀ v_iq + b_v).
+
+TPU notes: the routing state ``b`` stays shaped [B, N, K] across iterations
+(no reshapes inside the loop, so XLA fuses the whole loop body, SURVEY.md §7);
+the NTN bilinear is one einsum → a single large MXU contraction; its slice
+axis ``h`` is the natural tensor-parallel shard axis (see parallel/sharding).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from induction_network_on_fewrel_tpu.ops import squash
+
+
+class Induction(nn.Module):
+    induction_dim: int = 100
+    routing_iters: int = 3
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, support: jnp.ndarray) -> jnp.ndarray:
+        """[B, N, K, D] support encodings -> [B, N, C] class vectors."""
+        B, N, K, _ = support.shape
+        e_hat = nn.Dense(
+            self.induction_dim, dtype=self.compute_dtype, param_dtype=jnp.float32
+        )(support)
+        e_hat = squash(e_hat)                       # [B, N, K, C]
+        # Routing runs in f32: coupling logits accumulate dot products and
+        # drift in bf16 over iterations.
+        e32 = e_hat.astype(jnp.float32)
+
+        def routing_iter(_, b):
+            d = jax.nn.softmax(b, axis=-1)          # [B, N, K] over shots
+            c = squash(jnp.einsum("bnk,bnkc->bnc", d, e32))
+            return b + jnp.einsum("bnkc,bnc->bnk", e32, c)
+
+        b0 = jnp.zeros((B, N, K), jnp.float32)
+        b = jax.lax.fori_loop(0, self.routing_iters, routing_iter, b0)
+        d = jax.nn.softmax(b, axis=-1)
+        c = squash(jnp.einsum("bnk,bnkc->bnc", d, e32))
+        return c.astype(self.compute_dtype)
+
+
+class RelationNTN(nn.Module):
+    slices: int = 100       # h
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, class_vec: jnp.ndarray, query: jnp.ndarray) -> jnp.ndarray:
+        """([B, N, C], [B, TQ, C]) -> pre-sigmoid relation logits [B, TQ, N]."""
+        C = class_vec.shape[-1]
+        M = self.param(
+            "tensor_slices", nn.initializers.glorot_normal(batch_axis=(0,)), (self.slices, C, C)
+        )
+        # One contraction for all (query, class, slice) triples; MXU-sized.
+        cM = jnp.einsum(
+            "bnc,hcd->bnhd", class_vec, M.astype(self.compute_dtype)
+        )
+        v = nn.relu(jnp.einsum("bnhd,bqd->bqnh", cM, query))
+        out = nn.Dense(1, dtype=self.compute_dtype, param_dtype=jnp.float32)(v)
+        return out[..., 0]  # [B, TQ, N]
+
+
+class InductionNetwork(nn.Module):
+    """Full few-shot model: encoder -> induction -> relation scoring.
+
+    ``forward(support, query) -> logits [B, TQ, num_classes]`` where
+    num_classes = N (+1 when NOTA is active: the none-of-the-above logit is a
+    learned threshold against which real-class logits compete in softmax/MSE
+    space — static shapes per compile, SURVEY.md §7 "NOTA").
+    """
+
+    embedding: nn.Module
+    encoder: nn.Module
+    induction_dim: int = 100
+    routing_iters: int = 3
+    ntn_slices: int = 100
+    nota: bool = False
+    compute_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.induction = Induction(
+            self.induction_dim, self.routing_iters, compute_dtype=self.compute_dtype
+        )
+        self.relation = RelationNTN(self.ntn_slices, compute_dtype=self.compute_dtype)
+        self.query_proj = nn.Dense(
+            self.induction_dim, dtype=self.compute_dtype, param_dtype=jnp.float32
+        )
+        if self.nota:
+            self.nota_logit = self.param("nota_logit", nn.initializers.zeros, (1,))
+
+    def encode(self, word, pos1, pos2, mask) -> jnp.ndarray:
+        """[..., L] token features -> [..., H] sentence vectors."""
+        lead = word.shape[:-1]
+        L = word.shape[-1]
+        flat = lambda x: x.reshape(-1, L)
+        emb = self.embedding(flat(word), flat(pos1), flat(pos2))
+        enc = self.encoder(emb, flat(mask))
+        return enc.reshape(*lead, -1)
+
+    def __call__(self, support: dict[str, Any], query: dict[str, Any]) -> jnp.ndarray:
+        sup_enc = self.encode(
+            support["word"], support["pos1"], support["pos2"], support["mask"]
+        )                                                   # [B, N, K, H]
+        qry_enc = self.encode(
+            query["word"], query["pos1"], query["pos2"], query["mask"]
+        )                                                   # [B, TQ, H]
+        class_vec = self.induction(sup_enc)                 # [B, N, C]
+        # Queries go through the same learned transform family as support
+        # (W_s analog) so the NTN compares like with like.
+        qry_c = self.query_proj(qry_enc)                    # [B, TQ, C]
+        logits = self.relation(class_vec, qry_c)            # [B, TQ, N]
+        if self.nota:
+            B, TQ, _ = logits.shape
+            na = jnp.broadcast_to(
+                self.nota_logit.astype(logits.dtype), (B, TQ, 1)
+            )
+            logits = jnp.concatenate([logits, na], axis=-1)  # [B, TQ, N+1]
+        return logits.astype(jnp.float32)

@@ -1,0 +1,37 @@
+"""Losses and metrics.
+
+The paper trains with MSE between sigmoid relation scores and the one-hot
+episode label (Geng et al. §3.4); toolkit-family forks often use CE over
+logits instead (SURVEY.md §2.1 "Loss / metrics" — ambiguous in the unreadable
+reference, so both are supported and flag-selected).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def mse_onehot_loss(logits: jnp.ndarray, label: jnp.ndarray) -> jnp.ndarray:
+    """Mean squared error between sigmoid(logits) and one-hot(label).
+
+    logits: [B, TQ, num_classes] pre-sigmoid; label: [B, TQ] int.
+    """
+    scores = jax.nn.sigmoid(logits)
+    onehot = jax.nn.one_hot(label, logits.shape[-1], dtype=scores.dtype)
+    return jnp.mean(jnp.square(scores - onehot))
+
+
+def cross_entropy_loss(logits: jnp.ndarray, label: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(logits, label)
+    )
+
+
+def predict(logits: jnp.ndarray) -> jnp.ndarray:
+    return jnp.argmax(logits, axis=-1)
+
+
+def accuracy(logits: jnp.ndarray, label: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean((predict(logits) == label).astype(jnp.float32))

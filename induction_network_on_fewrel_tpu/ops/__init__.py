@@ -1,0 +1,6 @@
+from induction_network_on_fewrel_tpu.ops.core import (  # noqa: F401
+    masked_max,
+    masked_mean,
+    masked_softmax,
+    squash,
+)

@@ -1,0 +1,43 @@
+"""Small numerical ops shared across modules.
+
+``squash`` is the capsule-network nonlinearity at the heart of the induction
+module's dynamic routing (SURVEY.md §2.1 "Induction module":
+``squash(x) = ||x||^2/(1+||x||^2) * x/||x||``). The masked reductions keep
+padded token positions out of pooling/attention while preserving static
+shapes (TPU/XLA discipline: mask, never slice to a dynamic length).
+
+All ops are dtype-polymorphic; squash promotes its norm computation to f32
+because ``||x||^2`` underflows fast in bf16.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def squash(x: jnp.ndarray, axis: int = -1, eps: float = 1e-12) -> jnp.ndarray:
+    """Capsule squash along ``axis``: scales norm into [0, 1), keeps direction."""
+    x32 = x.astype(jnp.float32)
+    sq = jnp.sum(jnp.square(x32), axis=axis, keepdims=True)
+    scale = sq / (1.0 + sq) / jnp.sqrt(sq + eps)
+    return (x32 * scale).astype(x.dtype)
+
+
+def masked_softmax(scores: jnp.ndarray, mask: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Softmax over ``axis`` treating mask==0 positions as -inf."""
+    scores = jnp.where(mask > 0, scores, _NEG_INF)
+    scores = scores - jnp.max(scores, axis=axis, keepdims=True)
+    e = jnp.exp(scores) * (mask > 0)
+    return e / (jnp.sum(e, axis=axis, keepdims=True) + 1e-13)
+
+
+def masked_max(x: jnp.ndarray, mask: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Max over ``axis`` ignoring mask==0 positions (mask broadcasts to x)."""
+    return jnp.max(jnp.where(mask > 0, x, _NEG_INF), axis=axis)
+
+
+def masked_mean(x: jnp.ndarray, mask: jnp.ndarray, axis: int) -> jnp.ndarray:
+    s = jnp.sum(x * (mask > 0), axis=axis)
+    return s / (jnp.sum(mask > 0, axis=axis) + 1e-13)

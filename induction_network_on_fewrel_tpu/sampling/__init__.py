@@ -1,0 +1,4 @@
+from induction_network_on_fewrel_tpu.sampling.episodes import (  # noqa: F401
+    EpisodeBatch,
+    EpisodeSampler,
+)

@@ -1,0 +1,6 @@
+from induction_network_on_fewrel_tpu.train.steps import (  # noqa: F401
+    make_eval_step,
+    make_optimizer,
+    make_train_step,
+)
+from induction_network_on_fewrel_tpu.train.framework import FewShotTrainer  # noqa: F401

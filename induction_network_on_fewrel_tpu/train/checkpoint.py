@@ -1,0 +1,55 @@
+"""Orbax checkpointing: params + opt state + step + sampler RNG + config.
+
+Reference behavior (SURVEY.md §5.4): ``torch.save(state_dict)`` on best-val,
+``--load_ckpt`` for test/finetune. Here: orbax with best-metric retention AND
+full resume (optimizer state and step survive, which torch ckpts in the
+reference family lose).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+
+
+class CheckpointManager:
+    def __init__(self, ckpt_dir: str | Path, cfg: ExperimentConfig, max_to_keep: int = 3):
+        self.dir = Path(ckpt_dir).absolute()
+        self.dir.mkdir(parents=True, exist_ok=True)
+        (self.dir / "config.json").write_text(cfg.to_json())
+        self.mngr = ocp.CheckpointManager(
+            self.dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                best_fn=lambda m: m["val_accuracy"],
+                best_mode="max",
+            ),
+        )
+
+    def save(self, step: int, state: Any, val_accuracy: float) -> None:
+        self.mngr.save(
+            step,
+            args=ocp.args.StandardSave(state),
+            metrics={"val_accuracy": float(val_accuracy)},
+        )
+        self.mngr.wait_until_finished()
+
+    def restore_best(self, target: Any) -> tuple[Any, int]:
+        step = self.mngr.best_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.dir}")
+        return self.mngr.restore(step, args=ocp.args.StandardRestore(target)), step
+
+    def restore_latest(self, target: Any) -> tuple[Any, int]:
+        step = self.mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.dir}")
+        return self.mngr.restore(step, args=ocp.args.StandardRestore(target)), step
+
+    @staticmethod
+    def load_config(ckpt_dir: str | Path) -> ExperimentConfig:
+        return ExperimentConfig.from_json((Path(ckpt_dir) / "config.json").read_text())

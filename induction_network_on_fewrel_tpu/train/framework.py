@@ -1,0 +1,102 @@
+"""FewShotTrainer — the episode-loop training framework.
+
+TPU-shaped analog of the reference's ``FewShotREFramework.train/eval``
+(SURVEY.md §1 L5, §3.1): fetch host batch -> one jitted step (fwd+bwd+update,
+donated state) -> periodic eval -> best-checkpoint save. Host<->device
+traffic is exactly one batch per step in and two scalars out; JAX's async
+dispatch overlaps the host-side sampling of step t+1 with device compute of
+step t, replacing the reference's DataLoader worker processes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator
+
+import jax
+import numpy as np
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
+from induction_network_on_fewrel_tpu.train.steps import (
+    init_state,
+    make_eval_step,
+    make_train_step,
+)
+from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+
+class FewShotTrainer:
+    def __init__(
+        self,
+        model,
+        cfg: ExperimentConfig,
+        train_sampler,
+        val_sampler=None,
+        ckpt_dir: str | None = None,
+        logger: MetricsLogger | None = None,
+        train_step=None,
+        eval_step=None,
+    ):
+        self.model = model
+        self.cfg = cfg
+        self.train_sampler = train_sampler
+        self.val_sampler = val_sampler
+        self.logger = logger or MetricsLogger(quiet=True)
+        # Injectable steps so parallel/ can substitute mesh-sharded versions.
+        self.train_step = train_step or make_train_step(model, cfg)
+        self.eval_step = eval_step or make_eval_step(model, cfg)
+        self.ckpt = CheckpointManager(ckpt_dir, cfg) if ckpt_dir else None
+        self.best_val = -1.0
+
+    def init_state(self):
+        batch = self.train_sampler.sample_batch()
+        support, query, _ = batch_to_model_inputs(batch)
+        return init_state(self.model, self.cfg, support, query)
+
+    def train(self, state=None, num_iters: int | None = None):
+        cfg = self.cfg
+        state = state if state is not None else self.init_state()
+        num_iters = num_iters or cfg.train_iter
+        it = iter(self.train_sampler)
+        t0 = time.monotonic()
+        last_logged = 0
+        window = 50
+        for step in range(1, num_iters + 1):
+            support, query, label = batch_to_model_inputs(next(it))
+            state, metrics = self.train_step(state, support, query, label)
+            if step % window == 0 or step == num_iters:
+                m = jax.device_get(metrics)  # sync point, once per window
+                dt = time.monotonic() - t0
+                eps_per_s = (step - last_logged) * cfg.batch_size / max(dt, 1e-9)
+                self.logger.log(
+                    step,
+                    "train",
+                    loss=m["loss"],
+                    accuracy=m["accuracy"],
+                    episodes_per_s=eps_per_s,
+                )
+                t0 = time.monotonic()
+                last_logged = step
+            if self.val_sampler is not None and cfg.val_step and step % cfg.val_step == 0:
+                val_acc = self.evaluate(state.params, cfg.val_iter)
+                self.logger.log(step, "val", accuracy=val_acc)
+                if self.ckpt is not None and val_acc > self.best_val:
+                    self.best_val = val_acc
+                    self.ckpt.save(step, state, val_acc)
+                t0 = time.monotonic()
+                last_logged = step
+        return state
+
+    def evaluate(self, params, num_episodes: int, sampler=None) -> float:
+        """Mean episode accuracy over ``num_episodes`` episodes."""
+        sampler = sampler or self.val_sampler
+        accs = []
+        n_batches = max(1, num_episodes // sampler.batch_size)
+        it: Iterator = iter(sampler)
+        for _ in range(n_batches):
+            support, query, label = batch_to_model_inputs(next(it))
+            out = self.eval_step(params, support, query, label)
+            accs.append(out["accuracy"])
+        return float(np.mean(jax.device_get(accs)))

@@ -1,0 +1,102 @@
+"""Jitted train/eval steps and the optimizer chain.
+
+Replaces the reference's per-step ``.cuda()`` + forward/backward/opt.step
+Python loop (SURVEY.md §3.1): here the whole step — forward, loss, backward,
+clip, update — is ONE jitted XLA program with donated state, so parameters
+and optimizer state never round-trip to host and buffers are reused in-place.
+The episode batch axis B is vmapped implicitly (all model ops are written
+batched), matching "vmap over in-device episode batches" [BJ].
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.models.losses import (
+    accuracy,
+    cross_entropy_loss,
+    mse_onehot_loss,
+)
+
+LOSS_FNS: dict[str, Callable] = {"mse": mse_onehot_loss, "ce": cross_entropy_loss}
+
+
+class TrainState(train_state.TrainState):
+    """Params + optimizer state + step; flax TrainState is already a pytree."""
+
+
+def make_optimizer(cfg: ExperimentConfig) -> optax.GradientTransformation:
+    """clip -> (adam|sgd) with StepLR-style staircase decay (SURVEY.md §2.1)."""
+    schedule = optax.exponential_decay(
+        init_value=cfg.lr,
+        transition_steps=cfg.lr_step_size,
+        decay_rate=cfg.lr_gamma,
+        staircase=True,
+    )
+    if cfg.optimizer == "adam":
+        # Coupled L2 (decay added to the gradient BEFORE Adam's moment
+        # normalization) — matches torch optim.Adam(weight_decay=...), the
+        # reference family's optimizer. Decoupled AdamW is a different
+        # trajectory and is exposed separately.
+        opt = optax.chain(
+            optax.add_decayed_weights(cfg.weight_decay), optax.adam(schedule)
+        )
+    elif cfg.optimizer == "adamw":
+        opt = optax.adamw(schedule, weight_decay=cfg.weight_decay)
+    elif cfg.optimizer == "sgd":
+        opt = optax.chain(
+            optax.add_decayed_weights(cfg.weight_decay), optax.sgd(schedule)
+        )
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    return optax.chain(optax.clip_by_global_norm(cfg.grad_clip), opt)
+
+
+def loss_and_metrics(
+    model, params, support, query, label, loss_name: str
+) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+    logits = model.apply(params, support, query)
+    loss = LOSS_FNS[loss_name](logits, label)
+    return loss, {"loss": loss, "accuracy": accuracy(logits, label)}
+
+
+def make_train_step(model, cfg: ExperimentConfig):
+    """Returns jitted (state, support, query, label) -> (state, metrics)."""
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, support, query, label):
+        def loss_fn(params):
+            return loss_and_metrics(model, params, support, query, label, cfg.loss)
+
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+        state = state.apply_gradients(grads=grads)
+        return state, metrics
+
+    return train_step
+
+
+def make_eval_step(model, cfg: ExperimentConfig):
+    @jax.jit
+    def eval_step(params, support, query, label) -> dict[str, jnp.ndarray]:
+        logits = model.apply(params, support, query)
+        return {
+            "loss": LOSS_FNS[cfg.loss](logits, label),
+            "accuracy": accuracy(logits, label),
+        }
+
+    return eval_step
+
+
+def init_state(model, cfg: ExperimentConfig, support, query, rng=None) -> TrainState:
+    rng = rng if rng is not None else jax.random.key(cfg.seed)
+    params = model.init(rng, support, query)
+    return TrainState.create(
+        apply_fn=model.apply, params=params, tx=make_optimizer(cfg)
+    )

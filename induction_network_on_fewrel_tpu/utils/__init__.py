@@ -1,0 +1,1 @@
+from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger  # noqa: F401

@@ -1,0 +1,38 @@
+"""Structured metrics: stdout + metrics.jsonl (SURVEY.md §5.5).
+
+The reference prints step/loss/acc to stdout; here every record is also
+appended as one JSON line so runs are machine-readable (episodes/sec/chip is
+the [BJ] throughput metric of record).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+
+class MetricsLogger:
+    def __init__(self, out_dir: str | Path | None = None, quiet: bool = False):
+        self.quiet = quiet
+        self.path: Path | None = None
+        if out_dir is not None:
+            out = Path(out_dir)
+            out.mkdir(parents=True, exist_ok=True)
+            self.path = out / "metrics.jsonl"
+        self._t0 = time.monotonic()
+
+    def log(self, step: int, kind: str = "train", **scalars: float) -> None:
+        rec = {
+            "step": int(step),
+            "kind": kind,
+            "wall_s": round(time.monotonic() - self._t0, 3),
+            **{k: float(v) for k, v in scalars.items()},
+        }
+        if self.path is not None:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        if not self.quiet:
+            fields = " ".join(f"{k}={v:.4g}" for k, v in scalars.items())
+            print(f"[{kind}] step={step} {fields}", file=sys.stderr, flush=True)

@@ -1,0 +1,27 @@
+"""Test session setup: 8 virtual CPU devices (SURVEY.md §4.5).
+
+Multi-device tests run on the CPU backend with
+``--xla_force_host_platform_device_count=8`` so shard_map/psum paths are
+exercised without a pod.
+
+IMPORTANT environment quirk: this image's axon sitecustomize registers the
+TPU PJRT plugin in every Python process and overrides ``jax_platforms`` to
+"axon,cpu" — so the ``JAX_PLATFORMS=cpu`` env var is NOT enough (backend init
+then dials the TPU tunnel and can block). The reliable sequence is: set
+XLA_FLAGS before importing jax, then ``jax.config.update("jax_platforms",
+"cpu")`` before any backend init. TPU-only smoke tests are run separately
+(see tests/tpu/README.md).
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402  (after XLA_FLAGS)
+
+jax.config.update("jax_platforms", "cpu")

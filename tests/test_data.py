@@ -1,0 +1,84 @@
+"""Data layer: schema round-trip, GloVe vocab, tokenizer contract."""
+
+import json
+
+import numpy as np
+import pytest
+
+from induction_network_on_fewrel_tpu.data import (
+    GloveTokenizer,
+    load_fewrel_json,
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+
+
+@pytest.fixture(scope="module")
+def vocab():
+    return make_synthetic_glove(vocab_size=200, word_dim=50)
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return make_synthetic_fewrel(num_relations=6, instances_per_relation=12)
+
+
+def test_fewrel_json_roundtrip(tmp_path, ds):
+    raw = {
+        rel: [
+            {
+                "tokens": list(i.tokens),
+                "h": [i.head_name, "Q1", [list(i.head_pos)]],
+                "t": [i.tail_name, "Q2", [list(i.tail_pos)]],
+            }
+            for i in insts
+        ]
+        for rel, insts in ds.instances.items()
+    }
+    p = tmp_path / "train_wiki.json"
+    p.write_text(json.dumps(raw))
+    loaded = load_fewrel_json(p)
+    assert loaded.rel_names == ds.rel_names
+    first = loaded.instances[loaded.rel_names[0]][0]
+    orig = ds.instances[ds.rel_names[0]][0]
+    assert first.tokens == orig.tokens
+    assert first.head_pos == orig.head_pos
+
+
+def test_glove_vocab(vocab):
+    assert vocab.vocab_size == 202  # 200 + UNK + BLANK
+    assert vocab.word_dim == 50
+    assert vocab.lookup("w5") == 5
+    assert vocab.lookup("definitely-not-a-word") == vocab.unk_id
+    np.testing.assert_array_equal(vocab.vectors[vocab.blank_id], 0.0)
+
+
+def test_tokenizer_shapes_and_offsets(vocab, ds):
+    L = 16
+    tok = GloveTokenizer(vocab, max_length=L)
+    inst = ds.instances[ds.rel_names[0]][0]
+    t = tok(inst)
+    assert t.word.shape == (L,) and t.word.dtype == np.int32
+    assert t.pos1.shape == (L,) and t.mask.shape == (L,)
+    n = min(len(inst.tokens), L)
+    assert t.mask.sum() == n
+    # padding uses BLANK
+    if n < L:
+        assert (t.word[n:] == vocab.blank_id).all()
+    # position offsets: value at the head token index is exactly L (offset 0)
+    head = min(inst.head_pos[0], L - 1)
+    assert t.pos1[head] == L
+    assert (0 <= t.pos1).all() and (t.pos1 < 2 * L).all()
+    assert (0 <= t.pos2).all() and (t.pos2 < 2 * L).all()
+
+
+def test_tokenizer_truncation(vocab):
+    from induction_network_on_fewrel_tpu.data.fewrel import Instance
+
+    tok = GloveTokenizer(vocab, max_length=8)
+    inst = Instance(tokens=tuple(f"w{i}" for i in range(30)), head_pos=(25,), tail_pos=(2,))
+    t = tok(inst)
+    assert t.word.shape == (8,)
+    assert t.mask.sum() == 8
+    # head beyond max_length clamps to the last position
+    assert t.pos1[7] == 8  # offset 0 at clamped head

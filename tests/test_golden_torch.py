@@ -1,0 +1,100 @@
+"""Golden parity vs a torch-CPU twin (SURVEY.md §4.2).
+
+With the reference mount empty there is nothing to diff against, so
+correctness of the math is established by re-implementing each module
+independently in torch (2.13 CPU, installed) with the SAME weights and
+asserting the JAX outputs match to ~1e-5. The torch code below is written
+from the paper equations, not from the JAX code, so a shared bug would have
+to be made twice independently.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from induction_network_on_fewrel_tpu.models.encoders import CNNEncoder
+from induction_network_on_fewrel_tpu.models.induction import Induction, RelationNTN
+from induction_network_on_fewrel_tpu.ops import squash
+
+
+def torch_squash(x, eps=1e-12):
+    sq = (x**2).sum(-1, keepdim=True)
+    return (sq / (1 + sq)) * x / torch.sqrt(sq + eps)
+
+
+def test_squash_parity():
+    x = np.random.default_rng(0).normal(size=(6, 13)).astype(np.float32)
+    j = np.asarray(squash(jnp.asarray(x)))
+    t = torch_squash(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(j, t, atol=1e-6)
+
+
+def test_induction_routing_parity():
+    """Full induction module: shared transform + squash + 3 routing iters."""
+    rng = np.random.default_rng(1)
+    B, N, K, D, C = 2, 3, 4, 16, 8
+    support = rng.normal(size=(B, N, K, D)).astype(np.float32)
+
+    ind = Induction(induction_dim=C, routing_iters=3)
+    params = ind.init(jax.random.key(0), jnp.asarray(support))
+    W = np.asarray(params["params"]["Dense_0"]["kernel"])  # [D, C]
+    b = np.asarray(params["params"]["Dense_0"]["bias"])
+    j = np.asarray(ind.apply(params, jnp.asarray(support)))
+
+    # torch twin, straight from Geng et al. §3.2
+    sup = torch.tensor(support)
+    e_hat = torch_squash(sup @ torch.tensor(W) + torch.tensor(b))  # [B,N,K,C]
+    bij = torch.zeros(B, N, K)
+    for _ in range(3):
+        d = torch.softmax(bij, dim=-1)
+        c = torch_squash(torch.einsum("bnk,bnkc->bnc", d, e_hat))
+        bij = bij + torch.einsum("bnkc,bnc->bnk", e_hat, c)
+    d = torch.softmax(bij, dim=-1)
+    c = torch_squash(torch.einsum("bnk,bnkc->bnc", d, e_hat))
+    np.testing.assert_allclose(j, c.numpy(), atol=1e-5)
+
+
+def test_ntn_parity():
+    rng = np.random.default_rng(2)
+    B, N, TQ, C, H = 2, 3, 7, 8, 5
+    cvec = rng.normal(size=(B, N, C)).astype(np.float32)
+    qry = rng.normal(size=(B, TQ, C)).astype(np.float32)
+
+    ntn = RelationNTN(slices=H)
+    params = ntn.init(jax.random.key(0), jnp.asarray(cvec), jnp.asarray(qry))
+    M = np.asarray(params["params"]["tensor_slices"])          # [H, C, C]
+    Wv = np.asarray(params["params"]["Dense_0"]["kernel"])     # [H, 1]
+    bv = np.asarray(params["params"]["Dense_0"]["bias"])
+    j = np.asarray(ntn.apply(params, jnp.asarray(cvec), jnp.asarray(qry)))
+
+    c_t, q_t = torch.tensor(cvec), torch.tensor(qry)
+    # v_iq = relu(c_i^T M^[1:h] e_q), logit = W_v v + b_v  (paper §3.3)
+    v = torch.relu(torch.einsum("bnc,hcd,bqd->bqnh", c_t, torch.tensor(M), q_t))
+    logit = v @ torch.tensor(Wv) + torch.tensor(bv)
+    np.testing.assert_allclose(j, logit[..., 0].numpy(), atol=1e-4)
+
+
+def test_cnn_encoder_parity():
+    rng = np.random.default_rng(3)
+    M_, L_, D_, Hf = 6, 10, 12, 16
+    emb = rng.normal(size=(M_, L_, D_)).astype(np.float32)
+    mask = (rng.random((M_, L_)) > 0.2).astype(np.float32)
+    mask[:, 0] = 1.0  # at least one valid token
+
+    enc = CNNEncoder(hidden_size=Hf, window=3)
+    params = enc.init(jax.random.key(0), jnp.asarray(emb), jnp.asarray(mask))
+    Wc = np.asarray(params["params"]["Conv_0"]["kernel"])  # [3, D, Hf]
+    bc = np.asarray(params["params"]["Conv_0"]["bias"])
+    j = np.asarray(enc.apply(params, jnp.asarray(emb), jnp.asarray(mask)))
+
+    conv = torch.nn.Conv1d(D_, Hf, 3, padding=1)
+    with torch.no_grad():
+        conv.weight.copy_(torch.tensor(Wc).permute(2, 1, 0))  # [Hf, D, 3]
+        conv.bias.copy_(torch.tensor(bc))
+        x = torch.relu(conv(torch.tensor(emb).transpose(1, 2)))  # [M, Hf, L]
+        x = x.masked_fill(torch.tensor(mask)[:, None, :] == 0, -1e30)
+        t = x.max(dim=-1).values
+    np.testing.assert_allclose(j, t.numpy(), atol=1e-4)

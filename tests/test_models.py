@@ -1,0 +1,105 @@
+"""Model-level tests: shapes, routing properties, NOTA head, determinism."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    GloveTokenizer,
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+from induction_network_on_fewrel_tpu.models.induction import Induction, RelationNTN
+from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+
+L = 16
+BASE = ExperimentConfig(
+    n=5, k=2, q=3, batch_size=2, max_length=L, vocab_size=302, compute_dtype="float32"
+)
+
+
+@pytest.fixture(scope="module")
+def episode():
+    vocab = make_synthetic_glove(vocab_size=300)
+    ds = make_synthetic_fewrel(num_relations=8, instances_per_relation=10, vocab_size=300)
+    tok = GloveTokenizer(vocab, max_length=L)
+    s = EpisodeSampler(ds, tok, n=5, k=2, q=3, batch_size=2, seed=0)
+    return vocab, batch_to_model_inputs(s.sample_batch())
+
+
+@pytest.mark.parametrize("encoder", ["cnn", "bilstm"])
+def test_forward_shapes(episode, encoder):
+    vocab, (sup, qry, label) = episode
+    model = build_model(BASE.replace(encoder=encoder), glove_init=vocab.vectors)
+    params = model.init(jax.random.key(0), sup, qry)
+    logits = model.apply(params, sup, qry)
+    assert logits.shape == (2, 15, 5)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_forward_deterministic(episode):
+    vocab, (sup, qry, label) = episode
+    model = build_model(BASE.replace(encoder="cnn"), glove_init=vocab.vectors)
+    params = model.init(jax.random.key(0), sup, qry)
+    l1 = model.apply(params, sup, qry)
+    l2 = model.apply(params, sup, qry)
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+
+def test_induction_class_vectors_squashed():
+    ind = Induction(induction_dim=32, routing_iters=3)
+    support = jax.random.normal(jax.random.key(0), (2, 5, 4, 64))
+    params = ind.init(jax.random.key(1), support)
+    c = ind.apply(params, support)
+    assert c.shape == (2, 5, 32)
+    norms = jnp.linalg.norm(c, axis=-1)
+    assert (norms < 1.0).all()
+
+
+def test_induction_permutation_invariant():
+    """Class vectors must not depend on the order of the K support shots."""
+    ind = Induction(induction_dim=32, routing_iters=3)
+    support = jax.random.normal(jax.random.key(0), (1, 3, 4, 64))
+    params = ind.init(jax.random.key(1), support)
+    c1 = ind.apply(params, support)
+    c2 = ind.apply(params, support[:, :, ::-1, :])
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), atol=1e-5)
+
+
+def test_ntn_shapes():
+    ntn = RelationNTN(slices=7)
+    c = jax.random.normal(jax.random.key(0), (2, 5, 32))
+    q = jax.random.normal(jax.random.key(1), (2, 11, 32))
+    params = ntn.init(jax.random.key(2), c, q)
+    out = ntn.apply(params, c, q)
+    assert out.shape == (2, 11, 5)
+
+
+def test_nota_head():
+    vocab = make_synthetic_glove(vocab_size=300)
+    ds = make_synthetic_fewrel(num_relations=8, instances_per_relation=10, vocab_size=300)
+    tok = GloveTokenizer(vocab, max_length=L)
+    s = EpisodeSampler(ds, tok, n=5, k=2, q=3, batch_size=2, na_rate=1, seed=0)
+    sup, qry, label = batch_to_model_inputs(s.sample_batch())
+    cfg = BASE.replace(encoder="cnn", na_rate=1)
+    model = build_model(cfg, glove_init=vocab.vectors)
+    params = model.init(jax.random.key(0), sup, qry)
+    logits = model.apply(params, sup, qry)
+    assert logits.shape == (2, cfg.total_q, 6)  # N+1 classes
+    assert int(label.max()) == 5
+
+
+def test_bf16_compute_path(episode):
+    vocab, (sup, qry, label) = episode
+    model = build_model(
+        BASE.replace(encoder="cnn", compute_dtype="bfloat16"), glove_init=vocab.vectors
+    )
+    params = model.init(jax.random.key(0), sup, qry)
+    logits = model.apply(params, sup, qry)
+    assert logits.dtype == jnp.float32  # logits promoted for the loss
+    assert np.isfinite(np.asarray(logits)).all()

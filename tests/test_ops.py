@@ -1,0 +1,52 @@
+"""Math unit tests (SURVEY.md §4.1): squash, masked reductions."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from induction_network_on_fewrel_tpu.ops import masked_max, masked_mean, masked_softmax, squash
+
+
+def test_squash_norm_range():
+    x = jax.random.normal(jax.random.key(0), (32, 16)) * 5.0
+    y = squash(x)
+    norms = jnp.linalg.norm(y, axis=-1)
+    assert (norms >= 0).all() and (norms < 1).all()
+
+
+def test_squash_direction_preserved():
+    x = jax.random.normal(jax.random.key(1), (8, 16))
+    y = squash(x)
+    cos = jnp.sum(x * y, -1) / (
+        jnp.linalg.norm(x, axis=-1) * jnp.linalg.norm(y, axis=-1)
+    )
+    np.testing.assert_allclose(np.asarray(cos), 1.0, atol=1e-5)
+
+
+def test_squash_formula():
+    x = jnp.array([[3.0, 4.0]])  # ||x|| = 5
+    y = squash(x)
+    expect = (25.0 / 26.0) * (np.array([[3.0, 4.0]]) / 5.0)
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5)
+
+
+def test_squash_zero_safe():
+    y = squash(jnp.zeros((4, 8)))
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_masked_softmax():
+    scores = jnp.array([[1.0, 2.0, 3.0, 4.0]])
+    mask = jnp.array([[1.0, 1.0, 0.0, 1.0]])
+    p = np.asarray(masked_softmax(scores, mask))
+    assert p[0, 2] == 0.0
+    np.testing.assert_allclose(p.sum(), 1.0, atol=1e-5)
+    e = np.exp([1.0, 2.0, 4.0])
+    np.testing.assert_allclose(p[0, [0, 1, 3]], e / e.sum(), rtol=1e-5)
+
+
+def test_masked_max_mean():
+    x = jnp.array([[1.0, 5.0, 3.0]])
+    mask = jnp.array([[1.0, 0.0, 1.0]])
+    assert float(masked_max(x, mask, axis=-1)[0]) == 3.0
+    np.testing.assert_allclose(float(masked_mean(x, mask, axis=-1)[0]), 2.0, atol=1e-6)

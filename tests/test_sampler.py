@@ -1,0 +1,74 @@
+"""Sampler statistics (SURVEY.md §4.3): episode composition, determinism,
+support/query disjointness, NOTA fraction and labeling."""
+
+import numpy as np
+import pytest
+
+from induction_network_on_fewrel_tpu.data import GloveTokenizer, make_synthetic_fewrel, make_synthetic_glove
+from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+
+N, K, Q, L, B = 5, 2, 3, 16, 2
+
+
+@pytest.fixture(scope="module")
+def sampler_args():
+    vocab = make_synthetic_glove(vocab_size=300)
+    ds = make_synthetic_fewrel(num_relations=10, instances_per_relation=20, vocab_size=300)
+    tok = GloveTokenizer(vocab, max_length=L)
+    return ds, tok
+
+
+def test_shapes(sampler_args):
+    ds, tok = sampler_args
+    s = EpisodeSampler(ds, tok, n=N, k=K, q=Q, batch_size=B, seed=1)
+    b = s.sample_batch()
+    assert b.support_word.shape == (B, N, K, L)
+    assert b.support_mask.shape == (B, N, K, L)
+    assert b.query_word.shape == (B, N * Q, L)
+    assert b.label.shape == (B, N * Q)
+    assert b.label.dtype == np.int32
+    # every class appears exactly Q times among queries
+    for e in range(B):
+        counts = np.bincount(b.label[e], minlength=N)
+        assert (counts == Q).all()
+
+
+def test_determinism(sampler_args):
+    ds, tok = sampler_args
+    b1 = EpisodeSampler(ds, tok, n=N, k=K, q=Q, batch_size=B, seed=7).sample_batch()
+    b2 = EpisodeSampler(ds, tok, n=N, k=K, q=Q, batch_size=B, seed=7).sample_batch()
+    for a, c in zip(b1, b2):
+        np.testing.assert_array_equal(a, c)
+    b3 = EpisodeSampler(ds, tok, n=N, k=K, q=Q, batch_size=B, seed=8).sample_batch()
+    assert any((a != c).any() for a, c in zip(b1, b3))
+
+
+def test_support_query_disjoint(sampler_args):
+    ds, tok = sampler_args
+    s = EpisodeSampler(ds, tok, n=N, k=K, q=Q, batch_size=1, seed=3)
+    b = s.sample_batch()
+    sup = {tuple(row) for row in b.support_word[0].reshape(-1, L)}
+    qry = {tuple(row) for row in b.query_word[0]}
+    # trigger-word sentences are all distinct with overwhelming probability
+    assert not sup & qry
+
+
+def test_nota(sampler_args):
+    ds, tok = sampler_args
+    na_rate = 2
+    s = EpisodeSampler(ds, tok, n=N, k=K, q=Q, batch_size=4, na_rate=na_rate, seed=5)
+    b = s.sample_batch()
+    tq = N * Q + na_rate * Q
+    assert b.query_word.shape == (4, tq, L)
+    assert b.label.shape == (4, tq)
+    for e in range(4):
+        counts = np.bincount(b.label[e], minlength=N + 1)
+        assert (counts[:N] == Q).all()
+        assert counts[N] == na_rate * Q  # NOTA labeled N
+    assert s.total_q == tq
+
+
+def test_needs_enough_relations(sampler_args):
+    ds, tok = sampler_args
+    with pytest.raises(ValueError):
+        EpisodeSampler(ds, tok, n=11, k=K, q=Q)

@@ -1,0 +1,84 @@
+"""Training framework: jitted step updates, overfit integration (SURVEY.md
+§4.4), eval loop, metrics logging."""
+
+import jax
+import numpy as np
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    GloveTokenizer,
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+from induction_network_on_fewrel_tpu.train import FewShotTrainer
+from induction_network_on_fewrel_tpu.train.steps import init_state, make_train_step
+from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+L = 16
+
+
+def _setup(cfg, num_relations=4, seed=0):
+    vocab = make_synthetic_glove(vocab_size=300)
+    ds = make_synthetic_fewrel(
+        num_relations=num_relations, instances_per_relation=20, vocab_size=300, seed=seed
+    )
+    tok = GloveTokenizer(vocab, max_length=L)
+    sampler = EpisodeSampler(
+        ds, tok, n=cfg.n, k=cfg.k, q=cfg.q, batch_size=cfg.batch_size,
+        na_rate=cfg.na_rate, seed=seed,
+    )
+    model = build_model(cfg, glove_init=vocab.vectors)
+    return model, sampler
+
+
+def test_train_step_updates_params():
+    cfg = ExperimentConfig(
+        encoder="cnn", n=2, k=2, q=2, batch_size=2, max_length=L, vocab_size=302,
+        compute_dtype="float32", lr=1e-2,
+    )
+    model, sampler = _setup(cfg)
+    sup, qry, label = batch_to_model_inputs(sampler.sample_batch())
+    state = init_state(model, cfg, sup, qry)
+    step = make_train_step(model, cfg)
+    p0 = jax.tree.map(lambda x: np.asarray(x).copy(), state.params)
+    state, metrics = step(state, sup, qry, label)
+    assert int(state.step) == 1
+    assert np.isfinite(float(metrics["loss"]))
+    changed = jax.tree.map(
+        lambda a, b: not np.array_equal(np.asarray(a), b), state.params, p0
+    )
+    assert any(jax.tree.leaves(changed))
+
+
+def test_overfit_two_relations(tmp_path):
+    """2-way synthetic episodes must overfit to ~1.0 accuracy (SURVEY §4.4)."""
+    # weight_decay=0: the MSE+sigmoid plateau escape is trajectory-chaotic
+    # and tiny coupled L2 can push this seed onto a slow trajectory; the
+    # test pins a converging (deterministic) config.
+    cfg = ExperimentConfig(
+        encoder="cnn", n=2, k=2, q=2, batch_size=4, max_length=L, vocab_size=302,
+        compute_dtype="float32", lr=5e-3, loss="mse", val_step=0, weight_decay=0.0,
+    )
+    model, sampler = _setup(cfg, num_relations=4)
+    trainer = FewShotTrainer(
+        model, cfg, sampler, logger=MetricsLogger(tmp_path, quiet=True)
+    )
+    state = trainer.train(num_iters=400)
+    acc = trainer.evaluate(state.params, num_episodes=40, sampler=sampler)
+    assert acc > 0.9, f"overfit accuracy {acc}"
+    assert (tmp_path / "metrics.jsonl").exists()
+
+
+def test_ce_loss_also_trains():
+    cfg = ExperimentConfig(
+        encoder="cnn", n=2, k=2, q=2, batch_size=4, max_length=L, vocab_size=302,
+        compute_dtype="float32", lr=5e-3, loss="ce", val_step=0,
+    )
+    model, sampler = _setup(cfg)
+    trainer = FewShotTrainer(model, cfg, sampler)
+    state = trainer.train(num_iters=100)
+    acc = trainer.evaluate(state.params, num_episodes=20, sampler=sampler)
+    assert acc > 0.8, f"ce accuracy {acc}"
