@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Throughput benchmark: training episodes/sec/chip on the flagship config.
+
+Prints exactly ONE JSON line on stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Config: FewRel-style 5-way 5-shot, BiLSTM+self-attention induction network,
+L=40, bf16 compute — the reference's headline setup (BASELINE.json config #2)
+— full jitted train steps (fwd+bwd+update, donated state) on synthetic
+schema-faithful episodes so the number does not depend on data files.
+
+``vs_baseline``: ratio against the first recorded TPU v5e measurement
+(BASELINE.md "measured" table). Until that row exists the ratio is 1.0 by
+construction (the reference repo has no published numbers — BASELINE.json
+``published`` is empty).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+# First measured TPU v5e litepod-1 number (episodes/sec/chip) — the
+# self-established baseline all later rounds improve against (BASELINE.md).
+BASELINE_EPS: float | None = None
+
+BATCH = 8          # episodes per step
+WARMUP_STEPS = 3
+TIMED_STEPS = 30
+
+
+def main() -> int:
+    import jax
+
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.data import (
+        GloveTokenizer,
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+    from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+    from induction_network_on_fewrel_tpu.train.steps import init_state, make_train_step
+
+    backend = jax.default_backend()
+    n_chips = jax.local_device_count()
+    print(f"bench: backend={backend} chips={n_chips}", file=sys.stderr)
+
+    cfg = ExperimentConfig(
+        encoder="bilstm", n=5, k=5, q=5, batch_size=BATCH, max_length=40,
+        vocab_size=2002, compute_dtype="bfloat16",
+    )
+    vocab = make_synthetic_glove(vocab_size=cfg.vocab_size - 2)
+    ds = make_synthetic_fewrel(
+        num_relations=20, instances_per_relation=cfg.k + cfg.q + 5,
+        vocab_size=cfg.vocab_size - 2,
+    )
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    sampler = EpisodeSampler(ds, tok, cfg.n, cfg.k, cfg.q, cfg.batch_size, seed=0)
+    model = build_model(cfg, glove_init=vocab.vectors)
+
+    batches = [batch_to_model_inputs(sampler.sample_batch()) for _ in range(8)]
+    sup, qry, _ = batches[0]
+    state = init_state(model, cfg, sup, qry)
+    step = make_train_step(model, cfg)
+
+    t0 = time.monotonic()
+    for i in range(WARMUP_STEPS):
+        state, metrics = step(state, *batches[i % len(batches)])
+    jax.block_until_ready(metrics)
+    print(f"bench: warmup(+compile) {time.monotonic() - t0:.1f}s", file=sys.stderr)
+
+    t0 = time.monotonic()
+    for i in range(TIMED_STEPS):
+        state, metrics = step(state, *batches[i % len(batches)])
+    jax.block_until_ready(metrics)
+    dt = time.monotonic() - t0
+
+    eps_per_chip = TIMED_STEPS * BATCH / dt / max(n_chips, 1)
+    vs = eps_per_chip / BASELINE_EPS if BASELINE_EPS else 1.0
+    print(json.dumps({
+        "metric": f"train_episodes_per_sec_per_chip[5w5s,bilstm,L40,bf16,{backend}]",
+        "value": round(eps_per_chip, 2),
+        "unit": "episodes/s/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,274 @@
+"""Reference-compatible CLI (SURVEY.md §5.6): flag names preserved from the
+``train.py``/``test.py`` family (--N --K --Q --encoder --model --max_length
+--na_rate --lr --train_iter --val_step --load_ckpt --save_ckpt --only_test),
+plus the mandated ``--device={tpu,cpu}`` and mesh flags (--dp --tp).
+
+The parsed flags become a frozen ExperimentConfig (serialized into the ckpt
+dir), so a run is always reproducible from its checkpoint directory alone.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+
+
+def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="TPU-native induction network on FewRel episodes"
+    )
+    # episode geometry (reference flag names)
+    p.add_argument("--trainN", type=int, default=None, help="N-way during training (defaults to --N)")
+    p.add_argument("--N", type=int, default=5, help="N-way at eval")
+    p.add_argument("--K", type=int, default=5, help="K-shot")
+    p.add_argument("--Q", type=int, default=5, help="queries per class")
+    p.add_argument("--na_rate", type=int, default=0, help="NOTA negatives ratio (FewRel 2.0)")
+    p.add_argument("--batch_size", type=int, default=4, help="episodes per step")
+    # model
+    p.add_argument("--model", default="induction", choices=["induction"], help="few-shot model")
+    p.add_argument("--encoder", default="bilstm", choices=["cnn", "bilstm", "bert"])
+    p.add_argument("--max_length", type=int, default=40)
+    p.add_argument("--hidden_size", type=int, default=230)
+    p.add_argument("--lstm_hidden", type=int, default=128)
+    p.add_argument("--induction_dim", type=int, default=100)
+    p.add_argument("--routing_iters", type=int, default=3)
+    p.add_argument("--ntn_slices", type=int, default=100)
+    p.add_argument("--bert_frozen", action="store_true", help="freeze BERT backbone")
+    p.add_argument("--bert_layers", type=int, default=12)
+    # optimization
+    p.add_argument("--loss", default="mse", choices=["mse", "ce"])
+    p.add_argument("--optimizer", default="adam", choices=["adam", "adamw", "sgd"])
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--weight_decay", type=float, default=1e-5)
+    p.add_argument("--lr_step_size", type=int, default=2000)
+    p.add_argument("--grad_clip", type=float, default=10.0)
+    if train:
+        p.add_argument("--train_iter", type=int, default=10000)
+        p.add_argument("--val_iter", type=int, default=1000)
+        p.add_argument("--val_step", type=int, default=1000)
+    p.add_argument("--test_iter", type=int, default=3000)
+    # data
+    p.add_argument("--train_file", default=None, help="FewRel-schema JSON; synthetic if omitted")
+    p.add_argument("--val_file", default=None)
+    p.add_argument("--test_file", default=None)
+    p.add_argument("--glove", default=None, help="GloVe json (word2id or combined)")
+    p.add_argument("--glove_mat", default=None, help=".npy matrix for word2id json")
+    # device / parallelism
+    p.add_argument("--device", default="tpu", choices=["tpu", "cpu"])
+    p.add_argument("--dp", type=int, default=0, help="data-parallel mesh axis (0 = all devices)")
+    p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis")
+    p.add_argument("--fp16", action="store_true", help="(reference flag) alias for bf16 compute")
+    p.add_argument("--bf16", action="store_true", help="bfloat16 matmuls on the MXU")
+    # checkpoints / run dir
+    p.add_argument("--save_ckpt", default="./checkpoint", help="checkpoint directory")
+    p.add_argument("--load_ckpt", default=None, help="checkpoint directory to restore")
+    if train:
+        p.add_argument("--resume", action="store_true", help="resume latest state from --save_ckpt")
+        p.add_argument("--only_test", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--run_dir", default=None, help="metrics/log dir (defaults to --save_ckpt)")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
+    compute = "bfloat16" if (args.bf16 or args.fp16) else "float32"
+    train_iter = getattr(args, "train_iter", 0)
+    val_iter = getattr(args, "val_iter", 1000)
+    val_step = getattr(args, "val_step", 0)
+    return ExperimentConfig(
+        train_n=args.trainN or args.N,
+        n=args.N, k=args.K, q=args.Q, na_rate=args.na_rate,
+        batch_size=args.batch_size, max_length=args.max_length,
+        encoder=args.encoder, hidden_size=args.hidden_size,
+        lstm_hidden=args.lstm_hidden, induction_dim=args.induction_dim,
+        routing_iters=args.routing_iters, ntn_slices=args.ntn_slices,
+        bert_frozen=args.bert_frozen, bert_layers=args.bert_layers,
+        loss=args.loss, optimizer=args.optimizer, lr=args.lr,
+        weight_decay=args.weight_decay, lr_step_size=args.lr_step_size,
+        grad_clip=args.grad_clip, train_iter=train_iter,
+        val_iter=val_iter, val_step=val_step, test_iter=args.test_iter,
+        device=args.device, compute_dtype=compute, seed=args.seed,
+        dp=args.dp, tp=args.tp,
+    )
+
+
+def select_device(cfg: ExperimentConfig) -> None:
+    """Apply --device before any jax backend init.
+
+    --device=cpu must use the config-update path: this image's axon
+    sitecustomize overrides jax_platforms, so the env var alone would still
+    dial the TPU tunnel (see tests/conftest.py).
+    """
+    import jax
+
+    if cfg.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+
+def load_vocab(args, cfg: ExperimentConfig):
+    """Load GloVe once (it can be hundreds of MB); callers share the result."""
+    from induction_network_on_fewrel_tpu.data import make_synthetic_glove
+    from induction_network_on_fewrel_tpu.data.glove import load_glove
+
+    if args.glove:
+        return load_glove(args.glove, args.glove_mat)
+    return make_synthetic_glove(vocab_size=cfg.vocab_size - 2)
+
+
+def load_data(args, cfg: ExperimentConfig, split: str):
+    """Dataset for a split; synthetic schema-faithful fixtures when no file
+    is given (no FewRel/GloVe on disk in this sandbox)."""
+    from induction_network_on_fewrel_tpu.data import (
+        load_fewrel_json,
+        make_synthetic_fewrel,
+    )
+
+    path = {"train": args.train_file, "val": args.val_file, "test": args.test_file}[split]
+    if path:
+        return load_fewrel_json(path)
+    seed = {"train": 0, "val": 1, "test": 2}[split]
+    return make_synthetic_fewrel(
+        num_relations=max(cfg.train_n, cfg.n) * 2,
+        instances_per_relation=max(cfg.k + cfg.q + 5, 20),
+        vocab_size=cfg.vocab_size - 2,
+        seed=seed,
+    )
+
+
+def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
+    """Wire data, model, (possibly mesh-sharded) steps, ckpt, and logger."""
+    import jax
+
+    from induction_network_on_fewrel_tpu.data import GloveTokenizer
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+    from induction_network_on_fewrel_tpu.parallel import (
+        make_mesh,
+        make_sharded_eval_step,
+        make_sharded_train_step,
+        maybe_initialize_distributed,
+    )
+    from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+    from induction_network_on_fewrel_tpu.train import FewShotTrainer
+    from induction_network_on_fewrel_tpu.train.steps import init_state
+    from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+    maybe_initialize_distributed()
+
+    vocab = load_vocab(args, cfg)
+    train_ds = load_data(args, cfg, "train")
+    val_ds = load_data(args, cfg, "val")
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    train_sampler = EpisodeSampler(
+        train_ds, tok, cfg.train_n, cfg.k, cfg.q, cfg.batch_size,
+        na_rate=cfg.na_rate, seed=cfg.seed,
+    )
+    val_sampler = EpisodeSampler(
+        val_ds, tok, cfg.n, cfg.k, cfg.q, cfg.batch_size,
+        na_rate=cfg.na_rate, seed=cfg.seed + 1,
+    )
+    model = build_model(cfg, glove_init=vocab.vectors)
+
+    n_dev = len(jax.devices())
+    use_mesh = (cfg.dp == 0 and n_dev > 1) or cfg.dp > 1 or cfg.tp > 1
+    train_step = eval_step = state = mesh = None
+    if use_mesh:
+        mesh = make_mesh(dp=(cfg.dp or None), tp=cfg.tp)
+        dp = mesh.shape["dp"]
+        if cfg.batch_size % dp != 0:
+            raise ValueError(
+                f"--batch_size {cfg.batch_size} must be divisible by the "
+                f"data-parallel mesh axis dp={dp} (episodes are sharded "
+                f"over dp); try --batch_size {((cfg.batch_size // dp) + 1) * dp} "
+                f"or --dp {cfg.batch_size}"
+            )
+        sup, qry, _ = batch_to_model_inputs(train_sampler.sample_batch())
+        # The sharded steps are traced against this exact state's pytree
+        # metadata, so the same object is injected into the trainer.
+        state = init_state(model, cfg, sup, qry)
+        train_step = make_sharded_train_step(model, cfg, mesh, state)
+        eval_step = make_sharded_eval_step(model, cfg, mesh, state)
+
+    run_dir = args.run_dir or args.save_ckpt
+    trainer = FewShotTrainer(
+        model, cfg, train_sampler, val_sampler,
+        ckpt_dir=None if only_test else args.save_ckpt,
+        logger=MetricsLogger(run_dir),
+        train_step=train_step, eval_step=eval_step, initial_state=state,
+        mesh=mesh,
+    )
+    trainer.vocab, trainer.tokenizer = vocab, tok
+    return trainer
+
+
+def make_test_sampler(args, cfg: ExperimentConfig, tok):
+    from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+
+    test_ds = load_data(args, cfg, "test")
+    return EpisodeSampler(
+        test_ds, tok, cfg.n, cfg.k, cfg.q, cfg.batch_size,
+        na_rate=cfg.na_rate, seed=cfg.seed + 2,
+    )
+
+
+def train_main(argv=None) -> int:
+    args = build_arg_parser(train=True).parse_args(argv)
+    cfg = config_from_args(args)
+    select_device(cfg)
+    trainer = make_trainer(args, cfg)
+
+    state = trainer.init_state()
+    start_step = 0
+    if args.resume or args.load_ckpt:
+        from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
+
+        src = args.load_ckpt or args.save_ckpt
+        try:
+            mngr = CheckpointManager(src, cfg)
+            state, start_step = (
+                mngr.restore_latest(state) if args.resume else mngr.restore_best(state)
+            )
+            state = trainer.reshard_state(state)
+            print(f"restored checkpoint step={start_step} from {src}", file=sys.stderr)
+        except FileNotFoundError:
+            if args.load_ckpt:
+                raise
+            print(f"no checkpoint in {src}; starting fresh", file=sys.stderr)
+
+    if args.only_test:
+        sampler = make_test_sampler(args, cfg, trainer.tokenizer)
+        acc = trainer.evaluate(state.params, cfg.test_iter, sampler=sampler)
+        print(f'{{"test_accuracy": {acc:.4f}}}')
+        return 0
+
+    state = trainer.train(state, num_iters=cfg.train_iter)
+    if trainer.val_sampler is not None:
+        acc = trainer.evaluate(state.params, cfg.val_iter)
+        print(f'{{"final_val_accuracy": {acc:.4f}}}')
+    return 0
+
+
+def test_main(argv=None) -> int:
+    args = build_arg_parser(train=False).parse_args(argv)
+    if not args.load_ckpt and not os.path.isdir(args.save_ckpt):
+        print("test.py needs --load_ckpt (or an existing --save_ckpt dir)", file=sys.stderr)
+        return 2
+    cfg = config_from_args(args)
+    select_device(cfg)
+    trainer = make_trainer(args, cfg, only_test=True)
+
+    from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
+
+    src = args.load_ckpt or args.save_ckpt
+    state = trainer.init_state()
+    state, step = CheckpointManager(src, cfg).restore_best(state)
+    state = trainer.reshard_state(state)
+    print(f"loaded best checkpoint step={step} from {src}", file=sys.stderr)
+
+    test_sampler = make_test_sampler(args, cfg, trainer.tokenizer)
+    acc = trainer.evaluate(state.params, cfg.test_iter, sampler=test_sampler)
+    print(f'{{"test_accuracy": {acc:.4f}}}')
+    return 0

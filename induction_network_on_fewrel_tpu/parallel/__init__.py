@@ -1,0 +1,10 @@
+from induction_network_on_fewrel_tpu.parallel.mesh import make_mesh  # noqa: F401
+from induction_network_on_fewrel_tpu.parallel.sharding import (  # noqa: F401
+    batch_shardings,
+    make_sharded_eval_step,
+    make_sharded_train_step,
+    state_shardings,
+)
+from induction_network_on_fewrel_tpu.parallel.distributed import (  # noqa: F401
+    maybe_initialize_distributed,
+)

@@ -1,0 +1,38 @@
+"""Multi-host initialization hook (SURVEY.md §5.8).
+
+The reference has no multi-process communication layer at all; its analog
+here is ``jax.distributed.initialize()``, which wires the hosts of a TPU pod
+into one JAX process group: parameter/gradient collectives ride ICI inside a
+slice, host coordination and cross-slice traffic ride DCN. No NCCL/MPI/Gloo.
+
+Call this once at process start (the CLIs do). It is a no-op off-pod, so
+single-host code paths never pay for it.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_POD_ENV_VARS = (
+    # Set by TPU pod runtimes / launchers; presence implies a multi-host job.
+    "COORDINATOR_ADDRESS",
+    "TPU_WORKER_ID",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+)
+
+
+def maybe_initialize_distributed(force: bool = False) -> bool:
+    """Initialize jax.distributed when running as one process of a pod job.
+
+    Returns True if distributed mode was initialized. Safe to call twice
+    (second call is a no-op). ``force=True`` initializes unconditionally
+    (useful with explicit --coordinator flags).
+    """
+    if jax.distributed.is_initialized():
+        return True
+    if force or any(v in os.environ for v in _POD_ENV_VARS):
+        jax.distributed.initialize()
+        return True
+    return False

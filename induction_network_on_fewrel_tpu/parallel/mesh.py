@@ -1,0 +1,39 @@
+"""Device mesh construction.
+
+The reference's entire distribution story is single-process
+``nn.DataParallel`` over the GPUs of one host (SURVEY.md §2.2). The
+TPU-native replacement is a named 2-D ``jax.sharding.Mesh``:
+
+* ``dp`` — data parallel: the episode batch axis is sharded; gradients are
+  all-reduced over ICI (XLA inserts the psum under GSPMD, or `shard_map`
+  calls it explicitly).
+* ``tp`` — tensor parallel: the NTN's bilinear slice axis (and, for BERT,
+  attention heads / MLP hidden) shard here. Not needed for parity
+  (SURVEY.md §2.2 says the reference has no TP) but it falls out of the
+  design for free and covers the BERT-encoder scaling case.
+
+On a multi-host pod, call :func:`maybe_initialize_distributed` first; the
+mesh then spans ``jax.devices()`` across hosts with ICI inside a slice and
+DCN between slices (axis order puts ``dp`` outermost = DCN-friendly;
+``tp`` innermost = ICI-only).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(dp: int | None = None, tp: int = 1, devices=None) -> Mesh:
+    """Build a (dp, tp) mesh. ``dp=None`` -> use all remaining devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if dp is None:
+        if n % tp != 0:
+            raise ValueError(f"{n} devices not divisible by tp={tp}")
+        dp = n // tp
+    if dp * tp > n:
+        raise ValueError(f"dp*tp={dp * tp} exceeds {n} available devices")
+    grid = np.asarray(devices[: dp * tp]).reshape(dp, tp)
+    return Mesh(grid, axis_names=("dp", "tp"))

@@ -1,0 +1,174 @@
+"""Sharding rules + mesh-sharded train/eval steps.
+
+Two equivalent multi-chip paths are provided (tested equal to the
+single-device step in tests/test_parallel.py):
+
+* **GSPMD (default)** — ``jax.jit`` with ``NamedSharding`` on state and
+  batch; XLA partitions the whole fwd+bwd+update program and inserts the
+  gradient all-reduce over ICI itself. Params are replicated over ``dp``
+  and selectively sharded over ``tp`` (NTN slice axis); the episode batch
+  axis is sharded over ``dp``.
+* **shard_map** — explicit per-device program with ``jax.lax.pmean`` on
+  gradients over the ``dp`` axis: the TPU-native spelling of the
+  reference's DataParallel gradient reduction (SURVEY.md §2.2). Kept both
+  as an escape hatch for when GSPMD's choices need overriding and as the
+  explicit-collectives form.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.models.losses import accuracy
+from induction_network_on_fewrel_tpu.train.steps import LOSS_FNS, loss_and_metrics
+
+_BATCH_KEYS = ("word", "pos1", "pos2", "mask")
+
+# --- partition rules -------------------------------------------------------
+
+_TP_RULES: tuple[tuple[str, P], ...] = (
+    # NTN bilinear tensor M[h, C, C]: shard the slice axis h.
+    ("tensor_slices", P("tp", None, None)),
+    # BERT-style transformer blocks (models/bert.py): Megatron-style — MLP
+    # up-projection column-sharded, down-projection row-sharded.
+    ("intermediate/kernel", P(None, "tp")),
+    ("mlp_out/kernel", P("tp", None)),
+)
+
+
+def _spec_for_path(path: str, leaf) -> P:
+    for frag, spec in _TP_RULES:
+        if frag in path and len(spec) == getattr(leaf, "ndim", 0):
+            return spec
+    return P()  # replicated (dp sees full params; XLA psums their grads)
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p)))) for p in path
+    )
+
+
+def state_shardings(state: Any, mesh: Mesh):
+    """NamedShardings for a TrainState pytree. Works on real arrays or
+    ``jax.eval_shape`` ShapeDtypeStructs (only structure/rank are read);
+    opt-state leaves mirror the params rule via their own paths."""
+
+    def assign(path, leaf):
+        return NamedSharding(mesh, _spec_for_path(_path_str(path), leaf))
+
+    return jax.tree_util.tree_map_with_path(assign, state)
+
+
+def shard_state(state: Any, mesh: Mesh):
+    """Place a (restored or freshly built) state onto the mesh shardings.
+
+    Orbax restores commit arrays to a single device; jit with in_shardings
+    refuses committed args with mismatched placement, so reshard explicitly.
+    """
+    return jax.device_put(state, state_shardings(state, mesh))
+
+
+def episode_batch_shardings(mesh: Mesh):
+    """(support, query, label) shardings: episode axis over dp."""
+    sup = {k: NamedSharding(mesh, P("dp", None, None, None)) for k in _BATCH_KEYS}
+    qry = {k: NamedSharding(mesh, P("dp", None, None)) for k in _BATCH_KEYS}
+    lab = NamedSharding(mesh, P("dp", None))
+    return sup, qry, lab
+
+
+def batch_shardings(mesh: Mesh, tree: Any):
+    """Generic: leading (episode) axis over dp, everything else replicated."""
+
+    def assign(leaf):
+        ndim = getattr(leaf, "ndim", 0)
+        spec = P(*(("dp",) + (None,) * (ndim - 1))) if ndim else P()
+        return NamedSharding(mesh, spec)
+
+    return jax.tree.map(assign, tree)
+
+
+# --- GSPMD steps -----------------------------------------------------------
+
+
+def make_sharded_train_step(model, cfg: ExperimentConfig, mesh: Mesh, state_example):
+    """jit train step partitioned over ``mesh`` via NamedSharding.
+
+    ``state_example``: a real TrainState or ``jax.eval_shape`` result —
+    only tree structure and leaf ranks are read.
+    """
+    st_sh = state_shardings(state_example, mesh)
+    repl = NamedSharding(mesh, P())
+    sup_sh, qry_sh, lab_sh = episode_batch_shardings(mesh)
+
+    def step(state, support, query, label):
+        def loss_fn(params):
+            return loss_and_metrics(model, params, support, query, label, cfg.loss)
+
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+        return state.apply_gradients(grads=grads), metrics
+
+    return jax.jit(
+        step,
+        in_shardings=(st_sh, sup_sh, qry_sh, lab_sh),
+        out_shardings=(st_sh, {"loss": repl, "accuracy": repl}),
+        donate_argnums=(0,),
+    )
+
+
+def make_sharded_eval_step(model, cfg: ExperimentConfig, mesh: Mesh, state_example):
+    st_sh = state_shardings(state_example, mesh)
+    repl = NamedSharding(mesh, P())
+    sup_sh, qry_sh, lab_sh = episode_batch_shardings(mesh)
+
+    def step(params, support, query, label):
+        logits = model.apply(params, support, query)
+        return {
+            "loss": LOSS_FNS[cfg.loss](logits, label),
+            "accuracy": accuracy(logits, label),
+        }
+
+    return jax.jit(
+        step,
+        in_shardings=(st_sh.params, sup_sh, qry_sh, lab_sh),
+        out_shardings={"loss": repl, "accuracy": repl},
+    )
+
+
+# --- explicit shard_map data-parallel step ---------------------------------
+
+
+def make_shard_map_train_step(model, cfg: ExperimentConfig, mesh: Mesh):
+    """Pure-dp explicit-collective step: each device computes grads on its
+    episode shard, then ``lax.pmean`` over 'dp' — the literal TPU analog of
+    DataParallel's gradient reduction. Params replicated; updates identical
+    on every device by construction."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(),
+            {k: P("dp", None, None, None) for k in _BATCH_KEYS},
+            {k: P("dp", None, None) for k in _BATCH_KEYS},
+            P("dp", None),
+        ),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def sharded(state, support, query, label):
+        def loss_fn(params):
+            return loss_and_metrics(model, params, support, query, label, cfg.loss)
+
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+        grads = jax.lax.pmean(grads, "dp")
+        metrics = jax.lax.pmean(metrics, "dp")
+        new_state = state.apply_gradients(grads=grads)
+        return new_state, metrics
+
+    return jax.jit(sharded, donate_argnums=(0,))

@@ -38,6 +38,8 @@ class FewShotTrainer:
         logger: MetricsLogger | None = None,
         train_step=None,
         eval_step=None,
+        initial_state=None,
+        mesh=None,
     ):
         self.model = model
         self.cfg = cfg
@@ -49,11 +51,31 @@ class FewShotTrainer:
         self.eval_step = eval_step or make_eval_step(model, cfg)
         self.ckpt = CheckpointManager(ckpt_dir, cfg) if ckpt_dir else None
         self.best_val = -1.0
+        self._initial_state = initial_state
+        # Mesh the injected steps were built for (None = single device);
+        # restored checkpoints must be re-placed onto it (see reshard_state).
+        self.mesh = mesh
 
     def init_state(self):
+        # Reuse a pre-built state when one was injected: mesh-sharded steps
+        # are traced against its exact pytree metadata (optimizer function
+        # identities included), so a fresh init_state would not match.
+        if self._initial_state is not None:
+            state, self._initial_state = self._initial_state, None
+            return state
         batch = self.train_sampler.sample_batch()
         support, query, _ = batch_to_model_inputs(batch)
         return init_state(self.model, self.cfg, support, query)
+
+    def reshard_state(self, state):
+        """Place a restored state onto this trainer's mesh shardings (no-op
+        on single device). Orbax commits restored arrays to one device and
+        jit in_shardings refuses mismatched committed args."""
+        if self.mesh is None:
+            return state
+        from induction_network_on_fewrel_tpu.parallel.sharding import shard_state
+
+        return shard_state(state, self.mesh)
 
     def train(self, state=None, num_iters: int | None = None):
         cfg = self.cfg

@@ -1,0 +1,12 @@
+#!/usr/bin/env python3
+"""Eval entrypoint (reference parity: test.py, SURVEY.md §1 L6).
+
+Example:
+    python test.py --load_ckpt ./ckpt/bilstm_5w5s --N 5 --K 5 --test_iter 3000
+"""
+import sys
+
+from induction_network_on_fewrel_tpu.cli import test_main
+
+if __name__ == "__main__":
+    sys.exit(test_main())

@@ -1,0 +1,13 @@
+#!/usr/bin/env python3
+"""Train entrypoint (reference parity: train.py, SURVEY.md §1 L6).
+
+Example:
+    python train.py --encoder bilstm --N 5 --K 5 --Q 5 --train_iter 10000 \
+        --device tpu --save_ckpt ./ckpt/bilstm_5w5s
+"""
+import sys
+
+from induction_network_on_fewrel_tpu.cli import train_main
+
+if __name__ == "__main__":
+    sys.exit(train_main())
