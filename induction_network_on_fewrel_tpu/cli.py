@@ -38,6 +38,10 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--ntn_slices", type=int, default=100)
     p.add_argument("--bert_frozen", action="store_true", help="freeze BERT backbone")
     p.add_argument("--bert_layers", type=int, default=12)
+    p.add_argument("--bert_vocab", default=None, help="vocab.txt for WordPiece (hash fallback if absent)")
+    p.add_argument("--bert_vocab_size", type=int, default=30522, help="embedding rows in hash-fallback mode")
+    p.add_argument("--bert_weights", default=None, help=".npz of bert-base-uncased weights")
+    p.add_argument("--bert_remat", action="store_true", help="rematerialize BERT layers (HBM headroom)")
     # optimization
     p.add_argument("--loss", default="mse", choices=["mse", "ce"])
     p.add_argument("--optimizer", default="adam", choices=["adam", "adamw", "sgd"])
@@ -86,6 +90,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         lstm_hidden=args.lstm_hidden, induction_dim=args.induction_dim,
         routing_iters=args.routing_iters, ntn_slices=args.ntn_slices,
         bert_frozen=args.bert_frozen, bert_layers=args.bert_layers,
+        bert_vocab_size=args.bert_vocab_size, bert_vocab_path=args.bert_vocab,
+        bert_remat=args.bert_remat,
         loss=args.loss, optimizer=args.optimizer, lr=args.lr,
         weight_decay=args.weight_decay, lr_step_size=args.lr_step_size,
         grad_clip=args.grad_clip, train_iter=train_iter,
@@ -158,10 +164,22 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
 
     maybe_initialize_distributed()
 
-    vocab = load_vocab(args, cfg)
     train_ds = load_data(args, cfg, "train")
     val_ds = load_data(args, cfg, "val")
-    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    if cfg.encoder == "bert":
+        from induction_network_on_fewrel_tpu.data.bert_tokenizer import BertTokenizer
+
+        vocab = None  # the BERT path owns its embedding; GloVe is not loaded
+        tok = BertTokenizer(
+            cfg.max_length, vocab_path=cfg.bert_vocab_path,
+            vocab_size=cfg.bert_vocab_size,
+        )
+        # A vocab.txt resets the tokenizer's vocab size; the embedding table
+        # must match or out-of-range ids gather garbage silently on TPU.
+        cfg = cfg.replace(bert_vocab_size=tok.vocab_size)
+    else:
+        vocab = load_vocab(args, cfg)
+        tok = GloveTokenizer(vocab, max_length=cfg.max_length)
     train_sampler = EpisodeSampler(
         train_ds, tok, cfg.train_n, cfg.k, cfg.q, cfg.batch_size,
         na_rate=cfg.na_rate, seed=cfg.seed,
@@ -170,7 +188,7 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         val_ds, tok, cfg.n, cfg.k, cfg.q, cfg.batch_size,
         na_rate=cfg.na_rate, seed=cfg.seed + 1,
     )
-    model = build_model(cfg, glove_init=vocab.vectors)
+    model = build_model(cfg, glove_init=vocab.vectors if vocab is not None else None)
 
     n_dev = len(jax.devices())
     use_mesh = (cfg.dp == 0 and n_dev > 1) or cfg.dp > 1 or cfg.tp > 1
@@ -214,13 +232,40 @@ def make_test_sampler(args, cfg: ExperimentConfig, tok):
     )
 
 
+def _merge_ckpt_architecture(cfg: ExperimentConfig, src: str) -> ExperimentConfig:
+    """Take architecture fields from a checkpoint dir's config.json so the
+    restored weights always match the built model/tokenizer."""
+    from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
+
+    try:
+        saved = CheckpointManager.load_config(src)
+    except FileNotFoundError:
+        return cfg
+    merged = cfg.merge_architecture_from(saved)
+    if merged != cfg:
+        print(f"using architecture from {src}/config.json", file=sys.stderr)
+    return merged
+
+
 def train_main(argv=None) -> int:
-    args = build_arg_parser(train=True).parse_args(argv)
+    parser = build_arg_parser(train=True)
+    args = parser.parse_args(argv)
+    if args.bert_weights and args.encoder != "bert":
+        parser.error("--bert_weights requires --encoder bert")
     cfg = config_from_args(args)
+    if args.load_ckpt:
+        cfg = _merge_ckpt_architecture(cfg, args.load_ckpt)
     select_device(cfg)
     trainer = make_trainer(args, cfg)
+    cfg = trainer.cfg  # make_trainer may pin tokenizer-derived fields
 
     state = trainer.init_state()
+    if args.bert_weights:
+        from induction_network_on_fewrel_tpu.models.bert import load_hf_weights
+
+        enc = load_hf_weights({"params": state.params["params"]["encoder"]}, args.bert_weights)
+        state.params["params"]["encoder"] = enc["params"]
+        print(f"loaded BERT weights from {args.bert_weights}", file=sys.stderr)
     start_step = 0
     if args.resume or args.load_ckpt:
         from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
@@ -257,8 +302,10 @@ def test_main(argv=None) -> int:
         print("test.py needs --load_ckpt (or an existing --save_ckpt dir)", file=sys.stderr)
         return 2
     cfg = config_from_args(args)
+    cfg = _merge_ckpt_architecture(cfg, args.load_ckpt or args.save_ckpt)
     select_device(cfg)
     trainer = make_trainer(args, cfg, only_test=True)
+    cfg = trainer.cfg
 
     from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
 

@@ -41,7 +41,10 @@ class ExperimentConfig:
     bert_hidden: int = 768
     bert_heads: int = 12
     bert_intermediate: int = 3072
+    bert_vocab_size: int = 30522  # bert-base-uncased WordPiece vocab
+    bert_vocab_path: str | None = None  # vocab.txt (None -> hash fallback)
     bert_frozen: bool = True  # frozen -> fine-tuned regime (reference config 4)
+    bert_remat: bool = False  # jax.checkpoint per layer (HBM vs FLOPs)
 
     # --- induction + relation modules ---
     induction_dim: int = 100  # class-vector dim C after the squash transform
@@ -81,8 +84,26 @@ class ExperimentConfig:
         """Logit width: N, plus one 'none' class when NOTA is active."""
         return self.n + (1 if self.na_rate > 0 else 0)
 
+    # Fields that define the trained artifact (must match a checkpoint to
+    # load it); everything else is runtime/episode geometry a user may vary
+    # at eval time. test.py merges these from the checkpoint's config.json.
+    ARCHITECTURE_FIELDS = (
+        "encoder", "hidden_size", "lstm_hidden", "att_dim", "word_dim",
+        "pos_dim", "vocab_size", "max_length", "induction_dim",
+        "routing_iters", "ntn_slices", "bert_layers", "bert_hidden",
+        "bert_heads", "bert_intermediate", "bert_vocab_size",
+        "bert_vocab_path", "loss", "optimizer",
+    )
+
     def replace(self, **kw: Any) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)
+
+    def merge_architecture_from(self, other: "ExperimentConfig") -> "ExperimentConfig":
+        """Take architecture-defining fields from ``other`` (a checkpoint's
+        saved config), keep this config's runtime/episode fields."""
+        return self.replace(
+            **{f: getattr(other, f) for f in self.ARCHITECTURE_FIELDS}
+        )
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)

@@ -1,0 +1,247 @@
+"""BERT-base sentence encoder, written from scratch in Flax.
+
+Covers the reference's third encoder option (SURVEY.md §2.1 "BERT encoder":
+bert-base-uncased backbone, [CLS]/entity pooling, frozen -> fine-tuned
+regime). Built TPU-first rather than imported from HF:
+
+* bf16 matmuls throughout (params stay f32), fused QKV projection — one
+  [H, 3H] matmul instead of three [H, H] — and a single einsum per attention
+  contraction, all MXU-shaped.
+* No data-dependent control flow; attention masking is additive -inf bias,
+  shapes static in ``max_length``.
+* ``frozen=True`` wraps the backbone in ``jax.lax.stop_gradient`` — the
+  frozen phase of the reference's frozen->fine-tuned schedule — so the same
+  compiled program serves both phases (flip the flag, recompile once).
+* Layer boundaries are ``nn.remat``-able for HBM headroom at larger episode
+  batches (enable via ``remat=True``; SURVEY.md §7 "BERT fine-tune on one
+  v5e chip").
+* The MLP kernels are named so the tensor-parallel rules in
+  parallel/sharding.py (Megatron-style column/row split over 'tp') pick
+  them up by path.
+
+No pretrained weights ship in this sandbox (no network — SURVEY.md §7); the
+module random-initializes unless ``load_hf_weights`` finds a compatible
+``.npz``/msgpack on disk. Tokenization for the BERT path lives in
+data/bert_tokenizer.py (WordPiece when a vocab file exists, whitespace+hash
+fallback otherwise).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BertSelfAttention(nn.Module):
+    hidden_size: int
+    num_heads: int
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        B, L, H = x.shape
+        d = H // self.num_heads
+        qkv = nn.Dense(
+            3 * H, dtype=self.compute_dtype, param_dtype=jnp.float32, name="qkv"
+        )(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(B, L, self.num_heads, d)
+        q, k, v = split(q), split(k), split(v)
+
+        scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / np.sqrt(d)
+        bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e30)
+        att = jax.nn.softmax(scores.astype(jnp.float32) + bias, axis=-1)
+        out = jnp.einsum("bhlm,bmhd->blhd", att.astype(self.compute_dtype), v)
+        return nn.Dense(
+            H, dtype=self.compute_dtype, param_dtype=jnp.float32, name="out"
+        )(out.reshape(B, L, H))
+
+
+class BertLayer(nn.Module):
+    hidden_size: int
+    num_heads: int
+    intermediate_size: int
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        att = BertSelfAttention(
+            self.hidden_size, self.num_heads, self.compute_dtype, name="attention"
+        )(x, mask)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_att")(x + att)
+        h = nn.Dense(
+            self.intermediate_size, dtype=self.compute_dtype,
+            param_dtype=jnp.float32, name="intermediate",
+        )(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(
+            self.hidden_size, dtype=self.compute_dtype,
+            param_dtype=jnp.float32, name="mlp_out",
+        )(h)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + h)
+
+
+class BertBackbone(nn.Module):
+    vocab_size: int
+    num_layers: int = 12
+    hidden_size: int = 768
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab: int = 2
+    remat: bool = False
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        B, L = ids.shape
+        word = nn.Embed(
+            self.vocab_size, self.hidden_size, param_dtype=jnp.float32, name="tok_emb"
+        )(ids)
+        pos = self.param(
+            "pos_emb", nn.initializers.normal(0.02), (self.max_position, self.hidden_size)
+        )[:L]
+        seg = self.param(
+            "seg_emb", nn.initializers.normal(0.02), (self.type_vocab, self.hidden_size)
+        )[0]
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(word + pos[None] + seg[None, None])
+        x = x.astype(self.compute_dtype)
+
+        layer_cls = nn.remat(BertLayer) if self.remat else BertLayer
+        for i in range(self.num_layers):
+            x = layer_cls(
+                self.hidden_size, self.num_heads, self.intermediate_size,
+                self.compute_dtype, name=f"layer_{i}",
+            )(x, mask)
+        return x  # [B, L, H]
+
+
+class BertEmbeddingPassthrough(nn.Module):
+    """The BERT path owns its token embedding; the InductionNetwork's
+    ``embedding(word, pos1, pos2)`` slot just forwards the ids.
+
+    The GloVe-path position-offset features (pos1/pos2) are not consumed
+    here — entity position information enters via entity-start pooling in
+    BertEncoder instead, mirroring the reference family's BERT variant."""
+
+    @nn.compact
+    def __call__(self, word, pos1, pos2):
+        del pos1, pos2
+        return word  # ids pass through; BertEncoder embeds them itself
+
+
+class BertEncoder(nn.Module):
+    """(ids [M, L], mask [M, L]) -> sentence vectors [M, hidden].
+
+    Pooling: mean of [CLS] (position 0) and the two entity-start hidden
+    states when entity markers are present; plain [CLS] otherwise. The
+    entity starts arrive encoded in the ids stream by the BERT tokenizer
+    (data/bert_tokenizer.py) as known marker ids.
+    """
+
+    vocab_size: int
+    num_layers: int = 12
+    hidden_size: int = 768
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_length: int = 128
+    frozen: bool = True
+    remat: bool = False
+    head_marker_id: int = 1  # [E1] == [unused1]; tokenizer contract
+    tail_marker_id: int = 2  # [E2] == [unused2]
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        hidden = BertBackbone(
+            vocab_size=self.vocab_size,
+            num_layers=self.num_layers,
+            hidden_size=self.hidden_size,
+            num_heads=self.num_heads,
+            intermediate_size=self.intermediate_size,
+            remat=self.remat,
+            compute_dtype=self.compute_dtype,
+            name="backbone",
+        )(ids, mask)
+        if self.frozen:
+            # Frozen phase of the frozen->fine-tuned regime: gradients stop
+            # at the backbone output; only the induction/relation head trains.
+            hidden = jax.lax.stop_gradient(hidden)
+
+        cls_vec = hidden[:, 0]
+        # Entity-start pooling: first occurrence of each marker id (static
+        # shapes: argmax over a boolean mask, falls back to CLS when absent).
+        def marker_vec(marker_id):
+            hit = (ids == marker_id) & (mask > 0)
+            idx = jnp.argmax(hit, axis=1)                    # 0 when absent
+            present = jnp.any(hit, axis=1, keepdims=True)
+            vec = jnp.take_along_axis(hidden, idx[:, None, None], axis=1)[:, 0]
+            return jnp.where(present, vec, cls_vec)
+
+        pooled = (cls_vec + marker_vec(self.head_marker_id) + marker_vec(self.tail_marker_id)) / 3.0
+        return pooled.astype(self.compute_dtype)
+
+    @property
+    def output_dim(self) -> int:
+        return self.hidden_size
+
+
+def load_hf_weights(params: dict, npz_path: str) -> dict:
+    """Map a flat ``{hf_name: array}`` .npz of bert-base-uncased weights onto
+    this module's param tree. Returns a NEW params dict; raises KeyError on
+    missing tensors. Name mapping documented here for checkpoint importers:
+
+    bert.embeddings.word_embeddings.weight          -> backbone/tok_emb/embedding
+    bert.embeddings.position_embeddings.weight      -> backbone/pos_emb
+    bert.embeddings.token_type_embeddings.weight    -> backbone/seg_emb
+    bert.embeddings.LayerNorm.{gamma,beta}          -> backbone/ln_emb/{scale,bias}
+    ...encoder.layer.N.attention.self.{q,k,v}       -> backbone/layer_N/attention/qkv (fused)
+    ...attention.output.dense                       -> backbone/layer_N/attention/out
+    ...intermediate.dense / output.dense            -> backbone/layer_N/{intermediate,mlp_out}
+    """
+    import copy
+
+    raw = dict(np.load(npz_path))
+
+    def ln(prefix: str, which: str):
+        # TF-era exports use LayerNorm.gamma/beta; torch state_dicts use
+        # LayerNorm.weight/bias. Accept both.
+        alt = {"gamma": "weight", "beta": "bias"}[which]
+        key = f"{prefix}LayerNorm.{which}"
+        return raw[key] if key in raw else raw[f"{prefix}LayerNorm.{alt}"]
+
+    p = copy.deepcopy(params)
+    bb = p["params"]["backbone"]
+    pre = "bert.embeddings."
+    bb["tok_emb"]["embedding"] = raw[pre + "word_embeddings.weight"]
+    bb["pos_emb"] = raw[pre + "position_embeddings.weight"]
+    bb["seg_emb"] = raw[pre + "token_type_embeddings.weight"]
+    bb["ln_emb"]["scale"] = ln(pre, "gamma")
+    bb["ln_emb"]["bias"] = ln(pre, "beta")
+    i = 0
+    while f"layer_{i}" in bb:
+        lp = f"bert.encoder.layer.{i}."
+        lyr = bb[f"layer_{i}"]
+        qkv_w = np.concatenate(
+            [raw[lp + f"attention.self.{n}.weight"].T for n in ("query", "key", "value")],
+            axis=1,
+        )
+        qkv_b = np.concatenate(
+            [raw[lp + f"attention.self.{n}.bias"] for n in ("query", "key", "value")]
+        )
+        lyr["attention"]["qkv"]["kernel"] = qkv_w
+        lyr["attention"]["qkv"]["bias"] = qkv_b
+        lyr["attention"]["out"]["kernel"] = raw[lp + "attention.output.dense.weight"].T
+        lyr["attention"]["out"]["bias"] = raw[lp + "attention.output.dense.bias"]
+        lyr["ln_att"]["scale"] = ln(lp + "attention.output.", "gamma")
+        lyr["ln_att"]["bias"] = ln(lp + "attention.output.", "beta")
+        lyr["intermediate"]["kernel"] = raw[lp + "intermediate.dense.weight"].T
+        lyr["intermediate"]["bias"] = raw[lp + "intermediate.dense.bias"]
+        lyr["mlp_out"]["kernel"] = raw[lp + "output.dense.weight"].T
+        lyr["mlp_out"]["bias"] = raw[lp + "output.dense.bias"]
+        lyr["ln_mlp"]["scale"] = ln(lp + "output.", "gamma")
+        lyr["ln_mlp"]["bias"] = ln(lp + "output.", "beta")
+        i += 1
+    return p

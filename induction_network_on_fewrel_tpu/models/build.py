@@ -37,9 +37,10 @@ def build_model(
             hidden_size=cfg.bert_hidden,
             num_heads=cfg.bert_heads,
             intermediate_size=cfg.bert_intermediate,
-            vocab_size=cfg.vocab_size,
+            vocab_size=cfg.bert_vocab_size,
             max_length=cfg.max_length,
             frozen=cfg.bert_frozen,
+            remat=cfg.bert_remat,
             compute_dtype=dtype,
         )
     else:

@@ -118,17 +118,21 @@ class InductionNetwork(nn.Module):
         return enc.reshape(*lead, -1)
 
     def __call__(self, support: dict[str, Any], query: dict[str, Any]) -> jnp.ndarray:
-        sup_enc = self.encode(
-            support["word"], support["pos1"], support["pos2"], support["mask"]
-        )                                                   # [B, N, K, H]
-        qry_enc = self.encode(
-            query["word"], query["pos1"], query["pos2"], query["mask"]
-        )                                                   # [B, TQ, H]
-        class_vec = self.induction(sup_enc)                 # [B, N, C]
-        # Queries go through the same learned transform family as support
-        # (W_s analog) so the NTN compares like with like.
-        qry_c = self.query_proj(qry_enc)                    # [B, TQ, C]
-        logits = self.relation(class_vec, qry_c)            # [B, TQ, N]
+        # named_scope: HLO ops attribute to stages in profiler traces.
+        with jax.named_scope("encoder"):
+            sup_enc = self.encode(
+                support["word"], support["pos1"], support["pos2"], support["mask"]
+            )                                               # [B, N, K, H]
+            qry_enc = self.encode(
+                query["word"], query["pos1"], query["pos2"], query["mask"]
+            )                                               # [B, TQ, H]
+        with jax.named_scope("induction"):
+            class_vec = self.induction(sup_enc)             # [B, N, C]
+        with jax.named_scope("relation"):
+            # Queries go through the same learned transform family as support
+            # (W_s analog) so the NTN compares like with like.
+            qry_c = self.query_proj(qry_enc)                # [B, TQ, C]
+            logits = self.relation(class_vec, qry_c)        # [B, TQ, N]
         if self.nota:
             B, TQ, _ = logits.shape
             na = jnp.broadcast_to(

@@ -20,7 +20,10 @@ class CheckpointManager:
     def __init__(self, ckpt_dir: str | Path, cfg: ExperimentConfig, max_to_keep: int = 3):
         self.dir = Path(ckpt_dir).absolute()
         self.dir.mkdir(parents=True, exist_ok=True)
-        (self.dir / "config.json").write_text(cfg.to_json())
+        # Never clobber an existing config: restoring from a dir must not
+        # rewrite the architecture record of the weights stored there.
+        if not (self.dir / "config.json").exists():
+            (self.dir / "config.json").write_text(cfg.to_json())
         self.mngr = ocp.CheckpointManager(
             self.dir,
             options=ocp.CheckpointManagerOptions(
@@ -52,4 +55,7 @@ class CheckpointManager:
 
     @staticmethod
     def load_config(ckpt_dir: str | Path) -> ExperimentConfig:
-        return ExperimentConfig.from_json((Path(ckpt_dir) / "config.json").read_text())
+        path = Path(ckpt_dir) / "config.json"
+        if not path.exists():
+            raise FileNotFoundError(f"no config.json in {ckpt_dir}")
+        return ExperimentConfig.from_json(path.read_text())

@@ -1,0 +1,44 @@
+"""Debug-mode sanitizers (SURVEY.md §5.2).
+
+XLA programs are data-race-free by construction, so the reference-parity
+"sanitizer" story on device reduces to numeric checking: ``checkify_step``
+wraps a jitted step with ``jax.experimental.checkify`` NaN/index/div checks
+(debug runs only — it costs a fused-kernel boundary); ``assert_all_finite``
+is a cheap post-hoc host check for metrics dicts.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax.experimental import checkify
+
+
+def checkify_step(step_fn):
+    """Wrap a step fn; returns (err, out) semantics folded into an exception.
+
+    Usage (debug only):
+        step = checkify_step(make_train_step(model, cfg))
+        state, metrics = step(state, sup, qry, label)  # raises on NaN/OOB
+    """
+    checked = checkify.checkify(
+        step_fn, errors=checkify.float_checks | checkify.index_checks
+    )
+
+    def wrapped(*args, **kw):
+        err, out = checked(*args, **kw)
+        checkify.check_error(err)
+        return out
+
+    return wrapped
+
+
+def assert_all_finite(metrics: dict, step: int | None = None) -> None:
+    bad = {
+        k: float(v)
+        for k, v in jax.device_get(metrics).items()
+        if not math.isfinite(float(v))
+    }
+    if bad:
+        raise FloatingPointError(f"non-finite metrics at step {step}: {bad}")

@@ -1,0 +1,42 @@
+"""Tracing / profiling utilities (SURVEY.md §5.1).
+
+The reference has no profiling beyond wall-clock prints. Here:
+
+* ``trace(logdir)`` — context manager around ``jax.profiler`` producing a
+  TensorBoard-loadable XPlane trace of device execution.
+* ``timed_call`` — block_until_ready-based step timing for honest
+  wall-clock numbers under async dispatch.
+* ``annotate`` — ``jax.named_scope`` wrapper; the model's encoder /
+  induction / relation stages are annotated so HLO ops attribute to stages
+  in the profile.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+annotate = jax.named_scope
+
+
+def timed_call(fn, *args, **kw):
+    """Run ``fn`` and return ``(out, seconds)`` with the clock stopped only
+    after ``jax.block_until_ready(out)`` — honest device time under async
+    dispatch, not dispatch time."""
+    t0 = time.monotonic()
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    return out, time.monotonic() - t0

@@ -1,0 +1,31 @@
+"""Aux subsystems: checkify sanitizer, finite assertion, profiling timer."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from induction_network_on_fewrel_tpu.utils.debug import assert_all_finite, checkify_step
+from induction_network_on_fewrel_tpu.utils.profiling import timed_call
+
+
+def test_checkify_catches_nan():
+    def bad_step(x):
+        return jnp.log(x)  # NaN for negative input
+
+    checked = checkify_step(bad_step)
+    out = checked(jnp.asarray(4.0))
+    np.testing.assert_allclose(float(out), np.log(4.0), rtol=1e-6)
+    with pytest.raises(Exception, match="nan"):
+        checked(jnp.asarray(-1.0))
+
+
+def test_assert_all_finite():
+    assert_all_finite({"loss": jnp.asarray(1.0)})
+    with pytest.raises(FloatingPointError, match="loss"):
+        assert_all_finite({"loss": jnp.asarray(float("nan"))}, step=7)
+
+
+def test_timed_call():
+    out, dt = timed_call(lambda: (jnp.ones((64, 64)) @ jnp.ones((64, 64))).sum())
+    np.testing.assert_allclose(float(out), 64.0 * 64 * 64)
+    assert dt > 0.0
