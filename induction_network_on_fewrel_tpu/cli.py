@@ -60,6 +60,13 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--test_file", default=None)
     p.add_argument("--glove", default=None, help="GloVe json (word2id or combined)")
     p.add_argument("--glove_mat", default=None, help=".npy matrix for word2id json")
+    # host data pipeline
+    p.add_argument(
+        "--sampler", default="auto", choices=["auto", "native", "python"],
+        help="episode sampler backend: native = C++ prefetching pipeline",
+    )
+    p.add_argument("--prefetch", type=int, default=4, help="native sampler ring-buffer depth (0 = sync)")
+    p.add_argument("--sampler_threads", type=int, default=2, help="native sampler worker threads")
     # device / parallelism
     p.add_argument("--device", default="tpu", choices=["tpu", "cpu"])
     p.add_argument("--dp", type=int, default=0, help="data-parallel mesh axis (0 = all devices)")
@@ -98,6 +105,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         val_iter=val_iter, val_step=val_step, test_iter=args.test_iter,
         device=args.device, compute_dtype=compute, seed=args.seed,
         dp=args.dp, tp=args.tp,
+        sampler=args.sampler, prefetch=args.prefetch,
+        sampler_threads=args.sampler_threads,
     )
 
 
@@ -157,7 +166,7 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         make_sharded_train_step,
         maybe_initialize_distributed,
     )
-    from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+    from induction_network_on_fewrel_tpu.native import make_sampler
     from induction_network_on_fewrel_tpu.train import FewShotTrainer
     from induction_network_on_fewrel_tpu.train.steps import init_state
     from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
@@ -180,13 +189,17 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
     else:
         vocab = load_vocab(args, cfg)
         tok = GloveTokenizer(vocab, max_length=cfg.max_length)
-    train_sampler = EpisodeSampler(
+    train_sampler = make_sampler(
         train_ds, tok, cfg.train_n, cfg.k, cfg.q, cfg.batch_size,
-        na_rate=cfg.na_rate, seed=cfg.seed,
+        na_rate=cfg.na_rate, seed=cfg.seed, backend=cfg.sampler,
+        prefetch=cfg.prefetch, num_threads=cfg.sampler_threads,
     )
-    val_sampler = EpisodeSampler(
+    val_sampler = make_sampler(
         val_ds, tok, cfg.n, cfg.k, cfg.q, cfg.batch_size,
-        na_rate=cfg.na_rate, seed=cfg.seed + 1,
+        na_rate=cfg.na_rate, seed=cfg.seed + 1, backend=cfg.sampler,
+        # eval is bursty: a deep prefetch queue would waste work between
+        # val windows, so the val sampler stays synchronous
+        prefetch=0, num_threads=1,
     )
     model = build_model(cfg, glove_init=vocab.vectors if vocab is not None else None)
 
@@ -223,12 +236,13 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
 
 
 def make_test_sampler(args, cfg: ExperimentConfig, tok):
-    from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+    from induction_network_on_fewrel_tpu.native import make_sampler
 
     test_ds = load_data(args, cfg, "test")
-    return EpisodeSampler(
+    return make_sampler(
         test_ds, tok, cfg.n, cfg.k, cfg.q, cfg.batch_size,
-        na_rate=cfg.na_rate, seed=cfg.seed + 2,
+        na_rate=cfg.na_rate, seed=cfg.seed + 2, backend=cfg.sampler,
+        prefetch=0, num_threads=1,
     )
 
 

@@ -74,6 +74,11 @@ class ExperimentConfig:
     dp: int = 1               # data-parallel mesh axis (episodes sharded)
     tp: int = 1               # tensor-parallel mesh axis (NTN slices / hidden)
 
+    # --- host data pipeline ---
+    sampler: str = "auto"     # auto | native (C++ prefetching) | python
+    prefetch: int = 4         # native ring-buffer depth (0 = synchronous)
+    sampler_threads: int = 2  # native worker threads
+
     @property
     def total_q(self) -> int:
         """Queries per episode including NOTA negatives (static shape)."""

@@ -1,0 +1,30 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference keeps all host-side work in Python (torch DataLoader workers,
+SURVEY.md §2.3 records no native components). On TPU that is the wrong
+trade: a single v5e consumes episode batches faster than a Python loop can
+assemble them, so batch assembly is native here — ``native/episode_sampler.cpp``
+compiled on demand with g++ into a cached shared library.
+
+Everything degrades gracefully: if no C++ toolchain is available the public
+constructors raise ``NativeUnavailable`` and callers fall back to the pure
+numpy sampler (``sampling/episodes.py``), which is semantically identical.
+"""
+
+from induction_network_on_fewrel_tpu.native.lib import (
+    NativeUnavailable,
+    load_native_lib,
+    native_available,
+)
+from induction_network_on_fewrel_tpu.native.sampler import (
+    NativeEpisodeSampler,
+    make_sampler,
+)
+
+__all__ = [
+    "NativeUnavailable",
+    "load_native_lib",
+    "native_available",
+    "NativeEpisodeSampler",
+    "make_sampler",
+]

@@ -1,0 +1,101 @@
+"""Build + load the native episode-sampler shared library.
+
+The C++ source lives at ``native/episode_sampler.cpp`` (repo root). It is
+compiled once per source-hash into ``~/.cache/induction_network_tpu/`` and
+loaded with ctypes; no pybind11/setuptools machinery is needed for a
+C-ABI-only surface (environment has g++ but not pybind11).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_SOURCE = _REPO_ROOT / "native" / "episode_sampler.cpp"
+_CACHE_DIR = Path(
+    os.environ.get("INDUCTION_TPU_NATIVE_CACHE")
+    or Path.home() / ".cache" / "induction_network_tpu"
+)
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_error: Exception | None = None
+
+
+class NativeUnavailable(RuntimeError):
+    """The native library could not be built/loaded on this machine."""
+
+
+def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.inf_sampler_create.restype = ctypes.c_void_p
+    lib.inf_sampler_create.argtypes = [
+        i32p, i32p, i32p, f32p, i64p,
+        ctypes.c_int64,  # num_relations
+        ctypes.c_int32,  # L
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,  # n, k, q
+        ctypes.c_int32, ctypes.c_int32,  # na_rate, batch_size
+        ctypes.c_uint64,  # seed
+    ]
+    lib.inf_sampler_destroy.argtypes = [ctypes.c_void_p]
+    batch_args = [ctypes.c_void_p] + [i32p, i32p, i32p, f32p] * 2 + [i32p]
+    lib.inf_sampler_sample.argtypes = batch_args
+    lib.inf_pipeline_create.restype = ctypes.c_void_p
+    lib.inf_pipeline_create.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32
+    ]
+    lib.inf_pipeline_next.argtypes = batch_args
+    lib.inf_pipeline_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _build() -> Path:
+    src = _SOURCE.read_bytes()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = _CACHE_DIR / f"episode_sampler_{tag}.so"
+    if out.exists():
+        return out
+    _CACHE_DIR.mkdir(parents=True, exist_ok=True)
+    tmp = out.with_suffix(f".tmp{os.getpid()}.so")
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-o", str(tmp), str(_SOURCE),
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    if proc.returncode != 0:
+        raise NativeUnavailable(
+            f"g++ failed ({proc.returncode}):\n{proc.stderr[-2000:]}"
+        )
+    os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    return out
+
+
+def load_native_lib() -> ctypes.CDLL:
+    """Build (if needed) and load the library; cached per process."""
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_error is not None:
+            raise NativeUnavailable(str(_load_error)) from _load_error
+        try:
+            _lib = _declare(ctypes.CDLL(str(_build())))
+        except Exception as e:  # noqa: BLE001 — record any failure mode
+            _load_error = e
+            raise NativeUnavailable(str(e)) from e
+        return _lib
+
+
+def native_available() -> bool:
+    try:
+        load_native_lib()
+        return True
+    except NativeUnavailable:
+        return False
