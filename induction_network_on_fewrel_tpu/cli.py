@@ -33,6 +33,11 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--max_length", type=int, default=40)
     p.add_argument("--hidden_size", type=int, default=230)
     p.add_argument("--lstm_hidden", type=int, default=128)
+    p.add_argument(
+        "--lstm_backend", default="auto",
+        choices=["auto", "scan", "pallas", "interpret"],
+        help="LSTM recurrence impl: pallas = fused TPU kernel (auto on TPU)",
+    )
     p.add_argument("--induction_dim", type=int, default=100)
     p.add_argument("--routing_iters", type=int, default=3)
     p.add_argument("--ntn_slices", type=int, default=100)
@@ -94,7 +99,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         n=args.N, k=args.K, q=args.Q, na_rate=args.na_rate,
         batch_size=args.batch_size, max_length=args.max_length,
         encoder=args.encoder, hidden_size=args.hidden_size,
-        lstm_hidden=args.lstm_hidden, induction_dim=args.induction_dim,
+        lstm_hidden=args.lstm_hidden, lstm_backend=args.lstm_backend,
+        induction_dim=args.induction_dim,
         routing_iters=args.routing_iters, ntn_slices=args.ntn_slices,
         bert_frozen=args.bert_frozen, bert_layers=args.bert_layers,
         bert_vocab_size=args.bert_vocab_size, bert_vocab_path=args.bert_vocab,
@@ -194,11 +200,15 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         na_rate=cfg.na_rate, seed=cfg.seed, backend=cfg.sampler,
         prefetch=cfg.prefetch, num_threads=cfg.sampler_threads,
     )
+    # Eval streams must be reproducible across machines: under "auto" the
+    # backend would depend on whether a g++ toolchain is present (native and
+    # numpy samplers draw different RNG streams), so eval pins to "python"
+    # unless the user explicitly chose a backend. Synchronous (prefetch=0):
+    # eval is bursty and queued-ahead batches would be wasted work.
+    eval_backend = "python" if cfg.sampler == "auto" else cfg.sampler
     val_sampler = make_sampler(
         val_ds, tok, cfg.n, cfg.k, cfg.q, cfg.batch_size,
-        na_rate=cfg.na_rate, seed=cfg.seed + 1, backend=cfg.sampler,
-        # eval is bursty: a deep prefetch queue would waste work between
-        # val windows, so the val sampler stays synchronous
+        na_rate=cfg.na_rate, seed=cfg.seed + 1, backend=eval_backend,
         prefetch=0, num_threads=1,
     )
     model = build_model(cfg, glove_init=vocab.vectors if vocab is not None else None)
@@ -239,9 +249,11 @@ def make_test_sampler(args, cfg: ExperimentConfig, tok):
     from induction_network_on_fewrel_tpu.native import make_sampler
 
     test_ds = load_data(args, cfg, "test")
+    # Same reproducibility rule as the val sampler: "auto" pins to python.
     return make_sampler(
         test_ds, tok, cfg.n, cfg.k, cfg.q, cfg.batch_size,
-        na_rate=cfg.na_rate, seed=cfg.seed + 2, backend=cfg.sampler,
+        na_rate=cfg.na_rate, seed=cfg.seed + 2,
+        backend="python" if cfg.sampler == "auto" else cfg.sampler,
         prefetch=0, num_threads=1,
     )
 

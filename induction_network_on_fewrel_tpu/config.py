@@ -35,6 +35,7 @@ class ExperimentConfig:
     hidden_size: int = 230    # CNN filters / 2*lstm_hidden for bilstm output
     lstm_hidden: int = 128    # per direction
     att_dim: int = 64         # structured self-attention projection dim
+    lstm_backend: str = "auto"  # auto | scan | pallas | interpret (ops/lstm.py)
     # BERT (built from scratch in models/bert.py; random-init unless weights
     # are found on disk — this sandbox has no network):
     bert_layers: int = 12

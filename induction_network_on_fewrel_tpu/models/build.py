@@ -55,8 +55,16 @@ def build_model(
         if cfg.encoder == "cnn":
             encoder = CNNEncoder(hidden_size=cfg.hidden_size, compute_dtype=dtype)
         elif cfg.encoder == "bilstm":
+            backend = cfg.lstm_backend
+            if backend == "auto":
+                # Pallas kernel on a real TPU; lax.scan elsewhere (the CPU
+                # interpreter is for tests, not throughput).
+                import jax
+
+                backend = "pallas" if jax.default_backend() == "tpu" else "scan"
             encoder = BiLSTMSelfAttnEncoder(
-                lstm_hidden=cfg.lstm_hidden, att_dim=cfg.att_dim, compute_dtype=dtype
+                lstm_hidden=cfg.lstm_hidden, att_dim=cfg.att_dim,
+                lstm_backend=backend, compute_dtype=dtype,
             )
         else:
             raise ValueError(f"unknown encoder {cfg.encoder!r}")

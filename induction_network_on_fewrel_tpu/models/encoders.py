@@ -9,10 +9,13 @@ and MXU-shaped.
   max-pool over time — thunlp defaults, hidden=230.
 * BiLSTM + self-attention (paper §3.1): bidirectional LSTM, then structured
   self-attention ``a = softmax(w2 · tanh(W1 · Hᵀ))``, sentence vector
-  ``e = Σ aₜ hₜ``. The scan serializes over L (≤128 tokens, SURVEY.md §7
-  "hard parts") but each scan step is a fused 4-gate matmul on the MXU; both
-  directions run in a single scan over a stacked/flipped copy so the weights
-  are shared-shape and the kernel count halves.
+  ``e = Σ aₜ hₜ``. TPU decomposition (ops/lstm.py): the input projection is
+  hoisted out of the recurrence into one [M·L, D] x [D, 4u] MXU matmul; only
+  the true recurrence runs per-step — as a ``lax.scan`` or as the fused
+  Pallas kernel that keeps h/c in VMEM for all L steps (``lstm_backend``).
+  Both directions share cell weights and run stacked along the batch axis,
+  so the per-step matmul is twice as tall. The two backends share the same
+  parameters: checkpoints are interchangeable and equality is testable.
 """
 
 from __future__ import annotations
@@ -21,6 +24,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from induction_network_on_fewrel_tpu.ops import masked_max, masked_softmax
+from induction_network_on_fewrel_tpu.ops.lstm import lstm_recurrence
 
 
 class CNNEncoder(nn.Module):
@@ -48,24 +52,36 @@ class CNNEncoder(nn.Module):
 class BiLSTMSelfAttnEncoder(nn.Module):
     lstm_hidden: int = 128   # per direction; output dim is 2*lstm_hidden
     att_dim: int = 64
+    lstm_backend: str = "scan"  # scan | pallas | interpret (ops/lstm.py)
     compute_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, emb: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
         M, L, D = emb.shape
+        u = self.lstm_hidden
         emb = emb.astype(self.compute_dtype)
 
-        # Stack forward and time-reversed sequences along the batch axis and
-        # run ONE scan: same cell weights serve both directions, and the
-        # per-step gate matmul is twice as tall — friendlier to the MXU than
-        # two half-size scans.
+        # Stack forward and time-reversed sequences along the batch axis:
+        # same cell weights serve both directions, and every matmul below is
+        # twice as tall — friendlier to the MXU than two half-size passes.
         rev = jnp.flip(emb, axis=1)
         both = jnp.concatenate([emb, rev], axis=0)  # [2M, L, D]
-        cell = nn.OptimizedLSTMCell(
-            self.lstm_hidden, dtype=self.compute_dtype, param_dtype=jnp.float32
+
+        # Gate order [i, f, g, o] (matches torch.nn.LSTM; golden-tested).
+        w_ih = self.param("w_ih", nn.initializers.lecun_normal(), (D, 4 * u))
+        w_hh = self.param("w_hh", nn.initializers.orthogonal(), (u, 4 * u))
+        # Forget-gate bias starts at 1 so early training doesn't flush the
+        # cell state (standard LSTM practice).
+        b = self.param(
+            "bias",
+            lambda key, shape: jnp.zeros(shape).at[u : 2 * u].set(1.0),
+            (4 * u,),
         )
-        # nn.RNN is flax's lifted lax.scan over the time axis.
-        hs = nn.RNN(cell)(both)                        # [2M, L, u]
+        # Sequential-free input projection: one big MXU matmul over all
+        # timesteps; only the recurrence below runs per-step.
+        xg = both @ w_ih.astype(self.compute_dtype) + b.astype(self.compute_dtype)
+        hs = lstm_recurrence(xg, w_hh, backend=self.lstm_backend)  # [2M, L, u] f32
+        hs = hs.astype(self.compute_dtype)
         h_fwd, h_bwd = hs[:M], jnp.flip(hs[M:], axis=1)
         H = jnp.concatenate([h_fwd, h_bwd], axis=-1)   # [M, L, 2u]
 

@@ -1,0 +1,272 @@
+"""LSTM recurrence: `lax.scan` reference + fused Pallas TPU kernel.
+
+The BiLSTM is the FLOPs-dominant op of the flagship encoder (SURVEY.md §3.2
+"lstm fwd+bwd over L — sequential scan, HOT"). The TPU-shaped decomposition:
+
+1. The input projection ``xg = emb @ W_ih + b`` has no sequential dependency,
+   so it is hoisted OUT of the recurrence into one large [M*L, D] x [D, 4u]
+   MXU matmul that XLA schedules freely.
+2. Only the true recurrence ``a_t = xg_t + h_{t-1} @ W_hh`` runs per-step.
+   The Pallas kernel keeps h/c (and the [u, 4u] recurrent weights) resident
+   in VMEM across the whole time loop — one kernel for all L steps per row
+   tile, instead of L dispatches with h/c bouncing through HBM.
+3. The backward pass is a second Pallas kernel scanning time in reverse,
+   with gate activations saved from the forward pass (trade ~M*L*4u bytes
+   of HBM for re-computing the recurrent matmul).
+
+Gate order is [i, f, g, o] (sigmoid, sigmoid, tanh, sigmoid) — the same
+convention as torch.nn.LSTM, which the golden test exploits. All recurrence
+arithmetic is float32: bf16 cell state drifts over long sequences.
+
+``lstm_recurrence(xg, whh, backend=...)`` selects: "scan" (pure XLA,
+differentiable by tracing), "pallas" (compiled TPU kernel, custom VJP), or
+"interpret" (Pallas interpreter — same kernel code, runs on CPU; used by the
+test suite so the kernel logic is exercised without a chip).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Row-tile size. 128 matches the MXU systolic dimension; smaller inputs are
+# padded up to one tile (fine: the flagship config runs M = 800 rows).
+_TM = 128
+
+
+def _gates(a: jnp.ndarray, u: int):
+    i = jax.nn.sigmoid(a[..., 0 * u : 1 * u])
+    f = jax.nn.sigmoid(a[..., 1 * u : 2 * u])
+    g = jnp.tanh(a[..., 2 * u : 3 * u])
+    o = jax.nn.sigmoid(a[..., 3 * u : 4 * u])
+    return i, f, g, o
+
+
+# ---------------------------------------------------------------------------
+# Reference implementation: lax.scan (differentiable through tracing).
+# ---------------------------------------------------------------------------
+
+
+def lstm_scan(xg: jnp.ndarray, whh: jnp.ndarray) -> jnp.ndarray:
+    """([M, L, 4u] pre-projected inputs, [u, 4u]) -> hidden states [M, L, u].
+
+    Zero initial state; float32 recurrence regardless of input dtype.
+    """
+    M, L, G = xg.shape
+    u = G // 4
+    xg32 = xg.astype(jnp.float32)
+    whh32 = whh.astype(jnp.float32)
+
+    def step(carry, x_t):
+        h, c = carry
+        a = x_t + h @ whh32
+        i, f, g, o = _gates(a, u)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    init = (jnp.zeros((M, u), jnp.float32), jnp.zeros((M, u), jnp.float32))
+    _, hs = jax.lax.scan(step, init, jnp.swapaxes(xg32, 0, 1))  # [L, M, u]
+    return jnp.swapaxes(hs, 0, 1)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels.
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(xg_ref, whh_ref, hs_ref, cs_ref, gates_ref, h_scr, c_scr):
+    t = pl.program_id(1)
+    u = whh_ref.shape[0]
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[...] = jnp.zeros_like(h_scr)
+        c_scr[...] = jnp.zeros_like(c_scr)
+
+    a = xg_ref[:, 0, :] + jnp.dot(
+        h_scr[...], whh_ref[...], preferred_element_type=jnp.float32
+    )
+    i, f, g, o = _gates(a, u)
+    c = f * c_scr[...] + i * g
+    h = o * jnp.tanh(c)
+    h_scr[...] = h
+    c_scr[...] = c
+    hs_ref[:, 0, :] = h
+    cs_ref[:, 0, :] = c
+    gates_ref[:, 0, :] = jnp.concatenate([i, f, g, o], axis=-1)
+
+
+def _bwd_kernel(
+    dhs_ref, gates_ref, cs_ref, cs_prev_ref, hs_prev_ref, whh_ref,
+    dxg_ref, dwhh_ref, dh_scr, dc_scr, dwhh_scr,
+):
+    t = pl.program_id(1)
+    L = pl.num_programs(1)
+    rt = L - 1 - t  # walking time backwards
+    u = whh_ref.shape[0]
+
+    @pl.when(t == 0)
+    def _():
+        dh_scr[...] = jnp.zeros_like(dh_scr)
+        dc_scr[...] = jnp.zeros_like(dc_scr)
+        dwhh_scr[...] = jnp.zeros_like(dwhh_scr)
+
+    gates = gates_ref[:, 0, :]
+    i, f, g, o = (gates[:, k * u : (k + 1) * u] for k in range(4))
+    c_t = cs_ref[:, 0, :]
+    tc = jnp.tanh(c_t)
+    # The rt-1 index maps clamp at 0; mask the rt == 0 step to the true
+    # zero initial state.
+    first = (rt == 0).astype(jnp.float32)
+    c_prev = cs_prev_ref[:, 0, :] * (1.0 - first)
+    h_prev = hs_prev_ref[:, 0, :] * (1.0 - first)
+
+    dh_t = dhs_ref[:, 0, :] + dh_scr[...]
+    da_o = dh_t * tc * o * (1.0 - o)
+    dct = dc_scr[...] + dh_t * o * (1.0 - tc * tc)
+    da_i = dct * g * i * (1.0 - i)
+    da_g = dct * i * (1.0 - g * g)
+    da_f = dct * c_prev * f * (1.0 - f)
+    da = jnp.concatenate([da_i, da_f, da_g, da_o], axis=-1)  # [TM, 4u]
+
+    dxg_ref[:, 0, :] = da
+    dh_scr[...] = jax.lax.dot_general(
+        da, whh_ref[...], (((1,), (1,)), ((), ())),  # da @ whh^T
+        preferred_element_type=jnp.float32,
+    )
+    dc_scr[...] = dct * f
+    dwhh_scr[...] += jax.lax.dot_general(
+        h_prev, da, (((0,), (0,)), ((), ())),  # h_prev^T @ da
+        preferred_element_type=jnp.float32,
+    )
+    dwhh_ref[0] = dwhh_scr[...]
+
+
+def _pad_rows(x: jnp.ndarray, tm: int) -> jnp.ndarray:
+    M = x.shape[0]
+    pad = (-M) % tm
+    return jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1)) if pad else x
+
+
+def _fwd_call(xg: jnp.ndarray, whh: jnp.ndarray, interpret: bool):
+    M, L, G = xg.shape
+    u = G // 4
+    xg32 = _pad_rows(xg.astype(jnp.float32), _TM)
+    Mp = xg32.shape[0]
+    grid = (Mp // _TM, L)
+    out = pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TM, 1, G), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((u, G), lambda i, t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_TM, 1, u), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((_TM, 1, u), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((_TM, 1, G), lambda i, t: (i, t, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Mp, L, u), jnp.float32),  # hs
+            jax.ShapeDtypeStruct((Mp, L, u), jnp.float32),  # cs
+            jax.ShapeDtypeStruct((Mp, L, G), jnp.float32),  # gate activations
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_TM, u), jnp.float32),
+            pltpu.VMEM((_TM, u), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xg32, whh.astype(jnp.float32))
+    hs, cs, gates = out
+    return hs[:M], cs[:M], gates[:M]
+
+
+def _bwd_call(dhs, gates, cs, hs, whh, interpret: bool):
+    M, L, u = dhs.shape
+    G = 4 * u
+    dhs32 = _pad_rows(dhs.astype(jnp.float32), _TM)
+    gates32 = _pad_rows(gates, _TM)
+    cs32 = _pad_rows(cs, _TM)
+    hs32 = _pad_rows(hs, _TM)
+    Mp = dhs32.shape[0]
+    ntiles = Mp // _TM
+    grid = (ntiles, L)
+    rev = lambda i, t: (i, L - 1 - t, 0)           # noqa: E731
+    rev_prev = lambda i, t: (i, max_0(L - 2 - t), 0)  # noqa: E731
+    dxg, dwhh_p = pl.pallas_call(
+        _bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TM, 1, u), rev),       # dhs
+            pl.BlockSpec((_TM, 1, G), rev),       # gates
+            pl.BlockSpec((_TM, 1, u), rev),       # cs
+            pl.BlockSpec((_TM, 1, u), rev_prev),  # cs_{t-1} (clamped)
+            pl.BlockSpec((_TM, 1, u), rev_prev),  # hs_{t-1} (clamped)
+            pl.BlockSpec((u, G), lambda i, t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_TM, 1, G), rev),
+            pl.BlockSpec((1, u, G), lambda i, t: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Mp, L, G), jnp.float32),
+            jax.ShapeDtypeStruct((ntiles, u, G), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_TM, u), jnp.float32),
+            pltpu.VMEM((_TM, u), jnp.float32),
+            pltpu.VMEM((u, G), jnp.float32),
+        ],
+        interpret=interpret,
+        # cs appears twice: once at rt, once at rt-1 (separate index maps).
+    )(dhs32, gates32, cs32, cs32, hs32, whh.astype(jnp.float32))
+    return dxg[:M], dwhh_p.sum(axis=0)
+
+
+def max_0(v):
+    """Clamp a (possibly traced) index to >= 0 for prev-step block maps."""
+    return jnp.maximum(v, 0)
+
+
+# The custom-VJP function is float32-in/float32-out; lstm_recurrence casts
+# at the boundary, so autodiff transposes those casts and the residual tree
+# stays arrays-only.
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _lstm_pallas(xg, whh, interpret=False):
+    return _fwd_call(xg, whh, interpret)[0]
+
+
+def _lstm_pallas_fwd(xg, whh, interpret):
+    hs, cs, gates = _fwd_call(xg, whh, interpret)
+    return hs, (hs, cs, gates, whh)
+
+
+def _lstm_pallas_bwd(interpret, res, dhs):
+    hs, cs, gates, whh = res
+    return _bwd_call(dhs, gates, cs, hs, whh, interpret)
+
+
+_lstm_pallas.defvjp(_lstm_pallas_fwd, _lstm_pallas_bwd)
+
+
+def lstm_recurrence(
+    xg: jnp.ndarray, whh: jnp.ndarray, backend: str = "scan"
+) -> jnp.ndarray:
+    """Run the LSTM recurrence over pre-projected gate inputs.
+
+    backend: "scan" (XLA reference) | "pallas" (compiled TPU kernel) |
+    "interpret" (Pallas interpreter, any backend — used in tests).
+    Output is float32 [M, L, u].
+    """
+    if backend == "scan":
+        return lstm_scan(xg, whh)
+    if backend == "pallas":
+        return _lstm_pallas(xg.astype(jnp.float32), whh.astype(jnp.float32), False)
+    if backend == "interpret":
+        return _lstm_pallas(xg.astype(jnp.float32), whh.astype(jnp.float32), True)
+    raise ValueError(f"unknown lstm backend {backend!r}")

@@ -15,11 +15,37 @@ import orbax.checkpoint as ocp
 
 from induction_network_on_fewrel_tpu.config import ExperimentConfig
 
+# Parameter-tree layout version, stored next to config.json. Bump whenever a
+# module's param structure changes incompatibly so restores fail with THIS
+# message instead of an opaque orbax tree mismatch.
+#   v2: BiLSTM params became explicit w_ih/w_hh/bias (ops/lstm.py backends)
+#       instead of flax RNN/OptimizedLSTMCell's nested tree.
+FORMAT_VERSION = 2
+
 
 class CheckpointManager:
     def __init__(self, ckpt_dir: str | Path, cfg: ExperimentConfig, max_to_keep: int = 3):
         self.dir = Path(ckpt_dir).absolute()
         self.dir.mkdir(parents=True, exist_ok=True)
+        version_file = self.dir / "format_version"
+        has_steps = any(
+            p.name.isdigit() for p in self.dir.iterdir() if p.is_dir()
+        )
+        if version_file.exists() or has_steps:
+            # A populated dir without a version file predates versioning: v1.
+            stored = (
+                int(version_file.read_text().strip() or 0)
+                if version_file.exists() else 1
+            )
+            if stored != FORMAT_VERSION:
+                raise ValueError(
+                    f"checkpoint dir {self.dir} has param-tree format "
+                    f"v{stored}, this build writes v{FORMAT_VERSION}; "
+                    f"retrain or convert the checkpoint (param layouts "
+                    f"changed incompatibly between these versions)"
+                )
+        else:
+            version_file.write_text(str(FORMAT_VERSION))
         # Never clobber an existing config: restoring from a dir must not
         # rewrite the architecture record of the weights stored there.
         if not (self.dir / "config.json").exists():

@@ -1,0 +1,102 @@
+"""LSTM recurrence: Pallas kernel (interpret mode) vs lax.scan reference vs
+a torch.nn.LSTM golden twin (SURVEY.md §4.1/§4.2).
+
+The Pallas kernel runs here through the interpreter (no chip needed), so the
+exact kernel code that compiles on TPU is what gets checked — forward AND the
+custom-VJP backward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from induction_network_on_fewrel_tpu.ops.lstm import lstm_recurrence, lstm_scan
+
+M, L, D, U = 10, 7, 12, 16  # deliberately NOT tile-aligned (exercises padding)
+
+
+@pytest.fixture(scope="module")
+def inputs():
+    rng = np.random.default_rng(0)
+    xg = rng.normal(size=(M, L, 4 * U)).astype(np.float32) * 0.5
+    whh = (rng.normal(size=(U, 4 * U)) / np.sqrt(U)).astype(np.float32)
+    return jnp.asarray(xg), jnp.asarray(whh)
+
+
+def test_forward_parity_scan_vs_pallas(inputs):
+    xg, whh = inputs
+    hs_scan = lstm_scan(xg, whh)
+    hs_pl = lstm_recurrence(xg, whh, backend="interpret")
+    assert hs_pl.shape == (M, L, U)
+    np.testing.assert_allclose(np.asarray(hs_scan), np.asarray(hs_pl), atol=1e-5)
+
+
+def test_backward_parity_scan_vs_pallas(inputs):
+    xg, whh = inputs
+    rng = np.random.default_rng(1)
+    ct = jnp.asarray(rng.normal(size=(M, L, U)).astype(np.float32))
+
+    def loss(fn):
+        return lambda xg_, whh_: jnp.sum(fn(xg_, whh_) * ct)
+
+    g_scan = jax.grad(loss(lstm_scan), argnums=(0, 1))(xg, whh)
+    g_pl = jax.grad(
+        loss(lambda a, b: lstm_recurrence(a, b, backend="interpret")),
+        argnums=(0, 1),
+    )(xg, whh)
+    np.testing.assert_allclose(np.asarray(g_scan[0]), np.asarray(g_pl[0]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g_scan[1]), np.asarray(g_pl[1]), atol=1e-4)
+
+
+def test_golden_torch_lstm(inputs):
+    """lstm_scan == torch.nn.LSTM with the same weights (gate order i,f,g,o)."""
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(M, L, D)).astype(np.float32)
+    w_ih = (rng.normal(size=(D, 4 * U)) / np.sqrt(D)).astype(np.float32)
+    w_hh = (rng.normal(size=(U, 4 * U)) / np.sqrt(U)).astype(np.float32)
+    b = rng.normal(size=(4 * U,)).astype(np.float32)
+
+    xg = jnp.asarray(x) @ jnp.asarray(w_ih) + jnp.asarray(b)
+    hs_j = np.asarray(lstm_scan(xg, jnp.asarray(w_hh)))
+
+    lstm = torch.nn.LSTM(D, U, batch_first=True)
+    with torch.no_grad():
+        lstm.weight_ih_l0.copy_(torch.tensor(w_ih.T))  # torch: [4u, D]
+        lstm.weight_hh_l0.copy_(torch.tensor(w_hh.T))
+        lstm.bias_ih_l0.copy_(torch.tensor(b))
+        lstm.bias_hh_l0.zero_()
+        hs_t, _ = lstm(torch.tensor(x))
+    np.testing.assert_allclose(hs_j, hs_t.numpy(), atol=1e-5)
+
+
+def test_encoder_backend_equivalence():
+    """Same params -> same encoder output for scan and pallas backends
+    (checkpoints are interchangeable across lstm_backend settings)."""
+    from induction_network_on_fewrel_tpu.models.encoders import (
+        BiLSTMSelfAttnEncoder,
+    )
+
+    rng = np.random.default_rng(3)
+    emb = jnp.asarray(rng.normal(size=(6, L, D)).astype(np.float32))
+    mask = jnp.asarray((rng.random((6, L)) > 0.2).astype(np.float32).copy())
+    mask = mask.at[:, 0].set(1.0)
+
+    enc_scan = BiLSTMSelfAttnEncoder(lstm_hidden=U, att_dim=8, lstm_backend="scan")
+    enc_pl = BiLSTMSelfAttnEncoder(
+        lstm_hidden=U, att_dim=8, lstm_backend="interpret"
+    )
+    params = enc_scan.init(jax.random.key(0), emb, mask)
+    out_scan = enc_scan.apply(params, emb, mask)
+    out_pl = enc_pl.apply(params, emb, mask)
+    assert out_scan.shape == (6, 2 * U)
+    np.testing.assert_allclose(
+        np.asarray(out_scan), np.asarray(out_pl), atol=1e-5
+    )
+
+
+def test_unknown_backend(inputs):
+    xg, whh = inputs
+    with pytest.raises(ValueError):
+        lstm_recurrence(xg, whh, backend="cuda")

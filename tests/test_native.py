@@ -8,6 +8,8 @@ Generator vs xoshiro), so parity is on SEMANTICS (composition, labeling,
 disjointness), not bitwise batches.
 """
 
+import shutil
+
 import numpy as np
 import pytest
 
@@ -19,12 +21,15 @@ from induction_network_on_fewrel_tpu.data import (
 from induction_network_on_fewrel_tpu.native import (
     NativeEpisodeSampler,
     make_sampler,
-    native_available,
 )
 from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
 
+# Skip ONLY when no compiler exists at all (e.g. a stripped runtime image).
+# With g++ present, a broken native build must FAIL the tests, not skip them
+# — load_native_lib() raising inside the tests surfaces the compile error.
+# (shutil.which is cheap, so collection doesn't trigger a build.)
 pytestmark = pytest.mark.skipif(
-    not native_available(), reason="no C++ toolchain for the native sampler"
+    shutil.which("g++") is None, reason="no C++ toolchain on PATH"
 )
 
 N, K, Q, L, B = 5, 2, 3, 16, 2

@@ -82,3 +82,30 @@ def test_ce_loss_also_trains():
     state = trainer.train(num_iters=100)
     acc = trainer.evaluate(state.params, num_episodes=20, sampler=sampler)
     assert acc > 0.8, f"ce accuracy {acc}"
+
+
+def test_checkpoint_format_version_guard(tmp_path):
+    """A populated ckpt dir from an older param-tree layout must fail with a
+    clear versioning error, not an opaque orbax tree mismatch."""
+    import pytest
+
+    from induction_network_on_fewrel_tpu.train.checkpoint import (
+        FORMAT_VERSION,
+        CheckpointManager,
+    )
+
+    cfg = ExperimentConfig(encoder="cnn", vocab_size=102)
+    d = tmp_path / "ck"
+    CheckpointManager(d, cfg)  # fresh dir: stamps the current version
+    assert (d / "format_version").read_text() == str(FORMAT_VERSION)
+    CheckpointManager(d, cfg)  # same version: fine
+
+    (d / "format_version").write_text("1")
+    with pytest.raises(ValueError, match="format"):
+        CheckpointManager(d, cfg)
+
+    # Pre-versioning dir: has step dirs but no version file -> treated as v1.
+    legacy = tmp_path / "legacy"
+    (legacy / "7").mkdir(parents=True)
+    with pytest.raises(ValueError, match="format"):
+        CheckpointManager(legacy, cfg)
