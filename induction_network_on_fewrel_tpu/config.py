@@ -30,6 +30,10 @@ class ExperimentConfig:
     pos_dim: int = 5          # each of the two position embeddings
     vocab_size: int = 400002  # GloVe 400k + [UNK] + [BLANK]; synthetic is small
 
+    # --- few-shot model (reference flag --model) ---
+    model: str = "induction"  # induction | proto
+    proto_metric: str = "euclid"  # euclid | dot (proto only)
+
     # --- encoder ---
     encoder: str = "bilstm"   # cnn | bilstm | bert
     hidden_size: int = 230    # CNN filters / 2*lstm_hidden for bilstm output
@@ -94,6 +98,7 @@ class ExperimentConfig:
     # load it); everything else is runtime/episode geometry a user may vary
     # at eval time. test.py merges these from the checkpoint's config.json.
     ARCHITECTURE_FIELDS = (
+        "model", "proto_metric",
         "encoder", "hidden_size", "lstm_hidden", "att_dim", "word_dim",
         "pos_dim", "vocab_size", "max_length", "induction_dim",
         "routing_iters", "ntn_slices", "bert_layers", "bert_hidden",

@@ -3,10 +3,14 @@ from induction_network_on_fewrel_tpu.models.encoders import (  # noqa: F401
     BiLSTMSelfAttnEncoder,
     CNNEncoder,
 )
+from induction_network_on_fewrel_tpu.models.base import FewShotModel  # noqa: F401
 from induction_network_on_fewrel_tpu.models.induction import (  # noqa: F401
     Induction,
     InductionNetwork,
     RelationNTN,
+)
+from induction_network_on_fewrel_tpu.models.proto import (  # noqa: F401
+    PrototypicalNetwork,
 )
 from induction_network_on_fewrel_tpu.models.losses import (  # noqa: F401
     accuracy,

@@ -1,0 +1,60 @@
+"""Shared base for few-shot episode models.
+
+Every few-shot model in the toolkit family (SURVEY.md §2.1 "Few-shot model":
+``models/induction.py`` plus siblings like ``proto.py``) exposes the same
+surface: ``__call__(support, query) -> logits [B, TQ, N(+1)]`` where support /
+query are dicts of ``{word, pos1, pos2, mask}`` int arrays. The base class
+holds the encoder plumbing (token features -> sentence vectors via the shared
+embedding + encoder modules) and the NOTA head (a learned none-of-the-above
+threshold logit appended as class N — static shapes per compile, SURVEY.md §7
+"NOTA"), so each concrete model only implements its episode-level math.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class FewShotModel(nn.Module):
+    """Base: encoder plumbing + NOTA logit for episode models.
+
+    Subclasses implement ``__call__(support, query) -> logits`` and call
+    ``self.encode`` / ``self.append_nota`` for the shared pieces.
+    """
+
+    embedding: nn.Module
+    encoder: nn.Module
+    nota: bool = False
+    compute_dtype: jnp.dtype = jnp.float32
+
+    def encode(self, word, pos1, pos2, mask) -> jnp.ndarray:
+        """[..., L] token features -> [..., H] sentence vectors."""
+        lead = word.shape[:-1]
+        L = word.shape[-1]
+        flat = lambda x: x.reshape(-1, L)
+        emb = self.embedding(flat(word), flat(pos1), flat(pos2))
+        enc = self.encoder(emb, flat(mask))
+        return enc.reshape(*lead, -1)
+
+    def encode_episode(self, support, query) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """(support dict, query dict) -> ([B,N,K,H], [B,TQ,H]) encodings."""
+        sup_enc = self.encode(
+            support["word"], support["pos1"], support["pos2"], support["mask"]
+        )
+        qry_enc = self.encode(
+            query["word"], query["pos1"], query["pos2"], query["mask"]
+        )
+        return sup_enc, qry_enc
+
+    def append_nota(self, logits: jnp.ndarray) -> jnp.ndarray:
+        """Append the learned NOTA threshold logit as class N (if enabled)."""
+        if not self.nota:
+            return logits
+        B, TQ, _ = logits.shape
+        na = jnp.broadcast_to(self.nota_logit.astype(logits.dtype), (B, TQ, 1))
+        return jnp.concatenate([logits, na], axis=-1)
+
+    def make_nota_param(self):
+        if self.nota:
+            self.nota_logit = self.param("nota_logit", nn.initializers.zeros, (1,))

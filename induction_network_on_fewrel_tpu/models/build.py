@@ -69,15 +69,31 @@ def build_model(
         else:
             raise ValueError(f"unknown encoder {cfg.encoder!r}")
 
-    return InductionNetwork(
-        embedding=embedding,
-        encoder=encoder,
-        induction_dim=cfg.induction_dim,
-        routing_iters=cfg.routing_iters,
-        ntn_slices=cfg.ntn_slices,
-        nota=cfg.na_rate > 0,
-        compute_dtype=dtype,
-    )
+    if cfg.model == "induction":
+        return InductionNetwork(
+            embedding=embedding,
+            encoder=encoder,
+            induction_dim=cfg.induction_dim,
+            routing_iters=cfg.routing_iters,
+            ntn_slices=cfg.ntn_slices,
+            nota=cfg.na_rate > 0,
+            compute_dtype=dtype,
+        )
+    if cfg.model == "proto":
+        from induction_network_on_fewrel_tpu.models.proto import (
+            PrototypicalNetwork,
+        )
+
+        if cfg.proto_metric not in ("euclid", "dot"):
+            raise ValueError(f"unknown proto metric {cfg.proto_metric!r}")
+        return PrototypicalNetwork(
+            embedding=embedding,
+            encoder=encoder,
+            nota=cfg.na_rate > 0,
+            compute_dtype=dtype,
+            metric=cfg.proto_metric,
+        )
+    raise ValueError(f"unknown model {cfg.model!r}")
 
 
 def batch_to_model_inputs(batch) -> tuple[dict, dict, jnp.ndarray]:

@@ -28,6 +28,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from induction_network_on_fewrel_tpu.models.base import FewShotModel
 from induction_network_on_fewrel_tpu.ops import squash
 
 
@@ -80,22 +81,16 @@ class RelationNTN(nn.Module):
         return out[..., 0]  # [B, TQ, N]
 
 
-class InductionNetwork(nn.Module):
+class InductionNetwork(FewShotModel):
     """Full few-shot model: encoder -> induction -> relation scoring.
 
     ``forward(support, query) -> logits [B, TQ, num_classes]`` where
-    num_classes = N (+1 when NOTA is active: the none-of-the-above logit is a
-    learned threshold against which real-class logits compete in softmax/MSE
-    space — static shapes per compile, SURVEY.md §7 "NOTA").
+    num_classes = N (+1 when NOTA is active — see FewShotModel.append_nota).
     """
 
-    embedding: nn.Module
-    encoder: nn.Module
     induction_dim: int = 100
     routing_iters: int = 3
     ntn_slices: int = 100
-    nota: bool = False
-    compute_dtype: jnp.dtype = jnp.float32
 
     def setup(self):
         self.induction = Induction(
@@ -105,27 +100,12 @@ class InductionNetwork(nn.Module):
         self.query_proj = nn.Dense(
             self.induction_dim, dtype=self.compute_dtype, param_dtype=jnp.float32
         )
-        if self.nota:
-            self.nota_logit = self.param("nota_logit", nn.initializers.zeros, (1,))
-
-    def encode(self, word, pos1, pos2, mask) -> jnp.ndarray:
-        """[..., L] token features -> [..., H] sentence vectors."""
-        lead = word.shape[:-1]
-        L = word.shape[-1]
-        flat = lambda x: x.reshape(-1, L)
-        emb = self.embedding(flat(word), flat(pos1), flat(pos2))
-        enc = self.encoder(emb, flat(mask))
-        return enc.reshape(*lead, -1)
+        self.make_nota_param()
 
     def __call__(self, support: dict[str, Any], query: dict[str, Any]) -> jnp.ndarray:
         # named_scope: HLO ops attribute to stages in profiler traces.
         with jax.named_scope("encoder"):
-            sup_enc = self.encode(
-                support["word"], support["pos1"], support["pos2"], support["mask"]
-            )                                               # [B, N, K, H]
-            qry_enc = self.encode(
-                query["word"], query["pos1"], query["pos2"], query["mask"]
-            )                                               # [B, TQ, H]
+            sup_enc, qry_enc = self.encode_episode(support, query)
         with jax.named_scope("induction"):
             class_vec = self.induction(sup_enc)             # [B, N, C]
         with jax.named_scope("relation"):
@@ -133,10 +113,5 @@ class InductionNetwork(nn.Module):
             # (W_s analog) so the NTN compares like with like.
             qry_c = self.query_proj(qry_enc)                # [B, TQ, C]
             logits = self.relation(class_vec, qry_c)        # [B, TQ, N]
-        if self.nota:
-            B, TQ, _ = logits.shape
-            na = jnp.broadcast_to(
-                self.nota_logit.astype(logits.dtype), (B, TQ, 1)
-            )
-            logits = jnp.concatenate([logits, na], axis=-1)  # [B, TQ, N+1]
+        logits = self.append_nota(logits)                   # [B, TQ, N(+1)]
         return logits.astype(jnp.float32)

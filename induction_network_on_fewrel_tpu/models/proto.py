@@ -1,0 +1,48 @@
+"""Prototypical network — the classic toolkit sibling of the induction model.
+
+Toolkit-family repos ship an induction model alongside Snell et al. (2017)
+prototypical networks (SURVEY.md §2.1 "Few-shot model": ``models/induction.py``
+(+ siblings like ``proto.py`` in toolkit forks)). Episode math:
+
+* prototype per class = mean of the K support encodings:  p_i = mean_j e_ij
+* query logit for class i = similarity(q, p_i):
+    - ``euclid`` (toolkit default): -‖q - p_i‖²
+    - ``dot``: q · p_i
+
+TPU notes: the distance expands to one batched matmul plus two squared-norm
+reductions (‖q-p‖² = ‖q‖² - 2 q·p + ‖p‖²), so the hot op is a single MXU
+contraction over the hidden axis; everything else fuses into it.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from induction_network_on_fewrel_tpu.models.base import FewShotModel
+
+
+class PrototypicalNetwork(FewShotModel):
+    metric: str = "euclid"  # euclid | dot
+
+    def setup(self):
+        self.make_nota_param()
+
+    def __call__(self, support: dict[str, Any], query: dict[str, Any]) -> jnp.ndarray:
+        with jax.named_scope("encoder"):
+            sup_enc, qry_enc = self.encode_episode(support, query)
+        with jax.named_scope("proto"):
+            proto = jnp.mean(sup_enc, axis=2)                    # [B, N, H]
+            dots = jnp.einsum("bqh,bnh->bqn", qry_enc, proto)    # MXU contraction
+            if self.metric == "dot":
+                logits = dots
+            elif self.metric == "euclid":
+                q2 = jnp.sum(jnp.square(qry_enc), axis=-1)       # [B, TQ]
+                p2 = jnp.sum(jnp.square(proto), axis=-1)         # [B, N]
+                logits = 2.0 * dots - q2[..., None] - p2[:, None, :]
+            else:
+                raise ValueError(f"unknown proto metric {self.metric!r}")
+        logits = self.append_nota(logits)
+        return logits.astype(jnp.float32)

@@ -101,6 +101,32 @@ def _fwd_kernel(xg_ref, whh_ref, hs_ref, cs_ref, gates_ref, h_scr, c_scr):
     gates_ref[:, 0, :] = jnp.concatenate([i, f, g, o], axis=-1)
 
 
+def _fwd_kernel_infer(xg_ref, whh_ref, hs_ref, h_scr, c_scr):
+    """hs-only forward for inference: no cs/gates residuals leave VMEM.
+
+    The custom-VJP primal runs this variant — pallas_call is opaque to XLA,
+    so dead residual outputs in the training kernel could not be DCE'd and
+    would cost ~5x the output bytes on every no-grad call (eval episodes).
+    """
+    t = pl.program_id(1)
+    u = whh_ref.shape[0]
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[...] = jnp.zeros_like(h_scr)
+        c_scr[...] = jnp.zeros_like(c_scr)
+
+    a = xg_ref[:, 0, :] + jnp.dot(
+        h_scr[...], whh_ref[...], preferred_element_type=jnp.float32
+    )
+    i, f, g, o = _gates(a, u)
+    c = f * c_scr[...] + i * g
+    h = o * jnp.tanh(c)
+    h_scr[...] = h
+    c_scr[...] = c
+    hs_ref[:, 0, :] = h
+
+
 def _bwd_kernel(
     dhs_ref, gates_ref, cs_ref, cs_prev_ref, hs_prev_ref, whh_ref,
     dxg_ref, dwhh_ref, dh_scr, dc_scr, dwhh_scr,
@@ -186,6 +212,30 @@ def _fwd_call(xg: jnp.ndarray, whh: jnp.ndarray, interpret: bool):
     return hs[:M], cs[:M], gates[:M]
 
 
+def _fwd_call_infer(xg: jnp.ndarray, whh: jnp.ndarray, interpret: bool):
+    M, L, G = xg.shape
+    u = G // 4
+    xg32 = _pad_rows(xg.astype(jnp.float32), _TM)
+    Mp = xg32.shape[0]
+    grid = (Mp // _TM, L)
+    hs = pl.pallas_call(
+        _fwd_kernel_infer,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TM, 1, G), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((u, G), lambda i, t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((_TM, 1, u), lambda i, t: (i, t, 0)),
+        out_shape=jax.ShapeDtypeStruct((Mp, L, u), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((_TM, u), jnp.float32),
+            pltpu.VMEM((_TM, u), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xg32, whh.astype(jnp.float32))
+    return hs[:M]
+
+
 def _bwd_call(dhs, gates, cs, hs, whh, interpret: bool):
     M, L, u = dhs.shape
     G = 4 * u
@@ -238,7 +288,9 @@ def max_0(v):
 # stays arrays-only.
 @partial(jax.custom_vjp, nondiff_argnums=(2,))
 def _lstm_pallas(xg, whh, interpret=False):
-    return _fwd_call(xg, whh, interpret)[0]
+    # Primal (no-grad) path: hs-only kernel, no residuals to HBM. Under
+    # jax.grad the fwd rule below runs instead and saves residuals.
+    return _fwd_call_infer(xg, whh, interpret)
 
 
 def _lstm_pallas_fwd(xg, whh, interpret):

@@ -23,6 +23,22 @@ from induction_network_on_fewrel_tpu.config import ExperimentConfig
 FORMAT_VERSION = 2
 
 
+def _format_compatible(stored: int, arch: ExperimentConfig) -> bool:
+    """Whether a checkpoint written at ``stored`` restores under this build.
+
+    Version bumps usually touch one module's tree, so older checkpoints whose
+    architecture never instantiates that module are still valid — reject only
+    the combinations that actually changed.
+    """
+    if stored == FORMAT_VERSION:
+        return True
+    if stored == 1:
+        # v1 -> v2 changed only the BiLSTM encoder's param tree
+        # (ops/lstm.py explicit w_ih/w_hh/bias); cnn/bert restore unchanged.
+        return arch.encoder != "bilstm"
+    return False
+
+
 class CheckpointManager:
     def __init__(self, ckpt_dir: str | Path, cfg: ExperimentConfig, max_to_keep: int = 3):
         self.dir = Path(ckpt_dir).absolute()
@@ -37,7 +53,14 @@ class CheckpointManager:
                 int(version_file.read_text().strip() or 0)
                 if version_file.exists() else 1
             )
-            if stored != FORMAT_VERSION:
+            # Judge compatibility against the architecture of the weights
+            # actually stored there (the dir's own config.json), not the
+            # caller's runtime config.
+            try:
+                arch = self.load_config(self.dir)
+            except FileNotFoundError:
+                arch = cfg
+            if not _format_compatible(stored, arch):
                 raise ValueError(
                     f"checkpoint dir {self.dir} has param-tree format "
                     f"v{stored}, this build writes v{FORMAT_VERSION}; "

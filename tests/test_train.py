@@ -94,7 +94,7 @@ def test_checkpoint_format_version_guard(tmp_path):
         CheckpointManager,
     )
 
-    cfg = ExperimentConfig(encoder="cnn", vocab_size=102)
+    cfg = ExperimentConfig(encoder="bilstm", vocab_size=102)
     d = tmp_path / "ck"
     CheckpointManager(d, cfg)  # fresh dir: stamps the current version
     assert (d / "format_version").read_text() == str(FORMAT_VERSION)
@@ -107,5 +107,14 @@ def test_checkpoint_format_version_guard(tmp_path):
     # Pre-versioning dir: has step dirs but no version file -> treated as v1.
     legacy = tmp_path / "legacy"
     (legacy / "7").mkdir(parents=True)
+    (legacy / "config.json").write_text(cfg.to_json())
     with pytest.raises(ValueError, match="format"):
         CheckpointManager(legacy, cfg)
+
+    # v1 -> v2 changed only the BiLSTM tree: a v1 *cnn* checkpoint still
+    # restores, so the guard must let it through.
+    cnn = ExperimentConfig(encoder="cnn", vocab_size=102)
+    ok = tmp_path / "cnn_legacy"
+    (ok / "7").mkdir(parents=True)
+    (ok / "config.json").write_text(cnn.to_json())
+    CheckpointManager(ok, cnn)  # no raise
