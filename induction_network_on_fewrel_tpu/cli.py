@@ -28,8 +28,13 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--na_rate", type=int, default=0, help="NOTA negatives ratio (FewRel 2.0)")
     p.add_argument("--batch_size", type=int, default=4, help="episodes per step")
     # model
-    p.add_argument("--model", default="induction", choices=["induction", "proto"], help="few-shot model")
+    p.add_argument("--model", default="induction",
+                   choices=["induction", "proto", "proto_hatt", "gnn", "snail"],
+                   help="few-shot model")
     p.add_argument("--proto_metric", default="euclid", choices=["euclid", "dot"], help="proto similarity")
+    p.add_argument("--gnn_dim", type=int, default=64, help="features added per GNN block")
+    p.add_argument("--gnn_blocks", type=int, default=2)
+    p.add_argument("--snail_tc_filters", type=int, default=128)
     p.add_argument("--encoder", default="bilstm", choices=["cnn", "bilstm", "bert"])
     p.add_argument("--max_length", type=int, default=40)
     p.add_argument("--hidden_size", type=int, default=230)
@@ -100,6 +105,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         n=args.N, k=args.K, q=args.Q, na_rate=args.na_rate,
         batch_size=args.batch_size, max_length=args.max_length,
         model=args.model, proto_metric=args.proto_metric,
+        gnn_dim=args.gnn_dim, gnn_blocks=args.gnn_blocks,
+        snail_tc_filters=args.snail_tc_filters,
         encoder=args.encoder, hidden_size=args.hidden_size,
         lstm_hidden=args.lstm_hidden, lstm_backend=args.lstm_backend,
         induction_dim=args.induction_dim,

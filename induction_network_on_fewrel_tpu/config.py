@@ -31,8 +31,12 @@ class ExperimentConfig:
     vocab_size: int = 400002  # GloVe 400k + [UNK] + [BLANK]; synthetic is small
 
     # --- few-shot model (reference flag --model) ---
-    model: str = "induction"  # induction | proto
+    model: str = "induction"  # induction | proto | proto_hatt | gnn | snail
     proto_metric: str = "euclid"  # euclid | dot (proto only)
+    gnn_dim: int = 64         # features added per GNN block
+    gnn_blocks: int = 2
+    gnn_adj_hidden: int = 64  # adjacency MLP hidden width
+    snail_tc_filters: int = 128
 
     # --- encoder ---
     encoder: str = "bilstm"   # cnn | bilstm | bert
@@ -98,7 +102,8 @@ class ExperimentConfig:
     # load it); everything else is runtime/episode geometry a user may vary
     # at eval time. test.py merges these from the checkpoint's config.json.
     ARCHITECTURE_FIELDS = (
-        "model", "proto_metric",
+        "model", "proto_metric", "gnn_dim", "gnn_blocks", "gnn_adj_hidden",
+        "snail_tc_filters",
         "encoder", "hidden_size", "lstm_hidden", "att_dim", "word_dim",
         "pos_dim", "vocab_size", "max_length", "induction_dim",
         "routing_iters", "ntn_slices", "bert_layers", "bert_hidden",
@@ -109,12 +114,23 @@ class ExperimentConfig:
     def replace(self, **kw: Any) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)
 
+    # Episode-geometry fields that become architectural for specific models
+    # (they shape parameters there): gnn/snail bake the N-way label width
+    # into Dense/Conv shapes; proto_hatt's feature-attention conv kernel is
+    # K-sized. For induction/proto these stay freely variable at eval time.
+    MODEL_GEOMETRY_FIELDS = {
+        "gnn": ("train_n", "n"),
+        "snail": ("train_n", "n"),
+        "proto_hatt": ("k",),
+    }
+
     def merge_architecture_from(self, other: "ExperimentConfig") -> "ExperimentConfig":
         """Take architecture-defining fields from ``other`` (a checkpoint's
         saved config), keep this config's runtime/episode fields."""
-        return self.replace(
-            **{f: getattr(other, f) for f in self.ARCHITECTURE_FIELDS}
+        fields = self.ARCHITECTURE_FIELDS + self.MODEL_GEOMETRY_FIELDS.get(
+            other.model, ()
         )
+        return self.replace(**{f: getattr(other, f) for f in fields})
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)

@@ -48,11 +48,19 @@ class FewShotModel(nn.Module):
         return sup_enc, qry_enc
 
     def append_nota(self, logits: jnp.ndarray) -> jnp.ndarray:
-        """Append the learned NOTA threshold logit as class N (if enabled)."""
+        """Append the learned NOTA threshold logit as class N (if enabled).
+
+        Setup-style models create the param via ``make_nota_param()`` in
+        ``setup``; ``@nn.compact`` models just call this — the param is
+        created lazily here (attribute assignment is illegal in compact).
+        """
         if not self.nota:
             return logits
+        nota_logit = getattr(self, "nota_logit", None)
+        if nota_logit is None:
+            nota_logit = self.param("nota_logit", nn.initializers.zeros, (1,))
         B, TQ, _ = logits.shape
-        na = jnp.broadcast_to(self.nota_logit.astype(logits.dtype), (B, TQ, 1))
+        na = jnp.broadcast_to(nota_logit.astype(logits.dtype), (B, TQ, 1))
         return jnp.concatenate([logits, na], axis=-1)
 
     def make_nota_param(self):

@@ -11,7 +11,11 @@ from induction_network_on_fewrel_tpu.models.encoders import (
     BiLSTMSelfAttnEncoder,
     CNNEncoder,
 )
+from induction_network_on_fewrel_tpu.models.gnn import GNN
 from induction_network_on_fewrel_tpu.models.induction import InductionNetwork
+from induction_network_on_fewrel_tpu.models.proto import PrototypicalNetwork
+from induction_network_on_fewrel_tpu.models.proto_hatt import ProtoHATT
+from induction_network_on_fewrel_tpu.models.snail import SNAIL
 
 _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
 
@@ -79,20 +83,31 @@ def build_model(
             nota=cfg.na_rate > 0,
             compute_dtype=dtype,
         )
+    common = dict(
+        embedding=embedding,
+        encoder=encoder,
+        nota=cfg.na_rate > 0,
+        compute_dtype=dtype,
+    )
     if cfg.model == "proto":
-        from induction_network_on_fewrel_tpu.models.proto import (
-            PrototypicalNetwork,
-        )
-
         if cfg.proto_metric not in ("euclid", "dot"):
             raise ValueError(f"unknown proto metric {cfg.proto_metric!r}")
-        return PrototypicalNetwork(
-            embedding=embedding,
-            encoder=encoder,
-            nota=cfg.na_rate > 0,
-            compute_dtype=dtype,
-            metric=cfg.proto_metric,
-        )
+        return PrototypicalNetwork(metric=cfg.proto_metric, **common)
+    if cfg.model == "proto_hatt":
+        return ProtoHATT(k=cfg.k, **common)
+    if cfg.model in ("gnn", "snail"):
+        # These models bake N into parameter shapes (the label one-hot feeds
+        # the first Dense/Conv; the readout is Dense(N)), so unlike
+        # induction/proto the train-time and eval-time N must agree.
+        if cfg.train_n != cfg.n:
+            raise ValueError(
+                f"model {cfg.model!r} ties parameter shapes to N; "
+                f"--trainN ({cfg.train_n}) must equal --N ({cfg.n})"
+            )
+        if cfg.model == "gnn":
+            return GNN(gnn_dim=cfg.gnn_dim, gnn_blocks=cfg.gnn_blocks,
+                       adj_hidden=cfg.gnn_adj_hidden, **common)
+        return SNAIL(tc_filters=cfg.snail_tc_filters, **common)
     raise ValueError(f"unknown model {cfg.model!r}")
 
 

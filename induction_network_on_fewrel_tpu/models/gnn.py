@@ -1,0 +1,113 @@
+"""Few-shot graph neural network (gnn).
+
+Toolkit-family sibling model (SURVEY.md §2.1 "Few-shot model" siblings;
+Garcia & Bruna, ICLR 2018, "Few-Shot Learning with Graph Neural Networks").
+One graph per query: nodes are the N·K support instances plus the query,
+node features are the sentence encoding concatenated with the label one-hot
+(uniform 1/N for the unlabeled query node). Each GNN block
+
+1. learns an adjacency from pairwise absolute feature differences:
+   ``A_ij = softmax_j MLP(|x_i - x_j|)``, and
+2. aggregates: ``x ← concat(x, leaky_relu(Dense(A @ x)))`` (dense/residual
+   feature growth, as in the original architecture).
+
+A final graph layer maps the query node's aggregated features to N logits.
+
+TPU notes: the graph is tiny (N·K+1 ≤ 51 nodes) but there is one graph per
+query — all TQ graphs run as one batched einsum via a leading [B·TQ] axis,
+so the adjacency MLP and the aggregation matmuls are large and MXU-shaped.
+Static node count per compile; no dynamic graph construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from induction_network_on_fewrel_tpu.models.base import FewShotModel
+
+
+class _AdjacencyMLP(nn.Module):
+    """Pairwise |x_i - x_j| -> scalar edge logit; softmax over neighbors."""
+
+    hidden: int
+    compute_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        # x: [G, T, F] node features -> [G, T, T] row-stochastic adjacency.
+        diff = jnp.abs(x[:, :, None, :] - x[:, None, :, :])  # [G, T, T, F]
+        h = nn.Dense(self.hidden, dtype=self.compute_dtype,
+                     param_dtype=jnp.float32)(diff)
+        h = nn.leaky_relu(h)
+        h = nn.Dense(self.hidden, dtype=self.compute_dtype,
+                     param_dtype=jnp.float32)(h)
+        h = nn.leaky_relu(h)
+        logit = nn.Dense(1, dtype=self.compute_dtype,
+                         param_dtype=jnp.float32)(h)[..., 0]  # [G, T, T]
+        # Mask self-edges so a node aggregates neighbors, not itself (its own
+        # features persist through the residual concat).
+        T = x.shape[1]
+        eye = jnp.eye(T, dtype=bool)
+        logit = jnp.where(eye[None], -1e9, logit.astype(jnp.float32))
+        return jax.nn.softmax(logit, axis=-1).astype(self.compute_dtype)
+
+
+class GNN(FewShotModel):
+    """Per-query support graph with learned adjacency."""
+
+    gnn_dim: int = 64      # features added by each block
+    gnn_blocks: int = 2
+    adj_hidden: int = 64
+
+    @nn.compact
+    def __call__(self, support: dict[str, Any], query: dict[str, Any]) -> jnp.ndarray:
+        with jax.named_scope("encoder"):
+            sup_enc, qry_enc = self.encode_episode(support, query)
+        B, N, K, H = sup_enc.shape
+        TQ = qry_enc.shape[1]
+        cd = self.compute_dtype
+        T = N * K + 1  # nodes per graph
+
+        with jax.named_scope("graph_build"):
+            # Label one-hots: support gets its class, the query gets uniform.
+            sup_lab = jnp.broadcast_to(
+                jnp.eye(N, dtype=cd)[None, :, None, :], (B, N, K, N)
+            )
+            sup_nodes = jnp.concatenate(
+                [sup_enc.astype(cd), sup_lab], axis=-1
+            ).reshape(B, 1, N * K, H + N)
+            sup_nodes = jnp.broadcast_to(sup_nodes, (B, TQ, N * K, H + N))
+            qry_lab = jnp.full((B, TQ, 1, N), 1.0 / N, dtype=cd)
+            qry_nodes = jnp.concatenate(
+                [qry_enc.astype(cd)[:, :, None, :], qry_lab], axis=-1
+            )
+            #
+
+            # Query node first (index 0), then supports; one graph per query,
+            # flattened to a single big batch of graphs.
+            x = jnp.concatenate([qry_nodes, sup_nodes], axis=2)  # [B,TQ,T,F]
+            x = x.reshape(B * TQ, T, H + N)
+
+        for i in range(self.gnn_blocks):
+            with jax.named_scope(f"gnn_block_{i}"):
+                A = _AdjacencyMLP(self.adj_hidden, cd, name=f"adj_{i}")(x)
+                agg = jnp.einsum("gij,gjf->gif", A, x)           # [G, T, F]
+                new = nn.Dense(self.gnn_dim, dtype=cd, param_dtype=jnp.float32,
+                               name=f"gc_{i}")(jnp.concatenate([x, agg], -1))
+                x = jnp.concatenate([x, nn.leaky_relu(new)], axis=-1)
+
+        with jax.named_scope("gnn_readout"):
+            A = _AdjacencyMLP(self.adj_hidden, cd, name="adj_out")(x)
+            agg = jnp.einsum("gij,gjf->gif", A, x)
+            logits = nn.Dense(N, dtype=cd, param_dtype=jnp.float32,
+                              name="gc_out")(
+                jnp.concatenate([x, agg], -1)
+            )[:, 0, :]                                           # query node
+            logits = logits.reshape(B, TQ, N)
+
+        logits = self.append_nota(logits.astype(jnp.float32))
+        return logits.astype(jnp.float32)

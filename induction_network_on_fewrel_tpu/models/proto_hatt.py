@@ -1,0 +1,88 @@
+"""Hybrid-attention prototypical network (proto_hatt).
+
+Toolkit-family sibling of the induction model (SURVEY.md §2.1 "Few-shot
+model": siblings like ``proto.py`` in toolkit forks — the hybrid-attention
+variant is Gao et al., AAAI 2019, "Hybrid Attention-Based Prototypical
+Networks for Noisy Few-Shot Relation Classification"). Two attentions refine
+the vanilla prototype:
+
+* **Instance-level**: each query re-weights the K support instances of every
+  class before averaging, so noisy support sentences contribute less:
+  ``α_jk = softmax_k( Σ_h tanh(g(e_jk)) ⊙ g(q) )`` with a shared linear
+  ``g``; the prototype becomes query-conditioned: ``p_j(q) = Σ_k α_jk e_jk``.
+* **Feature-level**: a small conv stack over the K support encodings of a
+  class scores which hidden dimensions matter for that class; the squared
+  distance is re-weighted per-dimension: ``d(q, j) = Σ_h z_jh (q_h - p_jh)²``.
+
+TPU notes: the instance-attention inner product and the weighted prototype
+are einsums over the hidden axis (MXU contractions); the conv stack runs as
+NHWC ``nn.Conv`` with the K axis as height — all static shapes, one compile.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from induction_network_on_fewrel_tpu.models.base import FewShotModel
+
+
+class ProtoHATT(FewShotModel):
+    """Prototypical network with instance- and feature-level attention."""
+
+    k: int = 5  # K-shot (conv kernel over the support axis is K-sized)
+
+    @nn.compact
+    def __call__(self, support: dict[str, Any], query: dict[str, Any]) -> jnp.ndarray:
+        with jax.named_scope("encoder"):
+            sup_enc, qry_enc = self.encode_episode(support, query)
+        B, N, K, H = sup_enc.shape
+        TQ = qry_enc.shape[1]
+        cd = self.compute_dtype
+        sup_enc = sup_enc.astype(cd)
+        qry_enc = qry_enc.astype(cd)
+
+        with jax.named_scope("feature_attention"):
+            # Conv stack over the K support instances of each class: which
+            # hidden dims are stable (hence discriminative) for this class.
+            x = sup_enc.reshape(B * N, K, H, 1)  # NHWC: height=K, width=H
+            # Total padding k-1 keeps the support axis at exactly K rows for
+            # any k (a symmetric k//2 each side over-pads even k: K grows per
+            # conv and the strided VALID conv below then reads zero-pad rows).
+            pad = (((self.k - 1) // 2, self.k // 2), (0, 0))
+            x = nn.relu(
+                nn.Conv(32, (self.k, 1), padding=pad, dtype=cd,
+                        param_dtype=jnp.float32)(x)
+            )
+            x = nn.relu(
+                nn.Conv(64, (self.k, 1), padding=pad, dtype=cd,
+                        param_dtype=jnp.float32)(x)
+            )
+            x = nn.Conv(1, (self.k, 1), strides=(self.k, 1), padding="VALID",
+                        dtype=cd, param_dtype=jnp.float32)(x)
+            # 1 + relu(·): strictly positive per-dimension weights. A bare
+            # relu here can die wholesale (all logits become exactly 0 and
+            # gradients vanish — observed at lr=3e-3); the unit floor makes
+            # the distance fall back to plain euclidean when the conv stack
+            # abstains, which is also the sane init-time behavior.
+            fea_att = (1.0 + nn.relu(x[:, 0, :, 0])).reshape(B, N, H)
+
+        with jax.named_scope("instance_attention"):
+            g = nn.Dense(H, use_bias=True, dtype=cd, param_dtype=jnp.float32)
+            sup_g = jnp.tanh(g(sup_enc))                       # [B, N, K, H]
+            qry_g = g(qry_enc)                                 # [B, TQ, H]
+            # score[b, t, n, k] = Σ_h tanh(g(e_nk)) · g(q_t)
+            score = jnp.einsum("bnkh,bth->btnk", sup_g, qry_g)
+            alpha = jax.nn.softmax(score.astype(jnp.float32), axis=-1).astype(cd)
+            # Query-conditioned prototypes: [B, TQ, N, H]
+            proto = jnp.einsum("btnk,bnkh->btnh", alpha, sup_enc)
+
+        with jax.named_scope("distance"):
+            diff = proto - qry_enc[:, :, None, :]              # [B, TQ, N, H]
+            logits = -jnp.einsum("btnh,bnh->btn", jnp.square(diff), fea_att)
+
+        logits = self.append_nota(logits.astype(jnp.float32))
+        return logits.astype(jnp.float32)

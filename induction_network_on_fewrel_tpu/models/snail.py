@@ -1,0 +1,139 @@
+"""SNAIL meta-learner (snail).
+
+Toolkit-family sibling model (SURVEY.md §2.1 "Few-shot model" siblings;
+Mishra et al., ICLR 2018, "A Simple Neural Attentive Meta-Learner"). The
+episode is serialized per query: a sequence of the N·K (encoding, label
+one-hot) support pairs followed by the query with a zero label, length
+T = N·K + 1. The network interleaves
+
+* **TC blocks** — ⌈log₂ T⌉ causal dense blocks, each a gated causal conv
+  (dilation 1, 2, 4, …) whose output concatenates onto the features, and
+* **attention blocks** — single-head causal soft attention with learned
+  key/value projections, output concatenated onto the features,
+
+and reads the N class logits off the final (query) position.
+
+TPU notes: all queries run as one batch ([B·TQ] leading axis); causal convs
+are ``nn.Conv`` with left padding and kernel dilation (static shapes, MXU
+matmuls over the channel axis); causal attention is one masked softmax —
+sequence length is ≤ 51, so no blockwise machinery is needed (SURVEY.md
+§5.7: long-context machinery lives in ``parallel/ring.py``, not here).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from induction_network_on_fewrel_tpu.models.base import FewShotModel
+
+
+class _CausalConvBlock(nn.Module):
+    """Gated causal conv (WaveNet-style): concat(x, tanh(f) * sigmoid(g))."""
+
+    filters: int
+    dilation: int
+    compute_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        pad = ((self.dilation, 0),)  # left-pad: position t sees ≤ t only
+        conv = lambda name: nn.Conv(
+            self.filters, kernel_size=(2,), kernel_dilation=(self.dilation,),
+            padding=pad, dtype=self.compute_dtype, param_dtype=jnp.float32,
+            name=name,
+        )
+        gate = jnp.tanh(conv("filter")(x)) * jax.nn.sigmoid(conv("gate")(x))
+        return jnp.concatenate([x, gate], axis=-1)
+
+
+class _TCBlock(nn.Module):
+    """Stack of causal conv blocks with dilations 1, 2, 4, … covering T."""
+
+    seq_len: int
+    filters: int
+    compute_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for i in range(max(1, math.ceil(math.log2(self.seq_len)))):
+            x = _CausalConvBlock(self.filters, 2 ** i, self.compute_dtype,
+                                 name=f"cc_{i}")(x)
+        return x
+
+
+class _AttentionBlock(nn.Module):
+    """Single-head causal attention; output concatenated onto features."""
+
+    key_dim: int
+    value_dim: int
+    compute_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        G, T, _ = x.shape
+        dense = lambda d, name: nn.Dense(
+            d, dtype=self.compute_dtype, param_dtype=jnp.float32, name=name
+        )
+        q = dense(self.key_dim, "q")(x)
+        k = dense(self.key_dim, "k")(x)
+        v = dense(self.value_dim, "v")(x)
+        scores = jnp.einsum("gtd,gsd->gts", q, k) / math.sqrt(self.key_dim)
+        causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+        scores = jnp.where(causal[None], scores.astype(jnp.float32), -1e9)
+        att = jax.nn.softmax(scores, axis=-1).astype(self.compute_dtype)
+        out = jnp.einsum("gts,gsd->gtd", att, v)
+        return jnp.concatenate([x, out], axis=-1)
+
+
+class SNAIL(FewShotModel):
+    """Attentive meta-learner over the serialized episode."""
+
+    tc_filters: int = 128
+    att1: tuple[int, int] = (64, 32)    # (key_dim, value_dim)
+    att2: tuple[int, int] = (256, 128)
+
+    @nn.compact
+    def __call__(self, support: dict[str, Any], query: dict[str, Any]) -> jnp.ndarray:
+        with jax.named_scope("encoder"):
+            sup_enc, qry_enc = self.encode_episode(support, query)
+        B, N, K, H = sup_enc.shape
+        TQ = qry_enc.shape[1]
+        cd = self.compute_dtype
+        T = N * K + 1
+
+        with jax.named_scope("serialize"):
+            sup_lab = jnp.broadcast_to(
+                jnp.eye(N, dtype=cd)[None, :, None, :], (B, N, K, N)
+            )
+            sup_seq = jnp.concatenate(
+                [sup_enc.astype(cd), sup_lab], -1
+            ).reshape(B, 1, N * K, H + N)
+            sup_seq = jnp.broadcast_to(sup_seq, (B, TQ, N * K, H + N))
+            qry_tok = jnp.concatenate(
+                [qry_enc.astype(cd)[:, :, None, :],
+                 jnp.zeros((B, TQ, 1, N), dtype=cd)], -1
+            )
+            # Supports first, query LAST — causal attention lets the query
+            # position attend to every support.
+            x = jnp.concatenate([sup_seq, qry_tok], axis=2)
+            x = x.reshape(B * TQ, T, H + N)
+
+        with jax.named_scope("snail_stack"):
+            x = _AttentionBlock(*self.att1, cd, name="att_1")(x)
+            x = _TCBlock(T, self.tc_filters, cd, name="tc_1")(x)
+            x = _AttentionBlock(*self.att2, cd, name="att_2")(x)
+            x = _TCBlock(T, self.tc_filters, cd, name="tc_2")(x)
+            x = _AttentionBlock(512, 256, cd, name="att_3")(x)
+
+        with jax.named_scope("readout"):
+            logits = nn.Dense(N, dtype=cd, param_dtype=jnp.float32,
+                              name="out")(x[:, -1, :])
+            logits = logits.reshape(B, TQ, N)
+
+        logits = self.append_nota(logits.astype(jnp.float32))
+        return logits.astype(jnp.float32)

@@ -1,0 +1,137 @@
+"""Sibling few-shot models (proto_hatt, gnn, snail): shapes, NOTA, training.
+
+SURVEY.md §2.1 "Few-shot model": the toolkit family ships sibling episode
+models next to the induction network; each exposes the same
+``(support, query) -> logits [B, TQ, N(+1)]`` surface, so one parametrized
+suite covers all of them.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    GloveTokenizer,
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+
+L = 16
+MODELS = ["proto_hatt", "gnn", "snail"]
+BASE = ExperimentConfig(
+    encoder="cnn", train_n=4, n=4, k=2, q=3, batch_size=2, max_length=L,
+    vocab_size=302, compute_dtype="float32", hidden_size=64,
+    gnn_dim=16, gnn_adj_hidden=16, snail_tc_filters=16,
+)
+
+
+@pytest.fixture(scope="module")
+def episode():
+    vocab = make_synthetic_glove(vocab_size=300)
+    ds = make_synthetic_fewrel(num_relations=8, instances_per_relation=10, vocab_size=300)
+    tok = GloveTokenizer(vocab, max_length=L)
+    s = EpisodeSampler(ds, tok, n=4, k=2, q=3, batch_size=2, seed=0)
+    return vocab, batch_to_model_inputs(s.sample_batch())
+
+
+@pytest.mark.parametrize("name", MODELS)
+def test_forward_shapes(episode, name):
+    vocab, (sup, qry, label) = episode
+    model = build_model(BASE.replace(model=name), glove_init=vocab.vectors)
+    params = model.init(jax.random.key(0), sup, qry)
+    logits = model.apply(params, sup, qry)
+    assert logits.shape == (2, 12, 4)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+@pytest.mark.parametrize("name", MODELS)
+def test_nota_head(episode, name):
+    vocab, (sup, qry, _) = episode
+    model = build_model(
+        BASE.replace(model=name, na_rate=1), glove_init=vocab.vectors
+    )
+    params = model.init(jax.random.key(0), sup, qry)
+    logits = model.apply(params, sup, qry)
+    assert logits.shape == (2, 12, 5)  # N+1 classes
+
+
+@pytest.mark.parametrize("name", MODELS)
+def test_jit_forward(episode, name):
+    vocab, (sup, qry, _) = episode
+    model = build_model(BASE.replace(model=name), glove_init=vocab.vectors)
+    params = model.init(jax.random.key(0), sup, qry)
+    jitted = jax.jit(model.apply)
+    logits = jitted(params, sup, qry)
+    assert logits.shape == (2, 12, 4)
+
+
+def test_snail_reads_the_support_prefix(episode):
+    """The query position must actually read the support prefix through the
+    causal attention: permuting which encodings sit in which class slot
+    (labels are positional) must change the query logits."""
+    import numpy as np
+
+    vocab, (sup, qry, _) = episode
+    model = build_model(BASE.replace(model="snail"), glove_init=vocab.vectors)
+    params = model.init(jax.random.key(0), sup, qry)
+    logits = model.apply(params, sup, qry)
+
+    perm = [1, 0, 3, 2]  # swap class slots 0<->1 and 2<->3
+    sup_perm = {k: v[:, perm] for k, v in sup.items()}
+    logits_perm = model.apply(params, sup_perm, qry)
+    assert not np.allclose(np.asarray(logits), np.asarray(logits_perm)), (
+        "query logits ignored the support set"
+    )
+
+
+@pytest.mark.parametrize("name", ["gnn", "snail"])
+def test_n_mismatch_rejected(name):
+    """gnn/snail bake N into param shapes; trainN != N must fail fast."""
+    with pytest.raises(ValueError, match="trainN"):
+        build_model(BASE.replace(model=name, train_n=6, n=4))
+
+
+def test_checkpoint_merge_carries_model_geometry():
+    """Geometry fields that shape params (k for proto_hatt, n for gnn) ride
+    along in merge_architecture_from so restores don't hit shape errors."""
+    saved = BASE.replace(model="proto_hatt", k=5)
+    runtime = BASE.replace(model="proto_hatt", k=1)
+    assert runtime.merge_architecture_from(saved).k == 5
+
+    saved = BASE.replace(model="gnn", train_n=10, n=10)
+    runtime = BASE.replace(model="gnn", train_n=5, n=5)
+    merged = runtime.merge_architecture_from(saved)
+    assert (merged.train_n, merged.n) == (10, 10)
+
+    # induction stays N/K-agnostic: eval geometry is the runtime's own.
+    saved = BASE.replace(model="induction", k=5)
+    runtime = BASE.replace(model="induction", k=1)
+    assert runtime.merge_architecture_from(saved).k == 1
+
+
+@pytest.mark.parametrize("name", MODELS)
+def test_trains_end_to_end(name):
+    from induction_network_on_fewrel_tpu.train.steps import init_state, make_train_step
+
+    cfg = BASE.replace(
+        model=name, train_n=2, n=2, k=2, q=2, batch_size=2, loss="ce", lr=1e-2
+    )
+    vocab = make_synthetic_glove(vocab_size=300)
+    ds = make_synthetic_fewrel(num_relations=4, instances_per_relation=8, vocab_size=300)
+    tok = GloveTokenizer(vocab, max_length=L)
+    sampler = EpisodeSampler(ds, tok, n=2, k=2, q=2, batch_size=2, seed=0)
+    model = build_model(cfg, glove_init=vocab.vectors)
+    sup, qry, label = batch_to_model_inputs(sampler.sample_batch())
+    state = init_state(model, cfg, sup, qry)
+    step = make_train_step(model, cfg)
+    first = None
+    for _ in range(30):
+        state, metrics = step(state, sup, qry, label)
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first
