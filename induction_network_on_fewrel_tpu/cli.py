@@ -69,6 +69,18 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--train_file", default=None, help="FewRel-schema JSON; synthetic if omitted")
     p.add_argument("--val_file", default=None)
     p.add_argument("--test_file", default=None)
+    if train:
+        # FewRel 2.0 adversarial domain adaptation (DANN, one jitted step).
+        p.add_argument("--adv", nargs="?", const="synthetic", default=None,
+                       metavar="TARGET_FILE",
+                       help="adversarial adaptation against this unlabeled "
+                            "target-domain FewRel-schema JSON (e.g. pubmed); "
+                            "bare --adv uses a synthetic target domain")
+        p.add_argument("--adv_lambda", type=float, default=1.0,
+                       help="gradient-reversal scale on the encoder")
+        p.add_argument("--adv_dis_hidden", type=int, default=256)
+        p.add_argument("--adv_batch", type=int, default=32,
+                       help="unlabeled instances per domain per step")
     p.add_argument("--glove", default=None, help="GloVe json (word2id or combined)")
     p.add_argument("--glove_mat", default=None, help=".npy matrix for word2id json")
     # host data pipeline
@@ -122,6 +134,10 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         dp=args.dp, tp=args.tp,
         sampler=args.sampler, prefetch=args.prefetch,
         sampler_threads=args.sampler_threads,
+        adv=getattr(args, "adv", None) is not None,
+        adv_lambda=getattr(args, "adv_lambda", 1.0),
+        adv_dis_hidden=getattr(args, "adv_dis_hidden", 256),
+        adv_batch=getattr(args, "adv_batch", 32),
     )
 
 
@@ -242,13 +258,54 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         train_step = make_sharded_train_step(model, cfg, mesh, state)
         eval_step = make_sharded_eval_step(model, cfg, mesh, state)
 
+    adv_pieces = None
+    if cfg.adv and not only_test:
+        if use_mesh:
+            raise NotImplementedError(
+                "--adv currently runs single-device; drop --dp/--tp "
+                "(mesh-sharded DANN step not wired yet)"
+            )
+        from induction_network_on_fewrel_tpu.data import (
+            load_fewrel_json,
+            make_synthetic_fewrel,
+        )
+        from induction_network_on_fewrel_tpu.models.adversarial import (
+            DomainDiscriminator,
+        )
+        from induction_network_on_fewrel_tpu.models.build import encoder_output_dim
+        from induction_network_on_fewrel_tpu.sampling import InstanceSampler
+        from induction_network_on_fewrel_tpu.train.framework import AdvPieces
+        from induction_network_on_fewrel_tpu.train.steps import (
+            init_disc_state,
+            make_adv_train_step,
+        )
+
+        if args.adv != "synthetic":
+            tgt_ds = load_fewrel_json(args.adv)
+        else:
+            # Synthetic "other domain": disjoint token statistics (seed) so
+            # the discriminator has a real signal to separate.
+            tgt_ds = make_synthetic_fewrel(
+                num_relations=max(cfg.train_n, cfg.n) * 2,
+                instances_per_relation=max(cfg.k + cfg.q + 5, 20),
+                vocab_size=cfg.vocab_size - 2,
+                seed=97,
+            )
+        disc = DomainDiscriminator(hidden=cfg.adv_dis_hidden)
+        adv_pieces = AdvPieces(
+            step=make_adv_train_step(model, disc, cfg),
+            disc_state=init_disc_state(disc, cfg, encoder_output_dim(cfg)),
+            src_sampler=InstanceSampler(train_ds, tok, cfg.adv_batch, seed=cfg.seed + 31),
+            tgt_sampler=InstanceSampler(tgt_ds, tok, cfg.adv_batch, seed=cfg.seed + 32),
+        )
+
     run_dir = args.run_dir or args.save_ckpt
     trainer = FewShotTrainer(
         model, cfg, train_sampler, val_sampler,
         ckpt_dir=None if only_test else args.save_ckpt,
         logger=MetricsLogger(run_dir),
         train_step=train_step, eval_step=eval_step, initial_state=state,
-        mesh=mesh,
+        mesh=mesh, adv=adv_pieces,
     )
     trainer.vocab, trainer.tokenizer = vocab, tok
     return trainer

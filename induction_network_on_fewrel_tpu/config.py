@@ -73,6 +73,12 @@ class ExperimentConfig:
     val_step: int = 1000
     test_iter: int = 3000
 
+    # --- FewRel 2.0 adversarial domain adaptation (training-time only) ---
+    adv: bool = False         # train encoder against a domain discriminator
+    adv_lambda: float = 1.0   # gradient-reversal scale (encoder side)
+    adv_dis_hidden: int = 256 # discriminator MLP width
+    adv_batch: int = 32       # unlabeled instances per domain per step
+
     # --- numerics / device ---
     device: str = "tpu"       # tpu | cpu  (reference-mandated new flag)
     compute_dtype: str = "bfloat16"  # matmul dtype on the MXU

@@ -1,0 +1,31 @@
+"""Domain discriminator for FewRel 2.0 adversarial domain adaptation.
+
+The reference family's FewRel 2.0 recipe trains the sentence encoder against
+a domain classifier fed with unlabeled target-domain (PubMed) instances so
+the encoder's features become domain-invariant (SURVEY.md §0 pillar 7:
+"FewRel 2.0 domain adaptation (PubMed)"). There, the adversary is a small
+MLP over sentence encodings with three alternating optimizers; here the same
+game runs as ONE jitted step via ``ops.gradient_reversal`` (DANN), which is
+both simpler and XLA-friendly (no optimizer interleaving across compiles).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class DomainDiscriminator(nn.Module):
+    """Sentence encoding [M, H] -> domain logits [M, 2] (0=source, 1=target)."""
+
+    hidden: int = 256
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, feat: jnp.ndarray) -> jnp.ndarray:
+        dense = lambda d, name: nn.Dense(
+            d, dtype=self.compute_dtype, param_dtype=jnp.float32, name=name
+        )
+        x = nn.leaky_relu(dense(self.hidden, "fc1")(feat.astype(self.compute_dtype)))
+        x = nn.leaky_relu(dense(self.hidden, "fc2")(x))
+        return dense(2, "out")(x).astype(jnp.float32)

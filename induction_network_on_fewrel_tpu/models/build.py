@@ -111,6 +111,15 @@ def build_model(
     raise ValueError(f"unknown model {cfg.model!r}")
 
 
+def encoder_output_dim(cfg: ExperimentConfig) -> int:
+    """Sentence-vector width produced by cfg's encoder (discriminator input)."""
+    if cfg.encoder == "bert":
+        return cfg.bert_hidden
+    if cfg.encoder == "bilstm":
+        return 2 * cfg.lstm_hidden
+    return cfg.hidden_size  # cnn
+
+
 def batch_to_model_inputs(batch) -> tuple[dict, dict, jnp.ndarray]:
     """EpisodeBatch (numpy) -> (support dict, query dict, label) for the model."""
     support = {

@@ -1,4 +1,5 @@
 from induction_network_on_fewrel_tpu.ops.core import (  # noqa: F401
+    gradient_reversal,
     masked_max,
     masked_mean,
     masked_softmax,

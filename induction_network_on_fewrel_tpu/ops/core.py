@@ -41,3 +41,29 @@ def masked_max(x: jnp.ndarray, mask: jnp.ndarray, axis: int) -> jnp.ndarray:
 def masked_mean(x: jnp.ndarray, mask: jnp.ndarray, axis: int) -> jnp.ndarray:
     s = jnp.sum(x * (mask > 0), axis=axis)
     return s / (jnp.sum(mask > 0, axis=axis) + 1e-13)
+
+
+def gradient_reversal(x: jnp.ndarray, scale: float = 1.0) -> jnp.ndarray:
+    """Identity forward; gradient multiplied by ``-scale`` on the way back.
+
+    The DANN trick (Ganin & Lempitsky 2015) that lets one optimizer train
+    adversary and encoder in a single backward pass: the domain discriminator
+    upstream of this op minimizes its loss normally, while everything
+    downstream (the sentence encoder) receives the negated gradient and so
+    *maximizes* domain confusion. Replaces the reference family's three
+    alternating optimizers for FewRel 2.0 adaptation with one jitted step.
+    """
+    import jax
+
+    @jax.custom_vjp
+    def _rev(x):
+        return x
+
+    def _fwd(x):
+        return x, None
+
+    def _bwd(_, g):
+        return (jax.tree_util.tree_map(lambda t: -scale * t, g),)
+
+    _rev.defvjp(_fwd, _bwd)
+    return _rev(x)

@@ -1,4 +1,6 @@
 from induction_network_on_fewrel_tpu.sampling.episodes import (  # noqa: F401
     EpisodeBatch,
     EpisodeSampler,
+    InstanceBatch,
+    InstanceSampler,
 )

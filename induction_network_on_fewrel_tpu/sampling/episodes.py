@@ -141,3 +141,52 @@ class EpisodeSampler:
     def __iter__(self) -> Iterator[EpisodeBatch]:
         while True:
             yield self.sample_batch()
+
+
+class InstanceBatch(NamedTuple):
+    """A batch of M unlabeled instances (domain-adaptation side channel)."""
+
+    word: np.ndarray  # [M, L] int32
+    pos1: np.ndarray
+    pos2: np.ndarray
+    mask: np.ndarray  # [M, L] float32
+
+
+class InstanceSampler:
+    """Uniform unlabeled instance batches from a FewRel-schema dataset.
+
+    Feeds the FewRel 2.0 adversarial adaptation loop: the domain
+    discriminator sees (source, target) instance batches with no relation
+    labels, so this sampler flattens the dataset across relations and draws
+    uniformly. Same host-side discipline as EpisodeSampler: tokenize once
+    up front, per-batch work is integer indexing into fixed-shape blocks.
+    """
+
+    def __init__(
+        self,
+        dataset: FewRelDataset,
+        tokenizer: GloveTokenizer,
+        batch_size: int,
+        seed: int = 0,
+    ):
+        self.batch_size = batch_size
+        self.rng = np.random.default_rng(seed)
+        toks = [
+            tokenizer(inst)
+            for rel in dataset.rel_names
+            for inst in dataset.instances[rel]
+        ]
+        self.word = np.stack([t.word for t in toks])
+        self.pos1 = np.stack([t.pos1 for t in toks])
+        self.pos2 = np.stack([t.pos2 for t in toks])
+        self.mask = np.stack([t.mask for t in toks])
+
+    def sample_batch(self) -> InstanceBatch:
+        idx = self.rng.integers(self.word.shape[0], size=self.batch_size)
+        return InstanceBatch(
+            self.word[idx], self.pos1[idx], self.pos2[idx], self.mask[idx]
+        )
+
+    def __iter__(self) -> Iterator[InstanceBatch]:
+        while True:
+            yield self.sample_batch()

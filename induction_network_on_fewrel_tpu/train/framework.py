@@ -10,8 +10,9 @@ step t, replacing the reference's DataLoader worker processes.
 
 from __future__ import annotations
 
+import dataclasses
 import time
-from typing import Iterator
+from typing import Any, Callable, Iterator
 
 import jax
 import numpy as np
@@ -27,6 +28,19 @@ from induction_network_on_fewrel_tpu.train.steps import (
 from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
 
 
+@dataclasses.dataclass
+class AdvPieces:
+    """Everything the DANN loop needs beyond the plain trainer: the jitted
+    adversarial step (steps.make_adv_train_step), the discriminator's own
+    TrainState (mutated across steps, never checkpointed), and unlabeled
+    instance samplers for the source and target domains."""
+
+    step: Callable
+    disc_state: Any
+    src_sampler: Any
+    tgt_sampler: Any
+
+
 class FewShotTrainer:
     def __init__(
         self,
@@ -40,6 +54,7 @@ class FewShotTrainer:
         eval_step=None,
         initial_state=None,
         mesh=None,
+        adv=None,
     ):
         self.model = model
         self.cfg = cfg
@@ -55,6 +70,11 @@ class FewShotTrainer:
         # Mesh the injected steps were built for (None = single device);
         # restored checkpoints must be re-placed onto it (see reshard_state).
         self.mesh = mesh
+        # FewRel 2.0 adversarial adaptation: AdvPieces bundle, or None. When
+        # set, training runs the DANN step (few-shot loss + domain game)
+        # instead of the plain step; eval/checkpointing are unchanged (the
+        # discriminator is a training-time adversary, never saved).
+        self.adv = adv
 
     def init_state(self):
         # Reuse a pre-built state when one was injected: mesh-sharded steps
@@ -85,9 +105,17 @@ class FewShotTrainer:
         t0 = time.monotonic()
         last_logged = 0
         window = 50
+        adv = self.adv
         for step in range(1, num_iters + 1):
             support, query, label = batch_to_model_inputs(next(it))
-            state, metrics = self.train_step(state, support, query, label)
+            if adv is not None:
+                src = adv.src_sampler.sample_batch()._asdict()
+                tgt = adv.tgt_sampler.sample_batch()._asdict()
+                state, adv.disc_state, metrics = adv.step(
+                    state, adv.disc_state, support, query, label, src, tgt
+                )
+            else:
+                state, metrics = self.train_step(state, support, query, label)
             if step % window == 0 or step == num_iters:
                 m = jax.device_get(metrics)  # sync point, once per window
                 dt = time.monotonic() - t0
@@ -95,9 +123,8 @@ class FewShotTrainer:
                 self.logger.log(
                     step,
                     "train",
-                    loss=m["loss"],
-                    accuracy=m["accuracy"],
                     episodes_per_s=eps_per_s,
+                    **{k: v for k, v in m.items()},
                 )
                 t0 = time.monotonic()
                 last_logged = step

@@ -100,3 +100,74 @@ def init_state(model, cfg: ExperimentConfig, support, query, rng=None) -> TrainS
     return TrainState.create(
         apply_fn=model.apply, params=params, tx=make_optimizer(cfg)
     )
+
+
+# --- FewRel 2.0 adversarial domain adaptation (models/adversarial.py) ---
+
+
+def init_disc_state(disc, cfg: ExperimentConfig, feat_dim: int, rng=None) -> TrainState:
+    """Discriminator gets its own TrainState: it is a training-time-only
+    adversary and stays out of the model checkpoint (the reference family
+    likewise saves only the model state_dict)."""
+    rng = rng if rng is not None else jax.random.key(cfg.seed + 17)
+    params = disc.init(rng, jnp.zeros((1, feat_dim), jnp.float32))
+    return TrainState.create(
+        apply_fn=disc.apply, params=params, tx=make_optimizer(cfg)
+    )
+
+
+def make_adv_train_step(model, disc, cfg: ExperimentConfig):
+    """Jitted DANN step: few-shot loss + domain-confusion game in ONE pass.
+
+    (state, disc_state, support, query, label, src, tgt) ->
+    (state, disc_state, metrics); ``src``/``tgt`` are unlabeled instance
+    dicts {word, pos1, pos2, mask}: [M, L]. The discriminator minimizes
+    domain cross-entropy; ``ops.gradient_reversal`` hands the encoder the
+    negated gradient so it maximizes it — one backward, one optimizer step
+    each, no alternating schedule.
+    """
+    from induction_network_on_fewrel_tpu.models.base import FewShotModel
+    from induction_network_on_fewrel_tpu.ops import gradient_reversal
+
+    lam = cfg.adv_lambda
+
+    def encode(params, batch):
+        return model.apply(
+            params, batch["word"], batch["pos1"], batch["pos2"], batch["mask"],
+            method=FewShotModel.encode,
+        )
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def adv_train_step(state: TrainState, disc_state: TrainState,
+                       support, query, label, src, tgt):
+        def loss_fn(params, disc_params):
+            logits = model.apply(params, support, query)
+            fs_loss = LOSS_FNS[cfg.loss](logits, label)
+
+            feat = jnp.concatenate(
+                [encode(params, src), encode(params, tgt)], axis=0
+            )
+            dom_label = jnp.concatenate(
+                [jnp.zeros(src["word"].shape[0], jnp.int32),
+                 jnp.ones(tgt["word"].shape[0], jnp.int32)]
+            )
+            dom_logits = disc.apply(
+                disc_params, gradient_reversal(feat, lam)
+            )
+            dom_loss = cross_entropy_loss(dom_logits[None], dom_label[None])
+            metrics = {
+                "loss": fs_loss,
+                "accuracy": accuracy(logits, label),
+                "domain_loss": dom_loss,
+                "domain_accuracy": accuracy(dom_logits[None], dom_label[None]),
+            }
+            return fs_loss + dom_loss, metrics
+
+        grads, metrics = jax.grad(loss_fn, argnums=(0, 1), has_aux=True)(
+            state.params, disc_state.params
+        )
+        state = state.apply_gradients(grads=grads[0])
+        disc_state = disc_state.apply_gradients(grads=grads[1])
+        return state, disc_state, metrics
+
+    return adv_train_step
