@@ -35,7 +35,12 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--gnn_dim", type=int, default=64, help="features added per GNN block")
     p.add_argument("--gnn_blocks", type=int, default=2)
     p.add_argument("--snail_tc_filters", type=int, default=128)
-    p.add_argument("--encoder", default="bilstm", choices=["cnn", "bilstm", "bert"])
+    p.add_argument("--encoder", default="bilstm",
+                   choices=["cnn", "bilstm", "bert", "transformer"])
+    p.add_argument("--tfm_layers", type=int, default=4)
+    p.add_argument("--tfm_model", type=int, default=256)
+    p.add_argument("--tfm_heads", type=int, default=4)
+    p.add_argument("--tfm_ff", type=int, default=1024)
     p.add_argument("--max_length", type=int, default=40)
     p.add_argument("--hidden_size", type=int, default=230)
     p.add_argument("--lstm_hidden", type=int, default=128)
@@ -94,6 +99,9 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--device", default="tpu", choices=["tpu", "cpu"])
     p.add_argument("--dp", type=int, default=0, help="data-parallel mesh axis (0 = all devices)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel mesh axis: ring attention over "
+                        "the token axis (transformer encoder only)")
     p.add_argument("--fp16", action="store_true", help="(reference flag) alias for bf16 compute")
     p.add_argument("--bf16", action="store_true", help="bfloat16 matmuls on the MXU")
     # checkpoints / run dir
@@ -121,6 +129,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         snail_tc_filters=args.snail_tc_filters,
         encoder=args.encoder, hidden_size=args.hidden_size,
         lstm_hidden=args.lstm_hidden, lstm_backend=args.lstm_backend,
+        tfm_layers=args.tfm_layers, tfm_model=args.tfm_model,
+        tfm_heads=args.tfm_heads, tfm_ff=args.tfm_ff,
         induction_dim=args.induction_dim,
         routing_iters=args.routing_iters, ntn_slices=args.ntn_slices,
         bert_frozen=args.bert_frozen, bert_layers=args.bert_layers,
@@ -131,7 +141,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         grad_clip=args.grad_clip, train_iter=train_iter,
         val_iter=val_iter, val_step=val_step, test_iter=args.test_iter,
         device=args.device, compute_dtype=compute, seed=args.seed,
-        dp=args.dp, tp=args.tp,
+        dp=args.dp, tp=args.tp, sp=args.sp,
         sampler=args.sampler, prefetch=args.prefetch,
         sampler_threads=args.sampler_threads,
         adv=getattr(args, "adv", None) is not None,
@@ -236,13 +246,31 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         na_rate=cfg.na_rate, seed=cfg.seed + 1, backend=eval_backend,
         prefetch=0, num_threads=1,
     )
-    model = build_model(cfg, glove_init=vocab.vectors if vocab is not None else None)
-
     n_dev = len(jax.devices())
-    use_mesh = (cfg.dp == 0 and n_dev > 1) or cfg.dp > 1 or cfg.tp > 1
-    train_step = eval_step = state = mesh = None
+    use_mesh = (
+        (cfg.dp == 0 and n_dev > 1) or cfg.dp > 1 or cfg.tp > 1 or cfg.sp > 1
+    )
+    train_step = eval_step = state = mesh = attn_impl = None
     if use_mesh:
-        mesh = make_mesh(dp=(cfg.dp or None), tp=cfg.tp)
+        mesh = make_mesh(dp=(cfg.dp or None), tp=cfg.tp, sp=cfg.sp)
+        if cfg.sp > 1:
+            if cfg.encoder != "transformer":
+                raise ValueError(
+                    "--sp (ring attention) requires --encoder transformer; "
+                    f"the {cfg.encoder} encoder has no sequence-parallel path"
+                )
+            from induction_network_on_fewrel_tpu.parallel.ring import (
+                make_ring_attention,
+            )
+
+            attn_impl = make_ring_attention(
+                mesh, batch_axis="dp" if mesh.shape["dp"] > 1 else None
+            )
+    model = build_model(
+        cfg, glove_init=vocab.vectors if vocab is not None else None,
+        attn_impl=attn_impl,
+    )
+    if use_mesh:
         dp = mesh.shape["dp"]
         if cfg.batch_size % dp != 0:
             raise ValueError(

@@ -55,6 +55,12 @@ class ExperimentConfig:
     bert_frozen: bool = True  # frozen -> fine-tuned regime (reference config 4)
     bert_remat: bool = False  # jax.checkpoint per layer (HBM vs FLOPs)
 
+    # Transformer encoder (models/transformer.py; ring-attention capable):
+    tfm_layers: int = 4
+    tfm_model: int = 256
+    tfm_heads: int = 4
+    tfm_ff: int = 1024
+
     # --- induction + relation modules ---
     induction_dim: int = 100  # class-vector dim C after the squash transform
     routing_iters: int = 3    # fixed trip count -> jit-exact fori_loop
@@ -88,6 +94,7 @@ class ExperimentConfig:
     # --- parallelism ---
     dp: int = 1               # data-parallel mesh axis (episodes sharded)
     tp: int = 1               # tensor-parallel mesh axis (NTN slices / hidden)
+    sp: int = 1               # sequence-parallel mesh axis (ring attention)
 
     # --- host data pipeline ---
     sampler: str = "auto"     # auto | native (C++ prefetching) | python
@@ -114,7 +121,8 @@ class ExperimentConfig:
         "pos_dim", "vocab_size", "max_length", "induction_dim",
         "routing_iters", "ntn_slices", "bert_layers", "bert_hidden",
         "bert_heads", "bert_intermediate", "bert_vocab_size",
-        "bert_vocab_path", "loss", "optimizer",
+        "bert_vocab_path", "tfm_layers", "tfm_model", "tfm_heads", "tfm_ff",
+        "loss", "optimizer",
     )
 
     def replace(self, **kw: Any) -> "ExperimentConfig":

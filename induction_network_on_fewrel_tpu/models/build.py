@@ -21,8 +21,13 @@ _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
 
 
 def build_model(
-    cfg: ExperimentConfig, glove_init: np.ndarray | None = None
+    cfg: ExperimentConfig,
+    glove_init: np.ndarray | None = None,
+    attn_impl=None,
 ) -> InductionNetwork:
+    """``attn_impl``: override the transformer encoder's attention — e.g.
+    ``parallel.ring.make_ring_attention(mesh)`` for sp-sharded long-context
+    runs. Ignored by the other encoders."""
     dtype = _DTYPES[cfg.compute_dtype]
     if cfg.encoder == "bert":
         try:
@@ -58,6 +63,17 @@ def build_model(
         )
         if cfg.encoder == "cnn":
             encoder = CNNEncoder(hidden_size=cfg.hidden_size, compute_dtype=dtype)
+        elif cfg.encoder == "transformer":
+            from induction_network_on_fewrel_tpu.models.transformer import (
+                TransformerEncoder,
+            )
+
+            encoder = TransformerEncoder(
+                num_layers=cfg.tfm_layers, d_model=cfg.tfm_model,
+                num_heads=cfg.tfm_heads, d_ff=cfg.tfm_ff,
+                max_length=cfg.max_length, compute_dtype=dtype,
+                attn_impl=attn_impl,
+            )
         elif cfg.encoder == "bilstm":
             backend = cfg.lstm_backend
             if backend == "auto":
@@ -117,6 +133,8 @@ def encoder_output_dim(cfg: ExperimentConfig) -> int:
         return cfg.bert_hidden
     if cfg.encoder == "bilstm":
         return 2 * cfg.lstm_hidden
+    if cfg.encoder == "transformer":
+        return cfg.tfm_model
     return cfg.hidden_size  # cnn
 
 

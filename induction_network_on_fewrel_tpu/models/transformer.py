@@ -1,0 +1,80 @@
+"""Transformer sentence encoder with pluggable (ring-capable) attention.
+
+A fourth encoder family beyond cnn/bilstm/bert (SURVEY.md §1 L4 contract:
+``(embedded tokens [M, L, D], mask [M, L]) -> sentence vector [M, H]``).
+Unlike the BERT path this one is sized by config (not pinned to
+bert-base) and its attention is an injectable function, which is how
+long-context sequence parallelism enters the framework: pass
+``parallel.ring.make_ring_attention(mesh)`` and the O(L²) softmax runs as a
+ring over the mesh's ``sp`` axis with k/v blocks hopping ICI neighbors —
+the model code is identical on 1 chip and on a pod.
+
+Pre-LN blocks (stable without warmup at these depths), learned positional
+embeddings, masked-mean pooling. All matmuls are [M·L, d] GEMMs on the MXU;
+bf16 compute with f32 params/softmax.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from induction_network_on_fewrel_tpu.ops import masked_mean
+from induction_network_on_fewrel_tpu.parallel.ring import dense_attention
+
+
+class TransformerEncoder(nn.Module):
+    num_layers: int = 4
+    d_model: int = 256
+    num_heads: int = 4
+    d_ff: int = 1024
+    max_length: int = 40
+    compute_dtype: jnp.dtype = jnp.float32
+    # (q, k, v, kv_mask) -> out, all [M, H, L, hd] / mask [M, L]. None ->
+    # dense single-device attention; ring attention for sp-sharded runs.
+    attn_impl: Callable | None = None
+
+    @nn.compact
+    def __call__(self, emb: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        M, L, _ = emb.shape
+        cd = self.compute_dtype
+        d, H = self.d_model, self.num_heads
+        hd = d // H
+        assert d % H == 0, "d_model must divide num_heads"
+        attn = self.attn_impl or dense_attention
+        dense = lambda dim, name: nn.Dense(
+            dim, dtype=cd, param_dtype=jnp.float32, name=name
+        )
+
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(0.02),
+            (self.max_length, d),
+        )
+        x = dense(d, "in_proj")(emb.astype(cd)) + pos[None, :L].astype(cd)
+
+        for i in range(self.num_layers):
+            h = nn.LayerNorm(dtype=cd, param_dtype=jnp.float32,
+                             name=f"ln_att_{i}")(x)
+            qkv = dense(3 * d, f"qkv_{i}")(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            split = lambda t: t.reshape(M, L, H, hd).transpose(0, 2, 1, 3)
+            out = attn(split(q), split(k), split(v), mask)
+            out = out.transpose(0, 2, 1, 3).reshape(M, L, d)
+            x = x + dense(d, f"att_out_{i}")(out)
+
+            h = nn.LayerNorm(dtype=cd, param_dtype=jnp.float32,
+                             name=f"ln_mlp_{i}")(x)
+            # Layer names match the tp partition rules in parallel/sharding.py
+            # (intermediate column-sharded, mlp_out row-sharded).
+            h = nn.gelu(dense(self.d_ff, f"intermediate_{i}")(h))
+            x = x + dense(d, f"mlp_out_{i}")(h)
+
+        x = nn.LayerNorm(dtype=cd, param_dtype=jnp.float32, name="ln_final")(x)
+        return masked_mean(x, mask[..., None], axis=-2).astype(cd)
+
+    @property
+    def output_dim(self) -> int:
+        return self.d_model

@@ -25,15 +25,25 @@ import numpy as np
 from jax.sharding import Mesh
 
 
-def make_mesh(dp: int | None = None, tp: int = 1, devices=None) -> Mesh:
-    """Build a (dp, tp) mesh. ``dp=None`` -> use all remaining devices."""
+def make_mesh(
+    dp: int | None = None, tp: int = 1, sp: int = 1, devices=None
+) -> Mesh:
+    """Build a (dp, tp, sp) mesh. ``dp=None`` -> use all remaining devices.
+
+    ``sp`` is the sequence-parallel axis consumed by ``parallel/ring.py``
+    (ring attention); it is innermost so the per-hop ppermute of k/v blocks
+    rides neighbor ICI links. A size-1 sp axis is free — PartitionSpecs that
+    never mention it behave exactly as on a 2-D mesh.
+    """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if dp is None:
-        if n % tp != 0:
-            raise ValueError(f"{n} devices not divisible by tp={tp}")
-        dp = n // tp
-    if dp * tp > n:
-        raise ValueError(f"dp*tp={dp * tp} exceeds {n} available devices")
-    grid = np.asarray(devices[: dp * tp]).reshape(dp, tp)
-    return Mesh(grid, axis_names=("dp", "tp"))
+        if n % (tp * sp) != 0:
+            raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
+        dp = n // (tp * sp)
+    if dp * tp * sp > n:
+        raise ValueError(
+            f"dp*tp*sp={dp * tp * sp} exceeds {n} available devices"
+        )
+    grid = np.asarray(devices[: dp * tp * sp]).reshape(dp, tp, sp)
+    return Mesh(grid, axis_names=("dp", "tp", "sp"))

@@ -1,0 +1,112 @@
+"""Ring attention: sequence-parallel exact attention over an ``sp`` mesh axis.
+
+The reference never needs sequence parallelism (sentences are <=128 tokens,
+SURVEY.md §5.7), but this framework treats long-context as first-class: when
+a sequence no longer fits one chip's HBM (or its O(L²) attention one chip's
+FLOP budget), the sequence axis shards over the mesh's ``sp`` axis and
+attention runs as a ring (Liu et al. 2023, "Ring Attention with Blockwise
+Transformers"):
+
+* every device keeps its local query block resident;
+* key/value (+ key-padding-mask) blocks travel around the ring via
+  ``lax.ppermute`` over ICI, one hop per step, so after ``sp`` steps every
+  query block has attended to every key block;
+* softmax never materializes globally — the flash-attention online
+  (running-max, running-denominator) recurrence folds each arriving block
+  into the accumulator, keeping memory O(L·L/sp) per device;
+* compute and the ppermute transfer overlap: XLA double-buffers the ring
+  (the next block is in flight while the current one multiplies on the MXU).
+
+Exactness (vs. blockwise-approximate schemes) is tested against dense
+attention on an 8-virtual-device CPU mesh in tests/test_ring.py, forward
+and gradient.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30
+
+
+def dense_attention(q, k, v, kv_mask=None):
+    """Reference O(L²) attention. q,k,v: [B, H, L, D]; kv_mask: [B, L]."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :] > 0, s, _NEG)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+def ring_attention_local(q, k, v, kv_mask, axis_name: str):
+    """Per-device ring attention body — call inside shard_map.
+
+    q, k, v: [B, H, Lc, D] local chunks (sequence axis sharded over
+    ``axis_name``); kv_mask: [B, Lc] key-padding mask chunk that travels
+    with k/v. Returns the local output chunk [B, H, Lc, D].
+    """
+    n = jax.lax.axis_size(axis_name)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    B, H, Lc, D = q.shape
+    q32 = q.astype(jnp.float32)
+
+    m0 = jnp.full((B, H, Lc), _NEG, jnp.float32)        # running max
+    l0 = jnp.zeros((B, H, Lc), jnp.float32)             # running denominator
+    acc0 = jnp.zeros((B, H, Lc, D), jnp.float32)        # unnormalized out
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, _):
+        m, l, acc, k_blk, v_blk, msk = carry
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
+        s = jnp.where(msk[:, None, None, :] > 0, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        # Rotate k/v/mask one hop around the ring (ICI neighbor exchange).
+        k_blk, v_blk, msk = jax.lax.ppermute(
+            (k_blk, v_blk, msk), axis_name, perm
+        )
+        return (m_new, l, acc, k_blk, v_blk, msk), None
+
+    (m, l, acc, *_), _ = jax.lax.scan(
+        step, (m0, l0, acc0, k, v, kv_mask), None, length=n
+    )
+    return (acc / (l[..., None] + 1e-30)).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, axis: str = "sp", batch_axis: str | None = None):
+    """Global-view ring attention: q,k,v [B,H,L,D] sharded on L over ``axis``.
+
+    Returns a jittable fn(q, k, v, kv_mask) -> [B,H,L,D]. When composing with
+    data parallelism, pass ``batch_axis`` so the batch dimension's sharding
+    is declared too (each dp group runs its own independent ring; no
+    collectives cross dp).
+    """
+    b = batch_axis
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(b, None, axis, None),
+            P(b, None, axis, None),
+            P(b, None, axis, None),
+            P(b, axis),
+        ),
+        out_specs=P(b, None, axis, None),
+        check_vma=False,
+    )
+    def fn(q, k, v, kv_mask):
+        return ring_attention_local(q, k, v, kv_mask, axis)
+
+    return fn

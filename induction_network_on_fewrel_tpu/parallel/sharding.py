@@ -34,10 +34,13 @@ _BATCH_KEYS = ("word", "pos1", "pos2", "mask")
 _TP_RULES: tuple[tuple[str, P], ...] = (
     # NTN bilinear tensor M[h, C, C]: shard the slice axis h.
     ("tensor_slices", P("tp", None, None)),
-    # BERT-style transformer blocks (models/bert.py): Megatron-style — MLP
-    # up-projection column-sharded, down-projection row-sharded.
-    ("intermediate/kernel", P(None, "tp")),
-    ("mlp_out/kernel", P("tp", None)),
+    # Transformer blocks (models/bert.py, models/transformer.py):
+    # Megatron-style — MLP up-projection column-sharded, down-projection
+    # row-sharded. Bare substrings so both "intermediate/kernel" (bert) and
+    # "intermediate_3/kernel" (transformer) match; the rank check keeps
+    # biases replicated.
+    ("intermediate", P(None, "tp")),
+    ("mlp_out", P("tp", None)),
 )
 
 

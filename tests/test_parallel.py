@@ -116,4 +116,6 @@ def test_mesh_validation():
     with pytest.raises(ValueError):
         make_mesh(dp=16, tp=1)
     m = make_mesh(tp=2)  # dp inferred = 4
-    assert m.shape == {"dp": 4, "tp": 2}
+    assert m.shape == {"dp": 4, "tp": 2, "sp": 1}
+    m = make_mesh(tp=2, sp=2)  # dp inferred = 2
+    assert m.shape == {"dp": 2, "tp": 2, "sp": 2}

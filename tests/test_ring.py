@@ -1,0 +1,156 @@
+"""Ring attention (parallel/ring.py) + transformer encoder (long-context).
+
+Exactness is the whole point: ring attention over the sp mesh axis must
+equal dense attention bit-for-tolerance, forward AND gradient, including
+key-padding masks — on the 8-virtual-device CPU mesh from conftest.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    GloveTokenizer,
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+from induction_network_on_fewrel_tpu.parallel import make_mesh
+from induction_network_on_fewrel_tpu.parallel.ring import (
+    dense_attention,
+    make_ring_attention,
+)
+from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+
+L = 16
+
+
+def _qkvm(key, B=2, H=4, Lq=16, D=8, pad=3):
+    ks = jax.random.split(key, 3)
+    q, k, v = (jax.random.normal(kk, (B, H, Lq, D), jnp.float32) for kk in ks)
+    mask = np.ones((B, Lq), np.float32)
+    mask[:, Lq - pad:] = 0.0  # padded key positions
+    return q, k, v, jnp.asarray(mask)
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ring_matches_dense_forward(sp):
+    mesh = make_mesh(dp=1, tp=1, sp=sp)
+    ring = make_ring_attention(mesh)
+    q, k, v, mask = _qkvm(jax.random.key(0))
+    got = jax.jit(ring)(q, k, v, mask)
+    want = dense_attention(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_ring_matches_dense_gradient():
+    mesh = make_mesh(dp=1, tp=1, sp=4)
+    ring = make_ring_attention(mesh)
+    q, k, v, mask = _qkvm(jax.random.key(1))
+
+    def loss(fn, q, k, v):
+        out = fn(q, k, v, mask)
+        # weighted sum -> nontrivial cotangents
+        w = jnp.arange(out.size, dtype=jnp.float32).reshape(out.shape) / out.size
+        return jnp.sum(out * w)
+
+    g_ring = jax.grad(lambda *a: loss(ring, *a), argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(lambda *a: loss(dense_attention, *a), argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd), atol=1e-5)
+
+
+def test_ring_with_dp_batch_axis():
+    """dp x sp composition: independent rings per dp group."""
+    mesh = make_mesh(dp=2, tp=1, sp=4)
+    ring = make_ring_attention(mesh, batch_axis="dp")
+    q, k, v, mask = _qkvm(jax.random.key(2), B=4)
+    got = jax.jit(ring)(q, k, v, mask)
+    want = dense_attention(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+# --- transformer encoder ----------------------------------------------------
+
+TFM = ExperimentConfig(
+    model="proto", encoder="transformer", train_n=3, n=3, k=2, q=2,
+    batch_size=2, max_length=L, vocab_size=302, compute_dtype="float32",
+    tfm_layers=2, tfm_model=64, tfm_heads=4, tfm_ff=128, loss="ce",
+)
+
+
+@pytest.fixture(scope="module")
+def episode():
+    vocab = make_synthetic_glove(vocab_size=300)
+    ds = make_synthetic_fewrel(num_relations=6, instances_per_relation=10, vocab_size=300)
+    tok = GloveTokenizer(vocab, max_length=L)
+    s = EpisodeSampler(ds, tok, n=3, k=2, q=2, batch_size=2, seed=0)
+    return vocab, batch_to_model_inputs(s.sample_batch())
+
+
+def test_transformer_encoder_shapes(episode):
+    vocab, (sup, qry, _) = episode
+    model = build_model(TFM, glove_init=vocab.vectors)
+    params = model.init(jax.random.key(0), sup, qry)
+    logits = model.apply(params, sup, qry)
+    assert logits.shape == (2, 6, 3)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_transformer_ring_equals_dense_end_to_end(episode):
+    """The SAME params, dense single-device vs ring-under-sp: identical
+    logits. Sequence parallelism must be invisible to the model."""
+    vocab, (sup, qry, _) = episode
+    dense_model = build_model(TFM, glove_init=vocab.vectors)
+    params = dense_model.init(jax.random.key(0), sup, qry)
+    want = dense_model.apply(params, sup, qry)
+
+    mesh = make_mesh(dp=1, tp=1, sp=8)
+    ring_model = build_model(
+        TFM, glove_init=vocab.vectors, attn_impl=make_ring_attention(mesh)
+    )
+    got = ring_model.apply(params, sup, qry)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+def test_transformer_trains_end_to_end():
+    from induction_network_on_fewrel_tpu.train.steps import init_state, make_train_step
+
+    cfg = TFM.replace(lr=1e-3)
+    vocab = make_synthetic_glove(vocab_size=300)
+    ds = make_synthetic_fewrel(num_relations=6, instances_per_relation=10, vocab_size=300)
+    tok = GloveTokenizer(vocab, max_length=L)
+    sampler = EpisodeSampler(ds, tok, n=3, k=2, q=2, batch_size=2, seed=0)
+    model = build_model(cfg, glove_init=vocab.vectors)
+    sup, qry, label = batch_to_model_inputs(sampler.sample_batch())
+    state = init_state(model, cfg, sup, qry)
+    step = make_train_step(model, cfg)
+    first = None
+    for _ in range(30):
+        state, metrics = step(state, sup, qry, label)
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first
+
+
+def test_sp_train_step_runs_sharded(episode):
+    """Full GSPMD train step with the ring-attention transformer on a
+    (dp=2, sp=4) mesh: compiles, executes, finite loss."""
+    from induction_network_on_fewrel_tpu.parallel.sharding import (
+        make_sharded_train_step,
+    )
+    from induction_network_on_fewrel_tpu.train.steps import init_state
+
+    vocab, (sup, qry, label) = episode
+    mesh = make_mesh(dp=2, tp=1, sp=4)
+    model = build_model(
+        TFM, glove_init=vocab.vectors,
+        attn_impl=make_ring_attention(mesh, batch_axis=None),
+    )
+    state = init_state(model, TFM, sup, qry)
+    step = make_sharded_train_step(model, TFM, mesh, state)
+    state, metrics = step(state, sup, qry, label)
+    assert np.isfinite(float(metrics["loss"]))
