@@ -80,6 +80,10 @@ def lstm_scan(xg: jnp.ndarray, whh: jnp.ndarray) -> jnp.ndarray:
 
 
 def _fwd_kernel(xg_ref, whh_ref, hs_ref, cs_ref, gates_ref, h_scr, c_scr):
+    # All tensor blocks are TIME-MAJOR [1, TM, *]: the iterated (time) axis
+    # must be a leading block dim of size 1 — the TPU lowering constrains
+    # only the LAST TWO block dims to (8k, 128k)-divisible-or-full, which a
+    # middle time axis of block 1 violates (bench-caught on real v5e).
     t = pl.program_id(1)
     u = whh_ref.shape[0]
 
@@ -88,7 +92,7 @@ def _fwd_kernel(xg_ref, whh_ref, hs_ref, cs_ref, gates_ref, h_scr, c_scr):
         h_scr[...] = jnp.zeros_like(h_scr)
         c_scr[...] = jnp.zeros_like(c_scr)
 
-    a = xg_ref[:, 0, :] + jnp.dot(
+    a = xg_ref[0] + jnp.dot(
         h_scr[...], whh_ref[...], preferred_element_type=jnp.float32
     )
     i, f, g, o = _gates(a, u)
@@ -96,9 +100,9 @@ def _fwd_kernel(xg_ref, whh_ref, hs_ref, cs_ref, gates_ref, h_scr, c_scr):
     h = o * jnp.tanh(c)
     h_scr[...] = h
     c_scr[...] = c
-    hs_ref[:, 0, :] = h
-    cs_ref[:, 0, :] = c
-    gates_ref[:, 0, :] = jnp.concatenate([i, f, g, o], axis=-1)
+    hs_ref[0] = h
+    cs_ref[0] = c
+    gates_ref[0] = jnp.concatenate([i, f, g, o], axis=-1)
 
 
 def _fwd_kernel_infer(xg_ref, whh_ref, hs_ref, h_scr, c_scr):
@@ -116,7 +120,7 @@ def _fwd_kernel_infer(xg_ref, whh_ref, hs_ref, h_scr, c_scr):
         h_scr[...] = jnp.zeros_like(h_scr)
         c_scr[...] = jnp.zeros_like(c_scr)
 
-    a = xg_ref[:, 0, :] + jnp.dot(
+    a = xg_ref[0] + jnp.dot(
         h_scr[...], whh_ref[...], preferred_element_type=jnp.float32
     )
     i, f, g, o = _gates(a, u)
@@ -124,7 +128,7 @@ def _fwd_kernel_infer(xg_ref, whh_ref, hs_ref, h_scr, c_scr):
     h = o * jnp.tanh(c)
     h_scr[...] = h
     c_scr[...] = c
-    hs_ref[:, 0, :] = h
+    hs_ref[0] = h
 
 
 def _bwd_kernel(
@@ -142,17 +146,17 @@ def _bwd_kernel(
         dc_scr[...] = jnp.zeros_like(dc_scr)
         dwhh_scr[...] = jnp.zeros_like(dwhh_scr)
 
-    gates = gates_ref[:, 0, :]
+    gates = gates_ref[0]
     i, f, g, o = (gates[:, k * u : (k + 1) * u] for k in range(4))
-    c_t = cs_ref[:, 0, :]
+    c_t = cs_ref[0]
     tc = jnp.tanh(c_t)
     # The rt-1 index maps clamp at 0; mask the rt == 0 step to the true
     # zero initial state.
     first = (rt == 0).astype(jnp.float32)
-    c_prev = cs_prev_ref[:, 0, :] * (1.0 - first)
-    h_prev = hs_prev_ref[:, 0, :] * (1.0 - first)
+    c_prev = cs_prev_ref[0] * (1.0 - first)
+    h_prev = hs_prev_ref[0] * (1.0 - first)
 
-    dh_t = dhs_ref[:, 0, :] + dh_scr[...]
+    dh_t = dhs_ref[0] + dh_scr[...]
     da_o = dh_t * tc * o * (1.0 - o)
     dct = dc_scr[...] + dh_t * o * (1.0 - tc * tc)
     da_i = dct * g * i * (1.0 - i)
@@ -160,7 +164,7 @@ def _bwd_kernel(
     da_f = dct * c_prev * f * (1.0 - f)
     da = jnp.concatenate([da_i, da_f, da_g, da_o], axis=-1)  # [TM, 4u]
 
-    dxg_ref[:, 0, :] = da
+    dxg_ref[0] = da
     dh_scr[...] = jax.lax.dot_general(
         da, whh_ref[...], (((1,), (1,)), ((), ())),  # da @ whh^T
         preferred_element_type=jnp.float32,
@@ -180,36 +184,40 @@ def _pad_rows(x: jnp.ndarray, tm: int) -> jnp.ndarray:
 
 
 def _fwd_call(xg: jnp.ndarray, whh: jnp.ndarray, interpret: bool):
+    """Returns TIME-MAJOR (hs [M,L,u] plus residuals cs/gates [L,Mp,*])."""
     M, L, G = xg.shape
     u = G // 4
     xg32 = _pad_rows(xg.astype(jnp.float32), _TM)
     Mp = xg32.shape[0]
+    xg_t = jnp.swapaxes(xg32, 0, 1)  # [L, Mp, G] time-major for the kernel
     grid = (Mp // _TM, L)
     out = pl.pallas_call(
         _fwd_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_TM, 1, G), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((1, _TM, G), lambda i, t: (t, i, 0)),
             pl.BlockSpec((u, G), lambda i, t: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((_TM, 1, u), lambda i, t: (i, t, 0)),
-            pl.BlockSpec((_TM, 1, u), lambda i, t: (i, t, 0)),
-            pl.BlockSpec((_TM, 1, G), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((1, _TM, u), lambda i, t: (t, i, 0)),
+            pl.BlockSpec((1, _TM, u), lambda i, t: (t, i, 0)),
+            pl.BlockSpec((1, _TM, G), lambda i, t: (t, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((Mp, L, u), jnp.float32),  # hs
-            jax.ShapeDtypeStruct((Mp, L, u), jnp.float32),  # cs
-            jax.ShapeDtypeStruct((Mp, L, G), jnp.float32),  # gate activations
+            jax.ShapeDtypeStruct((L, Mp, u), jnp.float32),  # hs
+            jax.ShapeDtypeStruct((L, Mp, u), jnp.float32),  # cs
+            jax.ShapeDtypeStruct((L, Mp, G), jnp.float32),  # gate activations
         ],
         scratch_shapes=[
             pltpu.VMEM((_TM, u), jnp.float32),
             pltpu.VMEM((_TM, u), jnp.float32),
         ],
         interpret=interpret,
-    )(xg32, whh.astype(jnp.float32))
+    )(xg_t, whh.astype(jnp.float32))
     hs, cs, gates = out
-    return hs[:M], cs[:M], gates[:M]
+    # Residuals stay time-major/padded — the backward kernel consumes them
+    # as-is; only the user-facing hs is transposed back.
+    return jnp.swapaxes(hs, 0, 1)[:M], hs, cs, gates
 
 
 def _fwd_call_infer(xg: jnp.ndarray, whh: jnp.ndarray, interpret: bool):
@@ -217,54 +225,54 @@ def _fwd_call_infer(xg: jnp.ndarray, whh: jnp.ndarray, interpret: bool):
     u = G // 4
     xg32 = _pad_rows(xg.astype(jnp.float32), _TM)
     Mp = xg32.shape[0]
+    xg_t = jnp.swapaxes(xg32, 0, 1)  # [L, Mp, G]
     grid = (Mp // _TM, L)
     hs = pl.pallas_call(
         _fwd_kernel_infer,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_TM, 1, G), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((1, _TM, G), lambda i, t: (t, i, 0)),
             pl.BlockSpec((u, G), lambda i, t: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((_TM, 1, u), lambda i, t: (i, t, 0)),
-        out_shape=jax.ShapeDtypeStruct((Mp, L, u), jnp.float32),
+        out_specs=pl.BlockSpec((1, _TM, u), lambda i, t: (t, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((L, Mp, u), jnp.float32),
         scratch_shapes=[
             pltpu.VMEM((_TM, u), jnp.float32),
             pltpu.VMEM((_TM, u), jnp.float32),
         ],
         interpret=interpret,
-    )(xg32, whh.astype(jnp.float32))
-    return hs[:M]
+    )(xg_t, whh.astype(jnp.float32))
+    return jnp.swapaxes(hs, 0, 1)[:M]
 
 
-def _bwd_call(dhs, gates, cs, hs, whh, interpret: bool):
+def _bwd_call(dhs, gates_t, cs_t, hs_t, whh, interpret: bool):
+    """dhs: [M, L, u] cotangent; gates_t/cs_t/hs_t: TIME-MAJOR padded
+    residuals [L, Mp, *] straight from the forward kernel."""
     M, L, u = dhs.shape
     G = 4 * u
-    dhs32 = _pad_rows(dhs.astype(jnp.float32), _TM)
-    gates32 = _pad_rows(gates, _TM)
-    cs32 = _pad_rows(cs, _TM)
-    hs32 = _pad_rows(hs, _TM)
-    Mp = dhs32.shape[0]
+    dhs_t = jnp.swapaxes(_pad_rows(dhs.astype(jnp.float32), _TM), 0, 1)
+    Mp = dhs_t.shape[1]
     ntiles = Mp // _TM
     grid = (ntiles, L)
-    rev = lambda i, t: (i, L - 1 - t, 0)           # noqa: E731
-    rev_prev = lambda i, t: (i, max_0(L - 2 - t), 0)  # noqa: E731
+    rev = lambda i, t: (L - 1 - t, i, 0)           # noqa: E731
+    rev_prev = lambda i, t: (max_0(L - 2 - t), i, 0)  # noqa: E731
     dxg, dwhh_p = pl.pallas_call(
         _bwd_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_TM, 1, u), rev),       # dhs
-            pl.BlockSpec((_TM, 1, G), rev),       # gates
-            pl.BlockSpec((_TM, 1, u), rev),       # cs
-            pl.BlockSpec((_TM, 1, u), rev_prev),  # cs_{t-1} (clamped)
-            pl.BlockSpec((_TM, 1, u), rev_prev),  # hs_{t-1} (clamped)
+            pl.BlockSpec((1, _TM, u), rev),       # dhs
+            pl.BlockSpec((1, _TM, G), rev),       # gates
+            pl.BlockSpec((1, _TM, u), rev),       # cs
+            pl.BlockSpec((1, _TM, u), rev_prev),  # cs_{t-1} (clamped)
+            pl.BlockSpec((1, _TM, u), rev_prev),  # hs_{t-1} (clamped)
             pl.BlockSpec((u, G), lambda i, t: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((_TM, 1, G), rev),
+            pl.BlockSpec((1, _TM, G), rev),
             pl.BlockSpec((1, u, G), lambda i, t: (i, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((Mp, L, G), jnp.float32),
+            jax.ShapeDtypeStruct((L, Mp, G), jnp.float32),
             jax.ShapeDtypeStruct((ntiles, u, G), jnp.float32),
         ],
         scratch_shapes=[
@@ -274,8 +282,8 @@ def _bwd_call(dhs, gates, cs, hs, whh, interpret: bool):
         ],
         interpret=interpret,
         # cs appears twice: once at rt, once at rt-1 (separate index maps).
-    )(dhs32, gates32, cs32, cs32, hs32, whh.astype(jnp.float32))
-    return dxg[:M], dwhh_p.sum(axis=0)
+    )(dhs_t, gates_t, cs_t, cs_t, hs_t, whh.astype(jnp.float32))
+    return jnp.swapaxes(dxg, 0, 1)[:M], dwhh_p.sum(axis=0)
 
 
 def max_0(v):
@@ -294,13 +302,13 @@ def _lstm_pallas(xg, whh, interpret=False):
 
 
 def _lstm_pallas_fwd(xg, whh, interpret):
-    hs, cs, gates = _fwd_call(xg, whh, interpret)
-    return hs, (hs, cs, gates, whh)
+    hs, hs_t, cs_t, gates_t = _fwd_call(xg, whh, interpret)
+    return hs, (hs_t, cs_t, gates_t, whh)
 
 
 def _lstm_pallas_bwd(interpret, res, dhs):
-    hs, cs, gates, whh = res
-    return _bwd_call(dhs, gates, cs, hs, whh, interpret)
+    hs_t, cs_t, gates_t, whh = res
+    return _bwd_call(dhs, gates_t, cs_t, hs_t, whh, interpret)
 
 
 _lstm_pallas.defvjp(_lstm_pallas_fwd, _lstm_pallas_bwd)
