@@ -95,7 +95,9 @@ class BertBackbone(nn.Module):
     compute_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, ids: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    def __call__(
+        self, ids: jnp.ndarray, mask: jnp.ndarray, segment_ids=None
+    ) -> jnp.ndarray:
         B, L = ids.shape
         word = nn.Embed(
             self.vocab_size, self.hidden_size, param_dtype=jnp.float32, name="tok_emb"
@@ -103,10 +105,16 @@ class BertBackbone(nn.Module):
         pos = self.param(
             "pos_emb", nn.initializers.normal(0.02), (self.max_position, self.hidden_size)
         )[:L]
-        seg = self.param(
+        seg_table = self.param(
             "seg_emb", nn.initializers.normal(0.02), (self.type_vocab, self.hidden_size)
-        )[0]
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(word + pos[None] + seg[None, None])
+        )
+        # Single-sentence callers (the default) are all segment 0; the pair
+        # model passes explicit 0/1 ids for its two-sentence inputs.
+        seg = (
+            seg_table[0][None, None] if segment_ids is None
+            else seg_table[segment_ids]
+        )
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(word + pos[None] + seg)
         x = x.astype(self.compute_dtype)
 
         layer_cls = nn.remat(BertLayer) if self.remat else BertLayer

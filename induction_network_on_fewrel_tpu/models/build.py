@@ -29,6 +29,27 @@ def build_model(
     ``parallel.ring.make_ring_attention(mesh)`` for sp-sharded long-context
     runs. Ignored by the other encoders."""
     dtype = _DTYPES[cfg.compute_dtype]
+    if cfg.model == "pair":
+        # BERT-PAIR consumes raw token ids pairwise — it owns its backbone
+        # and bypasses the embedding/encoder split entirely.
+        if cfg.encoder != "bert":
+            raise ValueError(
+                "--model pair requires --encoder bert "
+                "(token-level sequence-pair input)"
+            )
+        from induction_network_on_fewrel_tpu.models.pair import PairModel
+
+        return PairModel(
+            vocab_size=cfg.bert_vocab_size,
+            num_layers=cfg.bert_layers,
+            hidden_size=cfg.bert_hidden,
+            num_heads=cfg.bert_heads,
+            intermediate_size=cfg.bert_intermediate,
+            frozen=cfg.bert_frozen,
+            remat=cfg.bert_remat,
+            nota=cfg.na_rate > 0,
+            compute_dtype=dtype,
+        )
     if cfg.encoder == "bert":
         try:
             from induction_network_on_fewrel_tpu.models.bert import (

@@ -1,0 +1,91 @@
+"""BERT-PAIR few-shot model (pair).
+
+The FewRel 2.0 NOTA baseline from the reference family (Gao et al., EMNLP
+2019): instead of encoding sentences independently, every (query, support)
+pair is concatenated at the TOKEN level and scored by the BERT backbone as a
+single sequence-pair match; a query's logit for class i aggregates its K
+match scores against that class's supports, and none-of-the-above falls out
+naturally as a learned threshold against all N aggregated scores.
+
+Layout per pair: ``[CLS] query [SEP] | [CLS] support [SEP]`` — each side is
+an already-tokenized fixed-L block (data/bert_tokenizer.py), joined along
+the token axis with segment ids 0/1; the pad positions inside each block
+stay masked. (Canonical BERT-PAIR re-packs tokens tightly after one [CLS];
+with fixed-shape blocks the second [CLS] serves as the separator. With
+random-init backbones — no pretrained weights ship in this sandbox — the
+distinction is purely conventional; swap the packing if importing HF
+weights for exact parity.)
+
+Cost note: this model runs B·TQ·N·K sequences of length 2L through the
+backbone per step — quadratic in the episode, exactly like the reference's
+BERT-PAIR. Batch sizes must be chosen accordingly.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from induction_network_on_fewrel_tpu.models.bert import BertBackbone
+
+
+class PairModel(nn.Module):
+    vocab_size: int
+    num_layers: int = 12
+    hidden_size: int = 768
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    frozen: bool = False
+    remat: bool = False
+    nota: bool = False
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, support: dict[str, Any], query: dict[str, Any]) -> jnp.ndarray:
+        s_ids, s_mask = support["word"], support["mask"]
+        q_ids, q_mask = query["word"], query["mask"]
+        B, N, K, L = s_ids.shape
+        TQ = q_ids.shape[1]
+
+        with jax.named_scope("pair_build"):
+            def pairs(qx, sx):
+                q = jnp.broadcast_to(qx[:, :, None, None], (B, TQ, N, K, L))
+                s = jnp.broadcast_to(sx[:, None], (B, TQ, N, K, L))
+                return jnp.concatenate([q, s], axis=-1).reshape(-1, 2 * L)
+
+            ids = pairs(q_ids, s_ids)
+            mask = pairs(q_mask.astype(jnp.float32), s_mask.astype(jnp.float32))
+            seg = jnp.concatenate(
+                [jnp.zeros((ids.shape[0], L), jnp.int32),
+                 jnp.ones((ids.shape[0], L), jnp.int32)], axis=-1
+            )
+
+        with jax.named_scope("pair_backbone"):
+            hidden = BertBackbone(
+                vocab_size=self.vocab_size,
+                num_layers=self.num_layers,
+                hidden_size=self.hidden_size,
+                num_heads=self.num_heads,
+                intermediate_size=self.intermediate_size,
+                remat=self.remat,
+                compute_dtype=self.compute_dtype,
+                name="backbone",
+            )(ids, mask, segment_ids=seg)
+            if self.frozen:
+                hidden = jax.lax.stop_gradient(hidden)
+
+        with jax.named_scope("pair_score"):
+            match = nn.Dense(
+                1, dtype=self.compute_dtype, param_dtype=jnp.float32,
+                name="match_head",
+            )(hidden[:, 0])[..., 0]                       # [B*TQ*N*K]
+            logits = match.reshape(B, TQ, N, K).astype(jnp.float32).mean(-1)
+
+        if self.nota:
+            na = self.param("nota_logit", nn.initializers.zeros, (1,))
+            na = jnp.broadcast_to(na, (B, TQ, 1))
+            logits = jnp.concatenate([logits, na], axis=-1)
+        return logits.astype(jnp.float32)

@@ -135,3 +135,57 @@ def test_trains_end_to_end(name):
         if first is None:
             first = float(metrics["loss"])
     assert float(metrics["loss"]) < first
+
+
+# --- BERT-PAIR -------------------------------------------------------------
+
+PAIR = BASE.replace(
+    model="pair", encoder="bert", bert_layers=2, bert_hidden=32,
+    bert_heads=2, bert_intermediate=64, bert_vocab_size=64, bert_frozen=False,
+)
+
+
+def _pair_episode():
+    from induction_network_on_fewrel_tpu.data.bert_tokenizer import BertTokenizer
+
+    ds = make_synthetic_fewrel(num_relations=8, instances_per_relation=10, vocab_size=300)
+    tok = BertTokenizer(L, vocab_size=64)
+    s = EpisodeSampler(ds, tok, n=4, k=2, q=3, batch_size=2, seed=0)
+    return batch_to_model_inputs(s.sample_batch())
+
+
+def test_pair_forward_shapes():
+    sup, qry, label = _pair_episode()
+    model = build_model(PAIR)
+    params = model.init(jax.random.key(0), sup, qry)
+    logits = model.apply(params, sup, qry)
+    assert logits.shape == (2, 12, 4)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_pair_nota_head():
+    sup, qry, _ = _pair_episode()
+    model = build_model(PAIR.replace(na_rate=1))
+    params = model.init(jax.random.key(0), sup, qry)
+    assert model.apply(params, sup, qry).shape == (2, 12, 5)
+
+
+def test_pair_requires_bert():
+    with pytest.raises(ValueError, match="encoder bert"):
+        build_model(BASE.replace(model="pair", encoder="cnn"))
+
+
+def test_pair_trains_end_to_end():
+    from induction_network_on_fewrel_tpu.train.steps import init_state, make_train_step
+
+    cfg = PAIR.replace(loss="ce", lr=1e-3)
+    sup, qry, label = _pair_episode()
+    model = build_model(cfg)
+    state = init_state(model, cfg, sup, qry)
+    step = make_train_step(model, cfg)
+    first = None
+    for _ in range(20):
+        state, metrics = step(state, sup, qry, label)
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first
