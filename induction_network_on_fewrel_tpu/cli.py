@@ -289,11 +289,6 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
 
     adv_pieces = None
     if cfg.adv and not only_test:
-        if use_mesh:
-            raise NotImplementedError(
-                "--adv currently runs single-device; drop --dp/--tp "
-                "(mesh-sharded DANN step not wired yet)"
-            )
         from induction_network_on_fewrel_tpu.data import (
             load_fewrel_json,
             make_synthetic_fewrel,
@@ -321,9 +316,28 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
                 seed=97,
             )
         disc = DomainDiscriminator(hidden=cfg.adv_dis_hidden)
+        disc_state = init_disc_state(disc, cfg, encoder_output_dim(cfg))
+        if use_mesh:
+            from induction_network_on_fewrel_tpu.parallel.sharding import (
+                make_sharded_adv_train_step,
+                shard_state,
+            )
+
+            dp = mesh.shape["dp"]
+            if cfg.adv_batch % dp != 0:
+                raise ValueError(
+                    f"--adv_batch {cfg.adv_batch} must be divisible by the "
+                    f"data-parallel mesh axis dp={dp}"
+                )
+            disc_state = shard_state(disc_state, mesh)
+            adv_step = make_sharded_adv_train_step(
+                model, disc, cfg, mesh, state, disc_state
+            )
+        else:
+            adv_step = make_adv_train_step(model, disc, cfg)
         adv_pieces = AdvPieces(
-            step=make_adv_train_step(model, disc, cfg),
-            disc_state=init_disc_state(disc, cfg, encoder_output_dim(cfg)),
+            step=adv_step,
+            disc_state=disc_state,
             src_sampler=InstanceSampler(train_ds, tok, cfg.adv_batch, seed=cfg.seed + 31),
             tgt_sampler=InstanceSampler(tgt_ds, tok, cfg.adv_batch, seed=cfg.seed + 32),
         )

@@ -175,3 +175,72 @@ def make_shard_map_train_step(model, cfg: ExperimentConfig, mesh: Mesh):
         return new_state, metrics
 
     return jax.jit(sharded, donate_argnums=(0,))
+
+
+# --- GSPMD adversarial (DANN) step -----------------------------------------
+
+
+def make_sharded_adv_train_step(
+    model, disc, cfg: ExperimentConfig, mesh: Mesh,
+    state_example, disc_state_example,
+):
+    """Mesh-sharded twin of train.steps.make_adv_train_step: episode batch
+    AND the unlabeled (source, target) instance batches shard over ``dp``;
+    both TrainStates follow the standard partition rules. XLA inserts the
+    gradient all-reduces — the domain game stays one program."""
+    from induction_network_on_fewrel_tpu.models.base import FewShotModel
+    from induction_network_on_fewrel_tpu.models.losses import cross_entropy_loss
+    from induction_network_on_fewrel_tpu.ops import gradient_reversal
+    import jax.numpy as jnp
+
+    st_sh = state_shardings(state_example, mesh)
+    dst_sh = state_shardings(disc_state_example, mesh)
+    repl = NamedSharding(mesh, P())
+    sup_sh, qry_sh, lab_sh = episode_batch_shardings(mesh)
+    inst_sh = {k: NamedSharding(mesh, P("dp", None)) for k in _BATCH_KEYS}
+    lam = cfg.adv_lambda
+
+    def encode(params, batch):
+        return model.apply(
+            params, batch["word"], batch["pos1"], batch["pos2"], batch["mask"],
+            method=FewShotModel.encode,
+        )
+
+    def step(state, disc_state, support, query, label, src, tgt):
+        def loss_fn(params, disc_params):
+            logits = model.apply(params, support, query)
+            fs_loss = LOSS_FNS[cfg.loss](logits, label)
+            feat = jnp.concatenate(
+                [encode(params, src), encode(params, tgt)], axis=0
+            )
+            dom_label = jnp.concatenate(
+                [jnp.zeros(src["word"].shape[0], jnp.int32),
+                 jnp.ones(tgt["word"].shape[0], jnp.int32)]
+            )
+            dom_logits = disc.apply(disc_params, gradient_reversal(feat, lam))
+            dom_loss = cross_entropy_loss(dom_logits[None], dom_label[None])
+            metrics = {
+                "loss": fs_loss,
+                "accuracy": accuracy(logits, label),
+                "domain_loss": dom_loss,
+                "domain_accuracy": accuracy(dom_logits[None], dom_label[None]),
+            }
+            return fs_loss + dom_loss, metrics
+
+        grads, metrics = jax.grad(loss_fn, argnums=(0, 1), has_aux=True)(
+            state.params, disc_state.params
+        )
+        return (
+            state.apply_gradients(grads=grads[0]),
+            disc_state.apply_gradients(grads=grads[1]),
+            metrics,
+        )
+
+    metric_sh = {k: repl for k in
+                 ("loss", "accuracy", "domain_loss", "domain_accuracy")}
+    return jax.jit(
+        step,
+        in_shardings=(st_sh, dst_sh, sup_sh, qry_sh, lab_sh, inst_sh, inst_sh),
+        out_shardings=(st_sh, dst_sh, metric_sh),
+        donate_argnums=(0, 1),
+    )
