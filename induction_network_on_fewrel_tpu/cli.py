@@ -113,6 +113,15 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
         p.add_argument("--only_test", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--run_dir", default=None, help="metrics/log dir (defaults to --save_ckpt)")
+    # observability / sanitizers (SURVEY.md §5.1-5.2)
+    if train:
+        p.add_argument("--profile", default=None, metavar="DIR",
+                       help="write a TensorBoard XPlane trace of steps "
+                            "2..2+profile_steps to DIR")
+        p.add_argument("--profile_steps", type=int, default=10)
+        p.add_argument("--debug_nans", action="store_true",
+                       help="checkify the train step: raise on NaN/inf/OOB "
+                            "(debug runs; costs fusion boundaries)")
     return p
 
 
@@ -349,7 +358,15 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         logger=MetricsLogger(run_dir),
         train_step=train_step, eval_step=eval_step, initial_state=state,
         mesh=mesh, adv=adv_pieces,
+        profile_dir=getattr(args, "profile", None),
+        profile_steps=getattr(args, "profile_steps", 10),
     )
+    if getattr(args, "debug_nans", False):
+        from induction_network_on_fewrel_tpu.utils.debug import checkify_step
+
+        trainer.train_step = checkify_step(trainer.train_step)
+        if trainer.adv is not None:
+            trainer.adv.step = checkify_step(trainer.adv.step)
     trainer.vocab, trainer.tokenizer = vocab, tok
     return trainer
 

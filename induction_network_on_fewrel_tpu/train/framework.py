@@ -55,6 +55,8 @@ class FewShotTrainer:
         initial_state=None,
         mesh=None,
         adv=None,
+        profile_dir: str | None = None,
+        profile_steps: int = 10,
     ):
         self.model = model
         self.cfg = cfg
@@ -75,6 +77,12 @@ class FewShotTrainer:
         # instead of the plain step; eval/checkpointing are unchanged (the
         # discriminator is a training-time adversary, never saved).
         self.adv = adv
+        # Tracing (SURVEY.md §5.1): profile steps [2, 2+profile_steps) into
+        # a TensorBoard XPlane trace. Step 1 is excluded on purpose — it is
+        # the compile, and a trace dominated by one 30 s XLA compilation
+        # hides the steady-state picture the profile is for.
+        self.profile_dir = profile_dir
+        self.profile_steps = profile_steps
 
     def init_state(self):
         # Reuse a pre-built state when one was injected: mesh-sharded steps
@@ -107,6 +115,12 @@ class FewShotTrainer:
         window = 50
         adv = self.adv
         for step in range(1, num_iters + 1):
+            if self.profile_dir is not None:
+                if step == 2:
+                    jax.profiler.start_trace(self.profile_dir)
+                elif step == 2 + self.profile_steps:
+                    jax.profiler.stop_trace()
+                    self.logger.log(step, "profile", written=1.0)
             support, query, label = batch_to_model_inputs(next(it))
             if adv is not None:
                 src = adv.src_sampler.sample_batch()._asdict()
@@ -136,6 +150,8 @@ class FewShotTrainer:
                     self.ckpt.save(step, state, val_acc)
                 t0 = time.monotonic()
                 last_logged = step
+        if self.profile_dir is not None and 2 <= num_iters < 2 + self.profile_steps:
+            jax.profiler.stop_trace()  # run ended inside the trace window
         return state
 
     def evaluate(self, params, num_episodes: int, sampler=None) -> float:
