@@ -30,7 +30,7 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     # model
     p.add_argument("--model", default="induction",
                    choices=["induction", "proto", "proto_hatt", "gnn",
-                            "snail", "pair"],
+                            "snail", "metanet", "pair"],
                    help="few-shot model (pair = BERT-PAIR, needs --encoder bert)")
     p.add_argument("--proto_metric", default="euclid", choices=["euclid", "dot"], help="proto similarity")
     p.add_argument("--gnn_dim", type=int, default=64, help="features added per GNN block")

@@ -135,6 +135,7 @@ class ExperimentConfig:
     MODEL_GEOMETRY_FIELDS = {
         "gnn": ("train_n", "n"),
         "snail": ("train_n", "n"),
+        "metanet": ("train_n", "n"),
         "proto_hatt": ("k",),
     }
 

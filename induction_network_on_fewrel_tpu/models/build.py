@@ -132,10 +132,11 @@ def build_model(
         return PrototypicalNetwork(metric=cfg.proto_metric, **common)
     if cfg.model == "proto_hatt":
         return ProtoHATT(k=cfg.k, **common)
-    if cfg.model in ("gnn", "snail"):
-        # These models bake N into parameter shapes (the label one-hot feeds
-        # the first Dense/Conv; the readout is Dense(N)), so unlike
-        # induction/proto the train-time and eval-time N must agree.
+    if cfg.model in ("gnn", "snail", "metanet"):
+        # These models bake N into parameter shapes (gnn/snail: label
+        # one-hot width and Dense(N) readout; metanet: the slow head
+        # W_slow[H, N]), so unlike induction/proto the train-time and
+        # eval-time N must agree.
         if cfg.train_n != cfg.n:
             raise ValueError(
                 f"model {cfg.model!r} ties parameter shapes to N; "
@@ -144,7 +145,11 @@ def build_model(
         if cfg.model == "gnn":
             return GNN(gnn_dim=cfg.gnn_dim, gnn_blocks=cfg.gnn_blocks,
                        adj_hidden=cfg.gnn_adj_hidden, **common)
-        return SNAIL(tc_filters=cfg.snail_tc_filters, **common)
+        if cfg.model == "snail":
+            return SNAIL(tc_filters=cfg.snail_tc_filters, **common)
+        from induction_network_on_fewrel_tpu.models.metanet import MetaNet
+
+        return MetaNet(**common)
     raise ValueError(f"unknown model {cfg.model!r}")
 
 

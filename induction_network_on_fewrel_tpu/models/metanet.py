@@ -1,0 +1,83 @@
+"""Meta Networks few-shot model (metanet).
+
+Toolkit-family sibling (SURVEY.md §2.1 "Few-shot model" siblings; Munkhdalai
+& Yu, ICML 2017, "Meta Networks"). The defining mechanism — fast weights
+generated from per-example loss gradients, stored in a memory indexed by
+support representations and read by the query through attention — maps to
+TPU/JAX cleanly because the per-example gradient of a linear+CE head has a
+closed form (no autodiff loop over examples):
+
+1. slow path: ``s_q = e_q @ W_slow`` (an episode-agnostic linear head);
+2. per-support meta-gradient, closed form:
+   ``G_ij = e_ij ⊗ (softmax(e_ij @ W_slow) - onehot(y_ij))  [H, N]``;
+3. fast-weight generation: a learned elementwise transform
+   ``F_ij = a2·tanh(a1·G_ij + b1) + b2`` (the paper's shared
+   gradient-to-weight meta-learner, in its cheapest shape-agnostic form);
+4. memory read: ``α_ij(q) = softmax_{ij} cos(e_q, e_ij)``,
+   ``W_fast(q) = Σ_ij α_ij F_ij``;
+5. logits = ``s_q + e_q @ W_fast(q)``, differentiable end-to-end (training
+   flows through the gradient-generation path — second-order terms kept).
+
+Like gnn/snail, W_slow bakes the N-way width into parameter shapes, so
+trainN must equal N (enforced in build_model) and N rides along in
+checkpoint config merging.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from induction_network_on_fewrel_tpu.models.base import FewShotModel
+
+
+class MetaNet(FewShotModel):
+    @nn.compact
+    def __call__(self, support: dict[str, Any], query: dict[str, Any]) -> jnp.ndarray:
+        with jax.named_scope("encoder"):
+            sup_enc, qry_enc = self.encode_episode(support, query)
+        B, N, K, H = sup_enc.shape
+        TQ = qry_enc.shape[1]
+        cd = self.compute_dtype
+        sup = sup_enc.astype(jnp.float32)
+        qry = qry_enc.astype(jnp.float32)
+
+        w_slow = self.param(
+            "w_slow", nn.initializers.lecun_normal(), (H, N)
+        ).astype(jnp.float32)
+
+        with jax.named_scope("meta_gradients"):
+            # Closed-form per-example gradient of CE(e @ W_slow, y) wrt
+            # W_slow, NEGATED: fast weights must move in the descent
+            # direction (toward classifying e_ij as y_ij). With the raw
+            # ascent gradient the tanh meta-learner starts anti-correlated
+            # and training diverges below chance (observed).
+            p = jax.nn.softmax(jnp.einsum("bnkh,hm->bnkm", sup, w_slow), axis=-1)
+            y = jnp.broadcast_to(jnp.eye(N)[None, :, None, :], (B, N, K, N))
+            G = jnp.einsum("bnkh,bnkm->bnkhm", sup, y - p)       # [B,N,K,H,N]
+
+        with jax.named_scope("fast_weights"):
+            a1 = self.param("meta_a1", nn.initializers.ones, (1,))
+            b1 = self.param("meta_b1", nn.initializers.zeros, (1,))
+            a2 = self.param("meta_a2", nn.initializers.ones, (1,))
+            b2 = self.param("meta_b2", nn.initializers.zeros, (1,))
+            F = a2 * jnp.tanh(a1 * G + b1) + b2                  # [B,N,K,H,N]
+
+        with jax.named_scope("memory_read"):
+            keys = sup.reshape(B, N * K, H)
+            norm = lambda x: x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-8)
+            att = jnp.einsum("bth,bsh->bts", norm(qry), norm(keys))  # cosine
+            att = jax.nn.softmax(att, axis=-1)                   # [B,TQ,N*K]
+            F_flat = F.reshape(B, N * K, H, N)
+            w_fast = jnp.einsum("bts,bshm->bthm", att, F_flat)   # [B,TQ,H,N]
+
+        with jax.named_scope("combine"):
+            slow = jnp.einsum("bth,hm->btm", qry, w_slow)
+            fast = jnp.einsum("bth,bthm->btm", qry, w_fast)
+            logits = slow + fast
+
+        logits = self.append_nota(logits.astype(jnp.float32))
+        return logits.astype(jnp.float32)

@@ -21,7 +21,7 @@ from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
 from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
 
 L = 16
-MODELS = ["proto_hatt", "gnn", "snail"]
+MODELS = ["proto_hatt", "gnn", "snail", "metanet"]
 BASE = ExperimentConfig(
     encoder="cnn", train_n=4, n=4, k=2, q=3, batch_size=2, max_length=L,
     vocab_size=302, compute_dtype="float32", hidden_size=64,
@@ -89,9 +89,9 @@ def test_snail_reads_the_support_prefix(episode):
     )
 
 
-@pytest.mark.parametrize("name", ["gnn", "snail"])
+@pytest.mark.parametrize("name", ["gnn", "snail", "metanet"])
 def test_n_mismatch_rejected(name):
-    """gnn/snail bake N into param shapes; trainN != N must fail fast."""
+    """These models bake N into param shapes; trainN != N must fail fast."""
     with pytest.raises(ValueError, match="trainN"):
         build_model(BASE.replace(model=name, train_n=6, n=4))
 
