@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""Throughput sweep over the five BASELINE.json benchmark configs.
+
+Same chunked best-rate methodology as bench.py (the axon tunnel's latency
+varies wildly between sessions; best chunk = demonstrated capability), one
+JSON line per config on stdout. bench.py stays the single-line driver
+contract; this is the full table for BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BATCH = 8
+WARMUP = 5
+CHUNK = 20
+MAX_CHUNKS = 6
+MAX_SECONDS = 45.0
+
+
+def run_config(name: str, cfg, adv: bool = False) -> dict:
+    import jax
+
+    from induction_network_on_fewrel_tpu.data import (
+        GloveTokenizer,
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.data.bert_tokenizer import BertTokenizer
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.models.adversarial import (
+        DomainDiscriminator,
+    )
+    from induction_network_on_fewrel_tpu.models.build import (
+        batch_to_model_inputs,
+        encoder_output_dim,
+    )
+    from induction_network_on_fewrel_tpu.native import make_sampler
+    from induction_network_on_fewrel_tpu.sampling import InstanceSampler
+    from induction_network_on_fewrel_tpu.train.steps import (
+        init_disc_state,
+        init_state,
+        make_adv_train_step,
+        make_train_step,
+    )
+
+    ds = make_synthetic_fewrel(
+        num_relations=max(2 * cfg.n, 20),
+        instances_per_relation=cfg.k + cfg.q + 5,
+        vocab_size=cfg.vocab_size - 2,
+    )
+    if cfg.encoder == "bert":
+        vocab = None
+        tok = BertTokenizer(cfg.max_length, vocab_size=cfg.bert_vocab_size)
+    else:
+        vocab = make_synthetic_glove(vocab_size=cfg.vocab_size - 2)
+        tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    sampler = make_sampler(
+        ds, tok, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size,
+        na_rate=cfg.na_rate, seed=0, backend="auto", prefetch=16, num_threads=4,
+    )
+    model = build_model(
+        cfg, glove_init=vocab.vectors if vocab is not None else None
+    )
+    sup, qry, _ = batch_to_model_inputs(sampler.sample_batch())
+    state = init_state(model, cfg, sup, qry)
+
+    if adv:
+        tgt_ds = make_synthetic_fewrel(
+            num_relations=20, instances_per_relation=cfg.k + cfg.q + 5,
+            vocab_size=cfg.vocab_size - 2, seed=97,
+        )
+        disc = DomainDiscriminator(hidden=cfg.adv_dis_hidden)
+        disc_state = init_disc_state(disc, cfg, encoder_output_dim(cfg))
+        src_s = InstanceSampler(ds, tok, cfg.adv_batch, seed=31)
+        tgt_s = InstanceSampler(tgt_ds, tok, cfg.adv_batch, seed=32)
+        adv_step = make_adv_train_step(model, disc, cfg)
+
+        def step_once(state_pack):
+            st, dst = state_pack
+            st, dst, m = adv_step(
+                st, dst, *batch_to_model_inputs(sampler.sample_batch()),
+                src_s.sample_batch()._asdict(), tgt_s.sample_batch()._asdict(),
+            )
+            return (st, dst), m
+
+        pack = (state, disc_state)
+    else:
+        step = make_train_step(model, cfg)
+
+        def step_once(st):
+            st, m = step(st, *batch_to_model_inputs(sampler.sample_batch()))
+            return st, m
+
+        pack = state
+
+    t0 = time.monotonic()
+    for _ in range(WARMUP):
+        pack, metrics = step_once(pack)
+    import jax
+
+    jax.block_until_ready(metrics)
+    compile_s = time.monotonic() - t0
+
+    best = 0.0
+    start = time.monotonic()
+    chunks = 0
+    while chunks < MAX_CHUNKS and time.monotonic() - start < MAX_SECONDS:
+        t0 = time.monotonic()
+        for _ in range(CHUNK):
+            pack, metrics = step_once(pack)
+        jax.block_until_ready(metrics)
+        rate = CHUNK * cfg.batch_size / (time.monotonic() - t0)
+        best = max(best, rate)
+        chunks += 1
+    if hasattr(sampler, "close"):
+        sampler.close()
+    return {
+        "config": name,
+        "episodes_per_s_per_chip": round(best, 1),
+        "warmup_s": round(compile_s, 1),
+        "backend": jax.default_backend(),
+    }
+
+
+def main() -> int:
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+
+    base = dict(batch_size=BATCH, max_length=40, vocab_size=2002,
+                compute_dtype="bfloat16")
+    configs = [
+        ("1: 5w1s cnn", ExperimentConfig(
+            encoder="cnn", n=5, k=1, q=5, **base), False),
+        ("2: 5w5s bilstm", ExperimentConfig(
+            encoder="bilstm", n=5, k=5, q=5, **base), False),
+        ("3: 10w5s bilstm", ExperimentConfig(
+            encoder="bilstm", train_n=10, n=10, k=5, q=5, **base), False),
+        ("4: 5w5s bert-base frozen", ExperimentConfig(
+            encoder="bert", n=5, k=5, q=5, bert_frozen=True,
+            **{**base, "batch_size": 2}), False),
+        ("5: 5w5s bilstm na_rate=5 +adv (FewRel2.0)", ExperimentConfig(
+            encoder="bilstm", n=5, k=5, q=5, na_rate=5, adv=True,
+            **base), True),
+    ]
+    only = sys.argv[1:] or None
+    for name, cfg, adv in configs:
+        if only and not any(s in name for s in only):
+            continue
+        try:
+            print(json.dumps(run_config(name, cfg, adv)), flush=True)
+        except Exception as e:  # keep sweeping; report the failure
+            print(json.dumps({"config": name, "error": repr(e)[:300]}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
