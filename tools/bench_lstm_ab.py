@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""Interleaved A/B bench: scan vs pallas BiLSTM, end-to-end train steps.
+
+The axon tunnel's latency drifts by orders of magnitude within a session, so
+back-to-back runs of two variants confound backend choice with tunnel state.
+This script builds BOTH train steps in one process and alternates chunks
+A,B,A,B,... so drift hits both arms equally; reports per-arm best and median
+chunk rates.
+
+Usage: python tools/bench_lstm_ab.py [rounds] [chunk_steps]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BATCH = 8
+ROUNDS = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+CHUNK = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+
+
+def build_arm(lstm_backend: str):
+    import jax
+
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.data import (
+        GloveTokenizer,
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+    from induction_network_on_fewrel_tpu.native import make_sampler
+    from induction_network_on_fewrel_tpu.train.steps import init_state, make_train_step
+
+    cfg = ExperimentConfig(
+        encoder="bilstm", n=5, k=5, q=5, batch_size=BATCH, max_length=40,
+        vocab_size=2002, compute_dtype="bfloat16", lstm_backend=lstm_backend,
+    )
+    ds = make_synthetic_fewrel(
+        num_relations=20, instances_per_relation=cfg.k + cfg.q + 5,
+        vocab_size=cfg.vocab_size - 2,
+    )
+    vocab = make_synthetic_glove(vocab_size=cfg.vocab_size - 2)
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    sampler = make_sampler(
+        ds, tok, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size,
+        seed=0, backend="auto", prefetch=16, num_threads=4,
+    )
+    model = build_model(cfg, glove_init=vocab.vectors)
+    sup, qry, _ = batch_to_model_inputs(sampler.sample_batch())
+    state = init_state(model, cfg, sup, qry)
+    step = make_train_step(model, cfg)
+
+    def step_once(st):
+        return step(st, *batch_to_model_inputs(sampler.sample_batch()))
+
+    return {"name": lstm_backend, "state": state, "step": step_once,
+            "sampler": sampler, "rates": []}
+
+
+def main() -> int:
+    import jax
+
+    from bench import _probe_tpu
+
+    if not _probe_tpu():
+        print("bench_lstm_ab: TPU backend unreachable; falling back to CPU",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+
+    arms = [build_arm("scan"), build_arm("pallas")]
+    # warmup/compile both
+    for arm in arms:
+        t0 = time.monotonic()
+        for _ in range(5):
+            arm["state"], m = arm["step"](arm["state"])
+        jax.block_until_ready(m)
+        print(f"# {arm['name']}: compiled in {time.monotonic()-t0:.1f}s",
+              file=sys.stderr)
+
+    for r in range(ROUNDS):
+        for arm in arms:
+            t0 = time.monotonic()
+            for _ in range(CHUNK):
+                arm["state"], m = arm["step"](arm["state"])
+            jax.block_until_ready(m)
+            arm["rates"].append(CHUNK * BATCH / (time.monotonic() - t0))
+
+    for arm in arms:
+        print(json.dumps({
+            "lstm_backend": arm["name"],
+            "best_eps": round(max(arm["rates"]), 1),
+            "median_eps": round(statistics.median(arm["rates"]), 1),
+            "rates": [round(x, 1) for x in arm["rates"]],
+            "backend": jax.default_backend(),
+        }), flush=True)
+        if hasattr(arm["sampler"], "close"):
+            arm["sampler"].close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -102,11 +102,10 @@ def run_config(name: str, cfg, adv: bool = False) -> dict:
     t0 = time.monotonic()
     for _ in range(WARMUP):
         pack, metrics = step_once(pack)
-    import jax
-
     jax.block_until_ready(metrics)
     compile_s = time.monotonic() - t0
 
+    n_chips = max(jax.local_device_count(), 1)
     best = 0.0
     start = time.monotonic()
     chunks = 0
@@ -115,7 +114,7 @@ def run_config(name: str, cfg, adv: bool = False) -> dict:
         for _ in range(CHUNK):
             pack, metrics = step_once(pack)
         jax.block_until_ready(metrics)
-        rate = CHUNK * cfg.batch_size / (time.monotonic() - t0)
+        rate = CHUNK * cfg.batch_size / (time.monotonic() - t0) / n_chips
         best = max(best, rate)
         chunks += 1
     if hasattr(sampler, "close"):
@@ -129,6 +128,15 @@ def run_config(name: str, cfg, adv: bool = False) -> dict:
 
 
 def main() -> int:
+    import jax
+
+    from bench import _probe_tpu
+
+    if not _probe_tpu():
+        print("bench_sweep: TPU backend unreachable; falling back to CPU",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+
     from induction_network_on_fewrel_tpu.config import ExperimentConfig
 
     base = dict(batch_size=BATCH, max_length=40, vocab_size=2002,
@@ -149,7 +157,12 @@ def main() -> int:
     ]
     only = sys.argv[1:] or None
     for name, cfg, adv in configs:
-        if only and not any(s in name for s in only):
+        # Match on the numeric prefix ("1".."5") or a substring of the rest;
+        # a bare-substring match would make "1" also select "3: 10w5s".
+        if only and not any(
+            name.startswith(s + ":") or s in name.split(":", 1)[1]
+            for s in only
+        ):
             continue
         try:
             print(json.dumps(run_config(name, cfg, adv)), flush=True)
