@@ -29,8 +29,8 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--batch_size", type=int, default=4, help="episodes per step")
     # model
     p.add_argument("--model", default="induction",
-                   choices=["induction", "proto", "proto_hatt", "gnn",
-                            "snail", "metanet", "pair"],
+                   choices=["induction", "proto", "proto_hatt", "siamese",
+                            "gnn", "snail", "metanet", "pair"],
                    help="few-shot model (pair = BERT-PAIR, needs --encoder bert)")
     p.add_argument("--proto_metric", default="euclid", choices=["euclid", "dot"], help="proto similarity")
     p.add_argument("--gnn_dim", type=int, default=64, help="features added per GNN block")

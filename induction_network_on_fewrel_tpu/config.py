@@ -31,7 +31,7 @@ class ExperimentConfig:
     vocab_size: int = 400002  # GloVe 400k + [UNK] + [BLANK]; synthetic is small
 
     # --- few-shot model (reference flag --model) ---
-    model: str = "induction"  # induction | proto | proto_hatt | gnn | snail
+    model: str = "induction"  # induction | proto | proto_hatt | siamese | gnn | snail | metanet | pair
     proto_metric: str = "euclid"  # euclid | dot (proto only)
     gnn_dim: int = 64         # features added per GNN block
     gnn_blocks: int = 2

@@ -13,6 +13,7 @@ from induction_network_on_fewrel_tpu.models.proto import (  # noqa: F401
     PrototypicalNetwork,
 )
 from induction_network_on_fewrel_tpu.models.proto_hatt import ProtoHATT  # noqa: F401
+from induction_network_on_fewrel_tpu.models.siamese import SiameseNetwork  # noqa: F401
 from induction_network_on_fewrel_tpu.models.gnn import GNN  # noqa: F401
 from induction_network_on_fewrel_tpu.models.snail import SNAIL  # noqa: F401
 from induction_network_on_fewrel_tpu.models.losses import (  # noqa: F401

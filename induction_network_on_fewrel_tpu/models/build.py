@@ -15,6 +15,7 @@ from induction_network_on_fewrel_tpu.models.gnn import GNN
 from induction_network_on_fewrel_tpu.models.induction import InductionNetwork
 from induction_network_on_fewrel_tpu.models.proto import PrototypicalNetwork
 from induction_network_on_fewrel_tpu.models.proto_hatt import ProtoHATT
+from induction_network_on_fewrel_tpu.models.siamese import SiameseNetwork
 from induction_network_on_fewrel_tpu.models.snail import SNAIL
 
 _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
@@ -132,6 +133,8 @@ def build_model(
         return PrototypicalNetwork(metric=cfg.proto_metric, **common)
     if cfg.model == "proto_hatt":
         return ProtoHATT(k=cfg.k, **common)
+    if cfg.model == "siamese":
+        return SiameseNetwork(**common)
     if cfg.model in ("gnn", "snail", "metanet"):
         # These models bake N into parameter shapes (gnn/snail: label
         # one-hot width and Dense(N) readout; metanet: the slow head

@@ -1,0 +1,52 @@
+"""Siamese network — learned pairwise metric over query/support pairs.
+
+Toolkit-family repos ship a siamese few-shot model next to proto/induction
+(SURVEY.md §2.1 "Few-shot model": siblings of ``models/induction.py``): every
+query is scored against each of the N·K support instances through a shared
+learned similarity, and a class logit is the mean of its K pair scores
+(Koch et al. 2015 adapted to episodes).
+
+Pair score here is a learned weighted distance plus a bilinear term:
+
+    s(q, e) = -Σ_h w_h (q_h - e_h)² + Σ_h v_h q_h e_h + b
+
+TPU notes: materializing the [B, TQ, N, K, H] pair tensor would be an HBM
+disaster at real episode sizes, so both terms are expanded into einsums over
+the hidden axis — Σ w (q-e)² = (q²·w) - 2 (q⊙w)·e + (e²·w) — which XLA maps
+onto single MXU contractions ([B,TQ,H] × [B,N·K,H]); the K-mean then folds
+into the same reduction chain. Nothing bigger than [B, TQ, N·K] ever exists.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from induction_network_on_fewrel_tpu.models.base import FewShotModel
+
+
+class SiameseNetwork(FewShotModel):
+    @nn.compact
+    def __call__(self, support: dict[str, Any], query: dict[str, Any]) -> jnp.ndarray:
+        with jax.named_scope("encoder"):
+            sup_enc, qry_enc = self.encode_episode(support, query)
+        B, N, K, H = sup_enc.shape
+        dt = self.compute_dtype
+        w = self.param("metric_w", nn.initializers.ones, (H,)).astype(dt)
+        v = self.param("metric_v", nn.initializers.zeros, (H,)).astype(dt)
+        b = self.param("metric_b", nn.initializers.zeros, ()).astype(dt)
+        q = qry_enc.astype(dt)                               # [B, TQ, H]
+        e = sup_enc.astype(dt).reshape(B, N * K, H)          # [B, NK, H]
+        with jax.named_scope("siamese_metric"):
+            # -Σ w (q-e)² + Σ v q e, expanded so the cross terms are MXU
+            # contractions and no [B,TQ,NK,H] intermediate is built.
+            cross = jnp.einsum("bqh,bsh->bqs", q * (2.0 * w + v), e)
+            q2 = jnp.einsum("bqh,h->bq", jnp.square(q), w)
+            e2 = jnp.einsum("bsh,h->bs", jnp.square(e), w)
+            pair = cross - q2[..., None] - e2[:, None, :] + b  # [B, TQ, NK]
+            logits = jnp.mean(pair.reshape(B, -1, N, K), axis=-1)
+        logits = self.append_nota(logits)
+        return logits.astype(jnp.float32)

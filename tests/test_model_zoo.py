@@ -21,7 +21,7 @@ from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
 from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
 
 L = 16
-MODELS = ["proto_hatt", "gnn", "snail", "metanet"]
+MODELS = ["proto_hatt", "siamese", "gnn", "snail", "metanet"]
 BASE = ExperimentConfig(
     encoder="cnn", train_n=4, n=4, k=2, q=3, batch_size=2, max_length=L,
     vocab_size=302, compute_dtype="float32", hidden_size=64,
@@ -87,6 +87,34 @@ def test_snail_reads_the_support_prefix(episode):
     assert not np.allclose(np.asarray(logits), np.asarray(logits_perm)), (
         "query logits ignored the support set"
     )
+
+
+def test_siamese_matches_naive_pair_metric(episode):
+    """The einsum-expanded metric must equal the naive [B,TQ,N,K,H] pair
+    computation: s(q,e) = -Σ w (q-e)² + Σ v q e + b, class = mean over K."""
+    import numpy as np
+
+    vocab, (sup, qry, _) = episode
+    model = build_model(BASE.replace(model="siamese"), glove_init=vocab.vectors)
+    params = model.init(jax.random.key(1), sup, qry)
+    logits = np.asarray(model.apply(params, sup, qry))
+
+    enc_fn = lambda s, q: model.apply(params, s, q, method=model.encode_episode)
+    sup_enc, qry_enc = map(np.asarray, enc_fn(sup, qry))
+    p = params["params"]
+    w, v, b = map(np.asarray, (p["metric_w"], p["metric_v"], p["metric_b"]))
+    B, N, K, H = sup_enc.shape
+    naive = np.zeros_like(logits)
+    for bi in range(B):
+        for qi in range(qry_enc.shape[1]):
+            for ni in range(N):
+                scores = [
+                    -np.sum(w * (qry_enc[bi, qi] - sup_enc[bi, ni, ki]) ** 2)
+                    + np.sum(v * qry_enc[bi, qi] * sup_enc[bi, ni, ki]) + b
+                    for ki in range(K)
+                ]
+                naive[bi, qi, ni] = np.mean(scores)
+    np.testing.assert_allclose(logits, naive, rtol=2e-4, atol=2e-4)
 
 
 @pytest.mark.parametrize("name", ["gnn", "snail", "metanet"])

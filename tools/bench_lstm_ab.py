@@ -71,9 +71,11 @@ def main() -> int:
     from bench import _probe_tpu
 
     if not _probe_tpu():
-        print("bench_lstm_ab: TPU backend unreachable; falling back to CPU",
-              file=sys.stderr)
-        jax.config.update("jax_platforms", "cpu")
+        # The compiled pallas arm only lowers on a real TPU, and a
+        # scan-vs-pallas A/B is meaningless on CPU — bail out cleanly.
+        print("bench_lstm_ab: TPU backend unreachable; aborting (A/B needs "
+              "the real chip)", file=sys.stderr)
+        return 1
 
     arms = [build_arm("scan"), build_arm("pallas")]
     # warmup/compile both
@@ -91,7 +93,8 @@ def main() -> int:
             for _ in range(CHUNK):
                 arm["state"], m = arm["step"](arm["state"])
             jax.block_until_ready(m)
-            arm["rates"].append(CHUNK * BATCH / (time.monotonic() - t0))
+            n_chips = max(jax.local_device_count(), 1)
+            arm["rates"].append(CHUNK * BATCH / (time.monotonic() - t0) / n_chips)
 
     for arm in arms:
         print(json.dumps({

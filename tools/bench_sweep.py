@@ -160,7 +160,8 @@ def main() -> int:
         # Match on the numeric prefix ("1".."5") or a substring of the rest;
         # a bare-substring match would make "1" also select "3: 10w5s".
         if only and not any(
-            name.startswith(s + ":") or s in name.split(":", 1)[1]
+            name.startswith(s + ":")
+            or (not s.isdigit() and s in name.split(":", 1)[1])
             for s in only
         ):
             continue
