@@ -33,8 +33,10 @@ import time
 BASELINE_EPS_TPU = 18274.0
 
 BATCH = 8            # episodes per step
+STEPS_PER_CALL = 8   # optimizer steps fused per dispatch (lax.scan; measured
+                     # 1.24x end-to-end on the tunneled v5e vs per-step calls)
 WARMUP_STEPS = 5
-CHUNK_STEPS = 25
+CHUNK_STEPS = 24     # multiple of STEPS_PER_CALL
 MAX_STEPS = 500
 MAX_SECONDS = 60.0
 
@@ -74,7 +76,10 @@ def main() -> int:
     from induction_network_on_fewrel_tpu.models import build_model
     from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
     from induction_network_on_fewrel_tpu.native import make_sampler
-    from induction_network_on_fewrel_tpu.train.steps import init_state, make_train_step
+    from induction_network_on_fewrel_tpu.train.steps import (
+        init_state,
+        make_multi_train_step,
+    )
 
     backend = jax.default_backend()
     n_chips = jax.local_device_count()
@@ -83,6 +88,7 @@ def main() -> int:
     cfg = ExperimentConfig(
         encoder="bilstm", n=5, k=5, q=5, batch_size=BATCH, max_length=40,
         vocab_size=2002, compute_dtype="bfloat16",
+        steps_per_call=STEPS_PER_CALL,
     )
     vocab = make_synthetic_glove(vocab_size=cfg.vocab_size - 2)
     ds = make_synthetic_fewrel(
@@ -98,30 +104,42 @@ def main() -> int:
     print(f"bench: sampler={'native' if native else 'python'}", file=sys.stderr)
     model = build_model(cfg, glove_init=vocab.vectors)
 
+    import numpy as np
+
     sup, qry, _ = batch_to_model_inputs(sampler.sample_batch())
     state = init_state(model, cfg, sup, qry)
-    step = make_train_step(model, cfg)
+    multi_step = make_multi_train_step(model, cfg)
+    S = STEPS_PER_CALL
+
+    def fused_call(state):
+        batches = [
+            batch_to_model_inputs(sampler.sample_batch()) for _ in range(S)
+        ]
+        sup_s, qry_s, lab_s = jax.tree.map(lambda *xs: np.stack(xs), *batches)
+        return multi_step(state, sup_s, qry_s, lab_s)
 
     t0 = time.monotonic()
-    for _ in range(WARMUP_STEPS):
-        state, metrics = step(state, *batch_to_model_inputs(sampler.sample_batch()))
+    for _ in range(max(WARMUP_STEPS // S, 2)):
+        state, metrics = fused_call(state)
     jax.block_until_ready(metrics)
     print(f"bench: warmup(+compile) {time.monotonic() - t0:.1f}s", file=sys.stderr)
 
     best_rate = 0.0
     total_steps = 0
+    calls_per_chunk = max(CHUNK_STEPS // S, 1)
     bench_start = time.monotonic()
     while total_steps < MAX_STEPS and time.monotonic() - bench_start < MAX_SECONDS:
         t0 = time.monotonic()
-        for _ in range(CHUNK_STEPS):
-            state, metrics = step(state, *batch_to_model_inputs(sampler.sample_batch()))
+        for _ in range(calls_per_chunk):
+            state, metrics = fused_call(state)
         jax.block_until_ready(metrics)
         dt = time.monotonic() - t0
-        total_steps += CHUNK_STEPS
-        rate = CHUNK_STEPS * BATCH / dt / max(n_chips, 1)
+        chunk_steps = calls_per_chunk * S
+        total_steps += chunk_steps
+        rate = chunk_steps * BATCH / dt / max(n_chips, 1)
         best_rate = max(best_rate, rate)
         print(
-            f"bench: chunk {total_steps // CHUNK_STEPS}: {dt:.3f}s "
+            f"bench: chunk {total_steps // chunk_steps}: {dt:.3f}s "
             f"-> {rate:.0f} eps/s/chip", file=sys.stderr,
         )
 
@@ -134,7 +152,7 @@ def main() -> int:
     print(json.dumps({
         "metric": (
             f"train_episodes_per_sec_per_chip"
-            f"[5w5s,bilstm,L40,bf16,{backend},e2e,{sampler_tag}]"
+            f"[5w5s,bilstm,L40,bf16,{backend},e2e,{sampler_tag},spc{S}]"
         ),
         "value": round(best_rate, 2),
         "unit": "episodes/s/chip",

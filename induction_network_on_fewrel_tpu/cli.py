@@ -70,6 +70,11 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
         p.add_argument("--train_iter", type=int, default=10000)
         p.add_argument("--val_iter", type=int, default=1000)
         p.add_argument("--val_step", type=int, default=1000)
+        p.add_argument(
+            "--steps_per_call", type=int, default=1,
+            help="optimizer steps fused into one dispatch (lax.scan); "
+                 "identical updates, amortized host/transfer latency",
+        )
     p.add_argument("--test_iter", type=int, default=3000)
     # data
     p.add_argument("--train_file", default=None, help="FewRel-schema JSON; synthetic if omitted")
@@ -150,6 +155,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         weight_decay=args.weight_decay, lr_step_size=args.lr_step_size,
         grad_clip=args.grad_clip, train_iter=train_iter,
         val_iter=val_iter, val_step=val_step, test_iter=args.test_iter,
+        steps_per_call=getattr(args, "steps_per_call", 1),
         device=args.device, compute_dtype=compute, seed=args.seed,
         dp=args.dp, tp=args.tp, sp=args.sp,
         sampler=args.sampler, prefetch=args.prefetch,
@@ -365,6 +371,8 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         from induction_network_on_fewrel_tpu.utils.debug import checkify_step
 
         trainer.train_step = checkify_step(trainer.train_step)
+        if trainer._fused_step is not None:
+            trainer._fused_step = checkify_step(trainer._fused_step)
         if trainer.adv is not None:
             trainer.adv.step = checkify_step(trainer.adv.step)
     trainer.vocab, trainer.tokenizer = vocab, tok

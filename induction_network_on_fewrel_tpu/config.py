@@ -78,6 +78,10 @@ class ExperimentConfig:
     val_iter: int = 1000
     val_step: int = 1000
     test_iter: int = 3000
+    # Optimizer steps fused into one dispatch via lax.scan (train/steps.py
+    # make_multi_train_step). 1 = classic per-step dispatch; >1 amortizes
+    # host dispatch + transfer latency with identical update semantics.
+    steps_per_call: int = 1
 
     # --- FewRel 2.0 adversarial domain adaptation (training-time only) ---
     adv: bool = False         # train encoder against a domain discriminator

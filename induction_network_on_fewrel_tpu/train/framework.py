@@ -23,6 +23,7 @@ from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
 from induction_network_on_fewrel_tpu.train.steps import (
     init_state,
     make_eval_step,
+    make_multi_train_step,
     make_train_step,
 )
 from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
@@ -83,6 +84,31 @@ class FewShotTrainer:
         # hides the steady-state picture the profile is for.
         self.profile_dir = profile_dir
         self.profile_steps = profile_steps
+        # steps_per_call fusion (train/steps.py make_multi_train_step): only
+        # for the stock single-device step — injected (mesh-sharded) steps
+        # and the adversarial path keep per-step dispatch; fusing those means
+        # building the scan into their own step factories, not wrapping here.
+        self._fused_step = None
+        if cfg.steps_per_call > 1 and train_step is None and adv is None:
+            if cfg.val_step and cfg.steps_per_call > cfg.val_step:
+                # A fused call may not skip val/checkpoint boundaries:
+                # mid-chunk params no longer exist to evaluate.
+                raise ValueError(
+                    f"steps_per_call ({cfg.steps_per_call}) must not exceed "
+                    f"val_step ({cfg.val_step}); lower it or raise val_step"
+                )
+            self._fused_step = make_multi_train_step(model, cfg)
+        elif cfg.steps_per_call > 1:
+            import warnings
+
+            reason = "adversarial training" if adv is not None else (
+                "an injected (mesh-sharded) train step"
+            )
+            warnings.warn(
+                f"steps_per_call={cfg.steps_per_call} is ignored with "
+                f"{reason}; training runs per-step dispatch",
+                stacklevel=2,
+            )
 
     def init_state(self):
         # Reuse a pre-built state when one was injected: mesh-sharded steps
@@ -114,35 +140,60 @@ class FewShotTrainer:
         last_logged = 0
         window = 50
         adv = self.adv
-        for step in range(1, num_iters + 1):
+        profiling = profile_done = False
+        step = 0
+        while step < num_iters:
+            # Trace steps [1, 1+profile_steps): the first call (the compile)
+            # stays outside the trace so it doesn't drown the steady state.
             if self.profile_dir is not None:
-                if step == 2:
+                if not profiling and not profile_done and step >= 1:
                     jax.profiler.start_trace(self.profile_dir)
-                elif step == 2 + self.profile_steps:
+                    profiling = True
+                elif profiling and step >= 1 + self.profile_steps:
                     jax.profiler.stop_trace()
+                    profiling, profile_done = False, True
                     self.logger.log(step, "profile", written=1.0)
-            support, query, label = batch_to_model_inputs(next(it))
-            if adv is not None:
-                src = adv.src_sampler.sample_batch()._asdict()
-                tgt = adv.tgt_sampler.sample_batch()._asdict()
-                state, adv.disc_state, metrics = adv.step(
-                    state, adv.disc_state, support, query, label, src, tgt
+            spc = cfg.steps_per_call
+            if self._fused_step is not None and num_iters - step >= spc:
+                batches = [
+                    batch_to_model_inputs(next(it)) for _ in range(spc)
+                ]
+                sup_s, qry_s, lab_s = jax.tree.map(
+                    lambda *xs: np.stack(xs), *batches
                 )
+                state, metrics = self._fused_step(state, sup_s, qry_s, lab_s)
+                prev, step = step, step + spc
             else:
-                state, metrics = self.train_step(state, support, query, label)
-            if step % window == 0 or step == num_iters:
+                support, query, label = batch_to_model_inputs(next(it))
+                if adv is not None:
+                    src = adv.src_sampler.sample_batch()._asdict()
+                    tgt = adv.tgt_sampler.sample_batch()._asdict()
+                    state, adv.disc_state, metrics = adv.step(
+                        state, adv.disc_state, support, query, label, src, tgt
+                    )
+                else:
+                    state, metrics = self.train_step(
+                        state, support, query, label
+                    )
+                prev, step = step, step + 1
+            if step - last_logged >= window or step >= num_iters:
                 m = jax.device_get(metrics)  # sync point, once per window
                 dt = time.monotonic() - t0
                 eps_per_s = (step - last_logged) * cfg.batch_size / max(dt, 1e-9)
+                # Fused metrics are stacked [S]; report the window mean.
                 self.logger.log(
                     step,
                     "train",
                     episodes_per_s=eps_per_s,
-                    **{k: v for k, v in m.items()},
+                    **{k: float(np.mean(v)) for k, v in m.items()},
                 )
                 t0 = time.monotonic()
                 last_logged = step
-            if self.val_sampler is not None and cfg.val_step and step % cfg.val_step == 0:
+            crossed_val = (
+                cfg.val_step
+                and step // cfg.val_step > prev // cfg.val_step
+            )
+            if self.val_sampler is not None and crossed_val:
                 val_acc = self.evaluate(state.params, cfg.val_iter)
                 self.logger.log(step, "val", accuracy=val_acc)
                 if self.ckpt is not None and val_acc > self.best_val:
@@ -150,7 +201,7 @@ class FewShotTrainer:
                     self.ckpt.save(step, state, val_acc)
                 t0 = time.monotonic()
                 last_logged = step
-        if self.profile_dir is not None and 2 <= num_iters < 2 + self.profile_steps:
+        if profiling:
             jax.profiler.stop_trace()  # run ended inside the trace window
         return state
 

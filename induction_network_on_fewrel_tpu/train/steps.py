@@ -82,6 +82,39 @@ def make_train_step(model, cfg: ExperimentConfig):
     return train_step
 
 
+def make_multi_train_step(model, cfg: ExperimentConfig):
+    """Fused S-step training: one dispatch runs ``lax.scan`` over S stacked
+    episode batches (leading axis S on every input array).
+
+    The reference pays Python dispatch + H2D latency once per step
+    (SURVEY.md §3.1 boundary #3); on this TPU (behind a high-latency tunnel)
+    that overhead is ~25% of the step budget at B=8. Scanning S steps inside
+    one jitted call amortizes it S-fold while computing the IDENTICAL
+    sequence of SGD updates — same grads, same optimizer math, same step
+    count (verified bitwise-close in tests/test_train.py).
+
+    Returns jitted ``(state, support_s, query_s, label_s) -> (state,
+    metrics)`` where each metric is stacked ``[S]``.
+    """
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def multi_train_step(state: TrainState, support_s, query_s, label_s):
+        def body(st, xs):
+            support, query, label = xs
+
+            def loss_fn(params):
+                return loss_and_metrics(
+                    model, params, support, query, label, cfg.loss
+                )
+
+            grads, metrics = jax.grad(loss_fn, has_aux=True)(st.params)
+            return st.apply_gradients(grads=grads), metrics
+
+        return jax.lax.scan(body, state, (support_s, query_s, label_s))
+
+    return multi_train_step
+
+
 def make_eval_step(model, cfg: ExperimentConfig):
     @jax.jit
     def eval_step(params, support, query, label) -> dict[str, jnp.ndarray]:

@@ -118,3 +118,87 @@ def test_checkpoint_format_version_guard(tmp_path):
     (ok / "7").mkdir(parents=True)
     (ok / "config.json").write_text(cnn.to_json())
     CheckpointManager(ok, cnn)  # no raise
+
+
+def test_fused_multi_step_matches_sequential():
+    """steps_per_call fusion must compute the IDENTICAL update sequence:
+    S scanned steps == S sequential single steps on the same batches."""
+    from induction_network_on_fewrel_tpu.train.steps import make_multi_train_step
+
+    cfg = ExperimentConfig(
+        encoder="cnn", n=2, k=2, q=2, batch_size=2, max_length=L, vocab_size=302,
+        compute_dtype="float32", lr=1e-2,
+    )
+    model, sampler = _setup(cfg)
+    batches = [batch_to_model_inputs(sampler.sample_batch()) for _ in range(4)]
+    sup0, qry0, _ = batches[0]
+
+    state_a = init_state(model, cfg, sup0, qry0)
+    step = make_train_step(model, cfg)
+    seq_metrics = []
+    for sup, qry, lab in batches:
+        state_a, m = step(state_a, sup, qry, lab)
+        seq_metrics.append(float(m["loss"]))
+
+    state_b = init_state(model, cfg, sup0, qry0)
+    multi = make_multi_train_step(model, cfg)
+    sup_s, qry_s, lab_s = jax.tree.map(lambda *xs: np.stack(xs), *batches)
+    state_b, m_s = multi(state_b, sup_s, qry_s, lab_s)
+
+    assert int(state_b.step) == int(state_a.step) == 4
+    np.testing.assert_allclose(
+        np.asarray(m_s["loss"]), np.asarray(seq_metrics), rtol=1e-5, atol=1e-6
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        state_a.params, state_b.params,
+    )
+
+
+def test_trainer_with_steps_per_call(tmp_path):
+    """Trainer runs fused chunks + a single-step remainder, crosses val_step
+    boundaries, and finishes at exactly train_iter optimizer steps."""
+    cfg = ExperimentConfig(
+        encoder="cnn", n=2, k=2, q=2, batch_size=2, max_length=L, vocab_size=302,
+        compute_dtype="float32", lr=1e-2, train_iter=10, val_step=4,
+        val_iter=4, steps_per_call=4,
+    )
+    import json
+
+    model, sampler = _setup(cfg)
+    logger = MetricsLogger(out_dir=tmp_path, quiet=True)
+    trainer = FewShotTrainer(model, cfg, sampler, val_sampler=sampler, logger=logger)
+    state = trainer.train()
+    assert int(state.step) == 10  # 4 + 4 + 1 + 1 (remainder unfused)
+    records = [
+        json.loads(line) for line in (tmp_path / "metrics.jsonl").open()
+    ]
+    vals = [r for r in records if r["kind"] == "val"]
+    assert [r["step"] for r in vals] == [4, 8]  # val_step crossings
+
+
+def test_steps_per_call_guards():
+    """spc > val_step is rejected; spc with mesh/adv-injected step warns."""
+    import warnings
+
+    import pytest
+
+    cfg = ExperimentConfig(
+        encoder="cnn", n=2, k=2, q=2, batch_size=2, max_length=L, vocab_size=302,
+        compute_dtype="float32", val_step=4, steps_per_call=8,
+    )
+    model, sampler = _setup(cfg)
+    with pytest.raises(ValueError, match="steps_per_call"):
+        FewShotTrainer(model, cfg, sampler)
+
+    from induction_network_on_fewrel_tpu.train.steps import make_train_step
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        FewShotTrainer(
+            model, cfg.replace(val_step=100), sampler,
+            train_step=make_train_step(model, cfg),
+        )
+    assert any("steps_per_call" in str(x.message) for x in w)
