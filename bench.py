@@ -33,10 +33,15 @@ import time
 BASELINE_EPS_TPU = 18274.0
 
 BATCH = 8            # episodes per step
-STEPS_PER_CALL = 8   # optimizer steps fused per dispatch (lax.scan; measured
-                     # 1.24x end-to-end on the tunneled v5e vs per-step calls)
+import os
+
+# Optimizer steps fused per dispatch (lax.scan). Swept on the v5e:
+# spc 1 -> 18.3k eps/s, 8 -> 28.0k, 16 -> 33.4-34.3k, 24 -> 28.4k,
+# 32 -> 29.4k; 16 is the knee (past it, host-side batch stacking for the
+# bigger call starts eating the dispatch win).
+STEPS_PER_CALL = int(os.environ.get("BENCH_SPC", "16"))
 WARMUP_STEPS = 5
-CHUNK_STEPS = 24     # multiple of STEPS_PER_CALL
+CHUNK_STEPS = 3 * STEPS_PER_CALL
 MAX_STEPS = 500
 MAX_SECONDS = 60.0
 

@@ -266,7 +266,7 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
     use_mesh = (
         (cfg.dp == 0 and n_dev > 1) or cfg.dp > 1 or cfg.tp > 1 or cfg.sp > 1
     )
-    train_step = eval_step = state = mesh = attn_impl = None
+    train_step = eval_step = fused_step = state = mesh = attn_impl = None
     if use_mesh:
         mesh = make_mesh(dp=(cfg.dp or None), tp=cfg.tp, sp=cfg.sp)
         if cfg.sp > 1:
@@ -301,6 +301,12 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         state = init_state(model, cfg, sup, qry)
         train_step = make_sharded_train_step(model, cfg, mesh, state)
         eval_step = make_sharded_eval_step(model, cfg, mesh, state)
+        if cfg.steps_per_call > 1 and not cfg.adv:
+            from induction_network_on_fewrel_tpu.parallel.sharding import (
+                make_sharded_multi_train_step,
+            )
+
+            fused_step = make_sharded_multi_train_step(model, cfg, mesh, state)
 
     adv_pieces = None
     if cfg.adv and not only_test:
@@ -362,7 +368,8 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         model, cfg, train_sampler, val_sampler,
         ckpt_dir=None if only_test else args.save_ckpt,
         logger=MetricsLogger(run_dir),
-        train_step=train_step, eval_step=eval_step, initial_state=state,
+        train_step=train_step, eval_step=eval_step, fused_step=fused_step,
+        initial_state=state,
         mesh=mesh, adv=adv_pieces,
         profile_dir=getattr(args, "profile", None),
         profile_steps=getattr(args, "profile_steps", 10),

@@ -25,7 +25,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from induction_network_on_fewrel_tpu.config import ExperimentConfig
 from induction_network_on_fewrel_tpu.models.losses import accuracy
-from induction_network_on_fewrel_tpu.train.steps import LOSS_FNS, loss_and_metrics
+from induction_network_on_fewrel_tpu.train.steps import (
+    LOSS_FNS,
+    loss_and_metrics,
+    make_update_body,
+)
 
 _BATCH_KEYS = ("word", "pos1", "pos2", "mask")
 
@@ -108,17 +112,44 @@ def make_sharded_train_step(model, cfg: ExperimentConfig, mesh: Mesh, state_exam
     st_sh = state_shardings(state_example, mesh)
     repl = NamedSharding(mesh, P())
     sup_sh, qry_sh, lab_sh = episode_batch_shardings(mesh)
+    body = make_update_body(model, cfg)
 
     def step(state, support, query, label):
-        def loss_fn(params):
-            return loss_and_metrics(model, params, support, query, label, cfg.loss)
-
-        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
-        return state.apply_gradients(grads=grads), metrics
+        return body(state, (support, query, label))
 
     return jax.jit(
         step,
         in_shardings=(st_sh, sup_sh, qry_sh, lab_sh),
+        out_shardings=(st_sh, {"loss": repl, "accuracy": repl}),
+        donate_argnums=(0,),
+    )
+
+
+def make_sharded_multi_train_step(
+    model, cfg: ExperimentConfig, mesh: Mesh, state_example
+):
+    """Mesh-sharded twin of train.steps.make_multi_train_step: one dispatch
+    scans ``steps_per_call`` stacked episode batches (leading axis S on every
+    batch array, sharded ``P(None, 'dp', ...)`` — the scan axis is never
+    partitioned), with the same GSPMD state shardings as the per-step path.
+    Dispatch/transfer amortization and multi-chip scaling compose this way:
+    XLA still inserts the gradient all-reduce over ICI inside every scan
+    iteration."""
+    st_sh = state_shardings(state_example, mesh)
+    repl = NamedSharding(mesh, P())
+    sup_sh, qry_sh, lab_sh = episode_batch_shardings(mesh)
+    stack = lambda sh: jax.tree.map(
+        lambda s: NamedSharding(mesh, P(None, *s.spec)), sh,
+        is_leaf=lambda x: isinstance(x, NamedSharding),
+    )
+    body = make_update_body(model, cfg)
+
+    def multi_step(state, support_s, query_s, label_s):
+        return jax.lax.scan(body, state, (support_s, query_s, label_s))
+
+    return jax.jit(
+        multi_step,
+        in_shardings=(st_sh, stack(sup_sh), stack(qry_sh), stack(lab_sh)),
         out_shardings=(st_sh, {"loss": repl, "accuracy": repl}),
         donate_argnums=(0,),
     )

@@ -53,6 +53,7 @@ class FewShotTrainer:
         logger: MetricsLogger | None = None,
         train_step=None,
         eval_step=None,
+        fused_step=None,
         initial_state=None,
         mesh=None,
         adv=None,
@@ -89,26 +90,41 @@ class FewShotTrainer:
         # and the adversarial path keep per-step dispatch; fusing those means
         # building the scan into their own step factories, not wrapping here.
         self._fused_step = None
-        if cfg.steps_per_call > 1 and train_step is None and adv is None:
-            if cfg.val_step and cfg.steps_per_call > cfg.val_step:
+        if cfg.steps_per_call > 1:
+            if adv is not None and fused_step is not None:
+                # The fused loop would silently bypass the DANN step.
+                raise ValueError(
+                    "fused_step cannot be combined with adversarial "
+                    "training; the fused loop skips the domain game"
+                )
+            if (
+                val_sampler is not None
+                and cfg.val_step
+                and cfg.steps_per_call > cfg.val_step
+            ):
                 # A fused call may not skip val/checkpoint boundaries:
                 # mid-chunk params no longer exist to evaluate.
                 raise ValueError(
                     f"steps_per_call ({cfg.steps_per_call}) must not exceed "
                     f"val_step ({cfg.val_step}); lower it or raise val_step"
                 )
-            self._fused_step = make_multi_train_step(model, cfg)
-        elif cfg.steps_per_call > 1:
-            import warnings
+            if fused_step is not None:
+                # parallel/sharding.make_sharded_multi_train_step, built by
+                # the caller against this trainer's mesh + state example.
+                self._fused_step = fused_step
+            elif train_step is None and adv is None:
+                self._fused_step = make_multi_train_step(model, cfg)
+            else:
+                import warnings
 
-            reason = "adversarial training" if adv is not None else (
-                "an injected (mesh-sharded) train step"
-            )
-            warnings.warn(
-                f"steps_per_call={cfg.steps_per_call} is ignored with "
-                f"{reason}; training runs per-step dispatch",
-                stacklevel=2,
-            )
+                reason = "adversarial training" if adv is not None else (
+                    "an injected (mesh-sharded) train step"
+                )
+                warnings.warn(
+                    f"steps_per_call={cfg.steps_per_call} is ignored with "
+                    f"{reason}; training runs per-step dispatch",
+                    stacklevel=2,
+                )
 
     def init_state(self):
         # Reuse a pre-built state when one was injected: mesh-sharded steps

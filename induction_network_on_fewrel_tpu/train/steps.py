@@ -67,17 +67,37 @@ def loss_and_metrics(
     return loss, {"loss": loss, "accuracy": accuracy(logits, label)}
 
 
+def make_update_body(model, cfg: ExperimentConfig):
+    """The one fwd+bwd+update body every step factory wraps: single-device
+    jit, GSPMD-sharded jit, and the lax.scan fused variants of both all call
+    this — one source of truth for the update math, so the per-step and
+    fused paths cannot diverge (tests assert they are bitwise-close).
+
+    ``(state, (support, query, label)) -> (state, metrics)`` — the scan-body
+    calling convention.
+    """
+
+    def body(state: TrainState, batch):
+        support, query, label = batch
+
+        def loss_fn(params):
+            return loss_and_metrics(
+                model, params, support, query, label, cfg.loss
+            )
+
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+        return state.apply_gradients(grads=grads), metrics
+
+    return body
+
+
 def make_train_step(model, cfg: ExperimentConfig):
     """Returns jitted (state, support, query, label) -> (state, metrics)."""
+    body = make_update_body(model, cfg)
 
     @partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, support, query, label):
-        def loss_fn(params):
-            return loss_and_metrics(model, params, support, query, label, cfg.loss)
-
-        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
-        state = state.apply_gradients(grads=grads)
-        return state, metrics
+        return body(state, (support, query, label))
 
     return train_step
 
@@ -97,19 +117,10 @@ def make_multi_train_step(model, cfg: ExperimentConfig):
     metrics)`` where each metric is stacked ``[S]``.
     """
 
+    body = make_update_body(model, cfg)
+
     @partial(jax.jit, donate_argnums=(0,))
     def multi_train_step(state: TrainState, support_s, query_s, label_s):
-        def body(st, xs):
-            support, query, label = xs
-
-            def loss_fn(params):
-                return loss_and_metrics(
-                    model, params, support, query, label, cfg.loss
-                )
-
-            grads, metrics = jax.grad(loss_fn, has_aux=True)(st.params)
-            return st.apply_gradients(grads=grads), metrics
-
         return jax.lax.scan(body, state, (support_s, query_s, label_s))
 
     return multi_train_step

@@ -119,3 +119,32 @@ def test_mesh_validation():
     assert m.shape == {"dp": 4, "tp": 2, "sp": 1}
     m = make_mesh(tp=2, sp=2)  # dp inferred = 2
     assert m.shape == {"dp": 2, "tp": 2, "sp": 2}
+
+
+def test_sharded_fused_step_matches_sequential(setup):
+    """GSPMD fused S-step scan == S sequential GSPMD steps == single-device
+    sequential steps: dispatch amortization must not change the math."""
+    from induction_network_on_fewrel_tpu.parallel.sharding import (
+        make_sharded_multi_train_step,
+        shard_state,
+    )
+
+    model, batches, state0 = setup
+    mesh = make_mesh(dp=4, tp=2)
+
+    seq_step = make_sharded_train_step(model, CFG, mesh, state0)
+    state_a = shard_state(_copy_state(state0), mesh)
+    state_a, _ = _run_steps(seq_step, state_a, batches)
+
+    multi = make_sharded_multi_train_step(model, CFG, mesh, state0)
+    state_b = shard_state(_copy_state(state0), mesh)
+    sup_s, qry_s, lab_s = jax.tree.map(lambda *xs: np.stack(xs), *batches)
+    state_b, metrics = multi(state_b, sup_s, qry_s, lab_s)
+
+    assert np.asarray(metrics["loss"]).shape == (len(batches),)
+    assert int(state_b.step) == int(state_a.step) == len(batches)
+    _params_allclose(state_a, state_b, atol=1e-6)
+
+    single = make_train_step(model, CFG)
+    state_c, _ = _run_steps(single, _copy_state(state0), batches)
+    _params_allclose(state_b, state_c, atol=1e-5)

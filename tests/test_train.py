@@ -191,7 +191,17 @@ def test_steps_per_call_guards():
     )
     model, sampler = _setup(cfg)
     with pytest.raises(ValueError, match="steps_per_call"):
-        FewShotTrainer(model, cfg, sampler)
+        FewShotTrainer(model, cfg, sampler, val_sampler=sampler)
+
+    # No val sampler -> val_step is irrelevant; big spc is fine.
+    FewShotTrainer(model, cfg, sampler)
+
+    # An injected fused step may not silently bypass adversarial training.
+    with pytest.raises(ValueError, match="adversarial"):
+        FewShotTrainer(
+            model, cfg.replace(val_step=100), sampler,
+            fused_step=lambda *a: a, adv=object(),
+        )
 
     from induction_network_on_fewrel_tpu.train.steps import make_train_step
 
