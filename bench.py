@@ -10,15 +10,21 @@ L=40, bf16 compute — the reference's headline setup (BASELINE.json config #2)
 pipeline when the toolchain is present, else the numpy sampler) feeding the
 jitted fwd+bwd+update step with donated state.
 
-Timing is chunked and wall-clock-bounded (the TPU here sits behind a tunnel
-whose latency can vary by orders of magnitude between sessions), and the
-reported value is the best chunk rate — the machine's demonstrated capability,
-insensitive to tunnel stalls between chunks.
+Timing is chunked, wall-clock-bounded, and — critically — HARD-SYNCED: every
+chunk ends with a device_get of a loss scalar. On this machine's tunneled
+backend ``jax.block_until_ready`` does NOT actually wait for execution (a
+queue of 500 "completed" steps drained for 6+ more seconds on the first real
+value fetch, measured 2026-07-30); only a value fetch forces completion.
+Block-based timings measured dispatch throughput, not training throughput —
+every pre-2026-07-30 number in BASELINE.md is such an illusion and is
+superseded by the hard-synced numbers.
 
-``vs_baseline``: ratio against the first recorded TPU v5e measurement
-(BASELINE.md "measured" table: 18274 eps/s/chip, 2026-07-29). The reference
-repo itself has no published numbers (BASELINE.json ``published`` is empty),
-so the self-established v5e number is the bar all later rounds must beat.
+``vs_baseline``: ratio against the first HONEST (hard-synced) bench.py run:
+1264 eps/s/chip, pallas BiLSTM, steps_per_call=64, 2026-07-30 (best scratch
+observation that day: 1840 — honest-mode tunnel variance is ±30%).
+The reference repo itself has no published numbers (BASELINE.json
+``published`` is empty), so the self-established number is the bar all later
+rounds must beat.
 """
 
 from __future__ import annotations
@@ -27,21 +33,20 @@ import json
 import sys
 import time
 
-# First measured TPU v5e number (episodes/sec/chip, this config) — the
-# self-established baseline later rounds improve against (BASELINE.md).
-# On non-TPU backends vs_baseline is reported as 1.0 (not comparable).
-BASELINE_EPS_TPU = 18274.0
-
-BATCH = 8            # episodes per step
 import os
 
-# Optimizer steps fused per dispatch (lax.scan). Swept on the v5e:
-# spc 1 -> 18.3k eps/s, 8 -> 28.0k, 16 -> 33.4-34.3k, 24 -> 28.4k,
-# 32 -> 29.4k; 16 is the knee (past it, host-side batch stacking for the
-# bigger call starts eating the dispatch win).
-STEPS_PER_CALL = int(os.environ.get("BENCH_SPC", "16"))
+# First HONEST (hard-synced) measured number for this config — the
+# self-established baseline later rounds improve against (BASELINE.md).
+# On non-TPU backends vs_baseline is reported as 1.0 (not comparable).
+BASELINE_EPS_TPU = 1264.0
+
+BATCH = 8            # episodes per step
+# Optimizer steps fused per dispatch (lax.scan). Hard-synced sweep on the
+# tunneled TPU: spc 1 -> 975, 16 -> 1678, 64 -> 1840, 128 -> 1829 eps/s
+# TRUE; 64 is the knee.
+STEPS_PER_CALL = int(os.environ.get("BENCH_SPC", "64"))
 WARMUP_STEPS = 5
-CHUNK_STEPS = 3 * STEPS_PER_CALL
+CHUNK_STEPS = 2 * STEPS_PER_CALL
 MAX_STEPS = 500
 MAX_SECONDS = 60.0
 
@@ -126,7 +131,11 @@ def main() -> int:
     t0 = time.monotonic()
     for _ in range(max(WARMUP_STEPS // S, 2)):
         state, metrics = fused_call(state)
-    jax.block_until_ready(metrics)
+    # HARD SYNC: a value fetch, not block_until_ready — on this tunneled
+    # backend block_until_ready returns before execution finishes (see
+    # module docstring), so only fetching a scalar forces the queue to
+    # actually drain. Every chunk below ends the same way.
+    _ = float(jax.device_get(metrics["loss"])[-1])
     print(f"bench: warmup(+compile) {time.monotonic() - t0:.1f}s", file=sys.stderr)
 
     best_rate = 0.0
@@ -137,7 +146,7 @@ def main() -> int:
         t0 = time.monotonic()
         for _ in range(calls_per_chunk):
             state, metrics = fused_call(state)
-        jax.block_until_ready(metrics)
+        _ = float(jax.device_get(metrics["loss"])[-1])  # hard sync
         dt = time.monotonic() - t0
         chunk_steps = calls_per_chunk * S
         total_steps += chunk_steps
@@ -157,7 +166,7 @@ def main() -> int:
     print(json.dumps({
         "metric": (
             f"train_episodes_per_sec_per_chip"
-            f"[5w5s,bilstm,L40,bf16,{backend},e2e,{sampler_tag},spc{S}]"
+            f"[5w5s,bilstm,L40,bf16,{backend},e2e,{sampler_tag},spc{S},hardsync]"
         ),
         "value": round(best_rate, 2),
         "unit": "episodes/s/chip",

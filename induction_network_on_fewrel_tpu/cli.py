@@ -60,6 +60,11 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--bert_weights", default=None, help=".npz of bert-base-uncased weights")
     p.add_argument("--bert_remat", action="store_true", help="rematerialize BERT layers (HBM headroom)")
     # optimization
+    p.add_argument(
+        "--feature_cache", action="store_true",
+        help="frozen-encoder feature cache: encode the dataset once, train "
+             "the episode head on gathered features (bert frozen only)",
+    )
     p.add_argument("--loss", default="mse", choices=["mse", "ce"])
     p.add_argument("--optimizer", default="adam", choices=["adam", "adamw", "sgd"])
     p.add_argument("--lr", type=float, default=1e-3)
@@ -156,6 +161,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         grad_clip=args.grad_clip, train_iter=train_iter,
         val_iter=val_iter, val_step=val_step, test_iter=args.test_iter,
         steps_per_call=getattr(args, "steps_per_call", 1),
+        feature_cache=getattr(args, "feature_cache", False),
         device=args.device, compute_dtype=compute, seed=args.seed,
         dp=args.dp, tp=args.tp, sp=args.sp,
         sampler=args.sampler, prefetch=args.prefetch,
@@ -286,7 +292,113 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         cfg, glove_init=vocab.vectors if vocab is not None else None,
         attn_impl=attn_impl,
     )
-    if use_mesh:
+    if cfg.feature_cache:
+        # Frozen-encoder feature cache (train/feature_cache.py): encode both
+        # splits once with the frozen backbone, then swap the token samplers
+        # for feature samplers — training runs the episode head only.
+        if cfg.encoder != "bert" or not cfg.bert_frozen:
+            raise ValueError(
+                "--feature_cache requires --encoder bert with the frozen "
+                "backbone (a trainable encoder would be silently frozen)"
+            )
+        if cfg.model == "pair":
+            raise ValueError(
+                "--feature_cache cannot serve --model pair: it scores "
+                "token-level sentence pairs through the backbone"
+            )
+        if cfg.adv:
+            raise ValueError(
+                "--feature_cache excludes --adv: the domain game trains "
+                "the encoder, which the cache freezes out of the step"
+            )
+        from induction_network_on_fewrel_tpu.train.feature_cache import (
+            FeatureEpisodeSampler,
+            encode_dataset,
+            make_cached_eval_step,
+            make_cached_multi_train_step,
+            make_cached_train_step,
+            make_encode_fn,
+        )
+
+        sup_t, qry_t, _ = batch_to_model_inputs(train_sampler.sample_batch())
+        full_params = model.init(jax.random.key(cfg.seed), sup_t, qry_t)
+        # Pretrained weights must be in the backbone BEFORE the cache is
+        # built — the cached train state is head-only, so this is the only
+        # point where they can enter (train_main skips its own injection).
+        if getattr(args, "bert_weights", None):
+            from induction_network_on_fewrel_tpu.models.bert import (
+                load_hf_weights,
+            )
+
+            enc = load_hf_weights(
+                {"params": full_params["params"]["encoder"]}, args.bert_weights
+            )
+            full_params["params"]["encoder"] = enc["params"]
+            print(f"feature cache: encoding with BERT weights from "
+                  f"{args.bert_weights}", file=sys.stderr)
+        encode_fn = make_encode_fn(model)  # one compile for all splits
+        blocks_tr = encode_dataset(model, full_params, train_ds, tok,
+                                   encode_fn=encode_fn)
+        blocks_va = encode_dataset(model, full_params, val_ds, tok,
+                                   encode_fn=encode_fn)
+        for s in (train_sampler, val_sampler):
+            if hasattr(s, "close"):
+                s.close()
+        # Index mode: the feature tables live ON DEVICE; per step only
+        # [B,N,K]+[B,TQ] int32 indices cross the host->device boundary
+        # (~1 KB vs ~500 KB of materialized features) and the gather runs
+        # inside the jitted step.
+        train_sampler = FeatureEpisodeSampler(
+            blocks_tr, cfg.train_n, cfg.k, cfg.q, cfg.batch_size,
+            na_rate=cfg.na_rate, seed=cfg.seed, return_indices=True,
+        )
+        val_sampler = FeatureEpisodeSampler(
+            blocks_va, cfg.n, cfg.k, cfg.q, cfg.batch_size,
+            na_rate=cfg.na_rate, seed=cfg.seed + 1, return_indices=True,
+        )
+        cache_mesh = mesh if use_mesh else None  # built above with attn_impl
+        if cache_mesh is not None and cfg.batch_size % cache_mesh.shape["dp"]:
+            raise ValueError(
+                f"--batch_size {cfg.batch_size} must be divisible by the "
+                f"data-parallel mesh axis dp={cache_mesh.shape['dp']}"
+            )
+        table_tr = jax.device_put(train_sampler.table)
+        table_va = jax.device_put(val_sampler.table)
+        # Head-only state: init on gathered features creates no backbone
+        # params (flax lazy param creation), so the optimizer never sees
+        # the frozen 110M either.
+        b0 = train_sampler.sample_batch()
+        state = init_state(
+            model, cfg, train_sampler.table[b0.support_idx],
+            train_sampler.table[b0.query_idx],
+        )
+        if cache_mesh is not None:
+            from induction_network_on_fewrel_tpu.parallel.sharding import (
+                shard_state,
+            )
+
+            state = shard_state(state, cache_mesh)
+        _train = make_cached_train_step(model, cfg, cache_mesh, state)
+        _eval = make_cached_eval_step(model, cfg, cache_mesh, state)
+        train_step = lambda st, si, qi, l: _train(st, table_tr, si, qi, l)
+        eval_step = lambda p, si, qi, l: _eval(p, table_va, si, qi, l)
+        if cfg.steps_per_call > 1:
+            _multi = make_cached_multi_train_step(model, cfg, cache_mesh, state)
+            fused_step = lambda st, si, qi, l: _multi(st, table_tr, si, qi, l)
+
+        def cached_test_eval(test_ds):
+            """(sampler, eval_step) for a test split under the cache: encode
+            it with the SAME backbone params the train/val caches used, and
+            bind a cached eval step to its own device table."""
+            blocks_te = encode_dataset(model, full_params, test_ds, tok,
+                                       encode_fn=encode_fn)
+            ts = FeatureEpisodeSampler(
+                blocks_te, cfg.n, cfg.k, cfg.q, cfg.batch_size,
+                na_rate=cfg.na_rate, seed=cfg.seed + 2, return_indices=True,
+            )
+            tab = jax.device_put(ts.table)
+            return ts, (lambda p, si, qi, l: _eval(p, tab, si, qi, l))
+    if use_mesh and not cfg.feature_cache:
         dp = mesh.shape["dp"]
         if cfg.batch_size % dp != 0:
             raise ValueError(
@@ -383,6 +495,11 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         if trainer.adv is not None:
             trainer.adv.step = checkify_step(trainer.adv.step)
     trainer.vocab, trainer.tokenizer = vocab, tok
+    # Cached-mode test evaluation factory (None on the token path): the test
+    # split needs its own feature table, encoded with the cache's backbone.
+    trainer.cached_test_eval = (
+        cached_test_eval if cfg.feature_cache else None
+    )
     return trainer
 
 
@@ -397,6 +514,19 @@ def make_test_sampler(args, cfg: ExperimentConfig, tok):
         backend="python" if cfg.sampler == "auto" else cfg.sampler,
         prefetch=0, num_threads=1,
     )
+
+
+def _test_accuracy(args, cfg: ExperimentConfig, trainer, state) -> float:
+    """Evaluate on the test split, via the feature-cache path when active
+    (the cached eval step reads int32 indices into a test-split table; the
+    token sampler's dicts would not even trace)."""
+    if trainer.cached_test_eval is not None:
+        test_ds = load_data(args, cfg, "test")
+        sampler, eval_step = trainer.cached_test_eval(test_ds)
+        trainer.eval_step = eval_step
+        return trainer.evaluate(state.params, cfg.test_iter, sampler=sampler)
+    sampler = make_test_sampler(args, cfg, trainer.tokenizer)
+    return trainer.evaluate(state.params, cfg.test_iter, sampler=sampler)
 
 
 def _merge_ckpt_architecture(cfg: ExperimentConfig, src: str) -> ExperimentConfig:
@@ -427,7 +557,9 @@ def train_main(argv=None) -> int:
     cfg = trainer.cfg  # make_trainer may pin tokenizer-derived fields
 
     state = trainer.init_state()
-    if args.bert_weights:
+    if args.bert_weights and not cfg.feature_cache:
+        # Cached mode has no backbone in the train state; make_trainer
+        # already folded the weights into the feature tables instead.
         from induction_network_on_fewrel_tpu.models.bert import load_hf_weights
 
         enc = load_hf_weights({"params": state.params["params"]["encoder"]}, args.bert_weights)
@@ -451,8 +583,7 @@ def train_main(argv=None) -> int:
             print(f"no checkpoint in {src}; starting fresh", file=sys.stderr)
 
     if args.only_test:
-        sampler = make_test_sampler(args, cfg, trainer.tokenizer)
-        acc = trainer.evaluate(state.params, cfg.test_iter, sampler=sampler)
+        acc = _test_accuracy(args, cfg, trainer, state)
         print(f'{{"test_accuracy": {acc:.4f}}}')
         return 0
 
@@ -482,7 +613,6 @@ def test_main(argv=None) -> int:
     state = trainer.reshard_state(state)
     print(f"loaded best checkpoint step={step} from {src}", file=sys.stderr)
 
-    test_sampler = make_test_sampler(args, cfg, trainer.tokenizer)
-    acc = trainer.evaluate(state.params, cfg.test_iter, sampler=test_sampler)
+    acc = _test_accuracy(args, cfg, trainer, state)
     print(f'{{"test_accuracy": {acc:.4f}}}')
     return 0

@@ -82,6 +82,10 @@ class ExperimentConfig:
     # make_multi_train_step). 1 = classic per-step dispatch; >1 amortizes
     # host dispatch + transfer latency with identical update semantics.
     steps_per_call: int = 1
+    # Frozen-encoder feature cache (train/feature_cache.py): encode the
+    # dataset once, train the episode head on gathered features. Requires
+    # --encoder bert with the frozen backbone; excludes pair/adv.
+    feature_cache: bool = False
 
     # --- FewRel 2.0 adversarial domain adaptation (training-time only) ---
     adv: bool = False         # train encoder against a domain discriminator
@@ -127,6 +131,9 @@ class ExperimentConfig:
         "bert_heads", "bert_intermediate", "bert_vocab_size",
         "bert_vocab_path", "tfm_layers", "tfm_model", "tfm_heads", "tfm_ff",
         "loss", "optimizer",
+        # feature_cache changes the state tree itself (head-only params), so
+        # a cached checkpoint can only restore into a cached runtime.
+        "feature_cache",
     )
 
     def replace(self, **kw: Any) -> "ExperimentConfig":

@@ -38,7 +38,14 @@ class FewShotModel(nn.Module):
         return enc.reshape(*lead, -1)
 
     def encode_episode(self, support, query) -> tuple[jnp.ndarray, jnp.ndarray]:
-        """(support dict, query dict) -> ([B,N,K,H], [B,TQ,H]) encodings."""
+        """(support dict, query dict) -> ([B,N,K,H], [B,TQ,H]) encodings.
+
+        Pre-encoded feature episodes (train/feature_cache.py: frozen-encoder
+        training) arrive as plain arrays instead of token dicts and pass
+        straight through — the episode-level math is encoder-agnostic.
+        """
+        if not isinstance(support, dict):
+            return jnp.asarray(support), jnp.asarray(query)
         sup_enc = self.encode(
             support["word"], support["pos1"], support["pos2"], support["mask"]
         )

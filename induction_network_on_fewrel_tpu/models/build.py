@@ -168,7 +168,16 @@ def encoder_output_dim(cfg: ExperimentConfig) -> int:
 
 
 def batch_to_model_inputs(batch) -> tuple[dict, dict, jnp.ndarray]:
-    """EpisodeBatch (numpy) -> (support dict, query dict, label) for the model."""
+    """EpisodeBatch (numpy) -> (support dict, query dict, label) for the model.
+
+    FeatureEpisodeBatch (train/feature_cache.py) passes its pre-encoded
+    support/query arrays through unchanged — the models' ``encode_episode``
+    accepts either form.
+    """
+    if hasattr(batch, "support_idx"):  # IndexEpisodeBatch (cached path)
+        return batch.support_idx, batch.query_idx, batch.label
+    if hasattr(batch, "support"):  # FeatureEpisodeBatch
+        return batch.support, batch.query, batch.label
     support = {
         "word": batch.support_word,
         "pos1": batch.support_pos1,

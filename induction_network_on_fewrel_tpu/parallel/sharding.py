@@ -82,7 +82,11 @@ def shard_state(state: Any, mesh: Mesh):
 
 
 def episode_batch_shardings(mesh: Mesh):
-    """(support, query, label) shardings: episode axis over dp."""
+    """(support, query, label) shardings: episode axis over dp.
+
+    Token batches only — the feature-cache path has its own index-mode
+    shardings (train/feature_cache.py ``_shard_cached``).
+    """
     sup = {k: NamedSharding(mesh, P("dp", None, None, None)) for k in _BATCH_KEYS}
     qry = {k: NamedSharding(mesh, P("dp", None, None)) for k in _BATCH_KEYS}
     lab = NamedSharding(mesh, P("dp", None))

@@ -77,13 +77,20 @@ def main() -> int:
               "the real chip)", file=sys.stderr)
         return 1
 
+    def hard_sync(m):
+        # Value fetch, not block_until_ready (bench.py docstring: block does
+        # not actually wait on this tunneled backend).
+        import numpy as np
+
+        _ = float(np.ravel(jax.device_get(m["loss"]))[-1])
+
     arms = [build_arm("scan"), build_arm("pallas")]
     # warmup/compile both
     for arm in arms:
         t0 = time.monotonic()
         for _ in range(5):
             arm["state"], m = arm["step"](arm["state"])
-        jax.block_until_ready(m)
+        hard_sync(m)
         print(f"# {arm['name']}: compiled in {time.monotonic()-t0:.1f}s",
               file=sys.stderr)
 
@@ -92,7 +99,7 @@ def main() -> int:
             t0 = time.monotonic()
             for _ in range(CHUNK):
                 arm["state"], m = arm["step"](arm["state"])
-            jax.block_until_ready(m)
+            hard_sync(m)
             n_chips = max(jax.local_device_count(), 1)
             arm["rates"].append(CHUNK * BATCH / (time.monotonic() - t0) / n_chips)
 
