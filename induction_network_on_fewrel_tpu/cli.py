@@ -155,7 +155,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         routing_iters=args.routing_iters, ntn_slices=args.ntn_slices,
         bert_frozen=args.bert_frozen, bert_layers=args.bert_layers,
         bert_vocab_size=args.bert_vocab_size, bert_vocab_path=args.bert_vocab,
-        bert_remat=args.bert_remat,
+        bert_remat=args.bert_remat, bert_weights=args.bert_weights,
         loss=args.loss, optimizer=args.optimizer, lr=args.lr,
         weight_decay=args.weight_decay, lr_step_size=args.lr_step_size,
         grad_clip=args.grad_clip, train_iter=train_iter,
@@ -320,57 +320,57 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
             make_encode_fn,
         )
 
+        import numpy as np
+
+        from induction_network_on_fewrel_tpu.models.build import (
+            encoder_output_dim,
+        )
+
         sup_t, qry_t, _ = batch_to_model_inputs(train_sampler.sample_batch())
         full_params = model.init(jax.random.key(cfg.seed), sup_t, qry_t)
-        # Pretrained weights must be in the backbone BEFORE the cache is
-        # built — the cached train state is head-only, so this is the only
+        # Pretrained weights must be in the backbone BEFORE any split is
+        # encoded — the cached train state is head-only, so this is the only
         # point where they can enter (train_main skips its own injection).
-        if getattr(args, "bert_weights", None):
+        # cfg.bert_weights (an ARCHITECTURE_FIELD) rides in the checkpoint's
+        # config.json, so test-time runs rebuild the same backbone.
+        if cfg.bert_weights:
             from induction_network_on_fewrel_tpu.models.bert import (
                 load_hf_weights,
             )
 
             enc = load_hf_weights(
-                {"params": full_params["params"]["encoder"]}, args.bert_weights
+                {"params": full_params["params"]["encoder"]}, cfg.bert_weights
             )
             full_params["params"]["encoder"] = enc["params"]
             print(f"feature cache: encoding with BERT weights from "
-                  f"{args.bert_weights}", file=sys.stderr)
+                  f"{cfg.bert_weights}", file=sys.stderr)
         encode_fn = make_encode_fn(model)  # one compile for all splits
-        blocks_tr = encode_dataset(model, full_params, train_ds, tok,
-                                   encode_fn=encode_fn)
-        blocks_va = encode_dataset(model, full_params, val_ds, tok,
-                                   encode_fn=encode_fn)
-        for s in (train_sampler, val_sampler):
-            if hasattr(s, "close"):
-                s.close()
-        # Index mode: the feature tables live ON DEVICE; per step only
-        # [B,N,K]+[B,TQ] int32 indices cross the host->device boundary
-        # (~1 KB vs ~500 KB of materialized features) and the gather runs
-        # inside the jitted step.
-        train_sampler = FeatureEpisodeSampler(
-            blocks_tr, cfg.train_n, cfg.k, cfg.q, cfg.batch_size,
-            na_rate=cfg.na_rate, seed=cfg.seed, return_indices=True,
-        )
-        val_sampler = FeatureEpisodeSampler(
-            blocks_va, cfg.n, cfg.k, cfg.q, cfg.batch_size,
-            na_rate=cfg.na_rate, seed=cfg.seed + 1, return_indices=True,
-        )
         cache_mesh = mesh if use_mesh else None  # built above with attn_impl
         if cache_mesh is not None and cfg.batch_size % cache_mesh.shape["dp"]:
             raise ValueError(
                 f"--batch_size {cfg.batch_size} must be divisible by the "
                 f"data-parallel mesh axis dp={cache_mesh.shape['dp']}"
             )
-        table_tr = jax.device_put(train_sampler.table)
-        table_va = jax.device_put(val_sampler.table)
-        # Head-only state: init on gathered features creates no backbone
-        # params (flax lazy param creation), so the optimizer never sees
-        # the frozen 110M either.
-        b0 = train_sampler.sample_batch()
+        if cache_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # Place tables with the replicated sharding the cached steps
+            # declare; a bare device_put would force a whole-table reshard
+            # copy on every step.
+            _put = lambda x: jax.device_put(
+                x, NamedSharding(cache_mesh, PartitionSpec())
+            )
+        else:
+            _put = jax.device_put
+        # Head-only state (flax lazy param creation: init on feature-shaped
+        # inputs builds no backbone params, so the optimizer never sees the
+        # frozen 110M either). Zero arrays suffice — init reads shapes, not
+        # values — which keeps the only_test path free of train/val encodes.
+        H = encoder_output_dim(cfg)
         state = init_state(
-            model, cfg, train_sampler.table[b0.support_idx],
-            train_sampler.table[b0.query_idx],
+            model, cfg,
+            np.zeros((cfg.batch_size, cfg.train_n, cfg.k, H), np.float32),
+            np.zeros((cfg.batch_size, cfg.total_q, H), np.float32),
         )
         if cache_mesh is not None:
             from induction_network_on_fewrel_tpu.parallel.sharding import (
@@ -378,13 +378,40 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
             )
 
             state = shard_state(state, cache_mesh)
-        _train = make_cached_train_step(model, cfg, cache_mesh, state)
         _eval = make_cached_eval_step(model, cfg, cache_mesh, state)
-        train_step = lambda st, si, qi, l: _train(st, table_tr, si, qi, l)
-        eval_step = lambda p, si, qi, l: _eval(p, table_va, si, qi, l)
-        if cfg.steps_per_call > 1:
-            _multi = make_cached_multi_train_step(model, cfg, cache_mesh, state)
-            fused_step = lambda st, si, qi, l: _multi(st, table_tr, si, qi, l)
+
+        if not only_test:
+            blocks_tr = encode_dataset(model, full_params, train_ds, tok,
+                                       encode_fn=encode_fn)
+            blocks_va = encode_dataset(model, full_params, val_ds, tok,
+                                       encode_fn=encode_fn)
+            for s in (train_sampler, val_sampler):
+                if hasattr(s, "close"):
+                    s.close()
+            # Index mode: the feature tables live ON DEVICE; per step only
+            # [B,N,K]+[B,TQ] int32 indices cross the host->device boundary
+            # (~1 KB vs ~500 KB of materialized features) and the gather
+            # runs inside the jitted step.
+            train_sampler = FeatureEpisodeSampler(
+                blocks_tr, cfg.train_n, cfg.k, cfg.q, cfg.batch_size,
+                na_rate=cfg.na_rate, seed=cfg.seed, return_indices=True,
+            )
+            val_sampler = FeatureEpisodeSampler(
+                blocks_va, cfg.n, cfg.k, cfg.q, cfg.batch_size,
+                na_rate=cfg.na_rate, seed=cfg.seed + 1, return_indices=True,
+            )
+            table_tr = _put(train_sampler.table)
+            table_va = _put(val_sampler.table)
+            _train = make_cached_train_step(model, cfg, cache_mesh, state)
+            train_step = lambda st, si, qi, l: _train(st, table_tr, si, qi, l)
+            eval_step = lambda p, si, qi, l: _eval(p, table_va, si, qi, l)
+            if cfg.steps_per_call > 1:
+                _multi = make_cached_multi_train_step(
+                    model, cfg, cache_mesh, state
+                )
+                fused_step = (
+                    lambda st, si, qi, l: _multi(st, table_tr, si, qi, l)
+                )
 
         def cached_test_eval(test_ds):
             """(sampler, eval_step) for a test split under the cache: encode
@@ -396,7 +423,7 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
                 blocks_te, cfg.n, cfg.k, cfg.q, cfg.batch_size,
                 na_rate=cfg.na_rate, seed=cfg.seed + 2, return_indices=True,
             )
-            tab = jax.device_put(ts.table)
+            tab = _put(ts.table)
             return ts, (lambda p, si, qi, l: _eval(p, tab, si, qi, l))
     if use_mesh and not cfg.feature_cache:
         dp = mesh.shape["dp"]
@@ -468,11 +495,22 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
             )
         else:
             adv_step = make_adv_train_step(model, disc, cfg)
+        adv_multi = None
+        if cfg.steps_per_call > 1 and not use_mesh:
+            # Fused DANN dispatch (single-device; the mesh DANN step keeps
+            # per-step dispatch — its fused twin would need sharded stacked
+            # specs like make_sharded_multi_train_step's).
+            from induction_network_on_fewrel_tpu.train.steps import (
+                make_adv_multi_train_step,
+            )
+
+            adv_multi = make_adv_multi_train_step(model, disc, cfg)
         adv_pieces = AdvPieces(
             step=adv_step,
             disc_state=disc_state,
             src_sampler=InstanceSampler(train_ds, tok, cfg.adv_batch, seed=cfg.seed + 31),
             tgt_sampler=InstanceSampler(tgt_ds, tok, cfg.adv_batch, seed=cfg.seed + 32),
+            multi_step=adv_multi,
         )
 
     run_dir = args.run_dir or args.save_ckpt
@@ -494,6 +532,8 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
             trainer._fused_step = checkify_step(trainer._fused_step)
         if trainer.adv is not None:
             trainer.adv.step = checkify_step(trainer.adv.step)
+            if trainer.adv.multi_step is not None:
+                trainer.adv.multi_step = checkify_step(trainer.adv.multi_step)
     trainer.vocab, trainer.tokenizer = vocab, tok
     # Cached-mode test evaluation factory (None on the token path): the test
     # split needs its own feature table, encoded with the cache's backbone.
@@ -524,6 +564,9 @@ def _test_accuracy(args, cfg: ExperimentConfig, trainer, state) -> float:
         test_ds = load_data(args, cfg, "test")
         sampler, eval_step = trainer.cached_test_eval(test_ds)
         trainer.eval_step = eval_step
+        # The stock fused eval (if any) expects token batches; the cached
+        # sampler yields index batches — force the per-batch cached step.
+        trainer._fused_eval = None
         return trainer.evaluate(state.params, cfg.test_iter, sampler=sampler)
     sampler = make_test_sampler(args, cfg, trainer.tokenizer)
     return trainer.evaluate(state.params, cfg.test_iter, sampler=sampler)

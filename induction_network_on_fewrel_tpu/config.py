@@ -53,6 +53,7 @@ class ExperimentConfig:
     bert_vocab_size: int = 30522  # bert-base-uncased WordPiece vocab
     bert_vocab_path: str | None = None  # vocab.txt (None -> hash fallback)
     bert_frozen: bool = True  # frozen -> fine-tuned regime (reference config 4)
+    bert_weights: str | None = None  # .npz of pretrained weights (or None)
     bert_remat: bool = False  # jax.checkpoint per layer (HBM vs FLOPs)
 
     # Transformer encoder (models/transformer.py; ring-attention capable):
@@ -132,8 +133,10 @@ class ExperimentConfig:
         "bert_vocab_path", "tfm_layers", "tfm_model", "tfm_heads", "tfm_ff",
         "loss", "optimizer",
         # feature_cache changes the state tree itself (head-only params), so
-        # a cached checkpoint can only restore into a cached runtime.
-        "feature_cache",
+        # a cached checkpoint can only restore into a cached runtime — and
+        # that runtime must rebuild the SAME backbone: frozen flag and
+        # pretrained-weights path ride along.
+        "feature_cache", "bert_frozen", "bert_weights",
     )
 
     def replace(self, **kw: Any) -> "ExperimentConfig":

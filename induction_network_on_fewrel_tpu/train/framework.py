@@ -23,6 +23,7 @@ from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
 from induction_network_on_fewrel_tpu.train.steps import (
     init_state,
     make_eval_step,
+    make_multi_eval_step,
     make_multi_train_step,
     make_train_step,
 )
@@ -40,6 +41,9 @@ class AdvPieces:
     disc_state: Any
     src_sampler: Any
     tgt_sampler: Any
+    # Optional steps_per_call twin (steps.make_adv_multi_train_step): scans
+    # S stacked (episode, src, tgt) batches per dispatch. None = per-step.
+    multi_step: Callable | None = None
 
 
 class FewShotTrainer:
@@ -114,6 +118,8 @@ class FewShotTrainer:
                 self._fused_step = fused_step
             elif train_step is None and adv is None:
                 self._fused_step = make_multi_train_step(model, cfg)
+            elif adv is not None and adv.multi_step is not None:
+                pass  # fused DANN path; handled in the train loop
             else:
                 import warnings
 
@@ -125,6 +131,11 @@ class FewShotTrainer:
                     f"{reason}; training runs per-step dispatch",
                     stacklevel=2,
                 )
+        # Fused eval (steps.make_multi_eval_step): stock eval path only —
+        # injected (mesh/cached) eval steps bind their own data layout.
+        self._fused_eval = None
+        if cfg.steps_per_call > 1 and eval_step is None:
+            self._fused_eval = make_multi_eval_step(model, cfg)
 
     def init_state(self):
         # Reuse a pre-built state when one was injected: mesh-sharded steps
@@ -170,6 +181,7 @@ class FewShotTrainer:
                     profiling, profile_done = False, True
                     self.logger.log(step, "profile", written=1.0)
             spc = cfg.steps_per_call
+            adv_fused = adv is not None and adv.multi_step is not None
             if self._fused_step is not None and num_iters - step >= spc:
                 batches = [
                     batch_to_model_inputs(next(it)) for _ in range(spc)
@@ -178,6 +190,23 @@ class FewShotTrainer:
                     lambda *xs: np.stack(xs), *batches
                 )
                 state, metrics = self._fused_step(state, sup_s, qry_s, lab_s)
+                prev, step = step, step + spc
+            elif adv_fused and num_iters - step >= spc:
+                batches = [
+                    batch_to_model_inputs(next(it)) for _ in range(spc)
+                ]
+                sup_s, qry_s, lab_s = jax.tree.map(
+                    lambda *xs: np.stack(xs), *batches
+                )
+                srcs = [adv.src_sampler.sample_batch()._asdict()
+                        for _ in range(spc)]
+                tgts = [adv.tgt_sampler.sample_batch()._asdict()
+                        for _ in range(spc)]
+                src_s = jax.tree.map(lambda *xs: np.stack(xs), *srcs)
+                tgt_s = jax.tree.map(lambda *xs: np.stack(xs), *tgts)
+                state, adv.disc_state, metrics = adv.multi_step(
+                    state, adv.disc_state, sup_s, qry_s, lab_s, src_s, tgt_s
+                )
                 prev, step = step, step + spc
             else:
                 support, query, label = batch_to_model_inputs(next(it))
@@ -227,8 +256,24 @@ class FewShotTrainer:
         accs = []
         n_batches = max(1, num_episodes // sampler.batch_size)
         it: Iterator = iter(sampler)
-        for _ in range(n_batches):
-            support, query, label = batch_to_model_inputs(next(it))
-            out = self.eval_step(params, support, query, label)
-            accs.append(out["accuracy"])
-        return float(np.mean(jax.device_get(accs)))
+        spc = self.cfg.steps_per_call
+        remaining = n_batches
+        while remaining > 0:
+            if self._fused_eval is not None and remaining >= spc:
+                batches = [
+                    batch_to_model_inputs(next(it)) for _ in range(spc)
+                ]
+                sup_s, qry_s, lab_s = jax.tree.map(
+                    lambda *xs: np.stack(xs), *batches
+                )
+                out = self._fused_eval(params, sup_s, qry_s, lab_s)
+                accs.append(out["accuracy"])  # [S]
+                remaining -= spc
+            else:
+                support, query, label = batch_to_model_inputs(next(it))
+                out = self.eval_step(params, support, query, label)
+                accs.append(out["accuracy"])
+                remaining -= 1
+        return float(np.mean(np.concatenate(
+            [np.atleast_1d(np.asarray(a)) for a in jax.device_get(accs)]
+        )))

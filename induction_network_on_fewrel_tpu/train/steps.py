@@ -138,6 +138,30 @@ def make_eval_step(model, cfg: ExperimentConfig):
     return eval_step
 
 
+def make_multi_eval_step(model, cfg: ExperimentConfig):
+    """Fused eval: one dispatch scores S stacked episode batches.
+
+    Eval batches are independent (params fixed), so this is ``lax.map`` over
+    the stacked axis — same per-call amortization as the fused train step
+    (each eval dispatch costs a full tunnel round-trip otherwise). Returns
+    metrics stacked ``[S]``.
+    """
+
+    @jax.jit
+    def multi_eval_step(params, support_s, query_s, label_s):
+        def body(xs):
+            support, query, label = xs
+            logits = model.apply(params, support, query)
+            return {
+                "loss": LOSS_FNS[cfg.loss](logits, label),
+                "accuracy": accuracy(logits, label),
+            }
+
+        return jax.lax.map(body, (support_s, query_s, label_s))
+
+    return multi_eval_step
+
+
 def init_state(model, cfg: ExperimentConfig, support, query, rng=None) -> TrainState:
     rng = rng if rng is not None else jax.random.key(cfg.seed)
     params = model.init(rng, support, query)
@@ -160,15 +184,10 @@ def init_disc_state(disc, cfg: ExperimentConfig, feat_dim: int, rng=None) -> Tra
     )
 
 
-def make_adv_train_step(model, disc, cfg: ExperimentConfig):
-    """Jitted DANN step: few-shot loss + domain-confusion game in ONE pass.
-
-    (state, disc_state, support, query, label, src, tgt) ->
-    (state, disc_state, metrics); ``src``/``tgt`` are unlabeled instance
-    dicts {word, pos1, pos2, mask}: [M, L]. The discriminator minimizes
-    domain cross-entropy; ``ops.gradient_reversal`` hands the encoder the
-    negated gradient so it maximizes it — one backward, one optimizer step
-    each, no alternating schedule.
+def make_adv_update_body(model, disc, cfg: ExperimentConfig):
+    """The DANN fwd+bwd+update body shared by the per-step and fused
+    factories: ``((state, disc_state), (support, query, label, src, tgt))
+    -> ((state, disc_state), metrics)`` — the scan calling convention.
     """
     from induction_network_on_fewrel_tpu.models.base import FewShotModel
     from induction_network_on_fewrel_tpu.ops import gradient_reversal
@@ -181,9 +200,10 @@ def make_adv_train_step(model, disc, cfg: ExperimentConfig):
             method=FewShotModel.encode,
         )
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def adv_train_step(state: TrainState, disc_state: TrainState,
-                       support, query, label, src, tgt):
+    def body(carry, batch):
+        state, disc_state = carry
+        support, query, label, src, tgt = batch
+
         def loss_fn(params, disc_params):
             logits = model.apply(params, support, query)
             fs_loss = LOSS_FNS[cfg.loss](logits, label)
@@ -212,6 +232,50 @@ def make_adv_train_step(model, disc, cfg: ExperimentConfig):
         )
         state = state.apply_gradients(grads=grads[0])
         disc_state = disc_state.apply_gradients(grads=grads[1])
+        return (state, disc_state), metrics
+
+    return body
+
+
+def make_adv_train_step(model, disc, cfg: ExperimentConfig):
+    """Jitted DANN step: few-shot loss + domain-confusion game in ONE pass.
+
+    (state, disc_state, support, query, label, src, tgt) ->
+    (state, disc_state, metrics); ``src``/``tgt`` are unlabeled instance
+    dicts {word, pos1, pos2, mask}: [M, L]. The discriminator minimizes
+    domain cross-entropy; ``ops.gradient_reversal`` hands the encoder the
+    negated gradient so it maximizes it — one backward, one optimizer step
+    each, no alternating schedule.
+    """
+    body = make_adv_update_body(model, disc, cfg)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def adv_train_step(state: TrainState, disc_state: TrainState,
+                       support, query, label, src, tgt):
+        (state, disc_state), metrics = body(
+            (state, disc_state), (support, query, label, src, tgt)
+        )
         return state, disc_state, metrics
 
     return adv_train_step
+
+
+def make_adv_multi_train_step(model, disc, cfg: ExperimentConfig):
+    """steps_per_call twin of the DANN step: scan S stacked (episode,
+    src, tgt) batches in one dispatch — identical update sequence.
+
+    (state, disc_state, support_s, query_s, label_s, src_s, tgt_s) ->
+    (state, disc_state, metrics stacked [S]).
+    """
+    body = make_adv_update_body(model, disc, cfg)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def adv_multi_train_step(state, disc_state,
+                             support_s, query_s, label_s, src_s, tgt_s):
+        (state, disc_state), metrics = jax.lax.scan(
+            body, (state, disc_state),
+            (support_s, query_s, label_s, src_s, tgt_s),
+        )
+        return state, disc_state, metrics
+
+    return adv_multi_train_step

@@ -138,3 +138,43 @@ def test_sharded_adv_step_matches_single_device():
         ),
         jax.device_get(st.params), jax.device_get(ref_state.params),
     )
+
+
+def test_adv_multi_step_matches_sequential():
+    """Fused DANN scan == S sequential DANN steps on the same batches."""
+    from induction_network_on_fewrel_tpu.train.steps import (
+        make_adv_multi_train_step,
+    )
+
+    model, ep, src, tgt = _pieces()
+    disc = DomainDiscriminator(hidden=CFG.adv_dis_hidden)
+    sup, qry, lab = batch_to_model_inputs(ep.sample_batch())
+    state_a = init_state(model, CFG, sup, qry)
+    disc_a = init_disc_state(disc, CFG, encoder_output_dim(CFG))
+    copy = lambda t: jax.tree.map(lambda x: jnp.array(x, copy=True), t)
+    state_b, disc_b = copy(state_a), copy(disc_a)
+
+    batches = [
+        (*batch_to_model_inputs(ep.sample_batch()),
+         src.sample_batch()._asdict(), tgt.sample_batch()._asdict())
+        for _ in range(3)
+    ]
+    step = make_adv_train_step(model, disc, CFG)
+    for b in batches:
+        state_a, disc_a, m_a = step(state_a, disc_a, *b)
+
+    multi = make_adv_multi_train_step(model, disc, CFG)
+    stacked = jax.tree.map(lambda *xs: np.stack(xs), *batches)
+    state_b, disc_b, m_s = multi(state_b, disc_b, *stacked)
+
+    assert np.asarray(m_s["loss"]).shape == (3,)
+    np.testing.assert_allclose(
+        float(np.asarray(m_s["loss"])[-1]), float(m_a["loss"]), rtol=1e-5
+    )
+    for a, b in ((state_a, state_b), (disc_a, disc_b)):
+        jax.tree.map(
+            lambda x, y: np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-6
+            ),
+            a.params, b.params,
+        )

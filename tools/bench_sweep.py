@@ -122,15 +122,39 @@ def run_config(name: str, cfg, adv: bool = False) -> dict:
         disc_state = init_disc_state(disc, cfg, encoder_output_dim(cfg))
         src_s = InstanceSampler(ds, tok, cfg.adv_batch, seed=31)
         tgt_s = InstanceSampler(tgt_ds, tok, cfg.adv_batch, seed=32)
-        adv_step = make_adv_train_step(model, disc, cfg)
+        if cfg.steps_per_call > 1:
+            import numpy as np
 
-        def step_once(state_pack):
-            st, dst = state_pack
-            st, dst, m = adv_step(
-                st, dst, *batch_to_model_inputs(sampler.sample_batch()),
-                src_s.sample_batch()._asdict(), tgt_s.sample_batch()._asdict(),
+            from induction_network_on_fewrel_tpu.train.steps import (
+                make_adv_multi_train_step,
             )
-            return (st, dst), m
+
+            adv_multi = make_adv_multi_train_step(model, disc, cfg)
+            S = cfg.steps_per_call
+
+            def step_once(state_pack):
+                st, dst = state_pack
+                bs = [
+                    (*batch_to_model_inputs(sampler.sample_batch()),
+                     src_s.sample_batch()._asdict(),
+                     tgt_s.sample_batch()._asdict())
+                    for _ in range(S)
+                ]
+                stacked = jax.tree.map(lambda *xs: np.stack(xs), *bs)
+                st, dst, m = adv_multi(st, dst, *stacked)
+                return (st, dst), m
+
+        else:
+            adv_step = make_adv_train_step(model, disc, cfg)
+
+            def step_once(state_pack):
+                st, dst = state_pack
+                st, dst, m = adv_step(
+                    st, dst, *batch_to_model_inputs(sampler.sample_batch()),
+                    src_s.sample_batch()._asdict(),
+                    tgt_s.sample_batch()._asdict(),
+                )
+                return (st, dst), m
 
         pack = (state, disc_state)
     elif cfg.steps_per_call > 1:
@@ -162,7 +186,7 @@ def run_config(name: str, cfg, adv: bool = False) -> dict:
 
         pack = state
 
-    eff = cfg.steps_per_call if (cfg.steps_per_call > 1 and not adv) else 1
+    eff = cfg.steps_per_call if cfg.steps_per_call > 1 else 1
     result = _time_loop(name, cfg, step_once, pack, eff=eff)
     if hasattr(sampler, "close"):
         sampler.close()
