@@ -23,16 +23,41 @@ _POD_ENV_VARS = (
 )
 
 
-def maybe_initialize_distributed(force: bool = False) -> bool:
+def maybe_initialize_distributed(
+    force: bool = False, timeout_s: int | None = 300
+) -> bool:
     """Initialize jax.distributed when running as one process of a pod job.
 
     Returns True if distributed mode was initialized. Safe to call twice
     (second call is a no-op). ``force=True`` initializes unconditionally
     (useful with explicit --coordinator flags).
+
+    Failure detection (SURVEY.md §5.3): the coordination barrier gets a
+    bounded ``timeout_s`` and a failed/timed-out rendezvous is re-raised as
+    a clean RuntimeError naming the likely causes, instead of an opaque
+    gRPC traceback from deep inside the client.
     """
     if jax.distributed.is_initialized():
         return True
     if force or any(v in os.environ for v in _POD_ENV_VARS):
-        jax.distributed.initialize()
+        kwargs = {}
+        if timeout_s is not None:
+            kwargs["initialization_timeout"] = timeout_s
+        try:
+            jax.distributed.initialize(**kwargs)
+        except Exception as e:  # surface a clean, actionable error
+            present = {v: os.environ[v] for v in _POD_ENV_VARS
+                       if v in os.environ}
+            bound = (
+                f" (barrier bound: {timeout_s}s)" if timeout_s is not None
+                else ""
+            )
+            raise RuntimeError(
+                f"multi-host initialization failed{bound}: {e}. "
+                f"Likely causes: a peer host crashed before the rendezvous, "
+                f"the coordinator address is unreachable, or this process "
+                f"was launched with pod env vars set ({present}) outside a "
+                f"real pod job."
+            ) from e
         return True
     return False

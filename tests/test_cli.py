@@ -1,0 +1,108 @@
+"""CLI smoke tests: the actual ``train.py``/``test.py`` surface, as a user
+runs it (subprocess, --device cpu, synthetic data).
+
+The library-level suites cannot catch wiring mistakes in cli.py (flag
+plumbing, sampler/step injection, checkpoint merge) — several review
+findings lived exactly there, so the entry points get end-to-end coverage.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {
+    **os.environ,
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "PYTHONPATH": REPO,
+}
+TINY = [
+    "--N", "3", "--K", "2", "--Q", "2", "--batch_size", "2",
+    "--max_length", "16", "--lr", "3e-3", "--device", "cpu",
+    "--dp", "1",  # the env forces 8 virtual devices; stay single-device
+]
+
+
+def run_cli(script, *extra, timeout=240):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, script), *extra],
+        capture_output=True, text=True, timeout=timeout, env=ENV, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout, proc.stderr
+
+
+def last_json(stdout: str) -> dict:
+    return json.loads(stdout.strip().splitlines()[-1])
+
+
+def test_train_then_test_cycle(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    out, _ = run_cli(
+        "train.py", "--model", "induction", "--encoder", "cnn", *TINY,
+        "--train_iter", "120", "--val_step", "60", "--val_iter", "10",
+        "--steps_per_call", "6", "--save_ckpt", ckpt,
+    )
+    assert "final_val_accuracy" in last_json(out)
+    # test.py recovers the architecture from config.json: no model/encoder
+    # flags re-passed.
+    out, _ = run_cli(
+        "test.py", *TINY, "--test_iter", "20", "--load_ckpt", ckpt,
+    )
+    assert "test_accuracy" in last_json(out)
+
+
+def test_feature_cache_cycle(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    bert = ["--encoder", "bert", "--bert_frozen", "--bert_layers", "2",
+            "--bert_vocab_size", "64"]
+    out, _ = run_cli(
+        "train.py", "--model", "induction", *bert, "--feature_cache", *TINY,
+        "--train_iter", "60", "--val_step", "30", "--val_iter", "6",
+        "--steps_per_call", "5", "--save_ckpt", ckpt,
+    )
+    assert "final_val_accuracy" in last_json(out)
+    out, _ = run_cli(  # merge recovers feature_cache + bert_frozen
+        "test.py", *TINY, "--test_iter", "10", "--load_ckpt", ckpt,
+    )
+    assert "test_accuracy" in last_json(out)
+
+
+def test_adv_fused_and_mesh(tmp_path):
+    out, _ = run_cli(
+        "train.py", "--model", "proto", "--encoder", "cnn", "--loss", "ce",
+        *TINY, "--adv", "--steps_per_call", "5", "--train_iter", "40",
+        "--val_step", "20", "--val_iter", "6",
+        "--save_ckpt", str(tmp_path / "a"),
+    )
+    assert "final_val_accuracy" in last_json(out)
+    out, err = run_cli(
+        "train.py", "--model", "proto", "--encoder", "cnn", "--loss", "ce",
+        "--N", "3", "--K", "2", "--Q", "2", "--batch_size", "8",
+        "--max_length", "16", "--lr", "3e-3", "--device", "cpu",
+        "--dp", "4", "--tp", "2", "--steps_per_call", "5",
+        "--train_iter", "20", "--val_step", "10", "--val_iter", "4",
+        "--save_ckpt", str(tmp_path / "b"),
+    )
+    assert "final_val_accuracy" in last_json(out)
+
+
+def test_bad_flag_combinations_fail_fast(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "train.py"), "--model", "pair",
+         "--encoder", "cnn", *TINY, "--train_iter", "5",
+         "--save_ckpt", str(tmp_path / "x")],
+        capture_output=True, text=True, timeout=120, env=ENV, cwd=REPO,
+    )
+    assert proc.returncode != 0 and "encoder bert" in proc.stderr
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "train.py"), "--feature_cache",
+         "--encoder", "cnn", *TINY, "--train_iter", "5",
+         "--save_ckpt", str(tmp_path / "y")],
+        capture_output=True, text=True, timeout=120, env=ENV, cwd=REPO,
+    )
+    assert proc.returncode != 0 and "feature_cache" in proc.stderr

@@ -148,3 +148,30 @@ def test_sharded_fused_step_matches_sequential(setup):
     single = make_train_step(model, CFG)
     state_c, _ = _run_steps(single, _copy_state(state0), batches)
     _params_allclose(state_b, state_c, atol=1e-5)
+
+
+def test_distributed_init_failure_is_clean(monkeypatch):
+    """A failed pod rendezvous surfaces as an actionable RuntimeError, not a
+    raw gRPC traceback (SURVEY.md §5.3 failure detection)."""
+    import pytest
+
+    from induction_network_on_fewrel_tpu.parallel.distributed import (
+        maybe_initialize_distributed,
+    )
+
+    # Off-pod: no env vars, no force -> no-op (clear the vars first, in
+    # case this machine's environment carries them).
+    for v in ("COORDINATOR_ADDRESS", "TPU_WORKER_ID",
+              "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(v, raising=False)
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    assert maybe_initialize_distributed() is False
+
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "127.0.0.1:1")  # nothing there
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: (_ for _ in ()).throw(TimeoutError("deadline exceeded")),
+    )
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    with pytest.raises(RuntimeError, match="multi-host initialization"):
+        maybe_initialize_distributed()
