@@ -193,7 +193,12 @@ def load_vocab(args, cfg: ExperimentConfig):
 
     if args.glove:
         return load_glove(args.glove, args.glove_mat)
-    return make_synthetic_glove(vocab_size=cfg.vocab_size - 2)
+    # Honor cfg geometry (vocab_size AND word_dim) so a checkpoint-merged
+    # architecture (e.g. trained on 300-d GloVe) is not silently re-pinned
+    # to the synthetic fallback's defaults at test time.
+    return make_synthetic_glove(
+        vocab_size=cfg.vocab_size - 2, word_dim=cfg.word_dim
+    )
 
 
 def load_data(args, cfg: ExperimentConfig, split: str):
@@ -251,6 +256,12 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         cfg = cfg.replace(bert_vocab_size=tok.vocab_size)
     else:
         vocab = load_vocab(args, cfg)
+        # A real GloVe file decides vocab size and word dim; the embedding
+        # table must match or out-of-range ids gather garbage silently.
+        if (cfg.vocab_size, cfg.word_dim) != (vocab.vocab_size, vocab.word_dim):
+            cfg = cfg.replace(
+                vocab_size=vocab.vocab_size, word_dim=vocab.word_dim
+            )
         tok = GloveTokenizer(vocab, max_length=cfg.max_length)
     train_sampler = make_sampler(
         train_ds, tok, cfg.train_n, cfg.k, cfg.q, cfg.batch_size,

@@ -61,8 +61,43 @@ class GloveVocab:
 
 
 def load_glove(path: str | Path, mat_path: str | Path | None = None) -> GloveVocab:
-    """Load GloVe from a word2id JSON + .npy matrix, or a combined JSON."""
+    """Load GloVe from a word2id JSON + .npy matrix, a combined JSON, or the
+    stock ``glove.6B.50d.txt`` format ("word v1 ... v50" per line)."""
     path = Path(path)
+    if path.suffix == ".txt":
+        # Tokens may themselves contain spaces (glove.840B.300d has entries
+        # like ". . ."), so the vector dim is detected once from the first
+        # line's maximal float suffix, then every line is split from the
+        # right: word = everything before the last ``dim`` fields.
+        words, rows, dim = [], [], None
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                parts = line.rstrip("\n").split(" ")
+                if len(parts) < 2:
+                    continue
+                if dim is None:
+                    dim = 0
+                    for p in reversed(parts[1:]):
+                        try:
+                            float(p)
+                        except ValueError:
+                            break
+                        dim += 1
+                    if dim == 0:
+                        raise ValueError(
+                            f"{path}:{lineno}: no numeric vector fields"
+                        )
+                try:
+                    rows.append(np.asarray(parts[-dim:], dtype=np.float32))
+                except ValueError as e:
+                    raise ValueError(
+                        f"{path}:{lineno}: expected {dim} floats at line "
+                        f"end: {e}"
+                    ) from e
+                words.append(" ".join(parts[:-dim]))
+        if not words:
+            raise ValueError(f"{path}: no GloVe vectors found")
+        return GloveVocab.from_words(words, np.stack(rows))
     with open(path) as f:
         raw = json.load(f)
     if isinstance(raw, dict):  # word2id json + separate matrix

@@ -165,7 +165,10 @@ class FewShotTrainer:
         it = iter(self.train_sampler)
         t0 = time.monotonic()
         last_logged = 0
-        window = 50
+        # Metric logging fetches values (a real device sync on tunneled
+        # backends — see bench.py's hard-sync note); with fused calls, log
+        # every few calls rather than every one so the sync amortizes.
+        window = max(50, 4 * cfg.steps_per_call)
         adv = self.adv
         profiling = profile_done = False
         step = 0

@@ -106,3 +106,18 @@ def test_bad_flag_combinations_fail_fast(tmp_path):
         capture_output=True, text=True, timeout=120, env=ENV, cwd=REPO,
     )
     assert proc.returncode != 0 and "feature_cache" in proc.stderr
+
+
+def test_real_glove_txt_pins_embedding_shape(tmp_path):
+    """A loaded GloVe decides vocab_size/word_dim: the CLI must pin the
+    embedding table to it (regression: default 400002x50 vs real file)."""
+    glove = tmp_path / "glove.tiny.3d.txt"
+    glove.write_text(
+        "".join(f"w{i} {0.1*i} {0.2*i} {0.3*i}\n" for i in range(20))
+    )
+    out, _ = run_cli(
+        "train.py", "--model", "proto", "--encoder", "cnn", *TINY,
+        "--glove", str(glove), "--train_iter", "20", "--val_step", "0",
+        "--val_iter", "4", "--save_ckpt", str(tmp_path / "ck"),
+    )
+    assert "final_val_accuracy" in last_json(out)

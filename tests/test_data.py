@@ -82,3 +82,41 @@ def test_tokenizer_truncation(vocab):
     assert t.mask.sum() == 8
     # head beyond max_length clamps to the last position
     assert t.pos1[7] == 8  # offset 0 at clamped head
+
+
+def test_load_glove_txt(tmp_path):
+    """Stock glove.6B-style .txt ('word v1 ... vd' per line) loads directly."""
+    from induction_network_on_fewrel_tpu.data.glove import load_glove
+
+    p = tmp_path / "glove.tiny.3d.txt"
+    p.write_text("the 0.1 0.2 0.3\ncat -1.0 0.5 0.25\n")
+    vocab = load_glove(p)
+    assert vocab.vocab_size == 4  # 2 words + UNK + BLANK
+    assert vocab.word_dim == 3
+    assert vocab.lookup("cat") == 1
+    assert vocab.lookup("dog") == vocab.unk_id
+    import numpy as np
+
+    np.testing.assert_allclose(vocab.vectors[0], [0.1, 0.2, 0.3])
+    np.testing.assert_allclose(vocab.vectors[vocab.blank_id], 0.0)
+
+
+def test_load_glove_txt_multiword_tokens(tmp_path):
+    """glove.840B-style lines where the token itself contains spaces parse
+    by splitting the float vector from the right."""
+    from induction_network_on_fewrel_tpu.data.glove import load_glove
+
+    p = tmp_path / "glove.weird.3d.txt"
+    p.write_text("the 0.1 0.2 0.3\n. . . -1.0 0.5 0.25\n")
+    vocab = load_glove(p)
+    assert vocab.lookup(". . .") == 1
+    import numpy as np
+
+    np.testing.assert_allclose(vocab.vectors[1], [-1.0, 0.5, 0.25])
+
+    bad = tmp_path / "bad.txt"
+    bad.write_text("the 0.1 0.2 0.3\noops 0.1 nan-ish 0.3x\n")
+    import pytest
+
+    with pytest.raises(ValueError, match="bad.txt:2"):
+        load_glove(bad)
