@@ -42,6 +42,15 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--tfm_model", type=int, default=256)
     p.add_argument("--tfm_heads", type=int, default=4)
     p.add_argument("--tfm_ff", type=int, default=1024)
+    p.add_argument("--moe_experts", type=int, default=0,
+                   help="MoE: route every --moe_every-th transformer block "
+                        "through this many experts (0 = dense MLP)")
+    p.add_argument("--moe_top_k", type=int, default=2)
+    p.add_argument("--moe_capacity", type=float, default=2.0,
+                   help="expert buffer capacity factor")
+    p.add_argument("--moe_every", type=int, default=2)
+    p.add_argument("--moe_aux_weight", type=float, default=1e-2,
+                   help="load-balance aux loss weight")
     p.add_argument("--max_length", type=int, default=40)
     p.add_argument("--hidden_size", type=int, default=230)
     p.add_argument("--lstm_hidden", type=int, default=128)
@@ -113,6 +122,16 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel mesh axis: ring attention over "
                         "the token axis (transformer encoder only)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel mesh axis: transformer layer "
+                        "stages with microbatched GPipe schedule")
+    p.add_argument("--pp_microbatches", type=int, default=4,
+                   help="GPipe microbatches per step (bubble = (pp-1)/(m+pp-1))")
+    p.add_argument("--tfm_stacked", action="store_true",
+                   help="layer-stacked transformer params (pp-restorable "
+                        "checkpoints; implied by --pp > 1)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel mesh axis (requires --moe_experts)")
     p.add_argument("--fp16", action="store_true", help="(reference flag) alias for bf16 compute")
     p.add_argument("--bf16", action="store_true", help="bfloat16 matmuls on the MXU")
     # checkpoints / run dir
@@ -151,6 +170,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         lstm_hidden=args.lstm_hidden, lstm_backend=args.lstm_backend,
         tfm_layers=args.tfm_layers, tfm_model=args.tfm_model,
         tfm_heads=args.tfm_heads, tfm_ff=args.tfm_ff,
+        moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,
+        moe_capacity=args.moe_capacity, moe_every=args.moe_every,
+        moe_aux_weight=args.moe_aux_weight,
         induction_dim=args.induction_dim,
         routing_iters=args.routing_iters, ntn_slices=args.ntn_slices,
         bert_frozen=args.bert_frozen, bert_layers=args.bert_layers,
@@ -163,7 +185,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         steps_per_call=getattr(args, "steps_per_call", 1),
         feature_cache=getattr(args, "feature_cache", False),
         device=args.device, compute_dtype=compute, seed=args.seed,
-        dp=args.dp, tp=args.tp, sp=args.sp,
+        dp=args.dp, tp=args.tp, sp=args.sp, pp=args.pp, ep=args.ep,
+        pp_microbatches=args.pp_microbatches,
+        tfm_stacked=args.tfm_stacked or args.pp > 1,
         sampler=args.sampler, prefetch=args.prefetch,
         sampler_threads=args.sampler_threads,
         adv=getattr(args, "adv", None) is not None,
@@ -281,11 +305,14 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
     )
     n_dev = len(jax.devices())
     use_mesh = (
-        (cfg.dp == 0 and n_dev > 1) or cfg.dp > 1 or cfg.tp > 1 or cfg.sp > 1
+        (cfg.dp == 0 and n_dev > 1) or cfg.dp > 1 or cfg.tp > 1
+        or cfg.sp > 1 or cfg.pp > 1 or cfg.ep > 1
     )
-    train_step = eval_step = fused_step = state = mesh = attn_impl = None
+    train_step = eval_step = fused_step = state = mesh = None
+    attn_impl = pipeline_impl = None
     if use_mesh:
-        mesh = make_mesh(dp=(cfg.dp or None), tp=cfg.tp, sp=cfg.sp)
+        mesh = make_mesh(dp=(cfg.dp or None), tp=cfg.tp, sp=cfg.sp,
+                         pp=cfg.pp, ep=cfg.ep)
         if cfg.sp > 1:
             if cfg.encoder != "transformer":
                 raise ValueError(
@@ -299,9 +326,39 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
             attn_impl = make_ring_attention(
                 mesh, batch_axis="dp" if mesh.shape["dp"] > 1 else None
             )
+        if cfg.ep > 1:
+            if cfg.moe_experts <= 0 or cfg.encoder != "transformer":
+                raise ValueError(
+                    "--ep (expert parallelism) requires --encoder "
+                    "transformer with --moe_experts > 0"
+                )
+            if cfg.moe_experts % cfg.ep != 0:
+                raise ValueError(
+                    f"--moe_experts ({cfg.moe_experts}) must be divisible "
+                    f"by --ep ({cfg.ep})"
+                )
+        if cfg.pp > 1:
+            if cfg.encoder != "transformer":
+                raise ValueError(
+                    "--pp (pipeline parallelism) requires --encoder "
+                    "transformer (stages are transformer layers)"
+                )
+            if cfg.tfm_layers % cfg.pp != 0:
+                raise ValueError(
+                    f"--tfm_layers ({cfg.tfm_layers}) must be divisible by "
+                    f"--pp ({cfg.pp}) pipeline stages"
+                )
+            from induction_network_on_fewrel_tpu.parallel.pipeline import (
+                make_gpipe,
+            )
+
+            pipeline_impl = make_gpipe(
+                mesh, microbatches=cfg.pp_microbatches,
+                batch_axis="dp" if mesh.shape["dp"] > 1 else None,
+            )
     model = build_model(
         cfg, glove_init=vocab.vectors if vocab is not None else None,
-        attn_impl=attn_impl,
+        attn_impl=attn_impl, pipeline_impl=pipeline_impl,
     )
     if cfg.feature_cache:
         # Frozen-encoder feature cache (train/feature_cache.py): encode both

@@ -61,6 +61,19 @@ class ExperimentConfig:
     tfm_model: int = 256
     tfm_heads: int = 4
     tfm_ff: int = 1024
+    # Mixture-of-Experts FFN (models/moe.py): 0 = dense MLP everywhere;
+    # > 0 routes every ``moe_every``-th block through that many experts,
+    # sharded over the mesh's ``ep`` axis.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity: float = 2.0
+    moe_every: int = 2
+    moe_aux_weight: float = 1e-2  # load-balance aux loss weight
+    # Layer-stacked transformer (models/pipeline_transformer.py): the
+    # pipeline-parallel parameter layout. Forced on when pp > 1; can be set
+    # alone so a single-device run produces pp-restorable checkpoints.
+    tfm_stacked: bool = False
+    pp_microbatches: int = 4  # GPipe microbatches per step (pp > 1)
 
     # --- induction + relation modules ---
     induction_dim: int = 100  # class-vector dim C after the squash transform
@@ -104,6 +117,8 @@ class ExperimentConfig:
     dp: int = 1               # data-parallel mesh axis (episodes sharded)
     tp: int = 1               # tensor-parallel mesh axis (NTN slices / hidden)
     sp: int = 1               # sequence-parallel mesh axis (ring attention)
+    pp: int = 1               # pipeline-parallel mesh axis (layer stages)
+    ep: int = 1               # expert-parallel mesh axis (MoE experts)
 
     # --- host data pipeline ---
     sampler: str = "auto"     # auto | native (C++ prefetching) | python
@@ -131,6 +146,10 @@ class ExperimentConfig:
         "routing_iters", "ntn_slices", "bert_layers", "bert_hidden",
         "bert_heads", "bert_intermediate", "bert_vocab_size",
         "bert_vocab_path", "tfm_layers", "tfm_model", "tfm_heads", "tfm_ff",
+        # moe_top_k/moe_capacity are runtime routing knobs (no param shapes
+        # depend on them) and stay restorable-across; experts/every shape
+        # the tree.
+        "moe_experts", "moe_every", "tfm_stacked",
         "loss", "optimizer",
         # feature_cache changes the state tree itself (head-only params), so
         # a cached checkpoint can only restore into a cached runtime — and

@@ -25,11 +25,39 @@ def build_model(
     cfg: ExperimentConfig,
     glove_init: np.ndarray | None = None,
     attn_impl=None,
+    pipeline_impl=None,
 ) -> InductionNetwork:
     """``attn_impl``: override the transformer encoder's attention — e.g.
     ``parallel.ring.make_ring_attention(mesh)`` for sp-sharded long-context
-    runs. Ignored by the other encoders."""
+    runs. ``pipeline_impl``: executor for the layer-stacked transformer —
+    ``parallel.pipeline.make_gpipe(mesh)`` for pp-sharded runs (implies the
+    stacked parameter layout). Both ignored by the other encoders."""
     dtype = _DTYPES[cfg.compute_dtype]
+    if cfg.moe_experts > 0 and cfg.encoder != "transformer":
+        raise ValueError(
+            "--moe_experts requires --encoder transformer (the MoE FFN "
+            "lives in the transformer blocks; other encoders have no MoE "
+            "path and would silently train dense)"
+        )
+    if cfg.moe_experts > 0 and cfg.tfm_layers < cfg.moe_every:
+        raise ValueError(
+            f"--moe_experts with --moe_every {cfg.moe_every} > --tfm_layers "
+            f"{cfg.tfm_layers} would create zero expert layers (block i is "
+            "MoE when (i+1) %% moe_every == 0) — the model would silently "
+            "train dense"
+        )
+    use_stacked = cfg.tfm_stacked or pipeline_impl is not None
+    if use_stacked:
+        if cfg.encoder != "transformer":
+            raise ValueError(
+                "--pp / tfm_stacked requires --encoder transformer "
+                "(pipeline stages are transformer layers)"
+            )
+        if cfg.moe_experts > 0 or attn_impl is not None:
+            raise ValueError(
+                "the layer-stacked (pipeline) transformer does not compose "
+                "with MoE or ring attention yet; drop --moe_experts/--sp"
+            )
     if cfg.model == "pair":
         # BERT-PAIR consumes raw token ids pairwise — it owns its backbone
         # and bypasses the embedding/encoder split entirely.
@@ -85,6 +113,17 @@ def build_model(
         )
         if cfg.encoder == "cnn":
             encoder = CNNEncoder(hidden_size=cfg.hidden_size, compute_dtype=dtype)
+        elif cfg.encoder == "transformer" and use_stacked:
+            from induction_network_on_fewrel_tpu.models.pipeline_transformer import (
+                PipelinedTransformerEncoder,
+            )
+
+            encoder = PipelinedTransformerEncoder(
+                num_layers=cfg.tfm_layers, d_model=cfg.tfm_model,
+                num_heads=cfg.tfm_heads, d_ff=cfg.tfm_ff,
+                max_length=cfg.max_length, compute_dtype=dtype,
+                pipeline_impl=pipeline_impl,
+            )
         elif cfg.encoder == "transformer":
             from induction_network_on_fewrel_tpu.models.transformer import (
                 TransformerEncoder,
@@ -95,6 +134,8 @@ def build_model(
                 num_heads=cfg.tfm_heads, d_ff=cfg.tfm_ff,
                 max_length=cfg.max_length, compute_dtype=dtype,
                 attn_impl=attn_impl,
+                num_experts=cfg.moe_experts, moe_top_k=cfg.moe_top_k,
+                moe_capacity=cfg.moe_capacity, moe_every=cfg.moe_every,
             )
         elif cfg.encoder == "bilstm":
             backend = cfg.lstm_backend

@@ -1,0 +1,153 @@
+"""Layer-stacked transformer encoder — the pipeline-parallel form.
+
+Same math as ``models/transformer.py`` (pre-LN blocks, learned positions,
+masked-mean pooling), but every per-layer parameter is STACKED along a
+leading layer axis ``[NL, ...]`` instead of living in per-layer submodules.
+That layout is what makes pipeline parallelism a pure sharding decision:
+
+* single device: the layer axis is scanned (``lax.scan`` over the stacked
+  pytree) — XLA compiles ONE block body, reused NL times;
+* ``pp > 1``: the layer axis shards over the mesh's ``pp`` axis
+  (``P('pp', ...)`` rules in parallel/sharding.py) and the executor is the
+  GPipe microbatch schedule in parallel/pipeline.py — activations hop
+  stage-to-stage over ICI via ``ppermute``.
+
+The executor is injectable exactly like the attention in the unstacked
+encoder: ``pipeline_impl(block_fn, stacked, x, mask) -> x``. ``None``
+means the sequential scan. Param trees are identical for both executors,
+so a ``pp=1`` checkpoint restores into a ``pp=8`` run unchanged (tested
+equal in tests/test_pipeline.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from induction_network_on_fewrel_tpu.ops import masked_mean
+
+_NEG = -1e30
+
+
+def _layer_norm(x, scale, bias, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def block_apply(layer: dict, x: jnp.ndarray, mask: jnp.ndarray,
+                num_heads: int) -> jnp.ndarray:
+    """One pre-LN transformer block with UNstacked params (one layer's
+    slice of the stack). x: [M, L, d]; mask: [M, L]."""
+    M, L, d = x.shape
+    H = num_heads
+    hd = d // H
+    cd = x.dtype
+
+    h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    qkv = h @ layer["qkv_w"].astype(cd) + layer["qkv_b"].astype(cd)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    split = lambda t: t.reshape(M, L, H, hd).transpose(0, 2, 1, 3)
+    q, k, v = split(q), split(k), split(v)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+    s = jnp.where(mask[:, None, None, :] > 0, s, _NEG)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(cd)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    out = out.transpose(0, 2, 1, 3).reshape(M, L, d)
+    x = x + out @ layer["att_out_w"].astype(cd) + layer["att_out_b"].astype(cd)
+
+    h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    h = nn.gelu(h @ layer["mlp_up_w"].astype(cd) + layer["mlp_up_b"].astype(cd))
+    return x + h @ layer["mlp_down_w"].astype(cd) + layer["mlp_down_b"].astype(cd)
+
+
+def sequential_blocks(block_fn: Callable, stacked: dict, x: jnp.ndarray,
+                      mask: jnp.ndarray) -> jnp.ndarray:
+    """Reference executor: scan the stacked layer axis on one device."""
+
+    def body(carry, layer):
+        return block_fn(layer, carry, mask), None
+
+    out, _ = jax.lax.scan(body, x, stacked)
+    return out
+
+
+class PipelinedTransformerEncoder(nn.Module):
+    """[M, L, D] embedded tokens + [M, L] mask -> [M, d_model] sentence vec."""
+
+    num_layers: int = 4
+    d_model: int = 256
+    num_heads: int = 4
+    d_ff: int = 1024
+    max_length: int = 40
+    compute_dtype: jnp.dtype = jnp.float32
+    # (block_fn, stacked_params, x, mask) -> x. None -> sequential scan;
+    # parallel.pipeline.make_gpipe(mesh, ...) for pp-sharded runs.
+    pipeline_impl: Callable | None = None
+
+    @nn.compact
+    def __call__(self, emb: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        M, L, _ = emb.shape
+        cd = self.compute_dtype
+        NL, d, f = self.num_layers, self.d_model, self.d_ff
+        assert d % self.num_heads == 0
+
+        x = nn.Dense(d, dtype=cd, param_dtype=jnp.float32, name="in_proj")(
+            emb.astype(cd)
+        )
+        pos = self.param("pos_embedding", nn.initializers.normal(0.02),
+                         (self.max_length, d))
+        x = x + pos[None, :L].astype(cd)
+
+        # Layer-stacked parameters. The "stack_" prefix keys the pp
+        # partition rules; fan-in-scaled normal init matches what
+        # lecun_normal gives each per-layer slice.
+        def w(name, shape, fan_in):
+            return self.param(
+                f"stack_{name}",
+                nn.initializers.normal(1.0 / math.sqrt(fan_in)),
+                (NL,) + shape,
+            )
+
+        def b(name, shape, value=0.0):
+            return self.param(
+                f"stack_{name}",
+                nn.initializers.constant(value),
+                (NL,) + shape,
+            )
+
+        stacked = {
+            "ln1_scale": b("ln1_scale", (d,), 1.0),
+            "ln1_bias": b("ln1_bias", (d,)),
+            "qkv_w": w("qkv_w", (d, 3 * d), d),
+            "qkv_b": b("qkv_b", (3 * d,)),
+            "att_out_w": w("att_out_w", (d, d), d),
+            "att_out_b": b("att_out_b", (d,)),
+            "ln2_scale": b("ln2_scale", (d,), 1.0),
+            "ln2_bias": b("ln2_bias", (d,)),
+            "mlp_up_w": w("mlp_up_w", (d, f), d),
+            "mlp_up_b": b("mlp_up_b", (f,)),
+            "mlp_down_w": w("mlp_down_w", (f, d), f),
+            "mlp_down_b": b("mlp_down_b", (d,)),
+        }
+
+        def block_fn(layer, xx, mm):
+            return block_apply(layer, xx, mm, self.num_heads)
+
+        run = self.pipeline_impl or sequential_blocks
+        x = run(block_fn, stacked, x, mask)
+
+        scale = self.param("final_ln_scale", nn.initializers.ones, (d,))
+        bias = self.param("final_ln_bias", nn.initializers.zeros, (d,))
+        x = _layer_norm(x, scale, bias)
+        return masked_mean(x, mask[..., None], axis=-2).astype(cd)
+
+    @property
+    def output_dim(self) -> int:
+        return self.d_model

@@ -35,6 +35,13 @@ class TransformerEncoder(nn.Module):
     # (q, k, v, kv_mask) -> out, all [M, H, L, hd] / mask [M, L]. None ->
     # dense single-device attention; ring attention for sp-sharded runs.
     attn_impl: Callable | None = None
+    # Mixture-of-Experts (models/moe.py): num_experts > 0 swaps the dense
+    # MLP for a routed expert layer in every ``moe_every``-th block; experts
+    # shard over the mesh's ``ep`` axis.
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity: float = 2.0
+    moe_every: int = 2
 
     @nn.compact
     def __call__(self, emb: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
@@ -67,10 +74,20 @@ class TransformerEncoder(nn.Module):
 
             h = nn.LayerNorm(dtype=cd, param_dtype=jnp.float32,
                              name=f"ln_mlp_{i}")(x)
-            # Layer names match the tp partition rules in parallel/sharding.py
-            # (intermediate column-sharded, mlp_out row-sharded).
-            h = nn.gelu(dense(self.d_ff, f"intermediate_{i}")(h))
-            x = x + dense(d, f"mlp_out_{i}")(h)
+            if self.num_experts > 0 and (i + 1) % self.moe_every == 0:
+                from induction_network_on_fewrel_tpu.models.moe import MoeFfn
+
+                x = x + MoeFfn(
+                    num_experts=self.num_experts, d_ff=self.d_ff,
+                    top_k=self.moe_top_k, capacity_factor=self.moe_capacity,
+                    compute_dtype=cd, name=f"moe_{i}",
+                )(h)
+            else:
+                # Layer names match the tp partition rules in
+                # parallel/sharding.py (intermediate column-sharded, mlp_out
+                # row-sharded).
+                h = nn.gelu(dense(self.d_ff, f"intermediate_{i}")(h))
+                x = x + dense(d, f"mlp_out_{i}")(h)
 
         x = nn.LayerNorm(dtype=cd, param_dtype=jnp.float32, name="ln_final")(x)
         return masked_mean(x, mask[..., None], axis=-2).astype(cd)

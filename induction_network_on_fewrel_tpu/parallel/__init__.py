@@ -8,3 +8,6 @@ from induction_network_on_fewrel_tpu.parallel.sharding import (  # noqa: F401
 from induction_network_on_fewrel_tpu.parallel.distributed import (  # noqa: F401
     maybe_initialize_distributed,
 )
+from induction_network_on_fewrel_tpu.parallel.pipeline import (  # noqa: F401
+    make_gpipe,
+)

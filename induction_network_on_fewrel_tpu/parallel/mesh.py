@@ -26,24 +26,38 @@ from jax.sharding import Mesh
 
 
 def make_mesh(
-    dp: int | None = None, tp: int = 1, sp: int = 1, devices=None
+    dp: int | None = None, tp: int = 1, sp: int = 1, pp: int = 1,
+    ep: int = 1, devices=None,
 ) -> Mesh:
-    """Build a (dp, tp, sp) mesh. ``dp=None`` -> use all remaining devices.
+    """Build a (dp, pp, ep, tp, sp) mesh. ``dp=None`` -> use the rest.
 
-    ``sp`` is the sequence-parallel axis consumed by ``parallel/ring.py``
-    (ring attention); it is innermost so the per-hop ppermute of k/v blocks
-    rides neighbor ICI links. A size-1 sp axis is free — PartitionSpecs that
-    never mention it behave exactly as on a 2-D mesh.
+    Axis roles:
+
+    * ``dp`` — episodes sharded, gradients all-reduced (outermost: its
+      collective is one allreduce per step, DCN-tolerant on pods).
+    * ``pp`` — pipeline stages (parallel/pipeline.py): layer-stacked params
+      shard here; activations hop stage-to-stage via ppermute.
+    * ``ep`` — MoE experts (models/moe.py): expert-stacked params shard
+      here; the dispatch/combine einsums become all-to-alls.
+    * ``tp`` — tensor parallel (NTN slices, MLP column/row splits).
+    * ``sp`` — sequence parallel, innermost so ring attention's per-hop
+      ppermute of k/v blocks rides neighbor ICI links.
+
+    Size-1 axes are free — PartitionSpecs that never mention them behave
+    exactly as on a smaller mesh.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
+    other = tp * sp * pp * ep
     if dp is None:
-        if n % (tp * sp) != 0:
-            raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
-        dp = n // (tp * sp)
-    if dp * tp * sp > n:
+        if n % other != 0:
+            raise ValueError(
+                f"{n} devices not divisible by pp*ep*tp*sp={other}"
+            )
+        dp = n // other
+    if dp * other > n:
         raise ValueError(
-            f"dp*tp*sp={dp * tp * sp} exceeds {n} available devices"
+            f"dp*pp*ep*tp*sp={dp * other} exceeds {n} available devices"
         )
-    grid = np.asarray(devices[: dp * tp * sp]).reshape(dp, tp, sp)
-    return Mesh(grid, axis_names=("dp", "tp", "sp"))
+    grid = np.asarray(devices[: dp * other]).reshape(dp, pp, ep, tp, sp)
+    return Mesh(grid, axis_names=("dp", "pp", "ep", "tp", "sp"))

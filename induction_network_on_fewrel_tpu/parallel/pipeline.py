@@ -1,0 +1,123 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a ``pp`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.2 "PP: NO" — its
+model fits one GPU many times over), but this framework treats every
+parallelism axis as first-class. The encoder depth shards over ``pp``:
+device s holds layers [s·NL/S, (s+1)·NL/S) of the layer-stacked transformer
+(models/pipeline_transformer.py), and an episode batch flows through as
+``m`` microbatches in the classic GPipe schedule:
+
+  tick t: stage s processes microbatch (t - s); activations hop to stage
+  s+1 over ICI via ``lax.ppermute``. After m + S - 1 ticks every microbatch
+  has crossed every stage; the last stage's outputs are psum-broadcast back.
+
+TPU-shaped choices:
+
+* The whole schedule is ONE ``lax.scan`` inside ``shard_map`` — fixed trip
+  count, static shapes, no data-dependent control flow; XLA pipelines the
+  per-tick block compute against the neighbor ppermute.
+* The bubble fraction is the textbook (S-1)/(m+S-1); callers pick
+  ``microbatches`` >= S to amortize it. Throughput parity with the
+  sequential executor is NOT the point on one host — HBM capacity per
+  device is: each device materializes only 1/S of the layer weights and
+  optimizer state (they are sharded P('pp', ...), never all-gathered).
+* Reverse-mode AD just works: scan + ppermute are differentiable, so the
+  backward pass is the mirrored pipeline (cotangents hop s+1 -> s), no
+  hand-written schedule.
+
+Exactness vs. the single-device sequential scan is pinned (forward AND
+training trajectory) in tests/test_pipeline.py on the 8-virtual-CPU mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def gpipe_local(block_fn: Callable, stacked_local, x: jnp.ndarray,
+                mask: jnp.ndarray, axis: str, microbatches: int):
+    """Per-device GPipe body — call inside shard_map.
+
+    stacked_local: this stage's slice of the layer-stacked params (leading
+    axis NL/S). x: [M, L, d] (replicated); mask: [M, L]. Returns [M, L, d].
+    """
+    S = jax.lax.axis_size(axis)
+    stage = jax.lax.axis_index(axis)
+    m = microbatches
+    M, L, d = x.shape
+    assert M % m == 0, f"batch rows {M} not divisible by {m} microbatches"
+    mb = M // m
+
+    xs = x.reshape(m, mb, L, d)
+    ms = mask.reshape(m, mb, L)
+
+    def stage_apply(act, act_mask):
+        def body(carry, layer):
+            return block_fn(layer, carry, act_mask), None
+
+        out, _ = jax.lax.scan(body, act, stacked_local)
+        return out
+
+    # Activations and their masks travel together (stage s at tick t holds
+    # microbatch t - s, so the mask must ride along the ring).
+    shift = [(i, i + 1) for i in range(S - 1)]  # stage s -> s+1, no wrap
+
+    def tick(carry, t):
+        act, act_mask = carry
+        j = jnp.clip(t, 0, m - 1)
+        inj = jax.lax.dynamic_index_in_dim(xs, j, 0, keepdims=False)
+        inj_m = jax.lax.dynamic_index_in_dim(ms, j, 0, keepdims=False)
+        first = stage == 0
+        cur = jnp.where(first, inj, act)
+        cur_m = jnp.where(first, inj_m, act_mask)
+        out = stage_apply(cur, cur_m)
+        nxt = jax.lax.ppermute((out, cur_m), axis, shift)
+        return nxt, out
+
+    init = (jnp.zeros((mb, L, d), x.dtype), jnp.zeros((mb, L), mask.dtype))
+    _, ys = jax.lax.scan(tick, init, jnp.arange(m + S - 1))
+
+    # Microbatch j finishes on the last stage at tick j + S - 1.
+    done = jax.lax.slice_in_dim(ys, S - 1, S - 1 + m, axis=0)  # [m, mb, L, d]
+    last = (stage == S - 1).astype(done.dtype)
+    out = jax.lax.psum(done * last, axis)
+    return out.reshape(M, L, d)
+
+
+def make_gpipe(mesh: Mesh, axis: str = "pp", microbatches: int = 4,
+               batch_axis: str | None = None) -> Callable:
+    """Build a pipeline executor for PipelinedTransformerEncoder.
+
+    Returns ``(block_fn, stacked, x, mask) -> x`` with the stacked layer
+    axis sharded over ``axis`` and the schedule of :func:`gpipe_local`
+    running per stage. ``batch_axis`` declares the episode-batch sharding
+    when composing with data parallelism (each dp group runs its own
+    independent pipeline).
+    """
+    b = batch_axis
+
+    def executor(block_fn, stacked, x, mask):
+        spec_stack = jax.tree.map(
+            lambda leaf: P(axis, *(None,) * (leaf.ndim - 1)), stacked
+        )
+
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(spec_stack, P(b, None, None), P(b, None)),
+            out_specs=P(b, None, None),
+            check_vma=False,
+        )
+        def run(stacked_local, x_l, mask_l):
+            return gpipe_local(
+                block_fn, stacked_local, x_l, mask_l, axis, microbatches
+            )
+
+        return run(stacked, x, mask)
+
+    return executor

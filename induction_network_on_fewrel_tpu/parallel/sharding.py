@@ -38,6 +38,19 @@ _BATCH_KEYS = ("word", "pos1", "pos2", "mask")
 _TP_RULES: tuple[tuple[str, P], ...] = (
     # NTN bilinear tensor M[h, C, C]: shard the slice axis h.
     ("tensor_slices", P("tp", None, None)),
+    # MoE expert-stacked weights [E, d, f] and biases [E, f]
+    # (models/moe.py): the expert axis shards over ep; GSPMD turns the
+    # dispatch/combine einsums into the token all-to-all.
+    ("experts_up_bias", P("ep", None)),
+    ("experts_down_bias", P("ep", None)),
+    ("experts_up", P("ep", None, None)),
+    ("experts_down", P("ep", None, None)),
+    # Layer-stacked transformer (models/pipeline_transformer.py): the
+    # leading layer axis shards over pp — each pipeline stage holds only
+    # its own layers' weights and optimizer state. Two entries, one per
+    # leaf rank (weights [NL, d, f], biases/LN [NL, d]).
+    ("stack_", P("pp", None, None)),
+    ("stack_", P("pp", None)),
     # Transformer blocks (models/bert.py, models/transformer.py):
     # Megatron-style — MLP up-projection column-sharded, down-projection
     # row-sharded. Bare substrings so both "intermediate/kernel" (bert) and
@@ -186,6 +199,16 @@ def make_shard_map_train_step(model, cfg: ExperimentConfig, mesh: Mesh):
     episode shard, then ``lax.pmean`` over 'dp' — the literal TPU analog of
     DataParallel's gradient reduction. Params replicated; updates identical
     on every device by construction."""
+    if cfg.moe_experts > 0:
+        # The MoE balance aux is a product of GLOBAL-batch statistics
+        # (E·Σ f_e·p_e); a per-shard product pmean'd over dp is a different
+        # objective (mean of products != product of means). The GSPMD path
+        # partitions the global computation and stays exact — use it.
+        raise ValueError(
+            "the explicit shard_map step does not support MoE "
+            "(per-shard load-balance aux diverges from the global "
+            "objective); use the GSPMD sharded step"
+        )
 
     @partial(
         jax.shard_map,
@@ -234,6 +257,7 @@ def make_sharded_adv_train_step(
     sup_sh, qry_sh, lab_sh = episode_batch_shardings(mesh)
     inst_sh = {k: NamedSharding(mesh, P("dp", None)) for k in _BATCH_KEYS}
     lam = cfg.adv_lambda
+    aux_w = cfg.moe_aux_weight if cfg.moe_experts > 0 else 0.0
 
     def encode(params, batch):
         return model.apply(
@@ -243,8 +267,11 @@ def make_sharded_adv_train_step(
 
     def step(state, disc_state, support, query, label, src, tgt):
         def loss_fn(params, disc_params):
-            logits = model.apply(params, support, query)
-            fs_loss = LOSS_FNS[cfg.loss](logits, label)
+            # Few-shot objective (incl. any sown MoE aux) from the shared
+            # loss_and_metrics — single source of aux handling.
+            fs_loss, fs_metrics = loss_and_metrics(
+                model, params, support, query, label, cfg.loss, aux_w
+            )
             feat = jnp.concatenate(
                 [encode(params, src), encode(params, tgt)], axis=0
             )
@@ -255,8 +282,7 @@ def make_sharded_adv_train_step(
             dom_logits = disc.apply(disc_params, gradient_reversal(feat, lam))
             dom_loss = cross_entropy_loss(dom_logits[None], dom_label[None])
             metrics = {
-                "loss": fs_loss,
-                "accuracy": accuracy(logits, label),
+                **fs_metrics,
                 "domain_loss": dom_loss,
                 "domain_accuracy": accuracy(dom_logits[None], dom_label[None]),
             }

@@ -60,11 +60,21 @@ def make_optimizer(cfg: ExperimentConfig) -> optax.GradientTransformation:
 
 
 def loss_and_metrics(
-    model, params, support, query, label, loss_name: str
+    model, params, support, query, label, loss_name: str,
+    aux_weight: float = 0.0,
 ) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
-    logits = model.apply(params, support, query)
-    loss = LOSS_FNS[loss_name](logits, label)
-    return loss, {"loss": loss, "accuracy": accuracy(logits, label)}
+    """``aux_weight`` > 0 collects sown auxiliary losses (the MoE
+    load-balance term, models/moe.py) from the "losses" collection and adds
+    them to the objective; metrics keep reporting the task loss alone."""
+    if aux_weight > 0.0:
+        logits, sown = model.apply(params, support, query, mutable="losses")
+        aux = sum(jnp.sum(leaf) for leaf in jax.tree.leaves(sown))
+        task_loss = LOSS_FNS[loss_name](logits, label)
+        loss = task_loss + aux_weight * aux
+    else:
+        logits = model.apply(params, support, query)
+        loss = task_loss = LOSS_FNS[loss_name](logits, label)
+    return loss, {"loss": task_loss, "accuracy": accuracy(logits, label)}
 
 
 def make_update_body(model, cfg: ExperimentConfig):
@@ -77,12 +87,14 @@ def make_update_body(model, cfg: ExperimentConfig):
     calling convention.
     """
 
+    aux_w = cfg.moe_aux_weight if cfg.moe_experts > 0 else 0.0
+
     def body(state: TrainState, batch):
         support, query, label = batch
 
         def loss_fn(params):
             return loss_and_metrics(
-                model, params, support, query, label, cfg.loss
+                model, params, support, query, label, cfg.loss, aux_w
             )
 
         grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
@@ -193,6 +205,7 @@ def make_adv_update_body(model, disc, cfg: ExperimentConfig):
     from induction_network_on_fewrel_tpu.ops import gradient_reversal
 
     lam = cfg.adv_lambda
+    aux_w = cfg.moe_aux_weight if cfg.moe_experts > 0 else 0.0
 
     def encode(params, batch):
         return model.apply(
@@ -205,8 +218,11 @@ def make_adv_update_body(model, disc, cfg: ExperimentConfig):
         support, query, label, src, tgt = batch
 
         def loss_fn(params, disc_params):
-            logits = model.apply(params, support, query)
-            fs_loss = LOSS_FNS[cfg.loss](logits, label)
+            # Few-shot objective (incl. any sown MoE aux) comes from the
+            # shared loss_and_metrics — the single source of aux handling.
+            fs_loss, fs_metrics = loss_and_metrics(
+                model, params, support, query, label, cfg.loss, aux_w
+            )
 
             feat = jnp.concatenate(
                 [encode(params, src), encode(params, tgt)], axis=0
@@ -220,8 +236,7 @@ def make_adv_update_body(model, disc, cfg: ExperimentConfig):
             )
             dom_loss = cross_entropy_loss(dom_logits[None], dom_label[None])
             metrics = {
-                "loss": fs_loss,
-                "accuracy": accuracy(logits, label),
+                **fs_metrics,
                 "domain_loss": dom_loss,
                 "domain_accuracy": accuracy(dom_logits[None], dom_label[None]),
             }

@@ -116,9 +116,11 @@ def test_mesh_validation():
     with pytest.raises(ValueError):
         make_mesh(dp=16, tp=1)
     m = make_mesh(tp=2)  # dp inferred = 4
-    assert m.shape == {"dp": 4, "tp": 2, "sp": 1}
+    assert dict(m.shape) == {"dp": 4, "pp": 1, "ep": 1, "tp": 2, "sp": 1}
     m = make_mesh(tp=2, sp=2)  # dp inferred = 2
-    assert m.shape == {"dp": 2, "tp": 2, "sp": 2}
+    assert dict(m.shape) == {"dp": 2, "pp": 1, "ep": 1, "tp": 2, "sp": 2}
+    m = make_mesh(pp=2, ep=2)  # dp inferred = 2
+    assert dict(m.shape) == {"dp": 2, "pp": 2, "ep": 2, "tp": 1, "sp": 1}
 
 
 def test_sharded_fused_step_matches_sequential(setup):

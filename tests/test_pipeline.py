@@ -1,0 +1,170 @@
+"""Pipeline parallelism: GPipe schedule == sequential layer scan, exactly.
+
+The pipelined transformer (models/pipeline_transformer.py +
+parallel/pipeline.py) must be a pure execution-strategy change: same param
+tree, same forward values, same training trajectory as the single-device
+sequential scan. Pinned here on the 8-virtual-CPU mesh, the same way the
+ring suite pins sequence parallelism.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    GloveTokenizer,
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+from induction_network_on_fewrel_tpu.models.pipeline_transformer import (
+    PipelinedTransformerEncoder,
+)
+from induction_network_on_fewrel_tpu.parallel import make_gpipe, make_mesh
+from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+
+D_MODEL = 32
+
+
+def _encoders(pp: int, microbatches: int = 4):
+    """(sequential encoder, pipelined encoder over a pp-stage mesh)."""
+    mesh = make_mesh(dp=1, pp=pp, devices=jax.devices()[:pp])
+    seq = PipelinedTransformerEncoder(
+        num_layers=4, d_model=D_MODEL, num_heads=2, d_ff=64, max_length=12
+    )
+    piped = seq.copy(pipeline_impl=make_gpipe(mesh, microbatches=microbatches))
+    return seq, piped
+
+
+def test_gpipe_forward_matches_sequential():
+    seq, piped = _encoders(pp=4)
+    emb = jax.random.normal(jax.random.key(0), (8, 12, 20))
+    mask = jnp.ones((8, 12), jnp.int32).at[:, 9:].set(0)
+    params = seq.init(jax.random.key(1), emb, mask)
+    y_seq = seq.apply(params, emb, mask)
+    y_pipe = piped.apply(params, emb, mask)  # identical param tree
+    np.testing.assert_allclose(
+        np.asarray(y_seq), np.asarray(y_pipe), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_gpipe_gradient_matches_sequential():
+    seq, piped = _encoders(pp=4)
+    emb = jax.random.normal(jax.random.key(2), (8, 12, 20))
+    mask = jnp.ones((8, 12), jnp.int32)
+    params = seq.init(jax.random.key(3), emb, mask)
+
+    def loss(p, enc):
+        return jnp.sum(enc.apply(p, emb, mask) ** 2)
+
+    g_seq = jax.grad(lambda p: loss(p, seq))(params)
+    g_pipe = jax.grad(lambda p: loss(p, piped))(params)
+    for a, b in zip(jax.tree.leaves(g_seq), jax.tree.leaves(g_pipe)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-5, atol=1e-5
+        )
+
+
+def test_gpipe_bubble_ticks_do_not_pollute():
+    """Microbatches > stages and microbatches == stages both stay exact
+    (inject/drain bubbles carry zeros that must never reach outputs)."""
+    for m in (2, 4, 8):
+        seq, piped = _encoders(pp=2, microbatches=m)
+        emb = jax.random.normal(jax.random.key(4), (8, 12, 20))
+        mask = jnp.ones((8, 12), jnp.int32)
+        params = seq.init(jax.random.key(5), emb, mask)
+        np.testing.assert_allclose(
+            np.asarray(seq.apply(params, emb, mask)),
+            np.asarray(piped.apply(params, emb, mask)),
+            rtol=1e-5, atol=1e-6,
+            err_msg=f"microbatches={m}",
+        )
+
+
+@pytest.fixture(scope="module")
+def pp_episode_setup():
+    # Support rows = B*N*K = 4*6 = 24? No: 4 episodes * 3-way * 2-shot = 24;
+    # query rows = 4 * 6 = 24; both divisible by microbatches=4.
+    cfg = ExperimentConfig(
+        model="proto", encoder="transformer", train_n=3, n=3, k=2, q=2,
+        batch_size=4, max_length=12, vocab_size=302, compute_dtype="float32",
+        tfm_layers=4, tfm_model=D_MODEL, tfm_heads=2, tfm_ff=64,
+        tfm_stacked=True, pp=4, pp_microbatches=4,
+        lr=1e-3, weight_decay=0.0,
+    )
+    vocab = make_synthetic_glove(vocab_size=300)
+    ds = make_synthetic_fewrel(
+        num_relations=6, instances_per_relation=8, vocab_size=300
+    )
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    sampler = EpisodeSampler(ds, tok, cfg.train_n, cfg.k, cfg.q,
+                             batch_size=cfg.batch_size, seed=0)
+    return cfg, vocab, sampler
+
+
+def test_pp_sharded_training_matches_single_device(pp_episode_setup):
+    """Full GSPMD train step with the pipeline executor on a (dp=2, pp=4)
+    mesh == single-device sequential-scan training, for 3 steps."""
+    from induction_network_on_fewrel_tpu.parallel.sharding import (
+        make_sharded_train_step,
+    )
+    from induction_network_on_fewrel_tpu.train.steps import (
+        init_state, make_train_step,
+    )
+
+    cfg, vocab, sampler = pp_episode_setup
+    sup, qry, label = batch_to_model_inputs(sampler.sample_batch())
+
+    model_seq = build_model(cfg.replace(pp=1), glove_init=vocab.vectors)
+    mesh = make_mesh(dp=2, pp=4, devices=jax.devices()[:8])
+    model_pp = build_model(
+        cfg, glove_init=vocab.vectors,
+        pipeline_impl=make_gpipe(
+            mesh, microbatches=cfg.pp_microbatches, batch_axis="dp"
+        ),
+    )
+
+    state_a = init_state(model_seq, cfg, sup, qry)
+    state_b = jax.tree.map(
+        lambda x: x.copy() if hasattr(x, "copy") else x, state_a
+    )
+    single = make_train_step(model_seq, cfg)
+    sharded = make_sharded_train_step(model_pp, cfg, mesh, state_a)
+
+    for _ in range(3):
+        sup_b, qry_b, label_b = batch_to_model_inputs(sampler.sample_batch())
+        state_a, m_a = single(state_a, sup_b, qry_b, label_b)
+        state_b, m_b = sharded(state_b, sup_b, qry_b, label_b)
+        np.testing.assert_allclose(
+            float(m_a["loss"]), float(m_b["loss"]), rtol=1e-5, atol=1e-6
+        )
+
+    # Looser than the forward/grad tests above: dp-psum + pipeline reduction
+    # order shifts grads by float-epsilon and Adam's rsqrt amplifies that on
+    # near-zero second moments over 3 steps. Real sharding bugs are orders
+    # of magnitude beyond these bounds (forward/grad exactness is pinned
+    # tight above).
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(state_a.params)),
+        jax.tree.leaves(jax.device_get(state_b.params)),
+    ):
+        np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-4)
+
+
+def test_stacked_checkpoint_restores_across_pp():
+    """pp=1 (sequential) and pp=4 (pipelined) share one param tree: a
+    checkpoint from either restores into the other bit-for-bit."""
+    seq, piped = _encoders(pp=4)
+    emb = jax.random.normal(jax.random.key(6), (4, 12, 20))
+    mask = jnp.ones((4, 12), jnp.int32)
+    params = seq.init(jax.random.key(7), emb, mask)
+    # Same tree structure and shapes — restoration is trivially valid.
+    p2 = piped.init(jax.random.key(8), emb, mask)
+    assert jax.tree_util.tree_structure(params) == jax.tree_util.tree_structure(p2)
+    assert all(
+        a.shape == b.shape
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p2))
+    )
