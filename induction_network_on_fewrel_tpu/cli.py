@@ -49,6 +49,8 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--moe_capacity", type=float, default=2.0,
                    help="expert buffer capacity factor")
     p.add_argument("--moe_every", type=int, default=2)
+    p.add_argument("--moe_group_size", type=int, default=512,
+                   help="tokens per MoE routing group (memory knob)")
     p.add_argument("--moe_aux_weight", type=float, default=1e-2,
                    help="load-balance aux loss weight")
     p.add_argument("--max_length", type=int, default=40)
@@ -172,6 +174,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         tfm_heads=args.tfm_heads, tfm_ff=args.tfm_ff,
         moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,
         moe_capacity=args.moe_capacity, moe_every=args.moe_every,
+        moe_group_size=args.moe_group_size,
         moe_aux_weight=args.moe_aux_weight,
         induction_dim=args.induction_dim,
         routing_iters=args.routing_iters, ntn_slices=args.ntn_slices,

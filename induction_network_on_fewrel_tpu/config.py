@@ -68,6 +68,7 @@ class ExperimentConfig:
     moe_top_k: int = 2
     moe_capacity: float = 2.0
     moe_every: int = 2
+    moe_group_size: int = 512  # tokens per routing group (memory knob)
     moe_aux_weight: float = 1e-2  # load-balance aux loss weight
     # Layer-stacked transformer (models/pipeline_transformer.py): the
     # pipeline-parallel parameter layout. Forced on when pp > 1; can be set

@@ -43,7 +43,7 @@ def build_model(
         raise ValueError(
             f"--moe_experts with --moe_every {cfg.moe_every} > --tfm_layers "
             f"{cfg.tfm_layers} would create zero expert layers (block i is "
-            "MoE when (i+1) %% moe_every == 0) — the model would silently "
+            "MoE when (i+1) % moe_every == 0) — the model would silently "
             "train dense"
         )
     use_stacked = cfg.tfm_stacked or pipeline_impl is not None
@@ -136,6 +136,7 @@ def build_model(
                 attn_impl=attn_impl,
                 num_experts=cfg.moe_experts, moe_top_k=cfg.moe_top_k,
                 moe_capacity=cfg.moe_capacity, moe_every=cfg.moe_every,
+                moe_group_size=cfg.moe_group_size,
             )
         elif cfg.encoder == "bilstm":
             backend = cfg.lstm_backend
@@ -219,16 +220,22 @@ def batch_to_model_inputs(batch) -> tuple[dict, dict, jnp.ndarray]:
         return batch.support_idx, batch.query_idx, batch.label
     if hasattr(batch, "support"):  # FeatureEpisodeBatch
         return batch.support, batch.query, batch.label
+    # Wire-dtype narrowing: pos offsets live in [0, 2·max_length) and the
+    # mask in {0, 1}, so they cross host->device as int16/int8 — on this
+    # TPU that boundary is a network tunnel and batch bytes are ~45% of the
+    # per-step payload. Device-side consumers are gathers and `> 0`
+    # comparisons, which take any int dtype; word ids stay int32 (GloVe
+    # vocab is 400k > int16).
     support = {
         "word": batch.support_word,
-        "pos1": batch.support_pos1,
-        "pos2": batch.support_pos2,
-        "mask": batch.support_mask,
+        "pos1": batch.support_pos1.astype(np.int16),
+        "pos2": batch.support_pos2.astype(np.int16),
+        "mask": batch.support_mask.astype(np.int8),
     }
     query = {
         "word": batch.query_word,
-        "pos1": batch.query_pos1,
-        "pos2": batch.query_pos2,
-        "mask": batch.query_mask,
+        "pos1": batch.query_pos1.astype(np.int16),
+        "pos2": batch.query_pos2.astype(np.int16),
+        "mask": batch.query_mask.astype(np.int8),
     }
     return support, query, batch.label

@@ -8,24 +8,32 @@ that scales encoder capacity without scaling per-token FLOPs.
 
 TPU-shaped design (GShard/Switch style, einsum formulation):
 
-* **Routing** is a single [T, E] matmul + top-k selection with a STATIC
-  per-expert capacity ``C = ceil(k·T/E · capacity_factor)`` — no dynamic
-  shapes, no sorting networks; everything lowers to one-hot matmuls the
-  MXU eats directly.
-* **Dispatch/combine** are einsums against a [T, E, C] one-hot tensor:
-  ``expert_in = einsum('tec,td->ecd')`` gathers each expert's tokens into a
-  dense [E, C, d] block; the expert FFN is then a *batched* GEMM
-  ``[E, C, d] x [E, d, f]`` — large, static, bf16-friendly.
+* **Token grouping**: tokens are routed in fixed-size groups of ``S =
+  group_size`` (padded with masked tokens), so the dispatch/combine
+  one-hots are ``[G, S, E, C]`` with ``C = ceil(k·S/E · capacity_factor)``
+  — memory stays LINEAR in total tokens (a single flat [T, E, C] would be
+  quadratic: C itself grows with T).
+* **Routing** is one [G, S, E] matmul + top-k selection with a STATIC
+  per-group capacity — no dynamic shapes, no sorts; everything lowers to
+  one-hot matmuls and cumsums the MXU/VPU eat directly.
+* **Padding-aware**: the sentence mask zeroes a pad token's routing
+  one-hot BEFORE the capacity cumsum, so pads consume no expert slots and
+  the load-balance statistics count real tokens only. (The dense MLP
+  merely wastes FLOPs on pads; a capacity-bounded MoE would silently drop
+  REAL tokens to make room for pad traffic.)
+* **Dispatch/combine** are einsums against the one-hot tensors: each
+  expert's tokens land in a dense ``[G, E, C, d]`` block and the expert
+  FFN is a *batched* GEMM — large, static, bf16-friendly.
 * **Expert parallelism**: expert-stacked parameters ``[E, d, f]`` carry a
   ``P('ep', None, None)`` sharding (parallel/sharding.py). Under GSPMD the
   dispatch einsum becomes the all-to-all that scatters token blocks to the
   devices owning each expert, and the combine einsum the inverse — XLA
   inserts both over ICI; there is no hand-written collective here.
 * **Load balance**: the standard aux loss ``E · Σ_e f_e·p_e`` (fraction of
-  tokens routed to e × mean router prob of e) is sown into the "losses"
-  collection; the train step adds it with weight ``cfg.moe_aux_weight``
-  (train/steps.py). Eval applies without the mutable collection, so the sow
-  is dropped — no eval-time overhead.
+  real tokens routed to e × their mean router prob of e) is sown into the
+  "losses" collection; the train step adds it with weight
+  ``cfg.moe_aux_weight`` (train/steps.py). Eval applies without the
+  mutable collection, so the sow is dropped — no eval-time overhead.
 
 Capacity overflow drops tokens (their residual path still carries them —
 the layer is residual in TransformerEncoder), matching the standard
@@ -42,79 +50,95 @@ import jax.numpy as jnp
 
 
 class MoeFfn(nn.Module):
-    """Top-k routed expert FFN: [M, L, d] -> [M, L, d]."""
+    """Top-k routed expert FFN: [M, L, d] (+ [M, L] mask) -> [M, L, d]."""
 
     num_experts: int
     d_ff: int
     top_k: int = 2
     capacity_factor: float = 2.0
+    group_size: int = 512  # tokens per routing group (memory knob)
     compute_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, mask: jnp.ndarray | None = None):
         M, L, d = x.shape
         E, k = self.num_experts, min(self.top_k, self.num_experts)
         T = M * L
-        # Static per-expert buffer size; every shape below is compile-time.
-        C = max(1, math.ceil(k * T / E * self.capacity_factor))
-        C = min(C, T)
+        S = min(self.group_size, T)
+        G = math.ceil(T / S)
+        pad = G * S - T
+        # Static per-group expert buffer; every shape below is compile-time.
+        C = min(max(1, math.ceil(k * S / E * self.capacity_factor)), S)
         cd = self.compute_dtype
 
         xt = x.reshape(T, d)
+        mk = (
+            jnp.ones((T,), jnp.float32) if mask is None
+            else (mask.reshape(T) > 0).astype(jnp.float32)
+        )
+        if pad:
+            xt = jnp.pad(xt, ((0, pad), (0, 0)))
+            mk = jnp.pad(mk, (0, pad))  # pad slots are masked out
+        xt = xt.reshape(G, S, d)
+        mk = mk.reshape(G, S)
+
         # Router runs in f32: tiny matmul, and routing decisions should not
         # flap with bf16 rounding.
         logits = nn.Dense(E, dtype=jnp.float32, param_dtype=jnp.float32,
                           name="router")(xt.astype(jnp.float32))
-        probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1) * mk[..., None]  # [G, S, E]
 
         # Iterative top-k assignment. Each round: argmax over still-unchosen
-        # experts -> one-hot -> capacity-bounded slot index via cumsum.
+        # experts -> one-hot (masked tokens contribute nothing) ->
+        # capacity-bounded slot index via a within-group cumsum.
         remaining = probs
-        slot_count = jnp.zeros((E,), jnp.int32)  # slots used per expert
-        dispatch = jnp.zeros((T, E, C), jnp.float32)
-        combine = jnp.zeros((T, E, C), jnp.float32)  # gate-weighted, unnorm
-        gate_sum = jnp.zeros((T,), jnp.float32)
+        slot_count = jnp.zeros((G, E), jnp.float32)  # slots used per expert
+        dispatch = jnp.zeros((G, S, E, C), jnp.float32)
+        combine = jnp.zeros((G, S, E, C), jnp.float32)  # gate-weighted
+        gate_sum = jnp.zeros((G, S), jnp.float32)
         first_oh = None
         for _ in range(k):
-            choice = jnp.argmax(remaining, axis=-1)             # [T]
-            oh = jax.nn.one_hot(choice, E, dtype=jnp.float32)   # [T, E]
+            choice = jnp.argmax(remaining, axis=-1)              # [G, S]
+            oh = jax.nn.one_hot(choice, E, dtype=jnp.float32)
+            oh = oh * mk[..., None]  # pads take no slots, count nowhere
             first_oh = oh if first_oh is None else first_oh
             # Position of each token within its chosen expert's buffer:
-            # running count over the token axis + slots used by earlier
-            # rounds. (Token order = priority; later tokens drop first.)
-            pos = jnp.cumsum(oh, axis=0) - oh + slot_count[None, :]  # [T, E]
-            pos_tok = jnp.sum(pos * oh, axis=-1)                # [T]
-            fits = pos_tok < C                                  # [T]
-            ohf = oh * fits[:, None].astype(jnp.float32)
+            # running count over the group's token axis + slots used by
+            # earlier rounds. (Token order = priority; later drop first.)
+            pos = jnp.cumsum(oh, axis=1) - oh + slot_count[:, None, :]
+            pos_tok = jnp.sum(pos * oh, axis=-1)                 # [G, S]
+            fits = (pos_tok < C).astype(jnp.float32)
+            ohf = oh * fits[..., None]
             slot = jax.nn.one_hot(pos_tok.astype(jnp.int32), C,
-                                  dtype=jnp.float32)            # [T, C]
-            piece = ohf[:, :, None] * slot[:, None, :]          # [T, E, C]
+                                  dtype=jnp.float32)             # [G, S, C]
+            piece = ohf[..., None] * slot[:, :, None, :]         # [G,S,E,C]
             dispatch = dispatch + piece
-            # Fold the gate into combine NOW (renormalized after the loop by
-            # the per-token gate sum) so per-round [T, E, C] slices never
-            # outlive their iteration.
-            gp = jnp.sum(probs * ohf, axis=-1)                  # [T]
-            combine = combine + gp[:, None, None] * piece
+            # Fold the gate into combine NOW (renormalized after the loop
+            # by the per-token gate sum) so per-round [G, S, E, C] slices
+            # never outlive their iteration.
+            gp = jnp.sum(probs * ohf, axis=-1)                   # [G, S]
+            combine = combine + gp[..., None, None] * piece
             gate_sum = gate_sum + gp
-            slot_count = slot_count + jnp.sum(ohf, axis=0).astype(jnp.int32)
+            slot_count = slot_count + jnp.sum(ohf, axis=1)
             remaining = remaining * (1.0 - oh)  # mask chosen expert out
 
-        # Load-balance aux (first-round assignment, pre-capacity): sown for
-        # the train step; silently dropped when "losses" is not mutable.
-        # Never sown during init — otherwise the collection would leak into
-        # the initialized variables (and from there into TrainState and
-        # checkpoints).
+        # Load-balance aux over REAL tokens (first-round assignment,
+        # pre-capacity): sown for the train step; silently dropped when
+        # "losses" is not mutable. Never sown during init — otherwise the
+        # collection would leak into the initialized variables (and from
+        # there into TrainState and checkpoints).
         if not self.is_initializing():
-            f_e = jnp.mean(first_oh, axis=0)                    # [E]
-            p_e = jnp.mean(probs, axis=0)                       # [E]
+            nreal = jnp.sum(mk) + 1e-9
+            f_e = jnp.sum(first_oh, axis=(0, 1)) / nreal         # [E]
+            p_e = jnp.sum(probs, axis=(0, 1)) / nreal            # [E]
             self.sow("losses", "moe_aux", E * jnp.sum(f_e * p_e))
 
         # Renormalize over the selected (surviving) experts: each token's
         # combine weights sum to 1 unless every selection was dropped.
-        combine = combine / (gate_sum[:, None, None] + 1e-9)
+        combine = combine / (gate_sum[..., None, None] + 1e-9)
 
-        # Expert computation: dense [E, C, d] blocks through per-expert
-        # weights — ONE batched GEMM pair on the MXU. Param names carry the
+        # Expert computation: dense [G, E, C, d] blocks through per-expert
+        # weights — batched GEMMs on the MXU. Param names carry the
         # "experts_" prefix the ep partition rules key on.
         w_up = self.param("experts_up", nn.initializers.lecun_normal(),
                           (E, d, self.d_ff), jnp.float32)
@@ -125,15 +149,18 @@ class MoeFfn(nn.Module):
         b_down = self.param("experts_down_bias", nn.initializers.zeros,
                             (E, d), jnp.float32)
 
-        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(cd),
+        expert_in = jnp.einsum("gsec,gsd->gecd", dispatch.astype(cd),
                                xt.astype(cd))
         h = nn.gelu(
-            jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(cd))
-            + b_up[:, None, :].astype(cd)
+            jnp.einsum("gecd,edf->gecf", expert_in, w_up.astype(cd))
+            + b_up[None, :, None, :].astype(cd)
         )
         out_e = (
-            jnp.einsum("ecf,efd->ecd", h, w_down.astype(cd))
-            + b_down[:, None, :].astype(cd)
+            jnp.einsum("gecf,efd->gecd", h, w_down.astype(cd))
+            + b_down[None, :, None, :].astype(cd)
         )
-        out = jnp.einsum("tec,ecd->td", combine.astype(cd), out_e)
+        out = jnp.einsum("gsec,gecd->gsd", combine.astype(cd), out_e)
+        out = out.reshape(G * S, d)
+        if pad:
+            out = out[:T]
         return out.reshape(M, L, d)

@@ -42,6 +42,7 @@ class TransformerEncoder(nn.Module):
     moe_top_k: int = 2
     moe_capacity: float = 2.0
     moe_every: int = 2
+    moe_group_size: int = 512
 
     @nn.compact
     def __call__(self, emb: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
@@ -77,11 +78,15 @@ class TransformerEncoder(nn.Module):
             if self.num_experts > 0 and (i + 1) % self.moe_every == 0:
                 from induction_network_on_fewrel_tpu.models.moe import MoeFfn
 
+                # The mask matters here (unlike the dense MLP, which merely
+                # wastes FLOPs on pads): routed pads would consume expert
+                # capacity slots and skew the load-balance statistics.
                 x = x + MoeFfn(
                     num_experts=self.num_experts, d_ff=self.d_ff,
                     top_k=self.moe_top_k, capacity_factor=self.moe_capacity,
+                    group_size=self.moe_group_size,
                     compute_dtype=cd, name=f"moe_{i}",
-                )(h)
+                )(h, mask)
             else:
                 # Layer names match the tp partition rules in
                 # parallel/sharding.py (intermediate column-sharded, mlp_out

@@ -81,6 +81,16 @@ class CheckpointManager:
                 best_mode="max",
             ),
         )
+        # Crash-recovery ring (SURVEY.md §5.3 failure detection / recovery):
+        # the best-metric manager above only writes on improvement, so a
+        # crash after a long plateau would lose everything since the last
+        # best. A second single-slot manager under latest/ is written at
+        # EVERY val boundary; --resume restores from whichever of the two
+        # is newest.
+        self.latest_mngr = ocp.CheckpointManager(
+            self.dir / "latest",
+            options=ocp.CheckpointManagerOptions(max_to_keep=1),
+        )
 
     def save(self, step: int, state: Any, val_accuracy: float) -> None:
         self.mngr.save(
@@ -90,6 +100,16 @@ class CheckpointManager:
         )
         self.mngr.wait_until_finished()
 
+    def save_latest(self, step: int, state: Any) -> None:
+        """Recovery save (single rotating slot). Skipped when either manager
+        already holds this step — restore_latest consults both, so a
+        best-save at the same boundary makes the ring write pure duplicate
+        I/O (each save is a full state serialization + blocking wait)."""
+        if step in (self.latest_mngr.latest_step(), self.mngr.latest_step()):
+            return
+        self.latest_mngr.save(step, args=ocp.args.StandardSave(state))
+        self.latest_mngr.wait_until_finished()
+
     def restore_best(self, target: Any) -> tuple[Any, int]:
         step = self.mngr.best_step()
         if step is None:
@@ -97,10 +117,22 @@ class CheckpointManager:
         return self.mngr.restore(step, args=ocp.args.StandardRestore(target)), step
 
     def restore_latest(self, target: Any) -> tuple[Any, int]:
-        step = self.mngr.latest_step()
-        if step is None:
+        """Newest state across the best-tracked steps AND the recovery ring."""
+        best_side = self.mngr.latest_step()
+        ring_side = self.latest_mngr.latest_step()
+        if best_side is None and ring_side is None:
             raise FileNotFoundError(f"no checkpoint in {self.dir}")
-        return self.mngr.restore(step, args=ocp.args.StandardRestore(target)), step
+        if ring_side is not None and (best_side is None or ring_side >= best_side):
+            return (
+                self.latest_mngr.restore(
+                    ring_side, args=ocp.args.StandardRestore(target)
+                ),
+                ring_side,
+            )
+        return (
+            self.mngr.restore(best_side, args=ocp.args.StandardRestore(target)),
+            best_side,
+        )
 
     @staticmethod
     def load_config(ckpt_dir: str | Path) -> ExperimentConfig:

@@ -244,13 +244,21 @@ class FewShotTrainer:
             if self.val_sampler is not None and crossed_val:
                 val_acc = self.evaluate(state.params, cfg.val_iter)
                 self.logger.log(step, "val", accuracy=val_acc)
-                if self.ckpt is not None and val_acc > self.best_val:
-                    self.best_val = val_acc
-                    self.ckpt.save(step, state, val_acc)
+                if self.ckpt is not None:
+                    if val_acc > self.best_val:
+                        self.best_val = val_acc
+                        self.ckpt.save(step, state, val_acc)
+                    # Recovery ring: saved at EVERY val boundary so a crash
+                    # on a plateau resumes from here, not the stale best.
+                    self.ckpt.save_latest(step, state)
                 t0 = time.monotonic()
                 last_logged = step
         if profiling:
             jax.profiler.stop_trace()  # run ended inside the trace window
+        if self.ckpt is not None:
+            # Final ring save (no-op if the last val boundary already wrote
+            # this step): --resume continues from the end of this run.
+            self.ckpt.save_latest(step, state)
         return state
 
     def evaluate(self, params, num_episodes: int, sampler=None) -> float:

@@ -202,3 +202,65 @@ def test_moe_ep_sharded_step_matches_single_device(moe_episode_setup):
     flat_b = jax.tree.leaves(jax.device_get(state_b.params))
     for a, b in zip(flat_a, flat_b):
         np.testing.assert_allclose(a, b, rtol=5e-3, atol=1e-3)
+
+
+def test_moe_mask_excludes_pads_from_capacity_and_aux():
+    """Pad tokens must consume no expert slots: with capacity sized for the
+    real tokens only, heavy padding must not cause real-token drops, pad
+    outputs must be zero, and the aux statistics must count real tokens."""
+    d = 8
+    M, L = 2, 16
+    x = jax.random.normal(jax.random.key(7), (M, L, d))
+    mask = jnp.zeros((M, L), jnp.int32).at[:, :4].set(1)  # 8 real / 32 total
+    moe = MoeFfn(num_experts=2, d_ff=16, top_k=1, capacity_factor=1.0)
+    params = _init_with_mask(moe, x, mask)
+    y = np.asarray(moe.apply(params, x, mask)).reshape(M * L, d)
+    flat_mask = np.asarray(mask).reshape(-1)
+    # Pad positions produce exactly zero (residual carries them).
+    assert np.abs(y[flat_mask == 0]).max() == 0.0
+    # Real positions all got routed (capacity C = ceil(1*32/2*1.0) = 16
+    # >> 8 real tokens, so none can drop even though pads outnumber them).
+    assert (np.abs(y[flat_mask == 1]).sum(axis=-1) > 0).all()
+    # Aux is computed over real tokens: still ~O(1), not diluted by pads.
+    _, sown = moe.apply(params, x, mask, mutable="losses")
+    (aux,) = jax.tree.leaves(sown)
+    assert 0.5 < float(aux) < 4.0
+
+
+def _init_with_mask(module, x, mask, seed=0):
+    return module.init(jax.random.key(seed), x, mask)
+
+
+def test_moe_grouped_routing_matches_dense_when_experts_identical():
+    """Grouping is a memory layout, not a semantics change, in the no-drop
+    regime: with identical experts the output still equals the dense FFN
+    even when tokens span several routing groups."""
+    d, f = 16, 32
+    x = jax.random.normal(jax.random.key(8), (4, 8, d))  # T=32
+    moe = MoeFfn(num_experts=4, d_ff=f, top_k=2, capacity_factor=100.0,
+                 group_size=8)  # 4 groups of 8
+    params = _init(moe, x)
+    w_up = jax.random.normal(jax.random.key(9), (d, f)) * 0.1
+    w_down = jax.random.normal(jax.random.key(10), (f, d)) * 0.1
+    p = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _tile(path, leaf, w_up, w_down), params
+    )
+    y = moe.apply(p, x)
+
+    def dense_ffn(t):
+        return jax.nn.gelu(t @ w_up) @ w_down
+
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(dense_ffn(x)), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_moe_group_padding_roundtrip():
+    """T not divisible by group_size: the pad-to-groups path must keep
+    shapes and not leak padding into outputs."""
+    x = jax.random.normal(jax.random.key(11), (3, 5, 8))  # T=15
+    moe = MoeFfn(num_experts=2, d_ff=16, top_k=1, group_size=4)  # G=4, pad=1
+    params = _init(moe, x)
+    y = moe.apply(params, x)
+    assert y.shape == x.shape
+    assert np.isfinite(np.asarray(y)).all()

@@ -288,3 +288,38 @@ def test_trainer_adv_fused_runs():
     trainer = FewShotTrainer(model, cfg, sampler, adv=adv)
     state = trainer.train()
     assert int(state.step) == 10  # 4+4 fused + 2 per-step remainder
+
+
+def test_recovery_ring_saves_latest_on_plateau(tmp_path):
+    """The crash-recovery ring (checkpoint.py save_latest) must advance at
+    every val boundary even when val accuracy never improves, and
+    restore_latest must pick the ring over a stale best."""
+    from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
+
+    cfg = ExperimentConfig(
+        encoder="cnn", n=2, k=2, q=2, batch_size=2, max_length=L,
+        vocab_size=302, compute_dtype="float32", lr=1e-3,
+        val_step=5, val_iter=4,
+    )
+    model, sampler = _setup(cfg)
+    trainer = FewShotTrainer(
+        model, cfg, sampler, val_sampler=sampler, ckpt_dir=tmp_path,
+        logger=MetricsLogger(quiet=True),
+    )
+    state = trainer.train(num_iters=15)
+
+    mgr = trainer.ckpt
+    # Ring holds the final step regardless of where the best landed.
+    assert mgr.latest_mngr.latest_step() == 15
+    restored, step = mgr.restore_latest(jax.device_get(state))
+    assert step == 15
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(restored.params)[0]),
+        np.asarray(jax.tree.leaves(jax.device_get(state).params)[0]),
+    )
+    # Best restore still works independently of the ring.
+    _, best_step = mgr.restore_best(jax.device_get(state))
+    assert best_step <= 15
+
+    # Dedupe: saving the same step twice is a no-op, not an orbax error.
+    mgr.save_latest(15, jax.device_get(state))
