@@ -78,6 +78,12 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     )
     p.add_argument("--loss", default="mse", choices=["mse", "ce"])
     p.add_argument("--optimizer", default="adam", choices=["adam", "adamw", "sgd"])
+    p.add_argument("--embed_optimizer", default="shared",
+                   choices=["shared", "sgd", "frozen"],
+                   help="word-embedding table optimizer: shared = main "
+                        "optimizer (reference parity; dense Adam touches "
+                        "the whole 400k-row table every step), sgd = "
+                        "stateless scatter update, frozen = fixed GloVe")
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--weight_decay", type=float, default=1e-5)
     p.add_argument("--lr_step_size", type=int, default=2000)
@@ -181,7 +187,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         bert_frozen=args.bert_frozen, bert_layers=args.bert_layers,
         bert_vocab_size=args.bert_vocab_size, bert_vocab_path=args.bert_vocab,
         bert_remat=args.bert_remat, bert_weights=args.bert_weights,
-        loss=args.loss, optimizer=args.optimizer, lr=args.lr,
+        loss=args.loss, optimizer=args.optimizer,
+        embed_optimizer=args.embed_optimizer, lr=args.lr,
         weight_decay=args.weight_decay, lr_step_size=args.lr_step_size,
         grad_clip=args.grad_clip, train_iter=train_iter,
         val_iter=val_iter, val_step=val_step, test_iter=args.test_iter,
@@ -701,7 +708,13 @@ def train_main(argv=None) -> int:
         print(f'{{"test_accuracy": {acc:.4f}}}')
         return 0
 
-    state = trainer.train(state, num_iters=cfg.train_iter)
+    # Global step numbering continues from the restored step on --resume so
+    # checkpoint retention / the recovery ring keep advancing (a fresh
+    # --load_ckpt fine-tune restarts numbering at 0 on purpose).
+    state = trainer.train(
+        state, num_iters=cfg.train_iter,
+        start_step=start_step if args.resume else 0,
+    )
     if trainer.val_sampler is not None:
         acc = trainer.evaluate(state.params, cfg.val_iter)
         print(f'{{"final_val_accuracy": {acc:.4f}}}')

@@ -84,6 +84,10 @@ class ExperimentConfig:
     # --- optimization ---
     loss: str = "mse"         # mse (paper §3.4) | ce (toolkit forks)
     optimizer: str = "adam"   # adam | sgd
+    # Word-embedding table optimizer: "shared" (reference parity — the main
+    # optimizer updates the table densely), "sgd" (stateless scatter update,
+    # ~5x faster steps with the real 400k GloVe table), "frozen".
+    embed_optimizer: str = "shared"
     lr: float = 1e-3
     weight_decay: float = 1e-5
     lr_step_size: int = 2000  # StepLR-style decay interval
@@ -151,7 +155,9 @@ class ExperimentConfig:
         # depend on them) and stay restorable-across; experts/every shape
         # the tree.
         "moe_experts", "moe_every", "tfm_stacked",
-        "loss", "optimizer",
+        # embed_optimizer changes the optimizer-state tree (multi_transform
+        # wrapper), so resume requires it to match.
+        "loss", "optimizer", "embed_optimizer",
         # feature_cache changes the state tree itself (head-only params), so
         # a cached checkpoint can only restore into a cached runtime — and
         # that runtime must rebuild the SAME backbone: frozen flag and

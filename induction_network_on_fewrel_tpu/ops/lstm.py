@@ -10,9 +10,11 @@ The BiLSTM is the FLOPs-dominant op of the flagship encoder (SURVEY.md §3.2
    The Pallas kernel keeps h/c (and the [u, 4u] recurrent weights) resident
    in VMEM across the whole time loop — one kernel for all L steps per row
    tile, instead of L dispatches with h/c bouncing through HBM.
-3. The backward pass is a second Pallas kernel scanning time in reverse,
-   with gate activations saved from the forward pass (trade ~M*L*4u bytes
-   of HBM for re-computing the recurrent matmul).
+3. The backward pass is a second Pallas kernel scanning time in reverse.
+   The forward saves only h/c residuals (2u per row-step); the backward
+   RECOMPUTES the gate activations from xg + h_{t-1} @ whh — one extra
+   MXU matmul per step in exchange for 3x less forward HBM write traffic
+   (the kernel is bandwidth-bound, not FLOP-bound).
 
 Gate order is [i, f, g, o] (sigmoid, sigmoid, tanh, sigmoid) — the same
 convention as torch.nn.LSTM, which the golden test exploits. All recurrence

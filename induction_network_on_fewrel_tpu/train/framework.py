@@ -158,34 +158,40 @@ class FewShotTrainer:
 
         return shard_state(state, self.mesh)
 
-    def train(self, state=None, num_iters: int | None = None):
+    def train(self, state=None, num_iters: int | None = None,
+              start_step: int = 0):
+        """Run ``num_iters`` optimizer steps, numbered globally from
+        ``start_step`` (pass the restored step on --resume so checkpoint
+        step numbers keep increasing across restarts — orbax retention and
+        the recovery ring compare by step)."""
         cfg = self.cfg
         state = state if state is not None else self.init_state()
         num_iters = num_iters or cfg.train_iter
+        end_step = start_step + num_iters
         it = iter(self.train_sampler)
         t0 = time.monotonic()
-        last_logged = 0
+        last_logged = start_step
         # Metric logging fetches values (a real device sync on tunneled
         # backends — see bench.py's hard-sync note); with fused calls, log
         # every few calls rather than every one so the sync amortizes.
         window = max(50, 4 * cfg.steps_per_call)
         adv = self.adv
         profiling = profile_done = False
-        step = 0
-        while step < num_iters:
+        step = start_step
+        while step < end_step:
             # Trace steps [1, 1+profile_steps): the first call (the compile)
             # stays outside the trace so it doesn't drown the steady state.
             if self.profile_dir is not None:
-                if not profiling and not profile_done and step >= 1:
+                if not profiling and not profile_done and step >= start_step + 1:
                     jax.profiler.start_trace(self.profile_dir)
                     profiling = True
-                elif profiling and step >= 1 + self.profile_steps:
+                elif profiling and step >= start_step + 1 + self.profile_steps:
                     jax.profiler.stop_trace()
                     profiling, profile_done = False, True
                     self.logger.log(step, "profile", written=1.0)
             spc = cfg.steps_per_call
             adv_fused = adv is not None and adv.multi_step is not None
-            if self._fused_step is not None and num_iters - step >= spc:
+            if self._fused_step is not None and end_step - step >= spc:
                 batches = [
                     batch_to_model_inputs(next(it)) for _ in range(spc)
                 ]
@@ -194,7 +200,7 @@ class FewShotTrainer:
                 )
                 state, metrics = self._fused_step(state, sup_s, qry_s, lab_s)
                 prev, step = step, step + spc
-            elif adv_fused and num_iters - step >= spc:
+            elif adv_fused and end_step - step >= spc:
                 batches = [
                     batch_to_model_inputs(next(it)) for _ in range(spc)
                 ]
@@ -224,7 +230,7 @@ class FewShotTrainer:
                         state, support, query, label
                     )
                 prev, step = step, step + 1
-            if step - last_logged >= window or step >= num_iters:
+            if step - last_logged >= window or step >= end_step:
                 m = jax.device_get(metrics)  # sync point, once per window
                 dt = time.monotonic() - t0
                 eps_per_s = (step - last_logged) * cfg.batch_size / max(dt, 1e-9)
