@@ -110,6 +110,7 @@ def build_model(
             max_length=cfg.max_length,
             glove_init=glove_init,
             compute_dtype=dtype,
+            freeze_word_table=cfg.embed_optimizer == "frozen",
         )
         if cfg.encoder == "cnn":
             encoder = CNNEncoder(hidden_size=cfg.hidden_size, compute_dtype=dtype)

@@ -12,6 +12,7 @@ the concat into the consumer, so no custom kernel is warranted here.
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -23,6 +24,11 @@ class Embedding(nn.Module):
     max_length: int = 40
     glove_init: np.ndarray | None = None  # [vocab_size, word_dim] or None
     compute_dtype: jnp.dtype = jnp.float32
+    # embed_optimizer="frozen": stop_gradient on the word table, so AD never
+    # materializes the dense [vocab, word_dim] gradient and the global-norm
+    # clip reduces over symbolic zeros (XLA folds them away) — a frozen
+    # table costs nothing per step, instead of a full-table grad pass.
+    freeze_word_table: bool = False
 
     @nn.compact
     def __call__(self, word: jnp.ndarray, pos1: jnp.ndarray, pos2: jnp.ndarray) -> jnp.ndarray:
@@ -37,6 +43,8 @@ class Embedding(nn.Module):
         else:
             init = nn.initializers.normal(0.1)
         word_table = self.param("word_embedding", init, (self.vocab_size, self.word_dim))
+        if self.freeze_word_table:
+            word_table = jax.lax.stop_gradient(word_table)
         pos1_table = self.param(
             "pos1_embedding", nn.initializers.normal(0.1), (2 * self.max_length, self.pos_dim)
         )

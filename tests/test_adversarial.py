@@ -178,3 +178,17 @@ def test_adv_multi_step_matches_sequential():
             ),
             a.params, b.params,
         )
+
+
+def test_adv_with_embed_optimizer_sgd_initializes():
+    """The discriminator has no word-embedding leaf; its TrainState must
+    init with the plain optimizer chain even when --embed_optimizer splits
+    the main model's table off (regression: label_fn raised at startup)."""
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.models.adversarial import DomainDiscriminator
+    from induction_network_on_fewrel_tpu.train.steps import init_disc_state
+
+    cfg = ExperimentConfig(embed_optimizer="sgd", adv=True)
+    disc = DomainDiscriminator(hidden=32)
+    state = init_disc_state(disc, cfg, feat_dim=16)
+    assert state is not None

@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""TPU smoke (SURVEY.md §4.6): the jitted flagship step runs on the real
+chip with NO recompilation across steps. Run manually: needs the tunneled
+v5e, so it stays out of the default pytest collection (tests/tpu/README.md).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+
+def main() -> int:
+    import jax
+
+    backend = jax.default_backend()
+    if backend != "tpu":
+        print(f"SKIP: default backend is {backend!r}, not tpu")
+        return 0
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})")
+
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.data import (
+        GloveTokenizer,
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+    from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+    from induction_network_on_fewrel_tpu.train.steps import init_state, make_train_step
+
+    cfg = ExperimentConfig(
+        encoder="bilstm", n=5, k=5, q=5, batch_size=4, max_length=40,
+        vocab_size=2002, compute_dtype="bfloat16", lstm_backend="pallas",
+    )
+    ds = make_synthetic_fewrel(
+        num_relations=10, instances_per_relation=cfg.k + cfg.q + 2,
+        vocab_size=cfg.vocab_size - 2,
+    )
+    vocab = make_synthetic_glove(vocab_size=cfg.vocab_size - 2)
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    sampler = EpisodeSampler(
+        ds, tok, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size, seed=0
+    )
+    model = build_model(cfg, glove_init=vocab.vectors)
+    sup, qry, label = batch_to_model_inputs(sampler.sample_batch())
+    state = init_state(model, cfg, sup, qry)
+    step = make_train_step(model, cfg)
+
+    t0 = time.monotonic()
+    state, metrics = step(state, sup, qry, label)
+    loss = float(jax.device_get(metrics["loss"]))  # hard sync (BASELINE.md)
+    compile_s = time.monotonic() - t0
+    print(f"step 1 (compile): {compile_s:.1f}s, loss={loss:.4f}")
+    assert loss == loss, "loss is NaN"
+
+    # One extra cache entry is expected between call 1 and 2 (the fresh
+    # numpy/uncommitted state vs. the committed donated output buffers);
+    # after that the executable must be stable across steps.
+    warm = []
+    baseline_cache = None
+    for i in range(4):
+        sup, qry, label = batch_to_model_inputs(sampler.sample_batch())
+        t0 = time.monotonic()
+        state, metrics = step(state, sup, qry, label)
+        loss = float(jax.device_get(metrics["loss"]))
+        warm.append(time.monotonic() - t0)
+        assert loss == loss, f"loss is NaN at warm step {i}"
+        if baseline_cache is None:
+            baseline_cache = step._cache_size()
+
+    cache_size = step._cache_size()
+    print(f"warm steps: {[f'{t * 1e3:.0f}ms' for t in warm]}, "
+          f"jit cache entries: {cache_size} (after-first-warm: {baseline_cache})")
+    assert cache_size == baseline_cache, (
+        f"recompilation across warm steps ({baseline_cache} -> {cache_size})"
+    )
+    assert min(warm) < max(compile_s / 5.0, 2.0), (
+        f"warm step {min(warm):.2f}s suspiciously close to compile "
+        f"{compile_s:.2f}s — recompiling?"
+    )
+    print("TPU SMOKE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
