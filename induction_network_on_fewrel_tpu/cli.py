@@ -76,6 +76,12 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
         help="frozen-encoder feature cache: encode the dataset once, train "
              "the episode head on gathered features (bert frozen only)",
     )
+    p.add_argument(
+        "--token_cache", action="store_true",
+        help="device-resident token cache: upload the tokenized dataset "
+             "once, stream only episode indices per step (any encoder, "
+             "full training semantics; ~3-4x e2e on tunneled backends)",
+    )
     p.add_argument("--loss", default="mse", choices=["mse", "ce"])
     p.add_argument("--optimizer", default="adam", choices=["adam", "adamw", "sgd"])
     p.add_argument("--embed_optimizer", default="shared",
@@ -163,6 +169,14 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
 
 
 def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
+    if getattr(args, "feature_cache", False) and getattr(args, "token_cache", False):
+        # Checked here, not in make_trainer: the feature-cache block runs
+        # first there and would encode whole splits through the backbone
+        # (minutes on a tunneled TPU) before the conflict surfaced.
+        raise ValueError(
+            "--token_cache and --feature_cache are exclusive (the feature "
+            "cache already runs in index mode)"
+        )
     compute = "bfloat16" if (args.bf16 or args.fp16) else "float32"
     train_iter = getattr(args, "train_iter", 0)
     val_iter = getattr(args, "val_iter", 1000)
@@ -194,6 +208,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         val_iter=val_iter, val_step=val_step, test_iter=args.test_iter,
         steps_per_call=getattr(args, "steps_per_call", 1),
         feature_cache=getattr(args, "feature_cache", False),
+        token_cache=getattr(args, "token_cache", False),
         device=args.device, compute_dtype=compute, seed=args.seed,
         dp=args.dp, tp=args.tp, sp=args.sp, pp=args.pp, ep=args.ep,
         pp_microbatches=args.pp_microbatches,
@@ -503,7 +518,91 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
             )
             tab = _put(ts.table)
             return ts, (lambda p, si, qi, l: _eval(p, tab, si, qi, l))
-    if use_mesh and not cfg.feature_cache:
+    token_test_eval = None
+    if cfg.token_cache:
+        # Device-resident token cache (train/token_cache.py): upload the
+        # tokenized dataset once, stream only episode indices per step. Same
+        # model, same episode statistics, same state tree — only the
+        # host->device transport changes (~3-4x e2e on the tunneled v5e).
+        if cfg.model == "pair" or cfg.adv:
+            raise ValueError(
+                "--token_cache does not serve --model pair or --adv "
+                "(pair consumes token pairs; the DANN domain samplers "
+                "stream separate unlabeled instances)"
+            )
+        from induction_network_on_fewrel_tpu.train.feature_cache import (
+            FeatureEpisodeSampler,
+        )
+        from induction_network_on_fewrel_tpu.train.token_cache import (
+            make_token_cached_eval_step,
+            make_token_cached_multi_train_step,
+            make_token_cached_train_step,
+            tokenize_dataset,
+        )
+
+        cache_mesh = mesh if use_mesh else None
+        if cache_mesh is not None and cfg.batch_size % cache_mesh.shape["dp"]:
+            raise ValueError(
+                f"--batch_size {cfg.batch_size} must be divisible by the "
+                f"data-parallel mesh axis dp={cache_mesh.shape['dp']}"
+            )
+        if cache_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            _tput = lambda x: jax.device_put(
+                x, NamedSharding(cache_mesh, PartitionSpec())
+            )
+        else:
+            _tput = jax.device_put
+        sup_t, qry_t, _ = batch_to_model_inputs(train_sampler.sample_batch())
+        state = init_state(model, cfg, sup_t, qry_t)
+        if cache_mesh is not None:
+            from induction_network_on_fewrel_tpu.parallel.sharding import (
+                shard_state,
+            )
+
+            state = shard_state(state, cache_mesh)
+        _eval = make_token_cached_eval_step(model, cfg, cache_mesh, state)
+
+        if not only_test:
+            tab_tr, sizes_tr = tokenize_dataset(train_ds, tok)
+            tab_va, sizes_va = tokenize_dataset(val_ds, tok)
+            for s in (train_sampler, val_sampler):
+                if hasattr(s, "close"):
+                    s.close()
+            train_sampler = FeatureEpisodeSampler(
+                sizes_tr, cfg.train_n, cfg.k, cfg.q, cfg.batch_size,
+                na_rate=cfg.na_rate, seed=cfg.seed,
+            )
+            val_sampler = FeatureEpisodeSampler(
+                sizes_va, cfg.n, cfg.k, cfg.q, cfg.batch_size,
+                na_rate=cfg.na_rate, seed=cfg.seed + 1,
+            )
+            table_tr = {k: _tput(v) for k, v in tab_tr.items()}
+            table_va = {k: _tput(v) for k, v in tab_va.items()}
+            _train = make_token_cached_train_step(model, cfg, cache_mesh, state)
+            train_step = lambda st, si, qi, l: _train(st, table_tr, si, qi, l)
+            eval_step = lambda p, si, qi, l: _eval(p, table_va, si, qi, l)
+            if cfg.steps_per_call > 1:
+                _multi = make_token_cached_multi_train_step(
+                    model, cfg, cache_mesh, state
+                )
+                fused_step = (
+                    lambda st, si, qi, l: _multi(st, table_tr, si, qi, l)
+                )
+
+        def token_test_eval(test_ds):
+            """(sampler, eval_step) for a test split: its own device-resident
+            token table bound to the shared cached eval step."""
+            tab_te, sizes_te = tokenize_dataset(test_ds, tok)
+            ts = FeatureEpisodeSampler(
+                sizes_te, cfg.n, cfg.k, cfg.q, cfg.batch_size,
+                na_rate=cfg.na_rate, seed=cfg.seed + 2,
+            )
+            table_te = {k: _tput(v) for k, v in tab_te.items()}
+            return ts, (lambda p, si, qi, l: _eval(p, table_te, si, qi, l))
+
+    if use_mesh and not cfg.feature_cache and not cfg.token_cache:
         dp = mesh.shape["dp"]
         if cfg.batch_size % dp != 0:
             raise ValueError(
@@ -613,10 +712,13 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
             if trainer.adv.multi_step is not None:
                 trainer.adv.multi_step = checkify_step(trainer.adv.multi_step)
     trainer.vocab, trainer.tokenizer = vocab, tok
-    # Cached-mode test evaluation factory (None on the token path): the test
-    # split needs its own feature table, encoded with the cache's backbone.
+    # Cached-mode test evaluation factory (None on the live-token path): the
+    # test split needs its own device table — features (encoded with the
+    # cache's backbone) or raw tokens.
     trainer.cached_test_eval = (
-        cached_test_eval if cfg.feature_cache else None
+        cached_test_eval if cfg.feature_cache
+        else token_test_eval if cfg.token_cache
+        else None
     )
     return trainer
 

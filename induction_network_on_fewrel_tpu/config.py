@@ -106,6 +106,10 @@ class ExperimentConfig:
     # dataset once, train the episode head on gathered features. Requires
     # --encoder bert with the frozen backbone; excludes pair/adv.
     feature_cache: bool = False
+    # Device-resident token cache (train/token_cache.py): upload the
+    # tokenized dataset once; per step only episode indices cross
+    # host->device. Any encoder, full training semantics; excludes pair/adv.
+    token_cache: bool = False
 
     # --- FewRel 2.0 adversarial domain adaptation (training-time only) ---
     adv: bool = False         # train encoder against a domain discriminator

@@ -142,7 +142,7 @@ class FeatureEpisodeSampler:
 
     def __init__(
         self,
-        blocks: list[np.ndarray],
+        blocks: "list[np.ndarray] | list[int]",
         n: int,
         k: int,
         q: int,
@@ -151,24 +151,35 @@ class FeatureEpisodeSampler:
         seed: int = 0,
         return_indices: bool = False,
     ):
+        """``blocks`` is either per-relation feature arrays, or — for pure
+        index sampling against an external table (train/token_cache.py) —
+        per-relation ROW COUNTS, which forces ``return_indices`` mode (there
+        is nothing here to gather from)."""
         if len(blocks) < n + (1 if na_rate > 0 else 0):
             raise ValueError(
                 f"need > {n} relations for N={n} with na_rate={na_rate}, "
                 f"got {len(blocks)}"
             )
-        for i, b in enumerate(blocks):
-            if b.shape[0] < k + q:
-                raise ValueError(f"relation #{i}: {b.shape[0]} < K+Q={k + q}")
-        self.blocks = blocks
+        sizes_only = blocks and isinstance(blocks[0], (int, np.integer))
+        sizes = (
+            [int(b) for b in blocks] if sizes_only
+            else [b.shape[0] for b in blocks]
+        )
+        for i, m in enumerate(sizes):
+            if m < k + q:
+                raise ValueError(f"relation #{i}: {m} < K+Q={k + q}")
+        self.sizes = sizes
         self.n, self.k, self.q = n, k, q
         self.batch_size, self.na_rate = batch_size, na_rate
         self.rng = np.random.default_rng(seed)
         # Flat table + per-relation row offsets: index mode samples GLOBAL
         # row ids so the device-resident table (make_cached_train_step) can
         # be gathered with a single take.
-        self.return_indices = return_indices
-        self.offsets = np.cumsum([0] + [b.shape[0] for b in blocks[:-1]])
-        self.table = np.concatenate(blocks).astype(np.float32)
+        self.return_indices = return_indices or sizes_only
+        self.offsets = np.cumsum([0] + sizes[:-1])
+        self.table = (
+            None if sizes_only else np.concatenate(blocks).astype(np.float32)
+        )
 
     @property
     def total_q(self) -> int:
@@ -178,21 +189,21 @@ class FeatureEpisodeSampler:
         """One episode of GLOBAL row indices: ([N,K], [TQ], [TQ]) int32."""
         n, k, q = self.n, self.k, self.q
         rng = self.rng
-        rel_ids = rng.choice(len(self.blocks), n, replace=False)
+        rel_ids = rng.choice(len(self.sizes), n, replace=False)
 
         sup, qry, labels = [], [], []
         for cls, rid in enumerate(rel_ids):
-            rows = self.blocks[rid].shape[0]
+            rows = self.sizes[rid]
             idx = rng.choice(rows, k + q, replace=False) + self.offsets[rid]
             sup.append(idx[:k])
             qry.append(idx[k:])
             labels.extend([cls] * q)
 
         if self.na_rate > 0:
-            outside = np.setdiff1d(np.arange(len(self.blocks)), rel_ids)
+            outside = np.setdiff1d(np.arange(len(self.sizes)), rel_ids)
             for _ in range(self.na_rate * q):
                 rid = int(rng.choice(outside))
-                row = int(rng.integers(self.blocks[rid].shape[0]))
+                row = int(rng.integers(self.sizes[rid]))
                 qry.append(np.asarray([row + self.offsets[rid]]))
                 labels.append(n)
 

@@ -1,0 +1,133 @@
+"""Device-resident token cache: upload the tokenized dataset once, stream
+only episode INDICES per step.
+
+Profiling the flagship bench config (XPlane, v5e, 2026-07-30) showed the
+device busy only ~1.3 ms of a ~4.3 ms wall step even at steps_per_call=64:
+the residual cost is host batch assembly plus the token batch crossing the
+tunneled host->device link (~6 MB per fused dispatch). But the dataset the
+batches are drawn from is tiny and static — FewRel train_wiki tokenizes to
+~16 MB — so the TPU-native layout is the same one the frozen-BERT feature
+cache uses (train/feature_cache.py), one level lower:
+
+1. ``tokenize_dataset`` — run the tokenizer over every instance once,
+   yielding one flat token table ``{word i32, pos1 i16, pos2 i16, mask i8}
+   [M_total, L]`` plus per-relation row counts. ``jax.device_put`` it once.
+2. ``FeatureEpisodeSampler(sizes, ...)`` in index mode — identical episode
+   statistics to the live sampler; per step only ``[B,N,K] + [B,TQ]`` int32
+   indices cross the link (~1 KB vs ~100 KB per step).
+3. The step gathers token rows ON DEVICE (``table[word][idx]`` inside jit)
+   and feeds the unchanged model — same math, same shapes, same episode
+   distribution; only the transport changed.
+
+Unlike the feature cache this is encoder-agnostic (the encoder still runs,
+trains, and backprops every step) and leaves the TrainState untouched, so
+checkpoints are interchangeable with the live-sampler path. Excluded:
+``pair`` (consumes token pairs, different input contract) and ``--adv``
+(domain samplers stream unlabeled instances separately).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from induction_network_on_fewrel_tpu.data.fewrel import FewRelDataset
+
+
+def tokenize_dataset(
+    dataset: FewRelDataset, tokenizer
+) -> tuple[dict[str, np.ndarray], list[int]]:
+    """Tokenize every instance once -> (flat token table, per-relation rows).
+
+    Wire dtypes match models/build.py's narrowing: pos offsets live in
+    [0, 2*max_length) (int16), mask in {0,1} (int8); word ids stay int32.
+    """
+    toks, rel_sizes = [], []
+    for rel in dataset.rel_names:
+        insts = dataset.instances[rel]
+        rel_sizes.append(len(insts))
+        toks.extend(tokenizer(inst) for inst in insts)
+    table = {
+        "word": np.stack([t.word for t in toks]).astype(np.int32),
+        "pos1": np.stack([t.pos1 for t in toks]).astype(np.int16),
+        "pos2": np.stack([t.pos2 for t in toks]).astype(np.int16),
+        "mask": np.stack([t.mask for t in toks]).astype(np.int8),
+    }
+    return table, rel_sizes
+
+
+def _gather(table: dict[str, Any], idx):
+    return {k: v[idx] for k, v in table.items()}
+
+
+def make_token_cached_train_step(model, cfg, mesh=None, state_example=None):
+    """jitted (state, table dict, sup_idx, qry_idx, label) -> (state, metrics).
+
+    The table is a jit ARGUMENT (device_put once by the caller), never a
+    closure — closed-over arrays bake into the program as constants and
+    blow the compile-RPC payload on tunneled backends.
+    """
+    import jax
+
+    from induction_network_on_fewrel_tpu.train.steps import make_update_body
+
+    body = make_update_body(model, cfg)
+
+    def step(state, table, sup_idx, qry_idx, label):
+        return body(state, (_gather(table, sup_idx), _gather(table, qry_idx), label))
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,))
+    return _shard(step, mesh, state_example)
+
+
+def make_token_cached_multi_train_step(model, cfg, mesh=None, state_example=None):
+    """steps_per_call twin: scan S stacked index batches against one table."""
+    import jax
+
+    from induction_network_on_fewrel_tpu.train.steps import make_update_body
+
+    body = make_update_body(model, cfg)
+
+    def multi_step(state, table, sup_idx_s, qry_idx_s, label_s):
+        def scan_body(st, xs):
+            si, qi, lab = xs
+            return body(st, (_gather(table, si), _gather(table, qi), lab))
+
+        return jax.lax.scan(scan_body, state, (sup_idx_s, qry_idx_s, label_s))
+
+    if mesh is None:
+        return jax.jit(multi_step, donate_argnums=(0,))
+    return _shard(multi_step, mesh, state_example, stacked=True)
+
+
+def make_token_cached_eval_step(model, cfg, mesh=None, state_example=None):
+    import jax
+
+    from induction_network_on_fewrel_tpu.models.losses import accuracy
+    from induction_network_on_fewrel_tpu.train.steps import LOSS_FNS
+
+    def step(params, table, sup_idx, qry_idx, label):
+        logits = model.apply(
+            params, _gather(table, sup_idx), _gather(table, qry_idx)
+        )
+        return {
+            "loss": LOSS_FNS[cfg.loss](logits, label),
+            "accuracy": accuracy(logits, label),
+        }
+
+    if mesh is None:
+        return jax.jit(step)
+    return _shard(step, mesh, state_example, params_only=True)
+
+
+def _shard(fn, mesh, state_example, stacked=False, params_only=False):
+    """Cached-path shardings — delegated to feature_cache._shard_cached:
+    state per the standard rules, the table replicated (the bare replicated
+    sharding it declares for its table arg is a PREFIX pytree, so it covers
+    this path's {word,pos1,pos2,mask} dict exactly as it covers a single
+    feature array), index/label episode axes over 'dp'."""
+    from induction_network_on_fewrel_tpu.train.feature_cache import _shard_cached
+
+    return _shard_cached(fn, mesh, state_example, stacked, params_only)

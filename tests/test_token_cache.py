@@ -1,0 +1,175 @@
+"""Device-resident token cache (train/token_cache.py): the index path must
+be a pure transport change — same episodes produce bitwise-identical
+training to the live token path."""
+
+import jax
+import numpy as np
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    GloveTokenizer,
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.train.feature_cache import (
+    FeatureEpisodeSampler,
+)
+from induction_network_on_fewrel_tpu.train.steps import (
+    init_state,
+    make_train_step,
+)
+from induction_network_on_fewrel_tpu.train.token_cache import (
+    make_token_cached_eval_step,
+    make_token_cached_multi_train_step,
+    make_token_cached_train_step,
+    tokenize_dataset,
+)
+
+L = 16
+CFG = ExperimentConfig(
+    encoder="cnn", n=3, k=2, q=2, batch_size=4, max_length=L, vocab_size=302,
+    compute_dtype="float32", lr=1e-3, weight_decay=0.0,
+)
+
+
+def _setup():
+    vocab = make_synthetic_glove(vocab_size=300)
+    ds = make_synthetic_fewrel(
+        num_relations=6, instances_per_relation=10, vocab_size=300
+    )
+    tok = GloveTokenizer(vocab, max_length=L)
+    model = build_model(CFG, glove_init=vocab.vectors)
+    table, sizes = tokenize_dataset(ds, tok)
+    return model, table, sizes
+
+
+def test_tokenize_dataset_shapes_and_dtypes():
+    _, table, sizes = _setup()
+    M = sum(sizes)
+    assert table["word"].shape == (M, L) and table["word"].dtype == np.int32
+    assert table["pos1"].dtype == np.int16 and table["pos2"].dtype == np.int16
+    assert table["mask"].dtype == np.int8
+    assert len(sizes) == 6 and all(s == 10 for s in sizes)
+
+
+def test_size_only_sampler_matches_array_sampler_indices():
+    """FeatureEpisodeSampler(sizes) draws the same index stream as
+    FeatureEpisodeSampler(arrays, return_indices=True) for the same seed."""
+    _, table, sizes = _setup()
+    blocks = [np.zeros((m, 4), np.float32) for m in sizes]
+    a = FeatureEpisodeSampler(sizes, 3, 2, 2, 4, na_rate=1, seed=5)
+    b = FeatureEpisodeSampler(blocks, 3, 2, 2, 4, na_rate=1, seed=5,
+                              return_indices=True)
+    ba, bb = a.sample_batch(), b.sample_batch()
+    np.testing.assert_array_equal(ba.support_idx, bb.support_idx)
+    np.testing.assert_array_equal(ba.query_idx, bb.query_idx)
+    np.testing.assert_array_equal(ba.label, bb.label)
+
+
+def test_token_cached_step_equals_live_step_on_same_episode():
+    """Gathering tokens on device from indices == feeding the same tokens
+    directly: identical loss and identical updated params."""
+    model, table, sizes = _setup()
+    sampler = FeatureEpisodeSampler(
+        sizes, CFG.n, CFG.k, CFG.q, CFG.batch_size, seed=2
+    )
+    batch = sampler.sample_batch()
+    # Host-side gather reproduces exactly what the live path would feed
+    # (including models/build.py's wire dtypes, which tokenize_dataset
+    # already applied).
+    sup = {k: v[batch.support_idx] for k, v in table.items()}
+    qry = {k: v[batch.query_idx] for k, v in table.items()}
+
+    state_a = init_state(model, CFG, sup, qry)
+    state_b = jax.tree.map(
+        lambda x: x.copy() if hasattr(x, "copy") else x, state_a
+    )
+    live = make_train_step(model, CFG)
+    cached = make_token_cached_train_step(model, CFG)
+    dev_table = jax.device_put(table)
+
+    state_a, m_a = live(state_a, sup, qry, batch.label)
+    state_b, m_b = cached(
+        state_b, dev_table, batch.support_idx, batch.query_idx, batch.label
+    )
+    np.testing.assert_allclose(float(m_a["loss"]), float(m_b["loss"]),
+                               rtol=1e-6, atol=1e-7)
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(state_a.params)),
+        jax.tree.leaves(jax.device_get(state_b.params)),
+    ):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_token_cached_multi_step_and_eval():
+    """Fused S-step scan over stacked index batches trains (finite metrics,
+    params move); the eval step scores against the same table."""
+    model, table, sizes = _setup()
+    sampler = FeatureEpisodeSampler(
+        sizes, CFG.n, CFG.k, CFG.q, CFG.batch_size, seed=3
+    )
+    dev_table = jax.device_put(table)
+    b0 = sampler.sample_batch()
+    sup = {k: v[b0.support_idx] for k, v in table.items()}
+    qry = {k: v[b0.query_idx] for k, v in table.items()}
+    state = init_state(model, CFG, sup, qry)
+
+    S = 3
+    batches = [sampler.sample_batch() for _ in range(S)]
+    si = np.stack([b.support_idx for b in batches])
+    qi = np.stack([b.query_idx for b in batches])
+    lab = np.stack([b.label for b in batches])
+    multi = make_token_cached_multi_train_step(model, CFG)
+    state, metrics = multi(state, dev_table, si, qi, lab)
+    assert metrics["loss"].shape == (S,)
+    assert np.isfinite(np.asarray(metrics["loss"])).all()
+
+    ev = make_token_cached_eval_step(model, CFG)
+    out = ev(state.params, dev_table, b0.support_idx, b0.query_idx, b0.label)
+    assert np.isfinite(float(out["loss"]))
+
+
+def test_token_cached_mesh_step_matches_single_device():
+    """(dp=2) GSPMD token-cached step == single-device token-cached step."""
+    from induction_network_on_fewrel_tpu.parallel import make_mesh
+
+    model, table, sizes = _setup()
+    sampler = FeatureEpisodeSampler(
+        sizes, CFG.n, CFG.k, CFG.q, CFG.batch_size, seed=4
+    )
+    b0 = sampler.sample_batch()
+    sup = {k: v[b0.support_idx] for k, v in table.items()}
+    qry = {k: v[b0.query_idx] for k, v in table.items()}
+    state_a = init_state(model, CFG, sup, qry)
+    state_b = jax.tree.map(
+        lambda x: x.copy() if hasattr(x, "copy") else x, state_a
+    )
+
+    single = make_token_cached_train_step(model, CFG)
+    mesh = make_mesh(dp=2, devices=jax.devices()[:2])
+    sharded = make_token_cached_train_step(model, CFG, mesh, state_a)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    tab_repl = {
+        k: jax.device_put(v, NamedSharding(mesh, PartitionSpec()))
+        for k, v in table.items()
+    }
+    dev_table = jax.device_put(table)
+
+    for _ in range(2):
+        b = sampler.sample_batch()
+        state_a, m_a = single(
+            state_a, dev_table, b.support_idx, b.query_idx, b.label
+        )
+        state_b, m_b = sharded(
+            state_b, tab_repl, b.support_idx, b.query_idx, b.label
+        )
+        np.testing.assert_allclose(
+            float(m_a["loss"]), float(m_b["loss"]), rtol=1e-5, atol=1e-6
+        )
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(state_a.params)),
+        jax.tree.leaves(jax.device_get(state_b.params)),
+    ):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
