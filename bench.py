@@ -46,12 +46,14 @@ BASELINE_EPS_TPU = 1264.0
 
 BATCH = 8            # episodes per step
 # Optimizer steps fused per dispatch (lax.scan). Hard-synced sweep on the
-# tunneled TPU: spc 1 -> 975, 16 -> 1678, 64 -> 1840, 128 -> 1829 eps/s
-# TRUE; 64 is the knee.
-STEPS_PER_CALL = int(os.environ.get("BENCH_SPC", "64"))
+# tunneled TPU, token-cache path (2026-07-30): spc 64 -> 3066, 128 -> 3531,
+# 256 -> 4166, 512 -> 4553, 1024 -> 4684 eps/s TRUE. 512 balances the
+# asymptote against chunk granularity (device busy ~1.3 ms/step puts the
+# ceiling near 6.3k at B=8).
+STEPS_PER_CALL = int(os.environ.get("BENCH_SPC", "512"))
 WARMUP_STEPS = 5
 CHUNK_STEPS = 2 * STEPS_PER_CALL
-MAX_STEPS = 500
+MAX_STEPS = 8192
 MAX_SECONDS = 60.0
 
 

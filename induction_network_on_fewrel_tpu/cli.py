@@ -312,10 +312,15 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
                 vocab_size=vocab.vocab_size, word_dim=vocab.word_dim
             )
         tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    # Token-cache runs replace these samplers with index samplers right
+    # after drawing one init-shape batch — don't spin up the native
+    # prefetching pipeline (threads + 16 queued batches) just to discard it.
+    live_backend = "python" if cfg.token_cache else cfg.sampler
+    live_prefetch = 0 if cfg.token_cache else cfg.prefetch
     train_sampler = make_sampler(
         train_ds, tok, cfg.train_n, cfg.k, cfg.q, cfg.batch_size,
-        na_rate=cfg.na_rate, seed=cfg.seed, backend=cfg.sampler,
-        prefetch=cfg.prefetch, num_threads=cfg.sampler_threads,
+        na_rate=cfg.na_rate, seed=cfg.seed, backend=live_backend,
+        prefetch=live_prefetch, num_threads=cfg.sampler_threads,
     )
     # Eval streams must be reproducible across machines: under "auto" the
     # backend would depend on whether a g++ toolchain is present (native and

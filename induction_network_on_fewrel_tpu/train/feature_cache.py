@@ -160,7 +160,7 @@ class FeatureEpisodeSampler:
                 f"need > {n} relations for N={n} with na_rate={na_rate}, "
                 f"got {len(blocks)}"
             )
-        sizes_only = blocks and isinstance(blocks[0], (int, np.integer))
+        sizes_only = isinstance(blocks[0], (int, np.integer))
         sizes = (
             [int(b) for b in blocks] if sizes_only
             else [b.shape[0] for b in blocks]
