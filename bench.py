@@ -103,6 +103,15 @@ def main() -> int:
     n_chips = jax.local_device_count()
     print(f"bench: backend={backend} chips={n_chips}", file=sys.stderr)
 
+    # The deep-fusion default is sized for the TPU; on the CPU fallback a
+    # 512-step fused call (and 1024-step chunks between MAX_SECONDS checks)
+    # would grind for many minutes before the first timing line.
+    global STEPS_PER_CALL, CHUNK_STEPS, MAX_STEPS
+    if backend != "tpu":
+        STEPS_PER_CALL = min(STEPS_PER_CALL, 16)
+        CHUNK_STEPS = 2 * STEPS_PER_CALL
+        MAX_STEPS = min(MAX_STEPS, 256)
+
     cfg = ExperimentConfig(
         encoder="bilstm", n=5, k=5, q=5, batch_size=BATCH, max_length=40,
         vocab_size=2002, compute_dtype="bfloat16",

@@ -270,6 +270,85 @@ def load_data(args, cfg: ExperimentConfig, split: str):
     )
 
 
+def _wire_index_cache(cfg, model, cache_mesh, state, only_test,
+                      train_ds, val_ds, train_sampler, val_sampler,
+                      build_table, factories):
+    """Shared wiring for the index-transfer cache paths (feature cache and
+    token cache): build per-split device-resident tables, swap the live
+    samplers for index samplers with identical episode statistics, and bind
+    the cached step factories to each split's table. Per step only
+    [B,N,K]+[B,TQ] int32 indices cross the host->device boundary; the
+    gather runs inside the jitted step.
+
+    ``build_table(ds) -> (device table, per-relation sizes)`` — the table is
+    opaque here (a [M,H] feature array or a token dict); every cached step
+    takes it as one argument. ``factories``: "train"/"multi"/"eval" step
+    factories, each ``(model, cfg, mesh, state_example) -> jitted fn``.
+
+    Returns (train_sampler, val_sampler, train_step, eval_step, fused_step,
+    test_eval_factory).
+    """
+    from induction_network_on_fewrel_tpu.train.feature_cache import (
+        FeatureEpisodeSampler,
+    )
+
+    if cache_mesh is not None and cfg.batch_size % cache_mesh.shape["dp"]:
+        raise ValueError(
+            f"--batch_size {cfg.batch_size} must be divisible by the "
+            f"data-parallel mesh axis dp={cache_mesh.shape['dp']}"
+        )
+    _eval = factories["eval"](model, cfg, cache_mesh, state)
+    train_step = eval_step = fused_step = None
+    if not only_test:
+        table_tr, sizes_tr = build_table(train_ds)
+        table_va, sizes_va = build_table(val_ds)
+        for s in (train_sampler, val_sampler):
+            if hasattr(s, "close"):
+                s.close()
+        train_sampler = FeatureEpisodeSampler(
+            sizes_tr, cfg.train_n, cfg.k, cfg.q, cfg.batch_size,
+            na_rate=cfg.na_rate, seed=cfg.seed,
+        )
+        val_sampler = FeatureEpisodeSampler(
+            sizes_va, cfg.n, cfg.k, cfg.q, cfg.batch_size,
+            na_rate=cfg.na_rate, seed=cfg.seed + 1,
+        )
+        _train = factories["train"](model, cfg, cache_mesh, state)
+        train_step = lambda st, si, qi, l: _train(st, table_tr, si, qi, l)
+        eval_step = lambda p, si, qi, l: _eval(p, table_va, si, qi, l)
+        if cfg.steps_per_call > 1:
+            _multi = factories["multi"](model, cfg, cache_mesh, state)
+            fused_step = lambda st, si, qi, l: _multi(st, table_tr, si, qi, l)
+
+    def test_eval(test_ds):
+        """(sampler, eval_step) for a test split: its own device-resident
+        table bound to the shared cached eval step."""
+        table_te, sizes_te = build_table(test_ds)
+        ts = FeatureEpisodeSampler(
+            sizes_te, cfg.n, cfg.k, cfg.q, cfg.batch_size,
+            na_rate=cfg.na_rate, seed=cfg.seed + 2,
+        )
+        return ts, (lambda p, si, qi, l: _eval(p, table_te, si, qi, l))
+
+    return (train_sampler, val_sampler, train_step, eval_step, fused_step,
+            test_eval)
+
+
+def _cache_table_put(cache_mesh):
+    """Device placement for cache tables: replicated NamedSharding on a
+    mesh (a bare device_put would force a whole-table reshard copy every
+    step), plain device_put on a single device."""
+    import jax
+
+    if cache_mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return lambda x: jax.device_put(
+            x, NamedSharding(cache_mesh, PartitionSpec())
+        )
+    return jax.device_put
+
+
 def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
     """Wire data, model, (possibly mesh-sharded) steps, ckpt, and logger."""
     import jax
@@ -312,11 +391,12 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
                 vocab_size=vocab.vocab_size, word_dim=vocab.word_dim
             )
         tok = GloveTokenizer(vocab, max_length=cfg.max_length)
-    # Token-cache runs replace these samplers with index samplers right
-    # after drawing one init-shape batch — don't spin up the native
-    # prefetching pipeline (threads + 16 queued batches) just to discard it.
-    live_backend = "python" if cfg.token_cache else cfg.sampler
-    live_prefetch = 0 if cfg.token_cache else cfg.prefetch
+    # Cache runs (token or feature) replace these samplers with index
+    # samplers right after drawing one init-shape batch — don't spin up the
+    # native prefetching pipeline (threads + 16 queued batches) for that.
+    caching = cfg.token_cache or cfg.feature_cache
+    live_backend = "python" if caching else cfg.sampler
+    live_prefetch = 0 if caching else cfg.prefetch
     train_sampler = make_sampler(
         train_ds, tok, cfg.train_n, cfg.k, cfg.q, cfg.batch_size,
         na_rate=cfg.na_rate, seed=cfg.seed, backend=live_backend,
@@ -390,6 +470,7 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         cfg, glove_init=vocab.vectors if vocab is not None else None,
         attn_impl=attn_impl, pipeline_impl=pipeline_impl,
     )
+    cache_test_eval = None  # set by either index-cache path below
     if cfg.feature_cache:
         # Frozen-encoder feature cache (train/feature_cache.py): encode both
         # splits once with the frozen backbone, then swap the token samplers
@@ -410,7 +491,6 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
                 "the encoder, which the cache freezes out of the step"
             )
         from induction_network_on_fewrel_tpu.train.feature_cache import (
-            FeatureEpisodeSampler,
             encode_dataset,
             make_cached_eval_step,
             make_cached_multi_train_step,
@@ -444,22 +524,7 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
                   f"{cfg.bert_weights}", file=sys.stderr)
         encode_fn = make_encode_fn(model)  # one compile for all splits
         cache_mesh = mesh if use_mesh else None  # built above with attn_impl
-        if cache_mesh is not None and cfg.batch_size % cache_mesh.shape["dp"]:
-            raise ValueError(
-                f"--batch_size {cfg.batch_size} must be divisible by the "
-                f"data-parallel mesh axis dp={cache_mesh.shape['dp']}"
-            )
-        if cache_mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            # Place tables with the replicated sharding the cached steps
-            # declare; a bare device_put would force a whole-table reshard
-            # copy on every step.
-            _put = lambda x: jax.device_put(
-                x, NamedSharding(cache_mesh, PartitionSpec())
-            )
-        else:
-            _put = jax.device_put
+        _put = _cache_table_put(cache_mesh)
         # Head-only state (flax lazy param creation: init on feature-shaped
         # inputs builds no backbone params, so the optimizer never sees the
         # frozen 110M either). Zero arrays suffice — init reads shapes, not
@@ -476,54 +541,23 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
             )
 
             state = shard_state(state, cache_mesh)
-        _eval = make_cached_eval_step(model, cfg, cache_mesh, state)
 
-        if not only_test:
-            blocks_tr = encode_dataset(model, full_params, train_ds, tok,
-                                       encode_fn=encode_fn)
-            blocks_va = encode_dataset(model, full_params, val_ds, tok,
-                                       encode_fn=encode_fn)
-            for s in (train_sampler, val_sampler):
-                if hasattr(s, "close"):
-                    s.close()
-            # Index mode: the feature tables live ON DEVICE; per step only
-            # [B,N,K]+[B,TQ] int32 indices cross the host->device boundary
-            # (~1 KB vs ~500 KB of materialized features) and the gather
-            # runs inside the jitted step.
-            train_sampler = FeatureEpisodeSampler(
-                blocks_tr, cfg.train_n, cfg.k, cfg.q, cfg.batch_size,
-                na_rate=cfg.na_rate, seed=cfg.seed, return_indices=True,
-            )
-            val_sampler = FeatureEpisodeSampler(
-                blocks_va, cfg.n, cfg.k, cfg.q, cfg.batch_size,
-                na_rate=cfg.na_rate, seed=cfg.seed + 1, return_indices=True,
-            )
-            table_tr = _put(train_sampler.table)
-            table_va = _put(val_sampler.table)
-            _train = make_cached_train_step(model, cfg, cache_mesh, state)
-            train_step = lambda st, si, qi, l: _train(st, table_tr, si, qi, l)
-            eval_step = lambda p, si, qi, l: _eval(p, table_va, si, qi, l)
-            if cfg.steps_per_call > 1:
-                _multi = make_cached_multi_train_step(
-                    model, cfg, cache_mesh, state
-                )
-                fused_step = (
-                    lambda st, si, qi, l: _multi(st, table_tr, si, qi, l)
-                )
+        def build_table(ds):
+            """Encode a split with the cache's backbone -> one flat device
+            feature table + per-relation row counts."""
+            blocks = encode_dataset(model, full_params, ds, tok,
+                                    encode_fn=encode_fn)
+            table = _put(np.concatenate(blocks).astype(np.float32))
+            return table, [b.shape[0] for b in blocks]
 
-        def cached_test_eval(test_ds):
-            """(sampler, eval_step) for a test split under the cache: encode
-            it with the SAME backbone params the train/val caches used, and
-            bind a cached eval step to its own device table."""
-            blocks_te = encode_dataset(model, full_params, test_ds, tok,
-                                       encode_fn=encode_fn)
-            ts = FeatureEpisodeSampler(
-                blocks_te, cfg.n, cfg.k, cfg.q, cfg.batch_size,
-                na_rate=cfg.na_rate, seed=cfg.seed + 2, return_indices=True,
-            )
-            tab = _put(ts.table)
-            return ts, (lambda p, si, qi, l: _eval(p, tab, si, qi, l))
-    token_test_eval = None
+        (train_sampler, val_sampler, train_step, eval_step, fused_step,
+         cache_test_eval) = _wire_index_cache(
+            cfg, model, cache_mesh, state, only_test, train_ds, val_ds,
+            train_sampler, val_sampler, build_table,
+            {"train": make_cached_train_step,
+             "multi": make_cached_multi_train_step,
+             "eval": make_cached_eval_step},
+        )
     if cfg.token_cache:
         # Device-resident token cache (train/token_cache.py): upload the
         # tokenized dataset once, stream only episode indices per step. Same
@@ -535,9 +569,6 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
                 "(pair consumes token pairs; the DANN domain samplers "
                 "stream separate unlabeled instances)"
             )
-        from induction_network_on_fewrel_tpu.train.feature_cache import (
-            FeatureEpisodeSampler,
-        )
         from induction_network_on_fewrel_tpu.train.token_cache import (
             make_token_cached_eval_step,
             make_token_cached_multi_train_step,
@@ -547,18 +578,13 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
 
         cache_mesh = mesh if use_mesh else None
         if cache_mesh is not None and cfg.batch_size % cache_mesh.shape["dp"]:
+            # Checked here too (not only in _wire_index_cache): the full
+            # model init below is the expensive part of this path.
             raise ValueError(
                 f"--batch_size {cfg.batch_size} must be divisible by the "
                 f"data-parallel mesh axis dp={cache_mesh.shape['dp']}"
             )
-        if cache_mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            _tput = lambda x: jax.device_put(
-                x, NamedSharding(cache_mesh, PartitionSpec())
-            )
-        else:
-            _tput = jax.device_put
+        _tput = _cache_table_put(cache_mesh)
         sup_t, qry_t, _ = batch_to_model_inputs(train_sampler.sample_batch())
         state = init_state(model, cfg, sup_t, qry_t)
         if cache_mesh is not None:
@@ -567,45 +593,20 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
             )
 
             state = shard_state(state, cache_mesh)
-        _eval = make_token_cached_eval_step(model, cfg, cache_mesh, state)
 
-        if not only_test:
-            tab_tr, sizes_tr = tokenize_dataset(train_ds, tok)
-            tab_va, sizes_va = tokenize_dataset(val_ds, tok)
-            for s in (train_sampler, val_sampler):
-                if hasattr(s, "close"):
-                    s.close()
-            train_sampler = FeatureEpisodeSampler(
-                sizes_tr, cfg.train_n, cfg.k, cfg.q, cfg.batch_size,
-                na_rate=cfg.na_rate, seed=cfg.seed,
-            )
-            val_sampler = FeatureEpisodeSampler(
-                sizes_va, cfg.n, cfg.k, cfg.q, cfg.batch_size,
-                na_rate=cfg.na_rate, seed=cfg.seed + 1,
-            )
-            table_tr = {k: _tput(v) for k, v in tab_tr.items()}
-            table_va = {k: _tput(v) for k, v in tab_va.items()}
-            _train = make_token_cached_train_step(model, cfg, cache_mesh, state)
-            train_step = lambda st, si, qi, l: _train(st, table_tr, si, qi, l)
-            eval_step = lambda p, si, qi, l: _eval(p, table_va, si, qi, l)
-            if cfg.steps_per_call > 1:
-                _multi = make_token_cached_multi_train_step(
-                    model, cfg, cache_mesh, state
-                )
-                fused_step = (
-                    lambda st, si, qi, l: _multi(st, table_tr, si, qi, l)
-                )
+        def build_table(ds):
+            """Tokenize a split once -> device-resident token dict + sizes."""
+            tab, sizes = tokenize_dataset(ds, tok)
+            return {k: _tput(v) for k, v in tab.items()}, sizes
 
-        def token_test_eval(test_ds):
-            """(sampler, eval_step) for a test split: its own device-resident
-            token table bound to the shared cached eval step."""
-            tab_te, sizes_te = tokenize_dataset(test_ds, tok)
-            ts = FeatureEpisodeSampler(
-                sizes_te, cfg.n, cfg.k, cfg.q, cfg.batch_size,
-                na_rate=cfg.na_rate, seed=cfg.seed + 2,
-            )
-            table_te = {k: _tput(v) for k, v in tab_te.items()}
-            return ts, (lambda p, si, qi, l: _eval(p, table_te, si, qi, l))
+        (train_sampler, val_sampler, train_step, eval_step, fused_step,
+         cache_test_eval) = _wire_index_cache(
+            cfg, model, cache_mesh, state, only_test, train_ds, val_ds,
+            train_sampler, val_sampler, build_table,
+            {"train": make_token_cached_train_step,
+             "multi": make_token_cached_multi_train_step,
+             "eval": make_token_cached_eval_step},
+        )
 
     if use_mesh and not cfg.feature_cache and not cfg.token_cache:
         dp = mesh.shape["dp"]
@@ -720,11 +721,7 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
     # Cached-mode test evaluation factory (None on the live-token path): the
     # test split needs its own device table — features (encoded with the
     # cache's backbone) or raw tokens.
-    trainer.cached_test_eval = (
-        cached_test_eval if cfg.feature_cache
-        else token_test_eval if cfg.token_cache
-        else None
-    )
+    trainer.cached_test_eval = cache_test_eval
     return trainer
 
 
