@@ -80,7 +80,9 @@ class BiLSTMSelfAttnEncoder(nn.Module):
         # Sequential-free input projection: one big MXU matmul over all
         # timesteps; only the recurrence below runs per-step.
         xg = both @ w_ih.astype(self.compute_dtype) + b.astype(self.compute_dtype)
-        hs = lstm_recurrence(xg, w_hh, backend=self.lstm_backend)  # [2M, L, u] f32
+        # [2M, L, u] in xg's dtype (pallas; f32 internal recurrence) or f32
+        # (scan) — consumers see compute_dtype either way.
+        hs = lstm_recurrence(xg, w_hh, backend=self.lstm_backend)
         hs = hs.astype(self.compute_dtype)
         h_fwd, h_bwd = hs[:M], jnp.flip(hs[M:], axis=1)
         H = jnp.concatenate([h_fwd, h_bwd], axis=-1)   # [M, L, 2u]

@@ -101,7 +101,7 @@ def _fwd_kernel(xg_ref, whh_ref, hs_ref, cs_ref, h_scr, c_scr):
         h_scr[...] = jnp.zeros_like(h_scr)
         c_scr[...] = jnp.zeros_like(c_scr)
 
-    a = xg_ref[0] + jnp.dot(
+    a = xg_ref[0].astype(jnp.float32) + jnp.dot(
         h_scr[...], whh_ref[...], preferred_element_type=jnp.float32
     )
     i, f, g, o = _gates(a, u)
@@ -109,8 +109,8 @@ def _fwd_kernel(xg_ref, whh_ref, hs_ref, cs_ref, h_scr, c_scr):
     h = o * jnp.tanh(c)
     h_scr[...] = h
     c_scr[...] = c
-    hs_ref[0] = h
-    cs_ref[0] = c
+    hs_ref[0] = h.astype(hs_ref.dtype)
+    cs_ref[0] = c.astype(cs_ref.dtype)
 
 
 def _fwd_kernel_infer(xg_ref, whh_ref, hs_ref, h_scr, c_scr):
@@ -128,7 +128,7 @@ def _fwd_kernel_infer(xg_ref, whh_ref, hs_ref, h_scr, c_scr):
         h_scr[...] = jnp.zeros_like(h_scr)
         c_scr[...] = jnp.zeros_like(c_scr)
 
-    a = xg_ref[0] + jnp.dot(
+    a = xg_ref[0].astype(jnp.float32) + jnp.dot(
         h_scr[...], whh_ref[...], preferred_element_type=jnp.float32
     )
     i, f, g, o = _gates(a, u)
@@ -136,7 +136,7 @@ def _fwd_kernel_infer(xg_ref, whh_ref, hs_ref, h_scr, c_scr):
     h = o * jnp.tanh(c)
     h_scr[...] = h
     c_scr[...] = c
-    hs_ref[0] = h
+    hs_ref[0] = h.astype(hs_ref.dtype)
 
 
 def _bwd_kernel(
@@ -154,22 +154,22 @@ def _bwd_kernel(
         dc_scr[...] = jnp.zeros_like(dc_scr)
         dwhh_scr[...] = jnp.zeros_like(dwhh_scr)
 
-    c_t = cs_ref[0]
+    c_t = cs_ref[0].astype(jnp.float32)
     tc = jnp.tanh(c_t)
     # The rt-1 index maps clamp at 0; mask the rt == 0 step to the true
     # zero initial state.
     first = (rt == 0).astype(jnp.float32)
-    c_prev = cs_prev_ref[0] * (1.0 - first)
-    h_prev = hs_prev_ref[0] * (1.0 - first)
+    c_prev = cs_prev_ref[0].astype(jnp.float32) * (1.0 - first)
+    h_prev = hs_prev_ref[0].astype(jnp.float32) * (1.0 - first)
 
     # Recompute the gate activations the forward did not save: one extra
     # [TM, u] x [u, 4u] matmul instead of reading 4u residuals from HBM.
-    a = xg_ref[0] + jnp.dot(
+    a = xg_ref[0].astype(jnp.float32) + jnp.dot(
         h_prev, whh_ref[...], preferred_element_type=jnp.float32
     )
     i, f, g, o = _gates(a, u)
 
-    dh_t = dhs_ref[0] + dh_scr[...]
+    dh_t = dhs_ref[0].astype(jnp.float32) + dh_scr[...]
     da_o = dh_t * tc * o * (1.0 - o)
     dct = dc_scr[...] + dh_t * o * (1.0 - tc * tc)
     da_i = dct * g * i * (1.0 - i)
@@ -177,7 +177,7 @@ def _bwd_kernel(
     da_f = dct * c_prev * f * (1.0 - f)
     da = jnp.concatenate([da_i, da_f, da_g, da_o], axis=-1)  # [TM, 4u]
 
-    dxg_ref[0] = da
+    dxg_ref[0] = da.astype(dxg_ref.dtype)
     dh_scr[...] = jax.lax.dot_general(
         da, whh_ref[...], (((1,), (1,)), ((), ())),  # da @ whh^T
         preferred_element_type=jnp.float32,
@@ -198,12 +198,19 @@ def _pad_rows(x: jnp.ndarray, tm: int) -> jnp.ndarray:
 
 def _fwd_call(xg: jnp.ndarray, whh: jnp.ndarray, interpret: bool):
     """Returns (hs [M,L,u], residuals xg_t/hs_t/cs_t all TIME-MAJOR
-    [L,Mp,*]). Gate activations are recomputed in the backward kernel."""
+    [L,Mp,*]). Gate activations are recomputed in the backward kernel.
+
+    Dtype-polymorphic: hs/cs residuals and outputs carry xg's dtype (the
+    VMEM recurrence math is always float32). In bf16 compute mode that
+    halves the kernel's HBM traffic and removes the f32<->bf16 convert
+    passes XLA otherwise wraps around the kernel; in f32 mode nothing
+    changes (golden tests pin that path at 1e-5)."""
     M, L, G = xg.shape
     u = G // 4
-    xg32 = _pad_rows(xg.astype(jnp.float32), _TM)
-    Mp = xg32.shape[0]
-    xg_t = jnp.swapaxes(xg32, 0, 1)  # [L, Mp, G] time-major for the kernel
+    dt = xg.dtype
+    xg_p = _pad_rows(xg, _TM)
+    Mp = xg_p.shape[0]
+    xg_t = jnp.swapaxes(xg_p, 0, 1)  # [L, Mp, G] time-major for the kernel
     grid = (Mp // _TM, L)
     out = pl.pallas_call(
         _fwd_kernel,
@@ -217,8 +224,8 @@ def _fwd_call(xg: jnp.ndarray, whh: jnp.ndarray, interpret: bool):
             pl.BlockSpec((1, _TM, u), lambda i, t: (t, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((L, Mp, u), jnp.float32),  # hs
-            jax.ShapeDtypeStruct((L, Mp, u), jnp.float32),  # cs
+            jax.ShapeDtypeStruct((L, Mp, u), dt),  # hs
+            jax.ShapeDtypeStruct((L, Mp, u), dt),  # cs
         ],
         scratch_shapes=[
             pltpu.VMEM((_TM, u), jnp.float32),
@@ -235,9 +242,9 @@ def _fwd_call(xg: jnp.ndarray, whh: jnp.ndarray, interpret: bool):
 def _fwd_call_infer(xg: jnp.ndarray, whh: jnp.ndarray, interpret: bool):
     M, L, G = xg.shape
     u = G // 4
-    xg32 = _pad_rows(xg.astype(jnp.float32), _TM)
-    Mp = xg32.shape[0]
-    xg_t = jnp.swapaxes(xg32, 0, 1)  # [L, Mp, G]
+    xg_p = _pad_rows(xg, _TM)
+    Mp = xg_p.shape[0]
+    xg_t = jnp.swapaxes(xg_p, 0, 1)  # [L, Mp, G]
     grid = (Mp // _TM, L)
     hs = pl.pallas_call(
         _fwd_kernel_infer,
@@ -247,7 +254,7 @@ def _fwd_call_infer(xg: jnp.ndarray, whh: jnp.ndarray, interpret: bool):
             pl.BlockSpec((u, G), lambda i, t: (0, 0)),
         ],
         out_specs=pl.BlockSpec((1, _TM, u), lambda i, t: (t, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((L, Mp, u), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((L, Mp, u), xg.dtype),
         scratch_shapes=[
             pltpu.VMEM((_TM, u), jnp.float32),
             pltpu.VMEM((_TM, u), jnp.float32),
@@ -262,7 +269,7 @@ def _bwd_call(dhs, xg_t, cs_t, hs_t, whh, interpret: bool):
     residuals [L, Mp, *] straight from the forward call."""
     M, L, u = dhs.shape
     G = 4 * u
-    dhs_t = jnp.swapaxes(_pad_rows(dhs.astype(jnp.float32), _TM), 0, 1)
+    dhs_t = jnp.swapaxes(_pad_rows(dhs, _TM), 0, 1)
     Mp = dhs_t.shape[1]
     ntiles = Mp // _TM
     grid = (ntiles, L)
@@ -284,7 +291,9 @@ def _bwd_call(dhs, xg_t, cs_t, hs_t, whh, interpret: bool):
             pl.BlockSpec((1, u, G), lambda i, t: (i, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((L, Mp, G), jnp.float32),
+            # dxg matches xg's dtype (the custom-VJP contract); dwhh stays
+            # f32 — it is the cotangent of the f32 weight param.
+            jax.ShapeDtypeStruct((L, Mp, G), xg_t.dtype),
             jax.ShapeDtypeStruct((ntiles, u, G), jnp.float32),
         ],
         scratch_shapes=[
@@ -303,9 +312,9 @@ def max_0(v):
     return jnp.maximum(v, 0)
 
 
-# The custom-VJP function is float32-in/float32-out; lstm_recurrence casts
-# at the boundary, so autodiff transposes those casts and the residual tree
-# stays arrays-only.
+# Dtype-polymorphic custom VJP: hs (and dxg) carry xg's dtype; whh and
+# dwhh are always float32 (the param dtype). The VMEM recurrence math is
+# float32 in every mode.
 @partial(jax.custom_vjp, nondiff_argnums=(2,))
 def _lstm_pallas(xg, whh, interpret=False):
     # Primal (no-grad) path: hs-only kernel, no residuals to HBM. Under
@@ -331,14 +340,15 @@ def lstm_recurrence(
 ) -> jnp.ndarray:
     """Run the LSTM recurrence over pre-projected gate inputs.
 
-    backend: "scan" (XLA reference) | "pallas" (compiled TPU kernel) |
-    "interpret" (Pallas interpreter, any backend — used in tests).
-    Output is float32 [M, L, u].
+    backend: "scan" (XLA reference, float32 out) | "pallas" (compiled TPU
+    kernel) | "interpret" (Pallas interpreter, any backend — used in
+    tests). The pallas/interpret output is [M, L, u] in xg's dtype (f32 in
+    -> f32 out; bf16 in -> bf16 out with f32 internal recurrence).
     """
     if backend == "scan":
         return lstm_scan(xg, whh)
     if backend == "pallas":
-        return _lstm_pallas(xg.astype(jnp.float32), whh.astype(jnp.float32), False)
+        return _lstm_pallas(xg, whh.astype(jnp.float32), False)
     if backend == "interpret":
-        return _lstm_pallas(xg.astype(jnp.float32), whh.astype(jnp.float32), True)
+        return _lstm_pallas(xg, whh.astype(jnp.float32), True)
     raise ValueError(f"unknown lstm backend {backend!r}")

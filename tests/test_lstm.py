@@ -100,3 +100,42 @@ def test_unknown_backend(inputs):
     xg, whh = inputs
     with pytest.raises(ValueError):
         lstm_recurrence(xg, whh, backend="cuda")
+
+
+def test_pallas_bf16_io_close_to_f32():
+    """bf16-in -> bf16-out kernel (f32 internal recurrence) tracks the f32
+    path to bf16 rounding, forward and backward."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from induction_network_on_fewrel_tpu.ops.lstm import lstm_recurrence
+
+    M, L, u = 8, 10, 16
+    key = jax.random.key(0)
+    xg = jax.random.normal(key, (M, L, 4 * u), jnp.float32) * 0.5
+    whh = jax.random.normal(jax.random.key(1), (u, 4 * u), jnp.float32) * 0.2
+
+    hs32 = lstm_recurrence(xg, whh, backend="interpret")
+    hs16 = lstm_recurrence(xg.astype(jnp.bfloat16), whh, backend="interpret")
+    assert hs16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(hs16, np.float32), np.asarray(hs32), rtol=0.05, atol=0.05
+    )
+
+    def loss32(x):
+        return jnp.sum(lstm_recurrence(x, whh, backend="interpret") ** 2)
+
+    def loss16(x):
+        out = lstm_recurrence(
+            x.astype(jnp.bfloat16), whh, backend="interpret"
+        )
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    g32 = jax.grad(loss32)(xg)
+    g16 = jax.grad(loss16)(xg)
+    # Grad errors compound through L bf16-rounded steps; only coarse
+    # agreement is meaningful here.
+    denom = np.abs(np.asarray(g32)).mean() + 1e-6
+    rel = np.abs(np.asarray(g16) - np.asarray(g32)).mean() / denom
+    assert rel < 0.15, f"bf16 grad relative error {rel}"
