@@ -70,6 +70,39 @@ def run_config(name: str, cfg, adv: bool = False) -> dict:
         cfg, glove_init=vocab.vectors if vocab is not None else None
     )
     sup, qry, _ = batch_to_model_inputs(sampler.sample_batch())
+    if cfg.token_cache:
+        # Device-resident token table + index episodes, fused scan — the
+        # production --token_cache path (train/token_cache.py).
+        import numpy as np
+
+        from induction_network_on_fewrel_tpu.train.feature_cache import (
+            FeatureEpisodeSampler,
+        )
+        from induction_network_on_fewrel_tpu.train.token_cache import (
+            make_token_cached_multi_train_step,
+            tokenize_dataset,
+        )
+
+        if hasattr(sampler, "close"):
+            sampler.close()
+        table_np, sizes = tokenize_dataset(ds, tok)
+        table = jax.device_put(table_np)
+        isampler = FeatureEpisodeSampler(
+            sizes, cfg.n, cfg.k, cfg.q, cfg.batch_size,
+            na_rate=cfg.na_rate, seed=0,
+        )
+        state = init_state(model, cfg, sup, qry)
+        S = max(cfg.steps_per_call, 1)
+        multi = make_token_cached_multi_train_step(model, cfg)
+
+        def step_once(st):
+            bs = [isampler.sample_batch() for _ in range(S)]
+            si = np.stack([b.support_idx for b in bs])
+            qi = np.stack([b.query_idx for b in bs])
+            ls = np.stack([b.label for b in bs])
+            return multi(st, table, si, qi, ls)
+
+        return _time_loop(name, cfg, step_once, state, eff=S)
     if cfg.feature_cache:
         # Index mode: device-resident table, int32 indices per step, fused
         # scan — the production cached path (train/feature_cache.py).
@@ -261,6 +294,20 @@ def main() -> int:
         ("5: 5w5s bilstm na_rate=5 +adv (FewRel2.0)", ExperimentConfig(
             encoder="bilstm", n=5, k=5, q=5, na_rate=5, adv=True,
             **base), True),
+        # Token-cache twins of the GloVe configs (--token_cache, spc=512):
+        # the production fast path bench.py records for the flagship.
+        ("1t: 5w1s cnn token_cache", ExperimentConfig(
+            encoder="cnn", n=5, k=1, q=5, token_cache=True,
+            **{**base, "steps_per_call": 512}), False),
+        ("2t: 5w5s bilstm token_cache", ExperimentConfig(
+            encoder="bilstm", n=5, k=5, q=5, token_cache=True,
+            **{**base, "steps_per_call": 512}), False),
+        ("3t: 10w5s bilstm token_cache", ExperimentConfig(
+            encoder="bilstm", train_n=10, n=10, k=5, q=5, token_cache=True,
+            **{**base, "steps_per_call": 512}), False),
+        ("5t: 5w5s bilstm na_rate=5 token_cache (NOTA)", ExperimentConfig(
+            encoder="bilstm", n=5, k=5, q=5, na_rate=5, token_cache=True,
+            **{**base, "steps_per_call": 512}), False),
     ]
     only = sys.argv[1:] or None
     for name, cfg, adv in configs:
