@@ -10,21 +10,42 @@ and MXU-shaped.
 * BiLSTM + self-attention (paper §3.1): bidirectional LSTM, then structured
   self-attention ``a = softmax(w2 · tanh(W1 · Hᵀ))``, sentence vector
   ``e = Σ aₜ hₜ``. TPU decomposition (ops/lstm.py): the input projection is
-  hoisted out of the recurrence into one [M·L, D] x [D, 4u] MXU matmul; only
-  the true recurrence runs per-step — as a ``lax.scan`` or as the fused
-  Pallas kernel that keeps h/c in VMEM for all L steps (``lstm_backend``).
-  Both directions share cell weights and run stacked along the batch axis,
-  so the per-step matmul is twice as tall. The two backends share the same
-  parameters: checkpoints are interchangeable and equality is testable.
+  hoisted out of the recurrence into ONE tall [M·L, D] x [D, 8u] MXU matmul
+  against the direction-concatenated weights (the reverse direction's time
+  flip commutes with the per-timestep projection, so it is applied to the
+  projected gates); only the true recurrence runs per-step — as a ``lax.scan`` or
+  as the fused Pallas kernel that keeps h/c in VMEM for all L steps
+  (``lstm_backend``). The two directions have INDEPENDENT weights (matching
+  torch ``nn.LSTM(bidirectional=True)``'s separate ``*_reverse`` tensors —
+  params carry a leading direction axis [2, ...]) and still run in one
+  fused dispatch via the grouped recurrence. The two backends share the
+  same parameters: checkpoints are interchangeable and equality is
+  testable.
 """
 
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from induction_network_on_fewrel_tpu.ops import masked_max, masked_softmax
-from induction_network_on_fewrel_tpu.ops.lstm import lstm_recurrence
+from induction_network_on_fewrel_tpu.ops.lstm import lstm_recurrence_grouped
+
+
+def _per_direction(init):
+    """Lift a 1-direction initializer to a leading [2, ...] direction axis.
+
+    Splitting the key per direction keeps each direction's init distribution
+    identical to a standalone LSTM's (a plain lecun/orthogonal over the
+    stacked shape would compute fan/orthogonality over the wrong axes).
+    """
+
+    def f(key, shape, dtype=jnp.float32):
+        keys = jax.random.split(key, shape[0])
+        return jnp.stack([init(k, shape[1:], dtype) for k in keys])
+
+    return f
 
 
 class CNNEncoder(nn.Module):
@@ -61,30 +82,41 @@ class BiLSTMSelfAttnEncoder(nn.Module):
         u = self.lstm_hidden
         emb = emb.astype(self.compute_dtype)
 
-        # Stack forward and time-reversed sequences along the batch axis:
-        # same cell weights serve both directions, and every matmul below is
-        # twice as tall — friendlier to the MXU than two half-size passes.
-        rev = jnp.flip(emb, axis=1)
-        both = jnp.concatenate([emb, rev], axis=0)  # [2M, L, D]
-
-        # Gate order [i, f, g, o] (matches torch.nn.LSTM; golden-tested).
-        w_ih = self.param("w_ih", nn.initializers.lecun_normal(), (D, 4 * u))
-        w_hh = self.param("w_hh", nn.initializers.orthogonal(), (u, 4 * u))
+        # Each direction has its own weights (torch bidirectional-LSTM
+        # convention: independent `*_reverse` tensors; leading param axis
+        # 2 = direction, 0 forward / 1 backward). The grouped recurrence
+        # runs both directions in one fused dispatch with a per-tile weight
+        # select — no extra kernel calls vs the old weight-shared layout.
+        w_ih = self.param(
+            "w_ih", _per_direction(nn.initializers.lecun_normal()), (2, D, 4 * u)
+        )
+        w_hh = self.param(
+            "w_hh", _per_direction(nn.initializers.orthogonal()), (2, u, 4 * u)
+        )
         # Forget-gate bias starts at 1 so early training doesn't flush the
         # cell state (standard LSTM practice).
         b = self.param(
             "bias",
-            lambda key, shape: jnp.zeros(shape).at[u : 2 * u].set(1.0),
-            (4 * u,),
+            lambda key, shape: jnp.zeros(shape).at[:, u : 2 * u].set(1.0),
+            (2, 4 * u),
         )
-        # Sequential-free input projection: one big MXU matmul over all
-        # timesteps; only the recurrence below runs per-step.
-        xg = both @ w_ih.astype(self.compute_dtype) + b.astype(self.compute_dtype)
-        # [2M, L, u] in xg's dtype (pallas; f32 internal recurrence) or f32
-        # (scan) — consumers see compute_dtype either way.
-        hs = lstm_recurrence(xg, w_hh, backend=self.lstm_backend)
+        # Sequential-free input projection as ONE tall MXU matmul against
+        # the direction-concatenated weights: [M·L, D] x [D, 8u]. The time
+        # flip for the reverse direction commutes with the per-timestep
+        # projection, so it applies to the projected gates, not the input —
+        # no duplicated [2, M, L, D] operand in HBM.
+        w_cat = jnp.concatenate([w_ih[0], w_ih[1]], axis=-1)  # [D, 8u]
+        xg_all = emb @ w_cat.astype(self.compute_dtype)       # [M, L, 8u]
+        bc = b.astype(self.compute_dtype)
+        xg = jnp.stack([
+            xg_all[..., : 4 * u] + bc[0],
+            jnp.flip(xg_all[..., 4 * u :], axis=1) + bc[1],
+        ])                                                    # [2, M, L, 4u]
+        # [2, M, L, u] in xg's dtype (pallas; f32 internal recurrence) or
+        # f32 (scan) — consumers see compute_dtype either way.
+        hs = lstm_recurrence_grouped(xg, w_hh, backend=self.lstm_backend)
         hs = hs.astype(self.compute_dtype)
-        h_fwd, h_bwd = hs[:M], jnp.flip(hs[M:], axis=1)
+        h_fwd, h_bwd = hs[0], jnp.flip(hs[1], axis=1)
         H = jnp.concatenate([h_fwd, h_bwd], axis=-1)   # [M, L, 2u]
 
         # Structured self-attention (Lin et al. 2017 form used by the paper):

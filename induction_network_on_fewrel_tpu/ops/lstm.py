@@ -16,6 +16,16 @@ The BiLSTM is the FLOPs-dominant op of the flagship encoder (SURVEY.md §3.2
    MXU matmul per step in exchange for 3x less forward HBM write traffic
    (the kernel is bandwidth-bound, not FLOP-bound).
 
+**Grouped recurrence** (the bidirectional case): ``lstm_recurrence_grouped``
+takes ``xg [Gc, M, L, 4u]`` and per-group weights ``whh [Gc, u, 4u]`` and
+runs ALL groups in ONE kernel call. Each group's rows are padded to the row
+tile independently, so a tile never straddles groups, and the BlockSpec
+index map picks the group's weight slab (``i // tiles_per_group``) — the
+per-step matmul shape is unchanged vs the shared-weight layout. This is how
+the BiLSTM gives its forward and backward directions INDEPENDENT recurrent
+weights (torch ``nn.LSTM(bidirectional=True)`` has separate ``*_reverse``
+tensors) without giving up the fused single-dispatch structure.
+
 Gate order is [i, f, g, o] (sigmoid, sigmoid, tanh, sigmoid) — the same
 convention as torch.nn.LSTM, which the golden test exploits. All recurrence
 arithmetic is float32: bf16 cell state drifts over long sequences.
@@ -24,6 +34,15 @@ arithmetic is float32: bf16 cell state drifts over long sequences.
 differentiable by tracing), "pallas" (compiled TPU kernel, custom VJP), or
 "interpret" (Pallas interpreter — same kernel code, runs on CPU; used by the
 test suite so the kernel logic is exercised without a chip).
+
+Gradient-precision note (bf16 mode): the backward kernel recomputes gate
+activations from the bf16-rounded hs/cs residuals while the forward
+recurrence ran on f32 VMEM state, so the returned cotangents are gradients
+of a slightly different (bf16-rounded) forward — an intentional bandwidth
+tradeoff. Measured mean relative grad error is ~10-15% on random inputs
+(tests/test_lstm.py::test_pallas_bf16_io_close_to_f32); training-quality
+parity should be monitored via final val accuracy in bf16 runs, not only
+throughput. The f32 path is exact to 1e-5 against `lax.scan`.
 """
 
 from __future__ import annotations
@@ -77,7 +96,8 @@ def lstm_scan(xg: jnp.ndarray, whh: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# Pallas kernels.
+# Pallas kernels. whh blocks are [1, u, 4u]: the leading axis is the GROUP
+# axis (e.g. BiLSTM direction), selected per row tile by the index map.
 # ---------------------------------------------------------------------------
 
 
@@ -94,7 +114,7 @@ def _fwd_kernel(xg_ref, whh_ref, hs_ref, cs_ref, h_scr, c_scr):
     # trading a matmul for 3x less forward write traffic is a clear win
     # (measured ~1.2x end-to-end on the tunneled v5e).
     t = pl.program_id(1)
-    u = whh_ref.shape[0]
+    u = whh_ref.shape[1]
 
     @pl.when(t == 0)
     def _():
@@ -102,7 +122,7 @@ def _fwd_kernel(xg_ref, whh_ref, hs_ref, cs_ref, h_scr, c_scr):
         c_scr[...] = jnp.zeros_like(c_scr)
 
     a = xg_ref[0].astype(jnp.float32) + jnp.dot(
-        h_scr[...], whh_ref[...], preferred_element_type=jnp.float32
+        h_scr[...], whh_ref[0], preferred_element_type=jnp.float32
     )
     i, f, g, o = _gates(a, u)
     c = f * c_scr[...] + i * g
@@ -121,7 +141,7 @@ def _fwd_kernel_infer(xg_ref, whh_ref, hs_ref, h_scr, c_scr):
     would cost ~5x the output bytes on every no-grad call (eval episodes).
     """
     t = pl.program_id(1)
-    u = whh_ref.shape[0]
+    u = whh_ref.shape[1]
 
     @pl.when(t == 0)
     def _():
@@ -129,7 +149,7 @@ def _fwd_kernel_infer(xg_ref, whh_ref, hs_ref, h_scr, c_scr):
         c_scr[...] = jnp.zeros_like(c_scr)
 
     a = xg_ref[0].astype(jnp.float32) + jnp.dot(
-        h_scr[...], whh_ref[...], preferred_element_type=jnp.float32
+        h_scr[...], whh_ref[0], preferred_element_type=jnp.float32
     )
     i, f, g, o = _gates(a, u)
     c = f * c_scr[...] + i * g
@@ -146,7 +166,7 @@ def _bwd_kernel(
     t = pl.program_id(1)
     L = pl.num_programs(1)
     rt = L - 1 - t  # walking time backwards
-    u = whh_ref.shape[0]
+    u = whh_ref.shape[1]
 
     @pl.when(t == 0)
     def _():
@@ -165,7 +185,7 @@ def _bwd_kernel(
     # Recompute the gate activations the forward did not save: one extra
     # [TM, u] x [u, 4u] matmul instead of reading 4u residuals from HBM.
     a = xg_ref[0].astype(jnp.float32) + jnp.dot(
-        h_prev, whh_ref[...], preferred_element_type=jnp.float32
+        h_prev, whh_ref[0], preferred_element_type=jnp.float32
     )
     i, f, g, o = _gates(a, u)
 
@@ -179,7 +199,7 @@ def _bwd_kernel(
 
     dxg_ref[0] = da.astype(dxg_ref.dtype)
     dh_scr[...] = jax.lax.dot_general(
-        da, whh_ref[...], (((1,), (1,)), ((), ())),  # da @ whh^T
+        da, whh_ref[0], (((1,), (1,)), ((), ())),  # da @ whh^T
         preferred_element_type=jnp.float32,
     )
     dc_scr[...] = dct * f
@@ -190,42 +210,60 @@ def _bwd_kernel(
     dwhh_ref[0] = dwhh_scr[...]
 
 
-def _pad_rows(x: jnp.ndarray, tm: int) -> jnp.ndarray:
-    M = x.shape[0]
-    pad = (-M) % tm
-    return jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1)) if pad else x
+def _to_time_major(x: jnp.ndarray):
+    """[Gc, M, L, *] -> time-major padded [L, Gc*Mp, *].
+
+    Each group is padded to the row tile INDEPENDENTLY so a tile never
+    straddles two groups — the per-tile weight index map relies on this.
+    """
+    Gc, M, L = x.shape[:3]
+    pad = (-M) % _TM
+    if pad:
+        widths = ((0, 0), (0, pad)) + ((0, 0),) * (x.ndim - 2)
+        x = jnp.pad(x, widths)
+    Mp = M + pad
+    flat = x.reshape((Gc * Mp, L) + x.shape[3:])
+    return jnp.swapaxes(flat, 0, 1), Mp
+
+
+def _from_time_major(x_t: jnp.ndarray, Gc: int, M: int):
+    """Inverse of _to_time_major: [L, Gc*Mp, *] -> [Gc, M, L, *]."""
+    L, GMp = x_t.shape[:2]
+    Mp = GMp // Gc
+    flat = jnp.swapaxes(x_t, 0, 1)  # [Gc*Mp, L, *]
+    return flat.reshape((Gc, Mp, L) + x_t.shape[2:])[:, :M]
 
 
 def _fwd_call(xg: jnp.ndarray, whh: jnp.ndarray, interpret: bool):
-    """Returns (hs [M,L,u], residuals xg_t/hs_t/cs_t all TIME-MAJOR
-    [L,Mp,*]). Gate activations are recomputed in the backward kernel.
+    """Grouped forward. xg [Gc, M, L, 4u], whh [Gc, u, 4u] -> (hs
+    [Gc, M, L, u], residuals xg_t/hs_t/cs_t all TIME-MAJOR [L, Gc*Mp, *]).
+    Gate activations are recomputed in the backward kernel.
 
     Dtype-polymorphic: hs/cs residuals and outputs carry xg's dtype (the
     VMEM recurrence math is always float32). In bf16 compute mode that
     halves the kernel's HBM traffic and removes the f32<->bf16 convert
     passes XLA otherwise wraps around the kernel; in f32 mode nothing
     changes (golden tests pin that path at 1e-5)."""
-    M, L, G = xg.shape
+    Gc, M, L, G = xg.shape
     u = G // 4
     dt = xg.dtype
-    xg_p = _pad_rows(xg, _TM)
-    Mp = xg_p.shape[0]
-    xg_t = jnp.swapaxes(xg_p, 0, 1)  # [L, Mp, G] time-major for the kernel
-    grid = (Mp // _TM, L)
+    xg_t, Mp = _to_time_major(xg)  # [L, Gc*Mp, G]
+    H = Mp // _TM  # row tiles per group
+    grid = (Gc * H, L)
     out = pl.pallas_call(
         _fwd_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, _TM, G), lambda i, t: (t, i, 0)),
-            pl.BlockSpec((u, G), lambda i, t: (0, 0)),
+            pl.BlockSpec((1, u, G), lambda i, t: (i // H, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, _TM, u), lambda i, t: (t, i, 0)),
             pl.BlockSpec((1, _TM, u), lambda i, t: (t, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((L, Mp, u), dt),  # hs
-            jax.ShapeDtypeStruct((L, Mp, u), dt),  # cs
+            jax.ShapeDtypeStruct((L, Gc * Mp, u), dt),  # hs
+            jax.ShapeDtypeStruct((L, Gc * Mp, u), dt),  # cs
         ],
         scratch_shapes=[
             pltpu.VMEM((_TM, u), jnp.float32),
@@ -236,42 +274,41 @@ def _fwd_call(xg: jnp.ndarray, whh: jnp.ndarray, interpret: bool):
     hs, cs = out
     # Residuals stay time-major/padded — the backward kernel consumes them
     # as-is; only the user-facing hs is transposed back.
-    return jnp.swapaxes(hs, 0, 1)[:M], xg_t, hs, cs
+    return _from_time_major(hs, Gc, M), xg_t, hs, cs
 
 
 def _fwd_call_infer(xg: jnp.ndarray, whh: jnp.ndarray, interpret: bool):
-    M, L, G = xg.shape
+    Gc, M, L, G = xg.shape
     u = G // 4
-    xg_p = _pad_rows(xg, _TM)
-    Mp = xg_p.shape[0]
-    xg_t = jnp.swapaxes(xg_p, 0, 1)  # [L, Mp, G]
-    grid = (Mp // _TM, L)
+    xg_t, Mp = _to_time_major(xg)  # [L, Gc*Mp, G]
+    H = Mp // _TM
+    grid = (Gc * H, L)
     hs = pl.pallas_call(
         _fwd_kernel_infer,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, _TM, G), lambda i, t: (t, i, 0)),
-            pl.BlockSpec((u, G), lambda i, t: (0, 0)),
+            pl.BlockSpec((1, u, G), lambda i, t: (i // H, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, _TM, u), lambda i, t: (t, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((L, Mp, u), xg.dtype),
+        out_shape=jax.ShapeDtypeStruct((L, Gc * Mp, u), xg.dtype),
         scratch_shapes=[
             pltpu.VMEM((_TM, u), jnp.float32),
             pltpu.VMEM((_TM, u), jnp.float32),
         ],
         interpret=interpret,
     )(xg_t, whh.astype(jnp.float32))
-    return jnp.swapaxes(hs, 0, 1)[:M]
+    return _from_time_major(hs, Gc, M)
 
 
 def _bwd_call(dhs, xg_t, cs_t, hs_t, whh, interpret: bool):
-    """dhs: [M, L, u] cotangent; xg_t/cs_t/hs_t: TIME-MAJOR padded
-    residuals [L, Mp, *] straight from the forward call."""
-    M, L, u = dhs.shape
+    """dhs: [Gc, M, L, u] cotangent; xg_t/cs_t/hs_t: TIME-MAJOR padded
+    residuals [L, Gc*Mp, *] straight from the forward call."""
+    Gc, M, L, u = dhs.shape
     G = 4 * u
-    dhs_t = jnp.swapaxes(_pad_rows(dhs, _TM), 0, 1)
-    Mp = dhs_t.shape[1]
-    ntiles = Mp // _TM
+    dhs_t, Mp = _to_time_major(dhs)  # [L, Gc*Mp, u]
+    H = Mp // _TM
+    ntiles = Gc * H
     grid = (ntiles, L)
     rev = lambda i, t: (L - 1 - t, i, 0)           # noqa: E731
     rev_prev = lambda i, t: (max_0(L - 2 - t), i, 0)  # noqa: E731
@@ -284,7 +321,7 @@ def _bwd_call(dhs, xg_t, cs_t, hs_t, whh, interpret: bool):
             pl.BlockSpec((1, _TM, u), rev),       # cs
             pl.BlockSpec((1, _TM, u), rev_prev),  # cs_{t-1} (clamped)
             pl.BlockSpec((1, _TM, u), rev_prev),  # hs_{t-1} (clamped)
-            pl.BlockSpec((u, G), lambda i, t: (0, 0)),
+            pl.BlockSpec((1, u, G), lambda i, t: (i // H, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, _TM, G), rev),
@@ -293,7 +330,7 @@ def _bwd_call(dhs, xg_t, cs_t, hs_t, whh, interpret: bool):
         out_shape=[
             # dxg matches xg's dtype (the custom-VJP contract); dwhh stays
             # f32 — it is the cotangent of the f32 weight param.
-            jax.ShapeDtypeStruct((L, Mp, G), xg_t.dtype),
+            jax.ShapeDtypeStruct((L, Gc * Mp, G), xg_t.dtype),
             jax.ShapeDtypeStruct((ntiles, u, G), jnp.float32),
         ],
         scratch_shapes=[
@@ -304,7 +341,8 @@ def _bwd_call(dhs, xg_t, cs_t, hs_t, whh, interpret: bool):
         interpret=interpret,
         # cs appears twice: once at rt, once at rt-1 (separate index maps).
     )(dhs_t, xg_t, cs_t, cs_t, hs_t, whh.astype(jnp.float32))
-    return jnp.swapaxes(dxg, 0, 1)[:M], dwhh_p.sum(axis=0)
+    dwhh = dwhh_p.reshape(Gc, H, u, G).sum(axis=1)  # per-group tile sums
+    return _from_time_major(dxg, Gc, M), dwhh
 
 
 def max_0(v):
@@ -312,9 +350,9 @@ def max_0(v):
     return jnp.maximum(v, 0)
 
 
-# Dtype-polymorphic custom VJP: hs (and dxg) carry xg's dtype; whh and
-# dwhh are always float32 (the param dtype). The VMEM recurrence math is
-# float32 in every mode.
+# Dtype-polymorphic custom VJP on GROUPED shapes: hs (and dxg) carry xg's
+# dtype; whh and dwhh are always float32 (the param dtype). The VMEM
+# recurrence math is float32 in every mode.
 @partial(jax.custom_vjp, nondiff_argnums=(2,))
 def _lstm_pallas(xg, whh, interpret=False):
     # Primal (no-grad) path: hs-only kernel, no residuals to HBM. Under
@@ -335,10 +373,30 @@ def _lstm_pallas_bwd(interpret, res, dhs):
 _lstm_pallas.defvjp(_lstm_pallas_fwd, _lstm_pallas_bwd)
 
 
+def lstm_recurrence_grouped(
+    xg: jnp.ndarray, whh: jnp.ndarray, backend: str = "scan"
+) -> jnp.ndarray:
+    """Run Gc independent LSTM recurrences with per-group weights.
+
+    xg: [Gc, M, L, 4u] pre-projected gate inputs; whh: [Gc, u, 4u].
+    Returns hidden states [Gc, M, L, u]. All groups run in ONE Pallas
+    dispatch (the weight index map picks the group slab per row tile), so
+    the BiLSTM's two directions cost one kernel call, same as the old
+    shared-weight layout — but with independent parameters per direction.
+    """
+    if backend == "scan":
+        return jax.vmap(lstm_scan)(xg, whh)
+    if backend == "pallas":
+        return _lstm_pallas(xg, whh.astype(jnp.float32), False)
+    if backend == "interpret":
+        return _lstm_pallas(xg, whh.astype(jnp.float32), True)
+    raise ValueError(f"unknown lstm backend {backend!r}")
+
+
 def lstm_recurrence(
     xg: jnp.ndarray, whh: jnp.ndarray, backend: str = "scan"
 ) -> jnp.ndarray:
-    """Run the LSTM recurrence over pre-projected gate inputs.
+    """Single-group LSTM recurrence over pre-projected gate inputs.
 
     backend: "scan" (XLA reference, float32 out) | "pallas" (compiled TPU
     kernel) | "interpret" (Pallas interpreter, any backend — used in
@@ -347,8 +405,4 @@ def lstm_recurrence(
     """
     if backend == "scan":
         return lstm_scan(xg, whh)
-    if backend == "pallas":
-        return _lstm_pallas(xg, whh.astype(jnp.float32), False)
-    if backend == "interpret":
-        return _lstm_pallas(xg, whh.astype(jnp.float32), True)
-    raise ValueError(f"unknown lstm backend {backend!r}")
+    return lstm_recurrence_grouped(xg[None], whh[None], backend)[0]

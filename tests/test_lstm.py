@@ -11,7 +11,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from induction_network_on_fewrel_tpu.ops.lstm import lstm_recurrence, lstm_scan
+from induction_network_on_fewrel_tpu.ops.lstm import (
+    lstm_recurrence,
+    lstm_recurrence_grouped,
+    lstm_scan,
+)
 
 M, L, D, U = 10, 7, 12, 16  # deliberately NOT tile-aligned (exercises padding)
 
@@ -69,6 +73,123 @@ def test_golden_torch_lstm(inputs):
         lstm.bias_hh_l0.zero_()
         hs_t, _ = lstm(torch.tensor(x))
     np.testing.assert_allclose(hs_j, hs_t.numpy(), atol=1e-5)
+
+
+def test_grouped_forward_backward_parity():
+    """Grouped (per-direction-weight) kernel == per-group lax.scan, forward
+    and backward — including group counts whose rows pad to different tiles."""
+    rng = np.random.default_rng(7)
+    Gc = 2
+    xg = jnp.asarray(rng.normal(size=(Gc, M, L, 4 * U)).astype(np.float32) * 0.5)
+    whh = jnp.asarray(
+        (rng.normal(size=(Gc, U, 4 * U)) / np.sqrt(U)).astype(np.float32)
+    )
+    ct = jnp.asarray(rng.normal(size=(Gc, M, L, U)).astype(np.float32))
+
+    hs_ref = jnp.stack([lstm_scan(xg[g], whh[g]) for g in range(Gc)])
+    hs_pl = lstm_recurrence_grouped(xg, whh, backend="interpret")
+    np.testing.assert_allclose(np.asarray(hs_ref), np.asarray(hs_pl), atol=1e-5)
+    # Groups must NOT share weights: perturbing group 1's weights must leave
+    # group 0's output untouched (this is the untied-direction contract).
+    hs_pl2 = lstm_recurrence_grouped(
+        xg, whh.at[1].mul(2.0), backend="interpret"
+    )
+    np.testing.assert_allclose(
+        np.asarray(hs_pl[0]), np.asarray(hs_pl2[0]), atol=1e-6
+    )
+    assert not np.allclose(np.asarray(hs_pl[1]), np.asarray(hs_pl2[1]))
+
+    def loss(fn):
+        return lambda a, b: jnp.sum(fn(a, b) * ct)
+
+    ref = loss(lambda a, b: jnp.stack(
+        [lstm_scan(a[g], b[g]) for g in range(Gc)]
+    ))
+    g_ref = jax.grad(ref, argnums=(0, 1))(xg, whh)
+    g_pl = jax.grad(
+        loss(lambda a, b: lstm_recurrence_grouped(a, b, backend="interpret")),
+        argnums=(0, 1),
+    )(xg, whh)
+    np.testing.assert_allclose(np.asarray(g_ref[0]), np.asarray(g_pl[0]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g_ref[1]), np.asarray(g_pl[1]), atol=1e-4)
+
+
+def test_golden_torch_bidirectional_lstm():
+    """Per-direction recurrence == torch.nn.LSTM(bidirectional=True) with
+    INDEPENDENT forward/reverse weights (the reference family's convention;
+    VERDICT r1 #1)."""
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(M, L, D)).astype(np.float32)
+    w_ih = (rng.normal(size=(2, D, 4 * U)) / np.sqrt(D)).astype(np.float32)
+    w_hh = (rng.normal(size=(2, U, 4 * U)) / np.sqrt(U)).astype(np.float32)
+    b = rng.normal(size=(2, 4 * U)).astype(np.float32)
+
+    # JAX path, exactly as BiLSTMSelfAttnEncoder computes it: stack fwd and
+    # flipped inputs on a direction axis, project with per-direction w_ih,
+    # grouped recurrence with per-direction w_hh, re-flip the reverse half.
+    both = jnp.stack([jnp.asarray(x), jnp.flip(jnp.asarray(x), axis=1)])
+    xg = jnp.einsum("gmld,gdh->gmlh", both, jnp.asarray(w_ih)) + jnp.asarray(
+        b
+    )[:, None, None]
+    hs = lstm_recurrence_grouped(xg, jnp.asarray(w_hh), backend="interpret")
+    H_j = np.concatenate(
+        [np.asarray(hs[0]), np.asarray(jnp.flip(hs[1], axis=1))], axis=-1
+    )  # [M, L, 2U]
+
+    lstm = torch.nn.LSTM(D, U, batch_first=True, bidirectional=True)
+    with torch.no_grad():
+        lstm.weight_ih_l0.copy_(torch.tensor(w_ih[0].T))
+        lstm.weight_hh_l0.copy_(torch.tensor(w_hh[0].T))
+        lstm.bias_ih_l0.copy_(torch.tensor(b[0]))
+        lstm.bias_hh_l0.zero_()
+        lstm.weight_ih_l0_reverse.copy_(torch.tensor(w_ih[1].T))
+        lstm.weight_hh_l0_reverse.copy_(torch.tensor(w_hh[1].T))
+        lstm.bias_ih_l0_reverse.copy_(torch.tensor(b[1]))
+        lstm.bias_hh_l0_reverse.zero_()
+        H_t, _ = lstm(torch.tensor(x))  # [M, L, 2U], fwd ++ reverse
+    np.testing.assert_allclose(H_j, H_t.numpy(), atol=1e-5)
+
+
+def test_golden_torch_bilstm_encoder_end_to_end():
+    """Full BiLSTMSelfAttnEncoder == torch twin: bidirectional nn.LSTM with
+    independent direction weights + structured self-attention."""
+    torch = pytest.importorskip("torch")
+    from induction_network_on_fewrel_tpu.models.encoders import (
+        BiLSTMSelfAttnEncoder,
+    )
+
+    rng = np.random.default_rng(13)
+    Mb, A = 6, 8
+    emb = rng.normal(size=(Mb, L, D)).astype(np.float32)
+    mask = (rng.random((Mb, L)) > 0.2).astype(np.float32)
+    mask[:, 0] = 1.0
+
+    enc = BiLSTMSelfAttnEncoder(lstm_hidden=U, att_dim=A, lstm_backend="scan")
+    params = enc.init(jax.random.key(0), jnp.asarray(emb), jnp.asarray(mask))
+    p = params["params"]
+    out_j = np.asarray(enc.apply(params, jnp.asarray(emb), jnp.asarray(mask)))
+
+    w_ih, w_hh, b = (np.asarray(p[k]) for k in ("w_ih", "w_hh", "bias"))
+    W1 = np.asarray(p["Dense_0"]["kernel"])  # [2U, A]
+    w2 = np.asarray(p["Dense_1"]["kernel"])  # [A, 1]
+
+    lstm = torch.nn.LSTM(D, U, batch_first=True, bidirectional=True)
+    with torch.no_grad():
+        lstm.weight_ih_l0.copy_(torch.tensor(w_ih[0].T))
+        lstm.weight_hh_l0.copy_(torch.tensor(w_hh[0].T))
+        lstm.bias_ih_l0.copy_(torch.tensor(b[0]))
+        lstm.bias_hh_l0.zero_()
+        lstm.weight_ih_l0_reverse.copy_(torch.tensor(w_ih[1].T))
+        lstm.weight_hh_l0_reverse.copy_(torch.tensor(w_hh[1].T))
+        lstm.bias_ih_l0_reverse.copy_(torch.tensor(b[1]))
+        lstm.bias_hh_l0_reverse.zero_()
+        H, _ = lstm(torch.tensor(emb))                     # [Mb, L, 2U]
+        scores = (torch.tanh(H @ torch.tensor(W1)) @ torch.tensor(w2))[..., 0]
+        scores = scores.masked_fill(torch.tensor(mask) == 0, -1e30)
+        att = torch.softmax(scores, dim=-1)
+        out_t = torch.einsum("ml,mlh->mh", att, H)
+    np.testing.assert_allclose(out_j, out_t.numpy(), atol=1e-5)
 
 
 def test_encoder_backend_equivalence():
