@@ -47,6 +47,9 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.inf_sampler_destroy.argtypes = [ctypes.c_void_p]
     batch_args = [ctypes.c_void_p] + [i32p, i32p, i32p, f32p] * 2 + [i32p]
     lib.inf_sampler_sample.argtypes = batch_args
+    lib.inf_sampler_sample_indices.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, i32p, i32p, i32p
+    ]
     lib.inf_pipeline_create.restype = ctypes.c_void_p
     lib.inf_pipeline_create.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32

@@ -28,6 +28,7 @@ from induction_network_on_fewrel_tpu.native.lib import (
 from induction_network_on_fewrel_tpu.sampling.episodes import (
     EpisodeBatch,
     EpisodeSampler,
+    check_episode_feasibility,
 )
 
 
@@ -51,11 +52,10 @@ class NativeEpisodeSampler:
         prefetch: int = 0,       # ring-buffer depth; 0 = synchronous
         num_threads: int = 2,
     ):
-        if dataset.num_relations < n + (1 if na_rate > 0 else 0):
-            raise ValueError(
-                f"need > {n} relations for N={n} with na_rate={na_rate}, "
-                f"got {dataset.num_relations}"
-            )
+        check_episode_feasibility(
+            [len(dataset.instances[r]) for r in dataset.rel_names],
+            n, k, q, na_rate, names=dataset.rel_names,
+        )
         self._lib = load_native_lib()
         self.n, self.k, self.q = n, k, q
         self.batch_size, self.na_rate = batch_size, na_rate
@@ -68,8 +68,6 @@ class NativeEpisodeSampler:
         offsets = [0]
         for rel in dataset.rel_names:
             insts = dataset.instances[rel]
-            if len(insts) < k + q:
-                raise ValueError(f"relation {rel!r}: {len(insts)} < K+Q={k + q}")
             for inst in insts:
                 t = tokenizer(inst)
                 words.append(t.word)
@@ -155,6 +153,97 @@ class NativeEpisodeSampler:
             self.close()
         except Exception:  # noqa: BLE001 — interpreter teardown
             pass
+
+
+class NativeIndexSampler:
+    """Index-only episodic sampler for the device-resident cache paths.
+
+    Emits GLOBAL row ids (``sup_idx [B,N,K]``, ``qry_idx [B,TQ]``, labels)
+    against a flat table the caller keeps on device — the host-side twin of
+    ``train.feature_cache.FeatureEpisodeSampler`` in index mode, backed by
+    the C++ sampler (same episode semantics; its own deterministic RNG
+    stream, like every native-vs-python sampler pair in this repo).
+    ``sample_fused(S)`` fills S batches stacked on a leading axis in one
+    C call — the exact layout a steps_per_call-fused dispatch consumes;
+    measured ~100x the Python index sampler's episodes/sec, which the
+    round-1 bench showed was the flagship bottleneck once token transport
+    moved on device.
+    """
+
+    def __init__(self, sizes, n, k, q, batch_size=1, na_rate=0, seed=0):
+        sizes = [int(s) for s in sizes]
+        check_episode_feasibility(sizes, n, k, q, na_rate)
+        self._lib = load_native_lib()
+        self.n, self.k, self.q = n, k, q
+        self.batch_size, self.na_rate = batch_size, na_rate
+        self._offsets = np.cumsum([0] + sizes).astype(np.int64)
+        # Corpus pointers are NULL: index mode never touches token rows.
+        self._handle = self._lib.inf_sampler_create(
+            None, None, None, None,
+            _ptr(self._offsets, ctypes.c_int64),
+            len(sizes), 1, n, k, q, na_rate, batch_size,
+            ctypes.c_uint64(seed),
+        )
+
+    @property
+    def total_q(self) -> int:
+        return self.n * self.q + self.na_rate * self.q
+
+    def sample_fused(self, s: int):
+        """S stacked batches: (sup [S,B,N,K], qry [S,B,TQ], label [S,B,TQ])."""
+        B, TQ = self.batch_size, self.total_q
+        sup = np.empty((s, B, self.n, self.k), np.int32)
+        qry = np.empty((s, B, TQ), np.int32)
+        lab = np.empty((s, B, TQ), np.int32)
+        self._lib.inf_sampler_sample_indices(
+            self._handle, s,
+            _ptr(sup, ctypes.c_int32), _ptr(qry, ctypes.c_int32),
+            _ptr(lab, ctypes.c_int32),
+        )
+        return sup, qry, lab
+
+    def sample_batch(self):
+        from induction_network_on_fewrel_tpu.train.feature_cache import (
+            IndexEpisodeBatch,  # deferred: feature_cache imports jax-heavy deps
+        )
+
+        sup, qry, lab = self.sample_fused(1)
+        return IndexEpisodeBatch(sup[0], qry[0], lab[0])
+
+    def __iter__(self):
+        while True:
+            yield self.sample_batch()
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None) is not None:
+            self._lib.inf_sampler_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+def make_index_sampler(
+    sizes, n, k, q, batch_size=1, na_rate=0, seed=0, backend: str = "auto"
+):
+    """Index-sampler factory: ``native`` | ``python`` | ``auto`` (native
+    when the toolchain is present, else the numpy FeatureEpisodeSampler)."""
+    if backend == "auto":
+        backend = "native" if native_available() else "python"
+    if backend == "native":
+        return NativeIndexSampler(sizes, n, k, q, batch_size, na_rate, seed)
+    if backend == "python":
+        from induction_network_on_fewrel_tpu.train.feature_cache import (
+            FeatureEpisodeSampler,
+        )
+
+        return FeatureEpisodeSampler(
+            sizes, n, k, q, batch_size=batch_size, na_rate=na_rate, seed=seed
+        )
+    raise ValueError(f"unknown sampler backend {backend!r}")
 
 
 def make_sampler(

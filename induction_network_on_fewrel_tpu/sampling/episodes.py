@@ -55,6 +55,26 @@ class _RelationBlock(NamedTuple):
     mask: np.ndarray  # [M, L] float32
 
 
+def check_episode_feasibility(sizes, n, k, q, na_rate, names=None):
+    """Validate that a corpus can furnish N-way K-shot (+NOTA) episodes.
+
+    ``sizes``: per-relation instance counts; ``names``: optional relation
+    labels for the error message. The single source of this check — every
+    sampler (python/native, token/index) validates through it, so the
+    backends accept and reject identical configs.
+    """
+    need = n + (1 if na_rate > 0 else 0)
+    if len(sizes) < need:
+        raise ValueError(
+            f"need >= {need} relations for N={n} with na_rate={na_rate}, "
+            f"got {len(sizes)}"
+        )
+    for i, m in enumerate(sizes):
+        if m < k + q:
+            label = names[i] if names is not None else f"#{i}"
+            raise ValueError(f"relation {label}: {m} instances < K+Q={k + q}")
+
+
 class EpisodeSampler:
     def __init__(
         self,
@@ -67,11 +87,10 @@ class EpisodeSampler:
         na_rate: int = 0,
         seed: int = 0,
     ):
-        if dataset.num_relations < n + (1 if na_rate > 0 else 0):
-            raise ValueError(
-                f"need > {n} relations for N={n} with na_rate={na_rate}, "
-                f"got {dataset.num_relations}"
-            )
+        check_episode_feasibility(
+            [len(dataset.instances[r]) for r in dataset.rel_names],
+            n, k, q, na_rate, names=dataset.rel_names,
+        )
         self.n, self.k, self.q = n, k, q
         self.batch_size, self.na_rate = batch_size, na_rate
         self.rng = np.random.default_rng(seed)
@@ -80,8 +99,6 @@ class EpisodeSampler:
         self.blocks: list[_RelationBlock] = []
         for rel in dataset.rel_names:
             toks = [tokenizer(inst) for inst in dataset.instances[rel]]
-            if len(toks) < k + q:
-                raise ValueError(f"relation {rel!r}: {len(toks)} < K+Q={k + q}")
             self.blocks.append(
                 _RelationBlock(
                     np.stack([t.word for t in toks]),

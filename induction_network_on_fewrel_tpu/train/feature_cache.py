@@ -155,19 +155,16 @@ class FeatureEpisodeSampler:
         index sampling against an external table (train/token_cache.py) —
         per-relation ROW COUNTS, which forces ``return_indices`` mode (there
         is nothing here to gather from)."""
-        if len(blocks) < n + (1 if na_rate > 0 else 0):
-            raise ValueError(
-                f"need > {n} relations for N={n} with na_rate={na_rate}, "
-                f"got {len(blocks)}"
-            )
+        from induction_network_on_fewrel_tpu.sampling.episodes import (
+            check_episode_feasibility,
+        )
+
         sizes_only = isinstance(blocks[0], (int, np.integer))
         sizes = (
             [int(b) for b in blocks] if sizes_only
             else [b.shape[0] for b in blocks]
         )
-        for i, m in enumerate(sizes):
-            if m < k + q:
-                raise ValueError(f"relation #{i}: {m} < K+Q={k + q}")
+        check_episode_feasibility(sizes, n, k, q, na_rate)
         self.sizes = sizes
         self.n, self.k, self.q = n, k, q
         self.batch_size, self.na_rate = batch_size, na_rate
@@ -212,6 +209,19 @@ class FeatureEpisodeSampler:
         label = np.asarray(labels, dtype=np.int32)
         perm = self.rng.permutation(label.shape[0])
         return support, query[perm], label[perm]
+
+    def sample_fused(self, s: int):
+        """S stacked index batches (interface twin of
+        native.sampler.NativeIndexSampler.sample_fused): (sup [S,B,N,K],
+        qry [S,B,TQ], label [S,B,TQ]). Index mode only."""
+        if not self.return_indices:
+            raise ValueError("sample_fused requires index mode")
+        batches = [self.sample_batch() for _ in range(s)]
+        return (
+            np.stack([b.support_idx for b in batches]),
+            np.stack([b.query_idx for b in batches]),
+            np.stack([b.label for b in batches]),
+        )
 
     def sample_batch(self):
         eps = [self._sample_episode() for _ in range(self.batch_size)]
