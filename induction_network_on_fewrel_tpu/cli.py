@@ -82,6 +82,12 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
              "once, stream only episode indices per step (any encoder, "
              "full training semantics; ~3-4x e2e on tunneled backends)",
     )
+    p.add_argument(
+        "--divergence_guard", default="none", choices=["none", "stop"],
+        help="on a >2x val-accuracy collapse (the MSE-sigmoid saturation "
+             "dead zone — unrecoverable): 'none' logs it, 'stop' restores "
+             "the best checkpoint and ends the run",
+    )
     p.add_argument("--loss", default="mse", choices=["mse", "ce"])
     p.add_argument("--optimizer", default="adam", choices=["adam", "adamw", "sgd"])
     p.add_argument("--embed_optimizer", default="shared",
@@ -209,6 +215,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         steps_per_call=getattr(args, "steps_per_call", 1),
         feature_cache=getattr(args, "feature_cache", False),
         token_cache=getattr(args, "token_cache", False),
+        divergence_guard=getattr(args, "divergence_guard", "none"),
         device=args.device, compute_dtype=compute, seed=args.seed,
         dp=args.dp, tp=args.tp, sp=args.sp, pp=args.pp, ep=args.ep,
         pp_microbatches=args.pp_microbatches,
@@ -288,8 +295,8 @@ def _wire_index_cache(cfg, model, cache_mesh, state, only_test,
     Returns (train_sampler, val_sampler, train_step, eval_step, fused_step,
     test_eval_factory).
     """
-    from induction_network_on_fewrel_tpu.train.feature_cache import (
-        FeatureEpisodeSampler,
+    from induction_network_on_fewrel_tpu.native.sampler import (
+        make_index_sampler,
     )
 
     if cache_mesh is not None and cfg.batch_size % cache_mesh.shape["dp"]:
@@ -299,19 +306,25 @@ def _wire_index_cache(cfg, model, cache_mesh, state, only_test,
         )
     _eval = factories["eval"](model, cfg, cache_mesh, state)
     train_step = eval_step = fused_step = None
+    # Same backend policy as the live samplers: training uses the C++
+    # index sampler under "auto" (measured 200-300x the Python index
+    # sampler — host assembly was the cached paths' bottleneck); eval
+    # pins to "python" unless a backend was chosen explicitly, so eval
+    # streams are reproducible whether or not a toolchain is present.
+    eval_backend = "python" if cfg.sampler == "auto" else cfg.sampler
     if not only_test:
         table_tr, sizes_tr = build_table(train_ds)
         table_va, sizes_va = build_table(val_ds)
         for s in (train_sampler, val_sampler):
             if hasattr(s, "close"):
                 s.close()
-        train_sampler = FeatureEpisodeSampler(
-            sizes_tr, cfg.train_n, cfg.k, cfg.q, cfg.batch_size,
-            na_rate=cfg.na_rate, seed=cfg.seed,
+        train_sampler = make_index_sampler(
+            sizes_tr, cfg.train_n, cfg.k, cfg.q, batch_size=cfg.batch_size,
+            na_rate=cfg.na_rate, seed=cfg.seed, backend=cfg.sampler,
         )
-        val_sampler = FeatureEpisodeSampler(
-            sizes_va, cfg.n, cfg.k, cfg.q, cfg.batch_size,
-            na_rate=cfg.na_rate, seed=cfg.seed + 1,
+        val_sampler = make_index_sampler(
+            sizes_va, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size,
+            na_rate=cfg.na_rate, seed=cfg.seed + 1, backend=eval_backend,
         )
         _train = factories["train"](model, cfg, cache_mesh, state)
         train_step = lambda st, si, qi, l: _train(st, table_tr, si, qi, l)
@@ -324,9 +337,9 @@ def _wire_index_cache(cfg, model, cache_mesh, state, only_test,
         """(sampler, eval_step) for a test split: its own device-resident
         table bound to the shared cached eval step."""
         table_te, sizes_te = build_table(test_ds)
-        ts = FeatureEpisodeSampler(
-            sizes_te, cfg.n, cfg.k, cfg.q, cfg.batch_size,
-            na_rate=cfg.na_rate, seed=cfg.seed + 2,
+        ts = make_index_sampler(
+            sizes_te, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size,
+            na_rate=cfg.na_rate, seed=cfg.seed + 2, backend=eval_backend,
         )
         return ts, (lambda p, si, qi, l: _eval(p, table_te, si, qi, l))
 
@@ -458,6 +471,30 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
                     f"--tfm_layers ({cfg.tfm_layers}) must be divisible by "
                     f"--pp ({cfg.pp}) pipeline stages"
                 )
+            # Every encoder call's per-dp-shard row count must split evenly
+            # into GPipe microbatches — caught here with flag guidance
+            # instead of a trace-time error deep in gpipe_local (advisor
+            # finding, round 1).
+            dp_sz = mesh.shape["dp"]
+            mb = cfg.pp_microbatches
+            # Train shapes are validated even under --only_test: init_state
+            # below always traces a train-shaped batch to build the model,
+            # so a non-divisible train config would crash mid-trace anyway.
+            row_counts = {
+                "train support": cfg.batch_size * cfg.train_n * cfg.k,
+                "train query": cfg.batch_size
+                * (cfg.train_n * cfg.q + cfg.na_rate * cfg.q),
+                "eval support": cfg.batch_size * cfg.n * cfg.k,
+                "eval query": cfg.batch_size * cfg.total_q,
+            }
+            for what, rows in row_counts.items():
+                if rows % dp_sz != 0 or (rows // dp_sz) % mb != 0:
+                    raise ValueError(
+                        f"{what} rows ({rows}) must divide evenly across "
+                        f"dp={dp_sz} shards and then into "
+                        f"--pp_microbatches ({mb}); adjust --batch_size, "
+                        f"--pp_microbatches, or the episode shape flags"
+                    )
             from induction_network_on_fewrel_tpu.parallel.pipeline import (
                 make_gpipe,
             )
@@ -820,6 +857,21 @@ def train_main(argv=None) -> int:
         start_step=start_step if args.resume else 0,
     )
     if trainer.val_sampler is not None:
+        # Reference behavior: the final number comes from the BEST
+        # checkpoint, not the last state (the toolkit family's train()
+        # reloads best-val weights before its final eval).
+        if trainer.ckpt is not None:
+            try:
+                import jax as _jax
+
+                state, best_step = trainer.ckpt.restore_best(
+                    _jax.device_get(state)
+                )
+                state = trainer.reshard_state(state)
+                print(f"final eval from best checkpoint (step {best_step})",
+                      file=sys.stderr)
+            except FileNotFoundError:
+                pass  # no best saved (e.g. val never ran): use last state
         acc = trainer.evaluate(state.params, cfg.val_iter)
         print(f'{{"final_val_accuracy": {acc:.4f}}}')
     return 0

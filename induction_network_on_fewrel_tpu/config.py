@@ -110,6 +110,15 @@ class ExperimentConfig:
     # tokenized dataset once; per step only episode indices cross
     # host->device. Any encoder, full training semantics; excludes pair/adv.
     token_cache: bool = False
+    # Training-divergence guard (SURVEY.md §5.3 failure detection). The
+    # paper's MSE-over-sigmoid loss has a saturation dead zone: on long
+    # overfit runs the constant downward pressure on false-class scores
+    # eventually drives EVERY score to ~0, where sigmoid gradients vanish
+    # and the run is permanently stuck (measured on the synthetic soak,
+    # 2026-07-30; inherent to the loss, not a porting artifact — CE is
+    # immune). "none": log a divergence event and keep going (reference
+    # behavior); "stop": restore the best checkpoint and end the run.
+    divergence_guard: str = "none"
 
     # --- FewRel 2.0 adversarial domain adaptation (training-time only) ---
     adv: bool = False         # train encoder against a domain discriminator
@@ -120,6 +129,14 @@ class ExperimentConfig:
     # --- numerics / device ---
     device: str = "tpu"       # tpu | cpu  (reference-mandated new flag)
     compute_dtype: str = "bfloat16"  # matmul dtype on the MXU
+    # Episode-head (induction/routing/NTN/logits) dtype. The head is tiny
+    # next to the encoder, but its output IS the loss surface: in bf16 the
+    # logits carry ~0.4% quantization, and a long overfit run sits exactly
+    # on that noise floor, where Adam's tiny second moments turn the noise
+    # into full-size random steps (observed collapse to the zero-logit
+    # basin at step ~1.2k on the synthetic soak, 2026-07-30). f32 here
+    # costs <~2% end-to-end and keeps the loss surface real.
+    head_dtype: str = "float32"
     param_dtype: str = "float32"
     seed: int = 0
 

@@ -163,6 +163,7 @@ def build_model(
             ntn_slices=cfg.ntn_slices,
             nota=cfg.na_rate > 0,
             compute_dtype=dtype,
+            head_dtype=_DTYPES[cfg.head_dtype],
         )
     common = dict(
         embedding=embedding,

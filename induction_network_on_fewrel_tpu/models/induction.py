@@ -91,14 +91,19 @@ class InductionNetwork(FewShotModel):
     induction_dim: int = 100
     routing_iters: int = 3
     ntn_slices: int = 100
+    # The episode head runs in its own (default f32) dtype: its output is
+    # the loss surface, and bf16 logit quantization (~0.4%) becomes the
+    # training noise floor on long overfit runs (see config.head_dtype).
+    # The FLOPs live in the encoder, which keeps compute_dtype.
+    head_dtype: jnp.dtype = jnp.float32
 
     def setup(self):
         self.induction = Induction(
-            self.induction_dim, self.routing_iters, compute_dtype=self.compute_dtype
+            self.induction_dim, self.routing_iters, compute_dtype=self.head_dtype
         )
-        self.relation = RelationNTN(self.ntn_slices, compute_dtype=self.compute_dtype)
+        self.relation = RelationNTN(self.ntn_slices, compute_dtype=self.head_dtype)
         self.query_proj = nn.Dense(
-            self.induction_dim, dtype=self.compute_dtype, param_dtype=jnp.float32
+            self.induction_dim, dtype=self.head_dtype, param_dtype=jnp.float32
         )
         self.make_nota_param()
 

@@ -50,7 +50,13 @@ def gpipe_local(block_fn: Callable, stacked_local, x: jnp.ndarray,
     stage = jax.lax.axis_index(axis)
     m = microbatches
     M, L, d = x.shape
-    assert M % m == 0, f"batch rows {M} not divisible by {m} microbatches"
+    if M % m != 0:
+        # ValueError (not assert): survives python -O, and the CLI surfaces
+        # it with per-flag guidance before tracing ever starts.
+        raise ValueError(
+            f"pipeline batch rows per dp shard ({M}) must divide evenly "
+            f"into pp_microbatches ({m}); adjust --batch_size/--pp_microbatches"
+        )
     mb = M // m
 
     xs = x.reshape(m, mb, L, d)

@@ -20,7 +20,10 @@ from induction_network_on_fewrel_tpu.config import ExperimentConfig
 # message instead of an opaque orbax tree mismatch.
 #   v2: BiLSTM params became explicit w_ih/w_hh/bias (ops/lstm.py backends)
 #       instead of flax RNN/OptimizedLSTMCell's nested tree.
-FORMAT_VERSION = 2
+#   v3: BiLSTM directions un-tied — w_ih/w_hh/bias grew a leading [2, ...]
+#       direction axis (torch bidirectional parity: independent `*_reverse`
+#       weights per direction).
+FORMAT_VERSION = 3
 
 
 def _format_compatible(stored: int, arch: ExperimentConfig) -> bool:
@@ -32,9 +35,10 @@ def _format_compatible(stored: int, arch: ExperimentConfig) -> bool:
     """
     if stored == FORMAT_VERSION:
         return True
-    if stored == 1:
+    if stored in (1, 2):
         # v1 -> v2 changed only the BiLSTM encoder's param tree
-        # (ops/lstm.py explicit w_ih/w_hh/bias); cnn/bert restore unchanged.
+        # (ops/lstm.py explicit w_ih/w_hh/bias); v2 -> v3 gave those params
+        # a leading direction axis. cnn/bert restore unchanged either way.
         return arch.encoder != "bilstm"
     return False
 
@@ -91,7 +95,6 @@ class CheckpointManager:
             self.dir / "latest",
             options=ocp.CheckpointManagerOptions(max_to_keep=1),
         )
-
     def save(self, step: int, state: Any, val_accuracy: float) -> None:
         self.mngr.save(
             step,
@@ -110,6 +113,24 @@ class CheckpointManager:
         self.latest_mngr.save(step, args=ocp.args.StandardSave(state))
         self.latest_mngr.wait_until_finished()
 
+    def check_start_step(self, start_step: int) -> None:
+        """Guard a run numbering steps from ``start_step`` against a dir
+        whose checkpoints are already ahead: orbax managers silently refuse
+        saves at steps <= their latest (verified: ``save`` returns False),
+        so every checkpoint of the new run would be dropped. Fail loudly at
+        run start instead (advisor finding, round 1)."""
+        existing = max(
+            (s for m in (self.mngr, self.latest_mngr) for s in m.all_steps()),
+            default=None,
+        )
+        if existing is not None and start_step < existing:
+            raise ValueError(
+                f"checkpoint dir {self.dir} already holds step {existing}, "
+                f"but this run numbers steps from {start_step}; orbax would "
+                f"silently drop every new save. Pass --resume to continue "
+                f"the existing run, or point --save_ckpt at a fresh directory"
+            )
+
     def restore_best(self, target: Any) -> tuple[Any, int]:
         step = self.mngr.best_step()
         if step is None:
@@ -117,7 +138,13 @@ class CheckpointManager:
         return self.mngr.restore(step, args=ocp.args.StandardRestore(target)), step
 
     def restore_latest(self, target: Any) -> tuple[Any, int]:
-        """Newest state across the best-tracked steps AND the recovery ring."""
+        """Newest state across the best-tracked steps AND the recovery ring.
+
+        Step number IS save order here: check_start_step (enforced at every
+        training start) refuses runs whose numbering would collide with a
+        dir's existing checkpoints, so within any dir this build writes,
+        higher step == later save. The ring wins ties (it is written at
+        every val boundary; the best manager only on improvement)."""
         best_side = self.mngr.latest_step()
         ring_side = self.latest_mngr.latest_step()
         if best_side is None and ring_side is None:

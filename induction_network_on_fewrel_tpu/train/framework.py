@@ -137,6 +137,12 @@ class FewShotTrainer:
         if cfg.steps_per_call > 1 and eval_step is None:
             self._fused_eval = make_multi_eval_step(model, cfg)
 
+    def _can_sample_fused(self) -> bool:
+        """Whether the train sampler fills a fused [S,B,*] stack in one
+        call (index samplers; FeatureEpisodeSampler only in index mode)."""
+        s = self.train_sampler
+        return hasattr(s, "sample_fused") and getattr(s, "return_indices", True)
+
     def init_state(self):
         # Reuse a pre-built state when one was injected: mesh-sharded steps
         # are traced against its exact pytree metadata (optimizer function
@@ -165,6 +171,10 @@ class FewShotTrainer:
         step numbers keep increasing across restarts — orbax retention and
         the recovery ring compare by step)."""
         cfg = self.cfg
+        if self.ckpt is not None:
+            # A dir whose checkpoints are ahead of this run's numbering
+            # would silently swallow every save — refuse up front.
+            self.ckpt.check_start_step(start_step)
         state = state if state is not None else self.init_state()
         num_iters = num_iters or cfg.train_iter
         end_step = start_step + num_iters
@@ -177,6 +187,7 @@ class FewShotTrainer:
         window = max(50, 4 * cfg.steps_per_call)
         adv = self.adv
         profiling = profile_done = False
+        diverged_stop = False
         step = start_step
         while step < end_step:
             # Trace steps [1, 1+profile_steps): the first call (the compile)
@@ -192,12 +203,18 @@ class FewShotTrainer:
             spc = cfg.steps_per_call
             adv_fused = adv is not None and adv.multi_step is not None
             if self._fused_step is not None and end_step - step >= spc:
-                batches = [
-                    batch_to_model_inputs(next(it)) for _ in range(spc)
-                ]
-                sup_s, qry_s, lab_s = jax.tree.map(
-                    lambda *xs: np.stack(xs), *batches
-                )
+                if self._can_sample_fused():
+                    # Index samplers fill the whole [S,B,*] stack in one
+                    # native call — the per-batch Python loop below was
+                    # measurable host overhead at large steps_per_call.
+                    sup_s, qry_s, lab_s = self.train_sampler.sample_fused(spc)
+                else:
+                    batches = [
+                        batch_to_model_inputs(next(it)) for _ in range(spc)
+                    ]
+                    sup_s, qry_s, lab_s = jax.tree.map(
+                        lambda *xs: np.stack(xs), *batches
+                    )
                 state, metrics = self._fused_step(state, sup_s, qry_s, lab_s)
                 prev, step = step, step + spc
             elif adv_fused and end_step - step >= spc:
@@ -250,20 +267,62 @@ class FewShotTrainer:
             if self.val_sampler is not None and crossed_val:
                 val_acc = self.evaluate(state.params, cfg.val_iter)
                 self.logger.log(step, "val", accuracy=val_acc)
+                improved = val_acc > self.best_val
+                if improved:
+                    # Tracked even with no ckpt dir: the divergence guard
+                    # below compares against it either way.
+                    self.best_val = val_acc
                 if self.ckpt is not None:
-                    if val_acc > self.best_val:
-                        self.best_val = val_acc
+                    if improved:
                         self.ckpt.save(step, state, val_acc)
                     # Recovery ring: saved at EVERY val boundary so a crash
                     # on a plateau resumes from here, not the stale best.
                     self.ckpt.save_latest(step, state)
+                # Divergence guard (SURVEY.md §5.3): the MSE-sigmoid loss
+                # can fall into its saturation dead zone on long overfit
+                # runs (all scores ~0, gradients vanished, unrecoverable —
+                # see config.divergence_guard). Detect the collapse at the
+                # val boundary; optionally restore the best checkpoint and
+                # end the run instead of burning the remaining steps.
+                if self.best_val > 0.5 and val_acc < 0.5 * self.best_val:
+                    self.logger.log(
+                        step, "divergence",
+                        val_accuracy=val_acc, best_val=self.best_val,
+                    )
+                    if cfg.divergence_guard == "stop" and self.ckpt is not None:
+                        try:
+                            state, best_step = self.ckpt.restore_best(
+                                jax.device_get(state)
+                            )
+                        except FileNotFoundError:
+                            best_step = None
+                        if self.mesh is not None:
+                            state = self.reshard_state(state)
+                        # Purge ring slots newer than the restored best:
+                        # they hold the dead-zone state, and orbax refuses
+                        # re-saves at <= its latest step, so a later
+                        # --resume would otherwise restore the collapse.
+                        for s in self.ckpt.latest_mngr.all_steps():
+                            if best_step is None or s > best_step:
+                                self.ckpt.latest_mngr.delete(s)
+                        self.logger.log(
+                            step, "divergence_stop",
+                            restored_step=float(
+                                best_step if best_step is not None else -1
+                            ),
+                        )
+                        diverged_stop = True
+                        break
                 t0 = time.monotonic()
                 last_logged = step
         if profiling:
             jax.profiler.stop_trace()  # run ended inside the trace window
-        if self.ckpt is not None:
+        if self.ckpt is not None and not diverged_stop:
             # Final ring save (no-op if the last val boundary already wrote
             # this step): --resume continues from the end of this run.
+            # Skipped after a divergence stop — the returned state is the
+            # restored BEST (an earlier step), and stamping it with the
+            # diverged run's step number would corrupt resume ordering.
             self.ckpt.save_latest(step, state)
         return state
 

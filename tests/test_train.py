@@ -336,6 +336,61 @@ def test_recovery_ring_saves_latest_on_plateau(tmp_path):
     assert mgr.latest_mngr.latest_step() == 20
 
 
+def test_stale_checkpoint_dir_guard(tmp_path):
+    """Orbax silently refuses saves at steps <= a dir's existing latest
+    (verified: ``save`` returns False), so a run that restarts step
+    numbering into a populated dir would lose EVERY checkpoint. The
+    check_start_step guard must refuse such runs up front with flag
+    guidance; legitimate resumes pass (advisor finding, r1)."""
+    import pytest
+
+    from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
+
+    cfg = ExperimentConfig(
+        encoder="cnn", n=2, k=2, q=2, batch_size=2, max_length=L,
+        vocab_size=302, compute_dtype="float32",
+    )
+    model, sampler = _setup(cfg)
+    sup, qry, _ = batch_to_model_inputs(sampler.sample_batch())
+    state = jax.device_get(init_state(model, cfg, sup, qry))
+
+    mgr = CheckpointManager(tmp_path, cfg)
+    mgr.save(500, state, val_accuracy=0.9)  # prior run's best, step 500
+    mgr.save_latest(700, state)             # prior run's ring, saved later
+
+    with pytest.raises(ValueError, match="resume"):
+        mgr.check_start_step(0)             # fresh fine-tune into old dir
+    mgr.check_start_step(700)               # legitimate --resume: fine
+
+    # Under the guard, step order == save order: restore_latest picks the
+    # newest (the ring here).
+    _, step = mgr.restore_latest(state)
+    assert step == 700
+
+
+def test_divergence_guard_stops_and_restores_best(tmp_path, monkeypatch):
+    """divergence_guard=stop: a >2x val collapse ends the run with the best
+    checkpoint restored (the MSE-sigmoid dead zone is unrecoverable, so
+    the remaining steps would be wasted)."""
+    cfg = ExperimentConfig(
+        encoder="cnn", n=2, k=2, q=2, batch_size=2, max_length=L,
+        vocab_size=302, compute_dtype="float32", val_step=5, val_iter=4,
+        divergence_guard="stop",
+    )
+    model, sampler = _setup(cfg)
+    trainer = FewShotTrainer(
+        model, cfg, sampler, val_sampler=sampler, ckpt_dir=tmp_path,
+        logger=MetricsLogger(quiet=True),
+    )
+    vals = iter([0.9, 0.2, 0.2, 0.2, 0.2, 0.2])
+    monkeypatch.setattr(trainer, "evaluate", lambda *a, **k: next(vals))
+    state = trainer.train(num_iters=30)
+    # Val 0.9 at step 5 (best saved), collapse 0.2 at step 10 -> stop and
+    # restore: fewer than 30 steps ran and the returned state is step 5.
+    assert trainer.ckpt.mngr.best_step() == 5
+    assert int(state.step) == 5
+
+
 def test_embed_optimizer_frozen_keeps_table_fixed():
     """embed_optimizer=frozen: GloVe rows never move; other params train."""
     cfg = ExperimentConfig(
