@@ -1,0 +1,83 @@
+"""Analytic FLOPs/step accounting for MFU reporting.
+
+The perf pillar of this framework is single-chip efficiency, so the bench
+reports model FLOPs utilization (MFU) next to episodes/sec: achieved
+matmul FLOPs/s divided by the chip's peak. Counting follows the standard
+MFU convention (PaLM appendix B / the scaling-book): MATMUL terms only —
+elementwise ops, gathers, softmaxes, and the optimizer update are excluded
+(they are bandwidth-, not FLOP-, bound), and the training step costs 3x the
+forward matmuls (1x forward + 2x backward).
+
+Shapes mirror models/encoders.py + models/induction.py exactly; if a module
+changes its contraction structure, update the matching term here (each term
+is labeled with its source line).
+"""
+
+from __future__ import annotations
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+
+# Peak dense matmul throughput per chip, by jax device_kind fragments.
+# v5e ("TPU v5 lite"): 197 TFLOP/s bf16, 99 TFLOP/s f32 (half rate).
+_PEAK_BF16 = {
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def peak_flops_per_chip(device_kind: str, compute_dtype: str) -> float | None:
+    """Best-effort peak lookup; None when the chip is unknown (CPU etc.)."""
+    kind = device_kind.lower()
+    for frag, peak in _PEAK_BF16.items():
+        if frag in kind:
+            return peak if "bfloat16" in compute_dtype else peak / 2
+    return None
+
+
+def bilstm_induction_train_flops(cfg: ExperimentConfig) -> dict:
+    """Matmul FLOPs per optimizer step of the flagship BiLSTM induction
+    network (batch_size episodes, train-shape rows).
+
+    Returns {"forward": F, "train": 3F, "per_episode": 3F/B}.
+    """
+    if cfg.encoder != "bilstm" or cfg.model != "induction":
+        raise ValueError(
+            "analytic FLOPs are derived for the bilstm induction flagship; "
+            f"got encoder={cfg.encoder!r} model={cfg.model!r}"
+        )
+    B = cfg.batch_size
+    N, K = cfg.train_n, cfg.k
+    TQ = cfg.train_n * cfg.q + cfg.na_rate * cfg.q
+    L = cfg.max_length
+    D = cfg.word_dim + 2 * cfg.pos_dim          # embedded token dim
+    u = cfg.lstm_hidden
+    A = cfg.att_dim
+    H = 2 * u                                   # encoder output dim
+    C = cfg.induction_dim
+    S = cfg.ntn_slices
+
+    Ms = B * N * K                              # support rows
+    Mq = B * TQ                                 # query rows
+    M = Ms + Mq                                 # rows through the encoder
+
+    f = 0.0
+    # encoders.py: input projection [M*L, D] x [D, 8u] (both directions).
+    f += 2.0 * M * L * D * (8 * u)
+    # ops/lstm.py recurrence: per timestep per direction [*, u] x [u, 4u].
+    f += 2.0 * M * L * u * (4 * u) * 2
+    # encoders.py structured attention: W1 proj, w2 scores, weighted sum.
+    f += 2.0 * M * L * H * A + 2.0 * M * L * A + 2.0 * M * L * H
+    # induction.py: shared squash transform on support rows [Ms, H] x [H, C],
+    # and query_proj on query rows [Mq, H] x [H, C] (InductionNetwork.setup).
+    f += 2.0 * Ms * H * C
+    f += 2.0 * Mq * H * C
+    # induction.py routing: riters x (d·e_hat and e_hat·c contractions).
+    f += cfg.routing_iters * 2 * (2.0 * B * N * K * C)
+    # induction.py NTN: bnc,hcd->bnhd then bnhd,bqd->bqnh, plus readout.
+    f += 2.0 * B * N * S * C * C + 2.0 * B * N * S * C * TQ
+    f += 2.0 * B * TQ * N * S
+    return {"forward": f, "train": 3.0 * f, "per_episode": 3.0 * f / B}
