@@ -35,3 +35,27 @@ def predict(logits: jnp.ndarray) -> jnp.ndarray:
 
 def accuracy(logits: jnp.ndarray, label: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean((predict(logits) == label).astype(jnp.float32))
+
+
+def metric_keys(cfg) -> tuple[str, ...]:
+    """Keys of the per-step metric dict (loss/accuracy + NOTA counts when
+    na_rate > 0) — single source for the sharded steps' out_shardings."""
+    base = ("loss", "accuracy")
+    return base + (("nota_tp", "nota_pred", "nota_true") if cfg.na_rate > 0
+                   else ())
+
+
+def episode_metrics(logits: jnp.ndarray, label: jnp.ndarray, nota: bool) -> dict:
+    """accuracy (+ NOTA confusion fractions when the N+1 'none' class is
+    active — BASELINE config #5). The three NOTA entries share one
+    denominator (all queries), so aggregated precision/recall are exact:
+    p = Σtp/Σpred, r = Σtp/Σtrue (see FewShotTrainer.evaluate)."""
+    m = {"accuracy": accuracy(logits, label)}
+    if nota:
+        n = logits.shape[-1] - 1  # the appended none-of-the-above class
+        is_pred = predict(logits) == n
+        is_true = label == n
+        m["nota_tp"] = jnp.mean((is_pred & is_true).astype(jnp.float32))
+        m["nota_pred"] = jnp.mean(is_pred.astype(jnp.float32))
+        m["nota_true"] = jnp.mean(is_true.astype(jnp.float32))
+    return m

@@ -294,22 +294,23 @@ def make_cached_eval_step(model, cfg, mesh=None, state_example=None):
     """jitted (params, table, sup_idx, qry_idx, label) -> metrics dict."""
     import jax
 
-    from induction_network_on_fewrel_tpu.models.losses import accuracy
+    from induction_network_on_fewrel_tpu.models.losses import episode_metrics
     from induction_network_on_fewrel_tpu.train.steps import LOSS_FNS
 
     def step(params, table, sup_idx, qry_idx, label):
         logits = model.apply(params, table[sup_idx], table[qry_idx])
         return {
             "loss": LOSS_FNS[cfg.loss](logits, label),
-            "accuracy": accuracy(logits, label),
+            **episode_metrics(logits, label, cfg.na_rate > 0),
         }
 
     if mesh is None:
         return jax.jit(step)
-    return _shard_cached(step, mesh, state_example, params_only=True)
+    return _shard_cached(step, mesh, state_example, params_only=True, cfg=cfg)
 
 
-def _shard_cached(fn, mesh, state_example, stacked=False, params_only=False):
+def _shard_cached(fn, mesh, state_example, stacked=False, params_only=False,
+                  cfg=None):
     """jit ``fn`` with cached-path shardings: state per the standard rules,
     table replicated, index/label episode axis over 'dp'."""
     import jax
@@ -328,8 +329,13 @@ def _shard_cached(fn, mesh, state_example, stacked=False, params_only=False):
         dp2 = NamedSharding(mesh, P(None, "dp", None))
         dp3 = NamedSharding(mesh, P(None, "dp", None, None))
 
+    from induction_network_on_fewrel_tpu.models.losses import metric_keys
+
     st_sh = state_shardings(state_example, mesh)
-    metric_sh = {"loss": repl, "accuracy": repl}
+    # Eval metric dicts grow NOTA keys when na_rate > 0 (losses.metric_keys);
+    # train paths pass cfg=None and keep the base shape.
+    keys = metric_keys(cfg) if cfg is not None else ("loss", "accuracy")
+    metric_sh = {k: repl for k in keys}
     if params_only:
         return jax.jit(
             fn,

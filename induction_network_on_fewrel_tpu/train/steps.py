@@ -22,6 +22,7 @@ from induction_network_on_fewrel_tpu.config import ExperimentConfig
 from induction_network_on_fewrel_tpu.models.losses import (
     accuracy,
     cross_entropy_loss,
+    episode_metrics,
     mse_onehot_loss,
 )
 
@@ -189,7 +190,7 @@ def make_eval_step(model, cfg: ExperimentConfig):
         logits = model.apply(params, support, query)
         return {
             "loss": LOSS_FNS[cfg.loss](logits, label),
-            "accuracy": accuracy(logits, label),
+            **episode_metrics(logits, label, cfg.na_rate > 0),
         }
 
     return eval_step
@@ -211,7 +212,7 @@ def make_multi_eval_step(model, cfg: ExperimentConfig):
             logits = model.apply(params, support, query)
             return {
                 "loss": LOSS_FNS[cfg.loss](logits, label),
-                "accuracy": accuracy(logits, label),
+                **episode_metrics(logits, label, cfg.na_rate > 0),
             }
 
         return jax.lax.map(body, (support_s, query_s, label_s))

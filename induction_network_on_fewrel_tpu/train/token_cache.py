@@ -105,7 +105,7 @@ def make_token_cached_multi_train_step(model, cfg, mesh=None, state_example=None
 def make_token_cached_eval_step(model, cfg, mesh=None, state_example=None):
     import jax
 
-    from induction_network_on_fewrel_tpu.models.losses import accuracy
+    from induction_network_on_fewrel_tpu.models.losses import episode_metrics
     from induction_network_on_fewrel_tpu.train.steps import LOSS_FNS
 
     def step(params, table, sup_idx, qry_idx, label):
@@ -114,15 +114,15 @@ def make_token_cached_eval_step(model, cfg, mesh=None, state_example=None):
         )
         return {
             "loss": LOSS_FNS[cfg.loss](logits, label),
-            "accuracy": accuracy(logits, label),
+            **episode_metrics(logits, label, cfg.na_rate > 0),
         }
 
     if mesh is None:
         return jax.jit(step)
-    return _shard(step, mesh, state_example, params_only=True)
+    return _shard(step, mesh, state_example, params_only=True, cfg=cfg)
 
 
-def _shard(fn, mesh, state_example, stacked=False, params_only=False):
+def _shard(fn, mesh, state_example, stacked=False, params_only=False, cfg=None):
     """Cached-path shardings — delegated to feature_cache._shard_cached:
     state per the standard rules, the table replicated (the bare replicated
     sharding it declares for its table arg is a PREFIX pytree, so it covers
@@ -130,4 +130,4 @@ def _shard(fn, mesh, state_example, stacked=False, params_only=False):
     feature array), index/label episode axes over 'dp'."""
     from induction_network_on_fewrel_tpu.train.feature_cache import _shard_cached
 
-    return _shard_cached(fn, mesh, state_example, stacked, params_only)
+    return _shard_cached(fn, mesh, state_example, stacked, params_only, cfg=cfg)

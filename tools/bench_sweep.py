@@ -308,6 +308,11 @@ def main() -> int:
         ("5t: 5w5s bilstm na_rate=5 token_cache (NOTA)", ExperimentConfig(
             encoder="bilstm", n=5, k=5, q=5, na_rate=5, token_cache=True,
             **{**base, "steps_per_call": 512}), False),
+        # NOTA fraction = na_rate/(n + na_rate): row 5t above is the 50%
+        # mix (na_rate=5 at 5-way); this row adds the light 1/6 mix.
+        ("5n: 5w5s bilstm na_rate=1 token_cache (NOTA 1:6)", ExperimentConfig(
+            encoder="bilstm", n=5, k=5, q=5, na_rate=1, token_cache=True,
+            **{**base, "steps_per_call": 512}), False),
     ]
     only = sys.argv[1:] or None
     for name, cfg, adv in configs:
