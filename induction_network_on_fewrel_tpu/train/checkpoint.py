@@ -8,12 +8,27 @@ reference family lose).
 
 from __future__ import annotations
 
+import queue
+import threading
 from pathlib import Path
 from typing import Any
 
 import orbax.checkpoint as ocp
 
 from induction_network_on_fewrel_tpu.config import ExperimentConfig
+
+
+def _device_snapshot(state: Any) -> Any:
+    """Copy device arrays on-device (HBM-speed, async dispatch) so the
+    snapshot is decoupled from buffer donation: the next train step donates
+    the live state's buffers, and the d2h transfer happens later on the
+    saver thread from this copy. Host arrays pass through untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, state
+    )
 
 # Parameter-tree layout version, stored next to config.json. Bump whenever a
 # module's param structure changes incompatibly so restores fail with THIS
@@ -95,23 +110,104 @@ class CheckpointManager:
             self.dir / "latest",
             options=ocp.CheckpointManagerOptions(max_to_keep=1),
         )
+
+        # Async saver thread. Orbax's own async checkpointer still copies
+        # device->host SYNCHRONOUSLY before returning, and on a tunneled
+        # backend that d2h (hundreds of MB at the 400k-vocab config) IS the
+        # boundary cost — so the whole save (d2h from a device-side
+        # snapshot + orbax write) runs here, off the training loop.
+        #
+        # Bounded queue = backpressure: each enqueued item pins a full
+        # on-device state snapshot, so an unbounded queue would grow HBM
+        # without limit if boundaries outpace the saver; with maxsize=2 a
+        # third save blocks (the old synchronous behavior) instead.
+        #
+        # Thread-safety: the orbax managers are NOT thread-safe, so after
+        # construction they are touched ONLY on this thread or behind
+        # wait() (restore_*/check_start_step); the save_latest dedupe reads
+        # the python-side _enqueued record, never the managers.
+        self._q: queue.Queue = queue.Queue(maxsize=2)
+        self._save_error: Exception | None = None
+        self._enqueued = {
+            "best": self.mngr.latest_step(),
+            "ring": self.latest_mngr.latest_step(),
+        }
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+        self._worker.start()
+        # Durability on abnormal exits: the worker is a daemon (a wedged
+        # device fetch must not block interpreter exit forever), so flush
+        # enqueued saves at exit — covers exceptions and SIGINT, which the
+        # old synchronous save() handled by construction.
+        import atexit
+
+        atexit.register(self._flush_at_exit)
+
+    def _flush_at_exit(self) -> None:
+        try:
+            self.wait()
+        except Exception:  # noqa: BLE001 — best-effort at interpreter exit
+            pass
+
+    def _drain(self) -> None:
+        import jax
+
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                kind, step, snap, metric = item
+                host = jax.device_get(snap)
+                if kind == "best":
+                    self.mngr.save(
+                        step,
+                        args=ocp.args.StandardSave(host),
+                        metrics={"val_accuracy": metric},
+                    )
+                else:
+                    self.latest_mngr.save(
+                        step, args=ocp.args.StandardSave(host)
+                    )
+            except Exception as e:  # noqa: BLE001 — surfaced by wait()
+                self._save_error = e
+            finally:
+                self._q.task_done()
+
     def save(self, step: int, state: Any, val_accuracy: float) -> None:
-        self.mngr.save(
-            step,
-            args=ocp.args.StandardSave(state),
-            metrics={"val_accuracy": float(val_accuracy)},
+        """ASYNC: snapshots the state on-device and returns; the d2h copy
+        and the orbax write happen on the saver thread, off the training
+        critical path. Durability points: restore_*() and wait() block
+        first; the trainer calls wait() at run end."""
+        self._check_save_error()
+        self._enqueued["best"] = step
+        self._q.put(
+            ("best", step, _device_snapshot(state), float(val_accuracy))
         )
-        self.mngr.wait_until_finished()
 
     def save_latest(self, step: int, state: Any) -> None:
-        """Recovery save (single rotating slot). Skipped when either manager
-        already holds this step — restore_latest consults both, so a
-        best-save at the same boundary makes the ring write pure duplicate
-        I/O (each save is a full state serialization + blocking wait)."""
-        if step in (self.latest_mngr.latest_step(), self.mngr.latest_step()):
+        """Recovery save (single rotating slot), async like save(). Skipped
+        when either side already holds (or was just enqueued with) this
+        step — restore_latest consults both, so a best-save at the same
+        boundary makes the ring write pure duplicate I/O. The dedupe reads
+        only the python-side ledger (_enqueued, seeded from the managers at
+        construction): the managers themselves belong to the saver thread."""
+        self._check_save_error()
+        if step in self._enqueued.values():
             return
-        self.latest_mngr.save(step, args=ocp.args.StandardSave(state))
+        self._enqueued["ring"] = step
+        self._q.put(("ring", step, _device_snapshot(state), None))
+
+    def wait(self) -> None:
+        """Block until every enqueued async save is durable on disk."""
+        self._q.join()
+        self.mngr.wait_until_finished()
         self.latest_mngr.wait_until_finished()
+        self._check_save_error()
+
+    def _check_save_error(self) -> None:
+        if self._save_error is not None:
+            err, self._save_error = self._save_error, None
+            raise RuntimeError("async checkpoint save failed") from err
 
     def check_start_step(self, start_step: int) -> None:
         """Guard a run numbering steps from ``start_step`` against a dir
@@ -119,6 +215,7 @@ class CheckpointManager:
         saves at steps <= their latest (verified: ``save`` returns False),
         so every checkpoint of the new run would be dropped. Fail loudly at
         run start instead (advisor finding, round 1)."""
+        self.wait()  # in-flight async saves count as existing
         existing = max(
             (s for m in (self.mngr, self.latest_mngr) for s in m.all_steps()),
             default=None,
@@ -132,6 +229,7 @@ class CheckpointManager:
             )
 
     def restore_best(self, target: Any) -> tuple[Any, int]:
+        self.wait()  # a step mid-write is not restorable yet
         step = self.mngr.best_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.dir}")
@@ -145,6 +243,7 @@ class CheckpointManager:
         dir's existing checkpoints, so within any dir this build writes,
         higher step == later save. The ring wins ties (it is written at
         every val boundary; the best manager only on improvement)."""
+        self.wait()  # a step mid-write is not restorable yet
         best_side = self.mngr.latest_step()
         ring_side = self.latest_mngr.latest_step()
         if best_side is None and ring_side is None:

@@ -265,8 +265,13 @@ class FewShotTrainer:
                 and step // cfg.val_step > prev // cfg.val_step
             )
             if self.val_sampler is not None and crossed_val:
-                val_acc = self.evaluate(state.params, cfg.val_iter)
-                self.logger.log(step, "val", accuracy=val_acc)
+                val_metrics = self.evaluate(
+                    state.params, cfg.val_iter, return_metrics=True
+                )
+                val_acc = val_metrics["accuracy"]
+                # metrics.jsonl carries nota_precision/nota_recall when
+                # na_rate > 0 (BASELINE config #5's evaluation depth).
+                self.logger.log(step, "val", **val_metrics)
                 improved = val_acc > self.best_val
                 if improved:
                     # Tracked even with no ckpt dir: the divergence guard
@@ -317,23 +322,39 @@ class FewShotTrainer:
                 last_logged = step
         if profiling:
             jax.profiler.stop_trace()  # run ended inside the trace window
-        if self.ckpt is not None and not diverged_stop:
-            # Final ring save (no-op if the last val boundary already wrote
-            # this step): --resume continues from the end of this run.
-            # Skipped after a divergence stop — the returned state is the
-            # restored BEST (an earlier step), and stamping it with the
-            # diverged run's step number would corrupt resume ordering.
-            self.ckpt.save_latest(step, state)
+        if self.ckpt is not None:
+            if not diverged_stop:
+                # Final ring save (no-op if the last val boundary already
+                # wrote this step): --resume continues from the end of this
+                # run. Skipped after a divergence stop — the returned state
+                # is the restored BEST (an earlier step), and stamping it
+                # with the diverged run's step number would corrupt resume
+                # ordering.
+                self.ckpt.save_latest(step, state)
+            # Saves are async (off the val-boundary critical path); the
+            # run's contract is that returning implies durable checkpoints.
+            self.ckpt.wait()
         return state
 
-    def evaluate(self, params, num_episodes: int, sampler=None) -> float:
-        """Mean episode accuracy over ``num_episodes`` episodes."""
+    def evaluate(self, params, num_episodes: int, sampler=None,
+                 return_metrics: bool = False):
+        """Mean episode accuracy over ``num_episodes`` episodes.
+
+        ``return_metrics=True`` returns the full metric dict instead of the
+        bare float — with ``na_rate > 0`` that adds ``nota_precision`` /
+        ``nota_recall`` (aggregated exactly from the per-batch confusion
+        fractions: all three share the all-queries denominator)."""
         sampler = sampler or self.val_sampler
-        accs = []
+        collected: dict[str, list] = {}
         n_batches = max(1, num_episodes // sampler.batch_size)
         it: Iterator = iter(sampler)
         spc = self.cfg.steps_per_call
         remaining = n_batches
+
+        def collect(out):
+            for k, v in out.items():
+                collected.setdefault(k, []).append(v)
+
         while remaining > 0:
             if self._fused_eval is not None and remaining >= spc:
                 batches = [
@@ -342,14 +363,26 @@ class FewShotTrainer:
                 sup_s, qry_s, lab_s = jax.tree.map(
                     lambda *xs: np.stack(xs), *batches
                 )
-                out = self._fused_eval(params, sup_s, qry_s, lab_s)
-                accs.append(out["accuracy"])  # [S]
+                collect(self._fused_eval(params, sup_s, qry_s, lab_s))  # [S]
                 remaining -= spc
             else:
                 support, query, label = batch_to_model_inputs(next(it))
-                out = self.eval_step(params, support, query, label)
-                accs.append(out["accuracy"])
+                collect(self.eval_step(params, support, query, label))
                 remaining -= 1
-        return float(np.mean(np.concatenate(
-            [np.atleast_1d(np.asarray(a)) for a in jax.device_get(accs)]
-        )))
+        means = {
+            k: float(np.mean(np.concatenate(
+                [np.atleast_1d(np.asarray(a)) for a in jax.device_get(v)]
+            )))
+            for k, v in collected.items()
+        }
+        if not return_metrics:
+            return means["accuracy"]
+        metrics = {"accuracy": means["accuracy"]}
+        if "nota_tp" in means:
+            metrics["nota_precision"] = means["nota_tp"] / max(
+                means["nota_pred"], 1e-12
+            )
+            metrics["nota_recall"] = means["nota_tp"] / max(
+                means["nota_true"], 1e-12
+            )
+        return metrics
