@@ -55,6 +55,12 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
                    help="load-balance aux loss weight")
     p.add_argument("--max_length", type=int, default=40)
     p.add_argument("--hidden_size", type=int, default=230)
+    p.add_argument(
+        "--vocab_size", type=int, default=400002,
+        help="word-embedding rows incl. UNK/BLANK (sets the synthetic GloVe "
+             "size when no --glove file is given; overridden by a loaded "
+             "vocab's true size)",
+    )
     p.add_argument("--lstm_hidden", type=int, default=128)
     p.add_argument(
         "--lstm_backend", default="auto",
@@ -191,6 +197,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         train_n=args.trainN or args.N,
         n=args.N, k=args.K, q=args.Q, na_rate=args.na_rate,
         batch_size=args.batch_size, max_length=args.max_length,
+        vocab_size=getattr(args, "vocab_size", 400002),
         model=args.model, proto_metric=args.proto_metric,
         gnn_dim=args.gnn_dim, gnn_blocks=args.gnn_blocks,
         snail_tc_filters=args.snail_tc_filters,

@@ -24,7 +24,11 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from induction_network_on_fewrel_tpu.config import ExperimentConfig
-from induction_network_on_fewrel_tpu.models.losses import accuracy
+from induction_network_on_fewrel_tpu.models.losses import (
+    accuracy,
+    episode_metrics,
+    metric_keys,
+)
 from induction_network_on_fewrel_tpu.train.steps import (
     LOSS_FNS,
     loss_and_metrics,
@@ -95,13 +99,26 @@ def shard_state(state: Any, mesh: Mesh):
 
 
 def episode_batch_shardings(mesh: Mesh):
-    """(support, query, label) shardings: episode axis over dp.
+    """(support, query, label) shardings: episode axis over dp; the token
+    (sequence) axis over sp when the mesh has one.
+
+    Declaring the sequence split AT THE JIT BOUNDARY matters under sequence
+    parallelism: ring attention consumes [.., L, ..] sharded over sp, and a
+    dp-only input sharding forces the partitioner into an "involuntary full
+    rematerialization" replicate-then-reshard of the narrow int8/int16
+    mask/pos leaves (observed in MULTICHIP_r01) — handing it the target
+    layout up front removes the reshard entirely.
 
     Token batches only — the feature-cache path has its own index-mode
     shardings (train/feature_cache.py ``_shard_cached``).
     """
-    sup = {k: NamedSharding(mesh, P("dp", None, None, None)) for k in _BATCH_KEYS}
-    qry = {k: NamedSharding(mesh, P("dp", None, None)) for k in _BATCH_KEYS}
+    sp = (
+        "sp"
+        if "sp" in mesh.axis_names and mesh.shape["sp"] > 1
+        else None
+    )
+    sup = {k: NamedSharding(mesh, P("dp", None, None, sp)) for k in _BATCH_KEYS}
+    qry = {k: NamedSharding(mesh, P("dp", None, sp)) for k in _BATCH_KEYS}
     lab = NamedSharding(mesh, P("dp", None))
     return sup, qry, lab
 
@@ -181,13 +198,13 @@ def make_sharded_eval_step(model, cfg: ExperimentConfig, mesh: Mesh, state_examp
         logits = model.apply(params, support, query)
         return {
             "loss": LOSS_FNS[cfg.loss](logits, label),
-            "accuracy": accuracy(logits, label),
+            **episode_metrics(logits, label, cfg.na_rate > 0),
         }
 
     return jax.jit(
         step,
         in_shardings=(st_sh.params, sup_sh, qry_sh, lab_sh),
-        out_shardings={"loss": repl, "accuracy": repl},
+        out_shardings={k: repl for k in metric_keys(cfg)},
     )
 
 
