@@ -89,6 +89,12 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
              "full training semantics; ~3-4x e2e on tunneled backends)",
     )
     p.add_argument(
+        "--zero_opt", action="store_true",
+        help="ZeRO-1-style optimizer-state sharding: Adam moments shard "
+             "over the dp mesh axis (1/dp of the optimizer HBM per device; "
+             "identical update trajectory)",
+    )
+    p.add_argument(
         "--divergence_guard", default="none", choices=["none", "stop"],
         help="on a >2x val-accuracy collapse (the MSE-sigmoid saturation "
              "dead zone — unrecoverable): 'none' logs it, 'stop' restores "
@@ -223,6 +229,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         feature_cache=getattr(args, "feature_cache", False),
         token_cache=getattr(args, "token_cache", False),
         divergence_guard=getattr(args, "divergence_guard", "none"),
+        zero_opt=getattr(args, "zero_opt", False),
         device=args.device, compute_dtype=compute, seed=args.seed,
         dp=args.dp, tp=args.tp, sp=args.sp, pp=args.pp, ep=args.ep,
         pp_microbatches=args.pp_microbatches,
@@ -584,7 +591,7 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
                 shard_state,
             )
 
-            state = shard_state(state, cache_mesh)
+            state = shard_state(state, cache_mesh, zero_opt=cfg.zero_opt)
 
         def build_table(ds):
             """Encode a split with the cache's backbone -> one flat device
@@ -636,7 +643,7 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
                 shard_state,
             )
 
-            state = shard_state(state, cache_mesh)
+            state = shard_state(state, cache_mesh, zero_opt=cfg.zero_opt)
 
         def build_table(ds):
             """Tokenize a split once -> device-resident token dict + sizes."""

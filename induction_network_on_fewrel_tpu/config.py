@@ -141,6 +141,12 @@ class ExperimentConfig:
     seed: int = 0
 
     # --- parallelism ---
+    # ZeRO-1-style optimizer-state sharding (SURVEY.md §2.2 "ZeRO/FSDP"):
+    # Adam moments shard their leading axis over dp instead of replicating
+    # — 1/dp of the optimizer HBM per device (the relevant regime: BERT
+    # fine-tune pressing v5e HBM at big batch). Exact same update
+    # trajectory; GSPMD inserts the collectives.
+    zero_opt: bool = False
     dp: int = 1               # data-parallel mesh axis (episodes sharded)
     tp: int = 1               # tensor-parallel mesh axis (NTN slices / hidden)
     sp: int = 1               # sequence-parallel mesh axis (ring attention)

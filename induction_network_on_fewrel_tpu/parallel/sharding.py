@@ -78,24 +78,65 @@ def _path_str(path) -> str:
     )
 
 
-def state_shardings(state: Any, mesh: Mesh):
+def state_shardings(state: Any, mesh: Mesh, zero_opt: bool = False):
     """NamedShardings for a TrainState pytree. Works on real arrays or
     ``jax.eval_shape`` ShapeDtypeStructs (only structure/rank are read);
-    opt-state leaves mirror the params rule via their own paths."""
+    opt-state leaves mirror the params rule via their own paths.
+
+    ``zero_opt`` (ZeRO-1-style, SURVEY.md §2.2 "ZeRO/FSDP" row): Adam
+    moment leaves (mu/nu) shard their leading axis over ``dp`` instead of
+    replicating — each dp rank holds 1/dp of the optimizer state (the
+    dominant HBM term beyond params: 2x params for Adam) and GSPMD inserts
+    the reduce-scatter/all-gather around the update. Params themselves stay
+    replicated (the tp/pp/ep rules still apply where they match), so
+    forward/backward are unchanged; only the update's memory/communication
+    layout moves. Per leaf, the first axis whose size divides dp evenly is
+    sharded (``jax.device_put`` rejects uneven shards); leaves with no such
+    axis (biases, odd-sized tables) stay replicated — best-effort coverage,
+    which on BERT-base shards every kernel/moment matrix."""
+    dp = mesh.shape["dp"] if "dp" in mesh.axis_names else 1
+
+    def _effectively_replicated(spec) -> bool:
+        # A spec whose named axes all have mesh size 1 (e.g. the tp rule on
+        # a tp=1 mesh) is replication in practice — without this check the
+        # largest BERT/transformer moment matrices (intermediate/mlp_out,
+        # tensor_slices) would silently dodge the dp sharding.
+        for entry in spec:
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            for ax in axes:
+                if ax is not None and mesh.shape.get(ax, 1) > 1:
+                    return False
+        return True
 
     def assign(path, leaf):
-        return NamedSharding(mesh, _spec_for_path(_path_str(path), leaf))
+        p = _path_str(path)
+        spec = _spec_for_path(p, leaf)
+        if (
+            zero_opt
+            and dp > 1
+            and "opt_state" in p
+            and ("/mu/" in p or "/nu/" in p)
+            and _effectively_replicated(spec)
+        ):
+            for ax, size in enumerate(getattr(leaf, "shape", ())):
+                if size >= dp and size % dp == 0:
+                    axes = [None] * leaf.ndim
+                    axes[ax] = "dp"
+                    spec = P(*axes)
+                    break
+        return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(assign, state)
 
 
-def shard_state(state: Any, mesh: Mesh):
+def shard_state(state: Any, mesh: Mesh, zero_opt: bool = False):
     """Place a (restored or freshly built) state onto the mesh shardings.
 
     Orbax restores commit arrays to a single device; jit with in_shardings
     refuses committed args with mismatched placement, so reshard explicitly.
+    ``zero_opt`` must match the step factories' setting (state_shardings).
     """
-    return jax.device_put(state, state_shardings(state, mesh))
+    return jax.device_put(state, state_shardings(state, mesh, zero_opt=zero_opt))
 
 
 def episode_batch_shardings(mesh: Mesh):
@@ -143,7 +184,9 @@ def make_sharded_train_step(model, cfg: ExperimentConfig, mesh: Mesh, state_exam
     ``state_example``: a real TrainState or ``jax.eval_shape`` result —
     only tree structure and leaf ranks are read.
     """
-    st_sh = state_shardings(state_example, mesh)
+    st_sh = state_shardings(
+        state_example, mesh, zero_opt=getattr(cfg, "zero_opt", False)
+    )
     repl = NamedSharding(mesh, P())
     sup_sh, qry_sh, lab_sh = episode_batch_shardings(mesh)
     body = make_update_body(model, cfg)
@@ -169,7 +212,9 @@ def make_sharded_multi_train_step(
     Dispatch/transfer amortization and multi-chip scaling compose this way:
     XLA still inserts the gradient all-reduce over ICI inside every scan
     iteration."""
-    st_sh = state_shardings(state_example, mesh)
+    st_sh = state_shardings(
+        state_example, mesh, zero_opt=getattr(cfg, "zero_opt", False)
+    )
     repl = NamedSharding(mesh, P())
     sup_sh, qry_sh, lab_sh = episode_batch_shardings(mesh)
     stack = lambda sh: jax.tree.map(
@@ -268,7 +313,9 @@ def make_sharded_adv_train_step(
     from induction_network_on_fewrel_tpu.ops import gradient_reversal
     import jax.numpy as jnp
 
-    st_sh = state_shardings(state_example, mesh)
+    st_sh = state_shardings(
+        state_example, mesh, zero_opt=getattr(cfg, "zero_opt", False)
+    )
     dst_sh = state_shardings(disc_state_example, mesh)
     repl = NamedSharding(mesh, P())
     sup_sh, qry_sh, lab_sh = episode_batch_shardings(mesh)

@@ -157,7 +157,20 @@ class CheckpointManager:
                 if item is None:
                     return
                 kind, step, snap, metric = item
-                host = jax.device_get(snap)
+                # Fully-addressable leaves (single host) are fetched to
+                # numpy here, keeping the d2h on this thread; leaves that
+                # span hosts (e.g. --zero_opt moments dp-sharded over a
+                # pod) go to orbax as jax.Arrays — it performs the
+                # distributed write itself, and device_get on them would
+                # raise.
+                host = jax.tree.map(
+                    lambda x: (
+                        jax.device_get(x)
+                        if not isinstance(x, jax.Array) or x.is_fully_addressable
+                        else x
+                    ),
+                    snap,
+                )
                 if kind == "best":
                     self.mngr.save(
                         step,

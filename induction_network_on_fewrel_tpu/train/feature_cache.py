@@ -267,7 +267,9 @@ def make_cached_train_step(model, cfg, mesh=None, state_example=None):
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,))
-    return _shard_cached(step, mesh, state_example)
+    return _shard_cached(
+        step, mesh, state_example, zero_opt=getattr(cfg, "zero_opt", False)
+    )
 
 
 def make_cached_multi_train_step(model, cfg, mesh=None, state_example=None):
@@ -287,7 +289,10 @@ def make_cached_multi_train_step(model, cfg, mesh=None, state_example=None):
 
     if mesh is None:
         return jax.jit(multi_step, donate_argnums=(0,))
-    return _shard_cached(multi_step, mesh, state_example, stacked=True)
+    return _shard_cached(
+        multi_step, mesh, state_example, stacked=True,
+        zero_opt=getattr(cfg, "zero_opt", False),
+    )
 
 
 def make_cached_eval_step(model, cfg, mesh=None, state_example=None):
@@ -310,7 +315,7 @@ def make_cached_eval_step(model, cfg, mesh=None, state_example=None):
 
 
 def _shard_cached(fn, mesh, state_example, stacked=False, params_only=False,
-                  cfg=None):
+                  cfg=None, zero_opt=False):
     """jit ``fn`` with cached-path shardings: state per the standard rules,
     table replicated, index/label episode axis over 'dp'."""
     import jax
@@ -331,7 +336,7 @@ def _shard_cached(fn, mesh, state_example, stacked=False, params_only=False,
 
     from induction_network_on_fewrel_tpu.models.losses import metric_keys
 
-    st_sh = state_shardings(state_example, mesh)
+    st_sh = state_shardings(state_example, mesh, zero_opt=zero_opt)
     # Eval metric dicts grow NOTA keys when na_rate > 0 (losses.metric_keys);
     # train paths pass cfg=None and keep the base shape.
     keys = metric_keys(cfg) if cfg is not None else ("loss", "accuracy")

@@ -162,7 +162,7 @@ class FewShotTrainer:
             return state
         from induction_network_on_fewrel_tpu.parallel.sharding import shard_state
 
-        return shard_state(state, self.mesh)
+        return shard_state(state, self.mesh, zero_opt=self.cfg.zero_opt)
 
     def train(self, state=None, num_iters: int | None = None,
               start_step: int = 0):

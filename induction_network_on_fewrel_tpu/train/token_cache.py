@@ -79,7 +79,9 @@ def make_token_cached_train_step(model, cfg, mesh=None, state_example=None):
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,))
-    return _shard(step, mesh, state_example)
+    return _shard(
+        step, mesh, state_example, zero_opt=getattr(cfg, "zero_opt", False)
+    )
 
 
 def make_token_cached_multi_train_step(model, cfg, mesh=None, state_example=None):
@@ -99,7 +101,10 @@ def make_token_cached_multi_train_step(model, cfg, mesh=None, state_example=None
 
     if mesh is None:
         return jax.jit(multi_step, donate_argnums=(0,))
-    return _shard(multi_step, mesh, state_example, stacked=True)
+    return _shard(
+        multi_step, mesh, state_example, stacked=True,
+        zero_opt=getattr(cfg, "zero_opt", False),
+    )
 
 
 def make_token_cached_eval_step(model, cfg, mesh=None, state_example=None):
@@ -122,7 +127,8 @@ def make_token_cached_eval_step(model, cfg, mesh=None, state_example=None):
     return _shard(step, mesh, state_example, params_only=True, cfg=cfg)
 
 
-def _shard(fn, mesh, state_example, stacked=False, params_only=False, cfg=None):
+def _shard(fn, mesh, state_example, stacked=False, params_only=False, cfg=None,
+           zero_opt=False):
     """Cached-path shardings — delegated to feature_cache._shard_cached:
     state per the standard rules, the table replicated (the bare replicated
     sharding it declares for its table arg is a PREFIX pytree, so it covers
@@ -130,4 +136,7 @@ def _shard(fn, mesh, state_example, stacked=False, params_only=False, cfg=None):
     feature array), index/label episode axes over 'dp'."""
     from induction_network_on_fewrel_tpu.train.feature_cache import _shard_cached
 
-    return _shard_cached(fn, mesh, state_example, stacked, params_only, cfg=cfg)
+    return _shard_cached(
+        fn, mesh, state_example, stacked, params_only, cfg=cfg,
+        zero_opt=zero_opt,
+    )

@@ -121,3 +121,50 @@ def test_real_glove_txt_pins_embedding_shape(tmp_path):
         "--val_iter", "4", "--save_ckpt", str(tmp_path / "ck"),
     )
     assert "final_val_accuracy" in last_json(out)
+
+
+def test_parallel_flag_validation_in_process():
+    """Every parallelism flag family rejects invalid combos with a flag-
+    named ValueError BEFORE any tracing starts (in-process: exercises the
+    same make_trainer guards the subprocess tests hit, at unit-test cost)."""
+    import pytest
+
+    from induction_network_on_fewrel_tpu.cli import train_main
+
+    tiny = ["--N", "2", "--K", "2", "--Q", "2", "--batch_size", "2",
+            "--max_length", "12", "--vocab_size", "202", "--train_iter", "2",
+            "--device", "cpu", "--sampler", "python"]
+
+    with pytest.raises(ValueError, match="ring attention"):
+        train_main(["--encoder", "cnn", "--sp", "2", *tiny])
+    with pytest.raises(ValueError, match="pipeline"):
+        train_main(["--encoder", "cnn", "--pp", "2", *tiny])
+    with pytest.raises(ValueError, match="expert"):
+        train_main(["--encoder", "cnn", "--ep", "2", *tiny])
+    with pytest.raises(ValueError, match="divisible"):
+        train_main(["--encoder", "transformer", "--ep", "2",
+                    "--moe_experts", "3", *tiny])
+    with pytest.raises(ValueError, match="token_cache"):
+        train_main(["--encoder", "bilstm", "--token_cache", "--adv", *tiny])
+    with pytest.raises(ValueError, match="batch_size"):
+        train_main(["--encoder", "bilstm", "--dp", "8",
+                    "--N", "2", "--K", "2", "--Q", "2", "--batch_size", "3",
+                    "--max_length", "12", "--vocab_size", "202",
+                    "--train_iter", "2", "--device", "cpu",
+                    "--sampler", "python"])
+
+
+def test_new_flags_reach_config():
+    """--zero_opt/--vocab_size/--divergence_guard land in ExperimentConfig."""
+    from induction_network_on_fewrel_tpu.cli import (
+        build_arg_parser,
+        config_from_args,
+    )
+
+    args = build_arg_parser(train=True).parse_args([
+        "--zero_opt", "--vocab_size", "1002", "--divergence_guard", "stop",
+    ])
+    cfg = config_from_args(args)
+    assert cfg.zero_opt is True
+    assert cfg.vocab_size == 1002
+    assert cfg.divergence_guard == "stop"

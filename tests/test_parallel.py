@@ -177,3 +177,65 @@ def test_distributed_init_failure_is_clean(monkeypatch):
     monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
     with pytest.raises(RuntimeError, match="multi-host initialization"):
         maybe_initialize_distributed()
+
+
+def test_zero_opt_moments_sharded_and_trajectory_identical():
+    """zero_opt (ZeRO-1): Adam moments shard over dp — 1/dp of the
+    optimizer HBM per device — with the training trajectory unchanged
+    vs the replicated single-device step (1e-5 after 3 steps)."""
+    from jax.sharding import PartitionSpec as P
+
+    from induction_network_on_fewrel_tpu.parallel.sharding import shard_state
+
+    # Dims chosen divisible by dp=8 so the per-leaf axis search shards the
+    # moment matrices (the embedding table 302x50 stays replicated — no
+    # divisible axis — which the best-effort rule must tolerate).
+    cfg = CFG.replace(hidden_size=32, induction_dim=16, ntn_slices=16)
+    vocab = make_synthetic_glove(vocab_size=300)
+    ds = make_synthetic_fewrel(num_relations=6, instances_per_relation=12,
+                               vocab_size=300)
+    tok = GloveTokenizer(vocab, max_length=L)
+    sampler = EpisodeSampler(ds, tok, cfg.n, cfg.k, cfg.q, cfg.batch_size, seed=0)
+    model = build_model(cfg, glove_init=vocab.vectors)
+    batches = [batch_to_model_inputs(sampler.sample_batch()) for _ in range(3)]
+    state0 = init_state(model, cfg, batches[0][0], batches[0][1])
+
+    cfg_z = cfg.replace(dp=8, zero_opt=True)
+    mesh = make_mesh(dp=8)
+
+    single_step = make_train_step(model, cfg)
+    ref_state, _ = _run_steps(single_step, _copy_state(state0), batches)
+
+    z_state = shard_state(_copy_state(state0), mesh, zero_opt=True)
+    z_step = make_sharded_train_step(model, cfg_z, mesh, z_state)
+    z_state, _ = _run_steps(z_step, z_state, batches)
+    _params_allclose(ref_state, jax.device_get(z_state), atol=1e-5)
+
+    # The moments must ACTUALLY be sharded: every mu matrix with an
+    # 8-divisible axis carries dp in its spec; params stay replicated.
+    def path_str(path):
+        return "/".join(
+            str(getattr(p, "key", getattr(p, "name", p))) for p in path
+        )
+
+    mu_leaves = [
+        (path_str(path), leaf)
+        for path, leaf in jax.tree_util.tree_leaves_with_path(z_state.opt_state)
+        if "/mu/" in path_str(path)
+    ]
+    assert mu_leaves
+    sharded = [
+        leaf for path, leaf in mu_leaves
+        if any(s >= 8 and s % 8 == 0 for s in leaf.shape)
+        # tensor_slices' mu keeps a tp-rule spec only when tp > 1; on this
+        # tp=1 mesh it is effectively replicated, so the dp rule claims it
+        # too — no exclusions needed, every shardable mu must carry dp.
+    ]
+    assert sharded, "no shardable mu leaves in this model"
+    assert all("dp" in str(leaf.sharding.spec) for leaf in sharded)
+    param_specs = {
+        leaf.sharding.spec for leaf in jax.tree.leaves(z_state.params)
+    }
+    # Params: replicated except the standing tp rule on tensor_slices
+    # (tp=1 on this mesh, so that spec is replication in practice).
+    assert param_specs <= {P(), P("tp", None, None)}, param_specs
