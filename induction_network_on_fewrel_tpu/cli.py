@@ -303,11 +303,14 @@ def _wire_index_cache(cfg, model, cache_mesh, state, only_test,
 
     ``build_table(ds) -> (device table, per-relation sizes)`` — the table is
     opaque here (a [M,H] feature array or a token dict); every cached step
-    takes it as one argument. ``factories``: "train"/"multi"/"eval" step
-    factories, each ``(model, cfg, mesh, state_example) -> jitted fn``.
+    takes it as one argument. ``factories``: "train"/"multi"/"eval"/
+    "multi_eval" step factories, each
+    ``(model, cfg, mesh, state_example) -> jitted fn`` ("multi"/"multi_eval"
+    are only invoked when cfg.steps_per_call > 1).
 
     Returns (train_sampler, val_sampler, train_step, eval_step, fused_step,
-    test_eval_factory).
+    fused_eval, test_eval_factory) — fused_eval is bound to the VAL table
+    (test evals must not reuse it; see _test_accuracy).
     """
     from induction_network_on_fewrel_tpu.native.sampler import (
         make_index_sampler,
@@ -319,7 +322,7 @@ def _wire_index_cache(cfg, model, cache_mesh, state, only_test,
             f"data-parallel mesh axis dp={cache_mesh.shape['dp']}"
         )
     _eval = factories["eval"](model, cfg, cache_mesh, state)
-    train_step = eval_step = fused_step = None
+    train_step = eval_step = fused_step = fused_eval = None
     # Same backend policy as the live samplers: training uses the C++
     # index sampler under "auto" (measured 200-300x the Python index
     # sampler — host assembly was the cached paths' bottleneck); eval
@@ -346,6 +349,10 @@ def _wire_index_cache(cfg, model, cache_mesh, state, only_test,
         if cfg.steps_per_call > 1:
             _multi = factories["multi"](model, cfg, cache_mesh, state)
             fused_step = lambda st, si, qi, l: _multi(st, table_tr, si, qi, l)
+            # Fused eval: one dispatch per steps_per_call val batches (the
+            # per-batch cached eval costs a full tunnel round-trip each).
+            _multi_ev = factories["multi_eval"](model, cfg, cache_mesh, state)
+            fused_eval = lambda p, si, qi, l: _multi_ev(p, table_va, si, qi, l)
 
     def test_eval(test_ds):
         """(sampler, eval_step) for a test split: its own device-resident
@@ -358,7 +365,7 @@ def _wire_index_cache(cfg, model, cache_mesh, state, only_test,
         return ts, (lambda p, si, qi, l: _eval(p, table_te, si, qi, l))
 
     return (train_sampler, val_sampler, train_step, eval_step, fused_step,
-            test_eval)
+            fused_eval, test_eval)
 
 
 def _cache_table_put(cache_mesh):
@@ -445,7 +452,7 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         (cfg.dp == 0 and n_dev > 1) or cfg.dp > 1 or cfg.tp > 1
         or cfg.sp > 1 or cfg.pp > 1 or cfg.ep > 1
     )
-    train_step = eval_step = fused_step = state = mesh = None
+    train_step = eval_step = fused_step = fused_eval = state = mesh = None
     attn_impl = pipeline_impl = None
     if use_mesh:
         mesh = make_mesh(dp=(cfg.dp or None), tp=cfg.tp, sp=cfg.sp,
@@ -544,6 +551,7 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         from induction_network_on_fewrel_tpu.train.feature_cache import (
             encode_dataset,
             make_cached_eval_step,
+            make_cached_multi_eval_step,
             make_cached_multi_train_step,
             make_cached_train_step,
             make_encode_fn,
@@ -602,12 +610,13 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
             return table, [b.shape[0] for b in blocks]
 
         (train_sampler, val_sampler, train_step, eval_step, fused_step,
-         cache_test_eval) = _wire_index_cache(
+         fused_eval, cache_test_eval) = _wire_index_cache(
             cfg, model, cache_mesh, state, only_test, train_ds, val_ds,
             train_sampler, val_sampler, build_table,
             {"train": make_cached_train_step,
              "multi": make_cached_multi_train_step,
-             "eval": make_cached_eval_step},
+             "eval": make_cached_eval_step,
+             "multi_eval": make_cached_multi_eval_step},
         )
     if cfg.token_cache:
         # Device-resident token cache (train/token_cache.py): upload the
@@ -622,6 +631,7 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
             )
         from induction_network_on_fewrel_tpu.train.token_cache import (
             make_token_cached_eval_step,
+            make_token_cached_multi_eval_step,
             make_token_cached_multi_train_step,
             make_token_cached_train_step,
             tokenize_dataset,
@@ -651,12 +661,13 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
             return {k: _tput(v) for k, v in tab.items()}, sizes
 
         (train_sampler, val_sampler, train_step, eval_step, fused_step,
-         cache_test_eval) = _wire_index_cache(
+         fused_eval, cache_test_eval) = _wire_index_cache(
             cfg, model, cache_mesh, state, only_test, train_ds, val_ds,
             train_sampler, val_sampler, build_table,
             {"train": make_token_cached_train_step,
              "multi": make_token_cached_multi_train_step,
-             "eval": make_token_cached_eval_step},
+             "eval": make_token_cached_eval_step,
+             "multi_eval": make_token_cached_multi_eval_step},
         )
 
     if use_mesh and not cfg.feature_cache and not cfg.token_cache:
@@ -753,6 +764,7 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         ckpt_dir=None if only_test else args.save_ckpt,
         logger=MetricsLogger(run_dir),
         train_step=train_step, eval_step=eval_step, fused_step=fused_step,
+        fused_eval=fused_eval,
         initial_state=state,
         mesh=mesh, adv=adv_pieces,
         profile_dir=getattr(args, "profile", None),
@@ -797,8 +809,10 @@ def _test_accuracy(args, cfg: ExperimentConfig, trainer, state) -> float:
         test_ds = load_data(args, cfg, "test")
         sampler, eval_step = trainer.cached_test_eval(test_ds)
         trainer.eval_step = eval_step
-        # The stock fused eval (if any) expects token batches; the cached
-        # sampler yields index batches — force the per-batch cached step.
+        # CRITICAL: any existing fused eval is bound to the VALIDATION
+        # split's table (cli._wire_index_cache closes over table_va), so
+        # reusing it here would silently score test indices against val
+        # rows. The per-batch eval_step above is bound to the test table.
         trainer._fused_eval = None
         return trainer.evaluate(state.params, cfg.test_iter, sampler=sampler)
     sampler = make_test_sampler(args, cfg, trainer.tokenizer)

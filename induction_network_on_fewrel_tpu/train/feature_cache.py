@@ -299,19 +299,50 @@ def make_cached_eval_step(model, cfg, mesh=None, state_example=None):
     """jitted (params, table, sup_idx, qry_idx, label) -> metrics dict."""
     import jax
 
+    step = _eval_batch_metrics(model, cfg)
+
+    if mesh is None:
+        return jax.jit(step)
+    return _shard_cached(step, mesh, state_example, params_only=True, cfg=cfg)
+
+
+def _eval_batch_metrics(model, cfg):
+    """The per-batch cached eval body — ONE source for the single-dispatch
+    eval step and its lax.map fused twin, so their metrics cannot drift."""
     from induction_network_on_fewrel_tpu.models.losses import episode_metrics
     from induction_network_on_fewrel_tpu.train.steps import LOSS_FNS
 
-    def step(params, table, sup_idx, qry_idx, label):
+    def metrics(params, table, sup_idx, qry_idx, label):
         logits = model.apply(params, table[sup_idx], table[qry_idx])
         return {
             "loss": LOSS_FNS[cfg.loss](logits, label),
             **episode_metrics(logits, label, cfg.na_rate > 0),
         }
 
+    return metrics
+
+
+def make_cached_multi_eval_step(model, cfg, mesh=None, state_example=None):
+    """Fused cached eval: ONE dispatch scores S stacked index batches via
+    ``lax.map`` (params fixed, batches independent) — per-dispatch latency
+    dominates cached eval otherwise (each eval batch is a full tunnel
+    round-trip; at the default val_iter this was hundreds of dispatches
+    per val boundary). (params, table, sup_s [S,B,N,K], qry_s [S,B,TQ],
+    lab_s [S,B,TQ]) -> metrics stacked [S]."""
+    import jax
+
+    body = _eval_batch_metrics(model, cfg)
+
+    def multi(params, table, sup_s, qry_s, lab_s):
+        return jax.lax.map(
+            lambda xs: body(params, table, *xs), (sup_s, qry_s, lab_s)
+        )
+
     if mesh is None:
-        return jax.jit(step)
-    return _shard_cached(step, mesh, state_example, params_only=True, cfg=cfg)
+        return jax.jit(multi)
+    return _shard_cached(
+        multi, mesh, state_example, stacked=True, params_only=True, cfg=cfg
+    )
 
 
 def _shard_cached(fn, mesh, state_example, stacked=False, params_only=False,

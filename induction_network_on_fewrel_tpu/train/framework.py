@@ -58,6 +58,7 @@ class FewShotTrainer:
         train_step=None,
         eval_step=None,
         fused_step=None,
+        fused_eval=None,
         initial_state=None,
         mesh=None,
         adv=None,
@@ -131,11 +132,15 @@ class FewShotTrainer:
                     f"{reason}; training runs per-step dispatch",
                     stacklevel=2,
                 )
-        # Fused eval (steps.make_multi_eval_step): stock eval path only —
-        # injected (mesh/cached) eval steps bind their own data layout.
+        # Fused eval: an injected fused step (the cached paths bind their
+        # table into one — cli._wire_index_cache), else the stock
+        # steps.make_multi_eval_step when the stock eval path is in use.
         self._fused_eval = None
-        if cfg.steps_per_call > 1 and eval_step is None:
-            self._fused_eval = make_multi_eval_step(model, cfg)
+        if cfg.steps_per_call > 1:
+            if fused_eval is not None:
+                self._fused_eval = fused_eval
+            elif eval_step is None:
+                self._fused_eval = make_multi_eval_step(model, cfg)
 
     def _can_sample_fused(self) -> bool:
         """Whether the train sampler fills a fused [S,B,*] stack in one
@@ -356,15 +361,24 @@ class FewShotTrainer:
                 collected.setdefault(k, []).append(v)
 
         while remaining > 0:
-            if self._fused_eval is not None and remaining >= spc:
+            # One dispatch per spc-batch group; a short tail pads by
+            # repeating the last batch (same compiled shape, padded results
+            # sliced off) rather than falling back to per-batch dispatches
+            # (each a full tunnel round-trip). Below spc/8 real batches the
+            # padded compute would outweigh the saved dispatches — tiny
+            # evals keep the per-batch path.
+            if self._fused_eval is not None and remaining >= max(1, spc // 8):
+                take = min(spc, remaining)
                 batches = [
-                    batch_to_model_inputs(next(it)) for _ in range(spc)
+                    batch_to_model_inputs(next(it)) for _ in range(take)
                 ]
+                batches += [batches[-1]] * (spc - take)
                 sup_s, qry_s, lab_s = jax.tree.map(
                     lambda *xs: np.stack(xs), *batches
                 )
-                collect(self._fused_eval(params, sup_s, qry_s, lab_s))  # [S]
-                remaining -= spc
+                out = self._fused_eval(params, sup_s, qry_s, lab_s)  # [S]
+                collect({k: v[:take] for k, v in out.items()})
+                remaining -= take
             else:
                 support, query, label = batch_to_model_inputs(next(it))
                 collect(self.eval_step(params, support, query, label))

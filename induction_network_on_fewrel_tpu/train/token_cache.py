@@ -110,10 +110,20 @@ def make_token_cached_multi_train_step(model, cfg, mesh=None, state_example=None
 def make_token_cached_eval_step(model, cfg, mesh=None, state_example=None):
     import jax
 
+    step = _eval_batch_metrics(model, cfg)
+
+    if mesh is None:
+        return jax.jit(step)
+    return _shard(step, mesh, state_example, params_only=True, cfg=cfg)
+
+
+def _eval_batch_metrics(model, cfg):
+    """The per-batch cached eval body — ONE source for the single-dispatch
+    eval step and its lax.map fused twin, so their metrics cannot drift."""
     from induction_network_on_fewrel_tpu.models.losses import episode_metrics
     from induction_network_on_fewrel_tpu.train.steps import LOSS_FNS
 
-    def step(params, table, sup_idx, qry_idx, label):
+    def metrics(params, table, sup_idx, qry_idx, label):
         logits = model.apply(
             params, _gather(table, sup_idx), _gather(table, qry_idx)
         )
@@ -122,9 +132,26 @@ def make_token_cached_eval_step(model, cfg, mesh=None, state_example=None):
             **episode_metrics(logits, label, cfg.na_rate > 0),
         }
 
+    return metrics
+
+
+def make_token_cached_multi_eval_step(model, cfg, mesh=None, state_example=None):
+    """Fused token-cache eval: one dispatch scores S stacked index batches
+    (see feature_cache.make_cached_multi_eval_step — same motivation)."""
+    import jax
+
+    body = _eval_batch_metrics(model, cfg)
+
+    def multi(params, table, sup_s, qry_s, lab_s):
+        return jax.lax.map(
+            lambda xs: body(params, table, *xs), (sup_s, qry_s, lab_s)
+        )
+
     if mesh is None:
-        return jax.jit(step)
-    return _shard(step, mesh, state_example, params_only=True, cfg=cfg)
+        return jax.jit(multi)
+    return _shard(
+        multi, mesh, state_example, stacked=True, params_only=True, cfg=cfg
+    )
 
 
 def _shard(fn, mesh, state_example, stacked=False, params_only=False, cfg=None,

@@ -173,3 +173,60 @@ def test_token_cached_mesh_step_matches_single_device():
         jax.tree.leaves(jax.device_get(state_b.params)),
     ):
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+
+
+def test_fused_cached_eval_matches_per_batch():
+    """make_token_cached_multi_eval_step == S per-batch cached evals."""
+    import jax
+    import numpy as np
+
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.data import (
+        GloveTokenizer,
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.train.feature_cache import (
+        FeatureEpisodeSampler,
+    )
+    from induction_network_on_fewrel_tpu.train.steps import init_state
+    from induction_network_on_fewrel_tpu.train.token_cache import (
+        make_token_cached_eval_step,
+        make_token_cached_multi_eval_step,
+        tokenize_dataset,
+    )
+
+    cfg = ExperimentConfig(
+        encoder="cnn", n=3, k=2, q=2, batch_size=2, max_length=16,
+        vocab_size=302, compute_dtype="float32", hidden_size=32,
+        induction_dim=16, ntn_slices=8, na_rate=1, steps_per_call=3,
+    )
+    vocab = make_synthetic_glove(vocab_size=300)
+    ds = make_synthetic_fewrel(num_relations=6, instances_per_relation=10,
+                               vocab_size=300)
+    tok = GloveTokenizer(vocab, max_length=16)
+    table_np, sizes = tokenize_dataset(ds, tok)
+    table = jax.device_put(table_np)
+    sampler = FeatureEpisodeSampler(
+        sizes, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size,
+        na_rate=cfg.na_rate, seed=0,
+    )
+    model = build_model(cfg, glove_init=vocab.vectors)
+    b0 = sampler.sample_batch()
+    sup = {k: v[b0.support_idx] for k, v in table_np.items()}
+    qry = {k: v[b0.query_idx] for k, v in table_np.items()}
+    params = init_state(model, cfg, sup, qry).params
+
+    si, qi, lab = sampler.sample_fused(3)
+    single = make_token_cached_eval_step(model, cfg)
+    multi = make_token_cached_multi_eval_step(model, cfg)
+    fused = jax.device_get(multi(params, table, si, qi, lab))
+    for s in range(3):
+        one = jax.device_get(single(params, table, si[s], qi[s], lab[s]))
+        for k in one:
+            np.testing.assert_allclose(
+                np.asarray(fused[k][s]), np.asarray(one[k]),
+                rtol=1e-6, atol=1e-6,
+            )
+    assert "nota_tp" in fused  # NOTA metrics ride the fused path too

@@ -496,3 +496,39 @@ def test_embed_optimizer_sgd_moves_only_touched_rows():
     untouched = np.setdiff1d(np.arange(cfg.vocab_size), touched)
     np.testing.assert_array_equal(after[untouched], before[untouched])
     assert not np.array_equal(after[touched], before[touched])
+
+
+def test_evaluate_fused_tail_padding_exact():
+    """evaluate()'s fused path pads short tails with a repeated batch and
+    slices the padding off — the reported mean must EQUAL the per-batch
+    path's on batch counts that don't divide steps_per_call."""
+    cfg = ExperimentConfig(
+        encoder="cnn", n=2, k=2, q=2, batch_size=2, max_length=L,
+        vocab_size=302, compute_dtype="float32", steps_per_call=4,
+    )
+    model, sampler = _setup(cfg)
+    sup, qry, _ = batch_to_model_inputs(sampler.sample_batch())
+    params = init_state(model, cfg, sup, qry).params
+
+    fused = FewShotTrainer(model, cfg, sampler, val_sampler=sampler)
+    plain = FewShotTrainer(
+        model, cfg.replace(steps_per_call=1), sampler, val_sampler=sampler
+    )
+    # 7 batches = one full group of 4 + a tail of 3 (>= spc//8 -> fused,
+    # padded to 4). Same seed stream on both sides.
+    for n_batches in (7, 3, 1):
+        a = FewShotTrainer(
+            model, cfg, _setup(cfg)[1], val_sampler=None
+        )
+        b = FewShotTrainer(
+            model, cfg.replace(steps_per_call=1), _setup(cfg)[1],
+            val_sampler=None,
+        )
+        acc_fused = a.evaluate(
+            params, n_batches * cfg.batch_size, sampler=_setup(cfg)[1]
+        )
+        acc_plain = b.evaluate(
+            params, n_batches * cfg.batch_size, sampler=_setup(cfg)[1]
+        )
+        assert abs(acc_fused - acc_plain) < 1e-6, (n_batches, acc_fused, acc_plain)
+    assert fused._fused_eval is not None and plain._fused_eval is None
