@@ -73,10 +73,8 @@ def run_config(name: str, cfg, adv: bool = False) -> dict:
     if cfg.token_cache:
         # Device-resident token table + index episodes, fused scan — the
         # production --token_cache path (train/token_cache.py).
-        import numpy as np
-
-        from induction_network_on_fewrel_tpu.train.feature_cache import (
-            FeatureEpisodeSampler,
+        from induction_network_on_fewrel_tpu.native.sampler import (
+            make_index_sampler,
         )
         from induction_network_on_fewrel_tpu.train.token_cache import (
             make_token_cached_multi_train_step,
@@ -87,8 +85,10 @@ def run_config(name: str, cfg, adv: bool = False) -> dict:
             sampler.close()
         table_np, sizes = tokenize_dataset(ds, tok)
         table = jax.device_put(table_np)
-        isampler = FeatureEpisodeSampler(
-            sizes, cfg.n, cfg.k, cfg.q, cfg.batch_size,
+        # Same sampler policy as the production CLI path: C++ index
+        # sampler when the toolchain is present.
+        isampler = make_index_sampler(
+            sizes, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size,
             na_rate=cfg.na_rate, seed=0,
         )
         state = init_state(model, cfg, sup, qry)
@@ -96,10 +96,7 @@ def run_config(name: str, cfg, adv: bool = False) -> dict:
         multi = make_token_cached_multi_train_step(model, cfg)
 
         def step_once(st):
-            bs = [isampler.sample_batch() for _ in range(S)]
-            si = np.stack([b.support_idx for b in bs])
-            qi = np.stack([b.query_idx for b in bs])
-            ls = np.stack([b.label for b in bs])
+            si, qi, ls = isampler.sample_fused(S)
             return multi(st, table, si, qi, ls)
 
         return _time_loop(name, cfg, step_once, state, eff=S)
