@@ -33,6 +33,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# HF BertConfig.layer_norm_eps default — bert-base-uncased ships 1e-12, not
+# flax's 1e-6 default. Golden-pinned in tests/test_bert.py.
+LN_EPS = 1e-12
+
 
 class BertSelfAttention(nn.Module):
     hidden_size: int
@@ -70,17 +74,21 @@ class BertLayer(nn.Module):
         att = BertSelfAttention(
             self.hidden_size, self.num_heads, self.compute_dtype, name="attention"
         )(x, mask)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_att")(x + att)
+        x = nn.LayerNorm(epsilon=LN_EPS, dtype=jnp.float32, name="ln_att")(x + att)
         h = nn.Dense(
             self.intermediate_size, dtype=self.compute_dtype,
             param_dtype=jnp.float32, name="intermediate",
         )(x)
-        h = nn.gelu(h, approximate=True)
+        # bert-base-uncased's hidden_act is "gelu" — the exact erf form, NOT
+        # the tanh approximation (HF calls that one "gelu_new"). Verified
+        # numerically against transformers.BertModel in
+        # tests/test_bert.py::test_golden_hf_backbone.
+        h = nn.gelu(h, approximate=False)
         h = nn.Dense(
             self.hidden_size, dtype=self.compute_dtype,
             param_dtype=jnp.float32, name="mlp_out",
         )(h)
-        return nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + h)
+        return nn.LayerNorm(epsilon=LN_EPS, dtype=jnp.float32, name="ln_mlp")(x + h)
 
 
 class BertBackbone(nn.Module):
@@ -114,7 +122,7 @@ class BertBackbone(nn.Module):
             seg_table[0][None, None] if segment_ids is None
             else seg_table[segment_ids]
         )
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(word + pos[None] + seg)
+        x = nn.LayerNorm(epsilon=LN_EPS, dtype=jnp.float32, name="ln_emb")(word + pos[None] + seg)
         x = x.astype(self.compute_dtype)
 
         layer_cls = nn.remat(BertLayer) if self.remat else BertLayer

@@ -114,6 +114,160 @@ def test_unfrozen_backbone_gets_grads(episode):
     assert any(float(jnp.abs(g).max()) > 0 for g in jax.tree.leaves(backbone))
 
 
+# ---------------------------------------------------------------------------
+# Numerical golden twins vs transformers.BertModel (torch CPU).
+#
+# SURVEY.md §4.2 mandates a torch golden twin per module; these pin the BERT
+# port's GELU variant (exact erf, not tanh), LayerNorm eps (1e-12), attention
+# scaling, and pooling against the HF reference implementation numerically.
+# ---------------------------------------------------------------------------
+
+
+def _hf_bert(vocab_size, hidden, layers, heads, intermediate, seed=0):
+    import torch
+    from transformers import BertConfig, BertModel
+
+    torch.manual_seed(seed)
+    cfg = BertConfig(
+        vocab_size=vocab_size, hidden_size=hidden, num_hidden_layers=layers,
+        num_attention_heads=heads, intermediate_size=intermediate,
+        max_position_embeddings=512, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    return BertModel(cfg).eval()
+
+
+def _export_npz(hf_model, path):
+    # BertModel.state_dict() keys lack the "bert." prefix that BertFor*
+    # state_dicts (and load_hf_weights) use; add it on export.
+    raw = {
+        "bert." + k: v.detach().numpy()
+        for k, v in hf_model.state_dict().items()
+    }
+    np.savez(path, **raw)
+
+
+def _golden_inputs(vocab_size, batch, length, seed=1):
+    rng = np.random.default_rng(seed)
+    # ids in [3, vocab) keep clear of the entity-marker ids 1/2 so the
+    # backbone test is marker-free; mask has a ragged padded tail.
+    ids = rng.integers(3, vocab_size, size=(batch, length)).astype(np.int32)
+    mask = np.ones((batch, length), np.float32)
+    mask[0, -5:] = 0.0
+    mask[1, -1:] = 0.0
+    ids[mask == 0] = 0
+    return ids, mask
+
+
+def _loaded_encoder(hf_model, tmp_path, vocab_size, hidden, layers, heads,
+                    intermediate, length):
+    from induction_network_on_fewrel_tpu.models.bert import load_hf_weights
+
+    npz = tmp_path / "hf.npz"
+    _export_npz(hf_model, npz)
+    enc = BertEncoder(
+        vocab_size=vocab_size, num_layers=layers, hidden_size=hidden,
+        num_heads=heads, intermediate_size=intermediate, max_length=length,
+    )
+    params = enc.init(
+        jax.random.key(0), jnp.ones((1, length), jnp.int32),
+        jnp.ones((1, length), jnp.float32),
+    )
+    return enc, load_hf_weights(params, str(npz))
+
+
+def _torch_hidden(hf_model, ids, mask):
+    import torch
+
+    with torch.no_grad():
+        out = hf_model(
+            input_ids=torch.from_numpy(np.asarray(ids, np.int64)),
+            attention_mask=torch.from_numpy(np.asarray(mask)),
+        )
+    return out.last_hidden_state.numpy()
+
+
+TINY_GOLD = dict(vocab_size=64, hidden=32, layers=3, heads=4, intermediate=64)
+
+
+def test_golden_hf_backbone(tmp_path):
+    """BertBackbone matches transformers.BertModel last_hidden_state at 1e-4
+    (f32 compute, random init exported through the real weight mapping)."""
+    from induction_network_on_fewrel_tpu.models.bert import BertBackbone
+
+    hf = _hf_bert(**TINY_GOLD)
+    L2 = 16
+    ids, mask = _golden_inputs(TINY_GOLD["vocab_size"], 2, L2)
+    _, loaded = _loaded_encoder(
+        hf, tmp_path, TINY_GOLD["vocab_size"], TINY_GOLD["hidden"],
+        TINY_GOLD["layers"], TINY_GOLD["heads"], TINY_GOLD["intermediate"], L2,
+    )
+    bb = BertBackbone(
+        vocab_size=TINY_GOLD["vocab_size"], num_layers=TINY_GOLD["layers"],
+        hidden_size=TINY_GOLD["hidden"], num_heads=TINY_GOLD["heads"],
+        intermediate_size=TINY_GOLD["intermediate"],
+    )
+    ours = np.asarray(bb.apply({"params": loaded["params"]["backbone"]}, ids, mask))
+    theirs = _torch_hidden(hf, ids, mask)
+    # Padded positions attend over the same masked keys in both impls but are
+    # not meaningful outputs; compare only live positions.
+    live = mask > 0
+    np.testing.assert_allclose(ours[live], theirs[live], atol=1e-4, rtol=1e-4)
+
+
+def test_golden_hf_encoder_pooling(tmp_path):
+    """BertEncoder end-to-end (pooling included) matches the same pooling
+    computed from torch hidden states — both the entity-marker path and the
+    no-marker [CLS] fallback."""
+    hf = _hf_bert(**TINY_GOLD)
+    L2 = 16
+    ids, mask = _golden_inputs(TINY_GOLD["vocab_size"], 2, L2)
+    # Row 0: markers present (E1 at 3, E2 at 7). Row 1: no markers.
+    ids[0, 3] = E1_ID
+    ids[0, 7] = E2_ID
+    enc, loaded = _loaded_encoder(
+        hf, tmp_path, TINY_GOLD["vocab_size"], TINY_GOLD["hidden"],
+        TINY_GOLD["layers"], TINY_GOLD["heads"], TINY_GOLD["intermediate"], L2,
+    )
+    ours = np.asarray(enc.apply(loaded, ids, mask))
+
+    hidden = _torch_hidden(hf, ids, mask)
+    cls = hidden[:, 0]
+    expect = np.stack([
+        (cls[0] + hidden[0, 3] + hidden[0, 7]) / 3.0,  # marker pooling
+        cls[1],                                         # CLS fallback
+    ])
+    np.testing.assert_allclose(ours, expect, atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_golden_hf_backbone_base_shape(tmp_path):
+    """Once at the real bert-base shape (12x768, vocab 30522): the full-size
+    mapping and numerics hold, not just the tiny proxy."""
+    from induction_network_on_fewrel_tpu.models.bert import BertBackbone
+
+    shape = dict(vocab_size=30522, hidden=768, layers=12, heads=12,
+                 intermediate=3072)
+    hf = _hf_bert(**shape)
+    L2 = 32
+    ids, mask = _golden_inputs(shape["vocab_size"], 2, L2)
+    _, loaded = _loaded_encoder(
+        hf, tmp_path, shape["vocab_size"], shape["hidden"], shape["layers"],
+        shape["heads"], shape["intermediate"], L2,
+    )
+    bb = BertBackbone(
+        vocab_size=shape["vocab_size"], num_layers=shape["layers"],
+        hidden_size=shape["hidden"], num_heads=shape["heads"],
+        intermediate_size=shape["intermediate"],
+    )
+    ours = np.asarray(bb.apply({"params": loaded["params"]["backbone"]}, ids, mask))
+    theirs = _torch_hidden(hf, ids, mask)
+    live = mask > 0
+    # 12 layers of f32 accumulation: slightly looser tolerance than the tiny
+    # twin but still tight enough to catch any variant/eps mismatch.
+    np.testing.assert_allclose(ours[live], theirs[live], atol=5e-4, rtol=5e-4)
+
+
 @pytest.mark.parametrize("ln_style", [("gamma", "beta"), ("weight", "bias")])
 def test_hf_weight_mapping_roundtrip(tmp_path, ln_style):
     """load_hf_weights maps a synthetic HF-style npz onto the param tree and
