@@ -138,15 +138,41 @@ def main() -> int:
     )
     tok = GloveTokenizer(vocab, max_length=cfg.max_length)
     table_np, sizes = tokenize_dataset(ds, tok)
+    if cfg.embed_optimizer == "lazy":
+        # Precomputed corpus remap: the cached lazy body trains the
+        # corpus-restricted sub-table directly (train/lazy_embed.py).
+        from induction_network_on_fewrel_tpu.train.lazy_embed import (
+            augment_token_table,
+        )
+
+        table_np, uids = augment_token_table(table_np)
+        table_np = {**table_np, "uids": uids}
     table = jax.device_put(table_np)
     sampler = make_index_sampler(
         sizes, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size, seed=0
     )
     model = build_model(cfg, glove_init=vocab.vectors)
 
+    try:
+        return _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips)
+    finally:
+        sampler.close()  # native handle: deterministic release, not __del__
+
+
+def _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips) -> int:
+    from induction_network_on_fewrel_tpu.train.steps import init_state
+    from induction_network_on_fewrel_tpu.train.token_cache import (
+        make_token_cached_multi_train_step,
+    )
+    from induction_network_on_fewrel_tpu.utils.flops import (
+        bilstm_induction_train_flops,
+        peak_flops_per_chip,
+    )
+
     b0s, b0q, _ = sampler.sample_fused(1)
-    sup = {k: v[b0s[0]] for k, v in table_np.items()}
-    qry = {k: v[b0q[0]] for k, v in table_np.items()}
+    # "uids" is table-level metadata (lazy mode), not a per-row column.
+    sup = {k: v[b0s[0]] for k, v in table_np.items() if k != "uids"}
+    qry = {k: v[b0q[0]] for k, v in table_np.items() if k != "uids"}
     state = init_state(model, cfg, sup, qry)
     multi_step = make_token_cached_multi_train_step(model, cfg)
     S = STEPS_PER_CALL
@@ -187,6 +213,16 @@ def main() -> int:
             file=sys.stderr,
         )
 
+    # Device-busy fraction (VERDICT round-2 weak item 1): one traced chunk,
+    # parsed from the XPlane via jax.profiler.ProfileData — puts "how much
+    # of the wall is device work vs tunnel RPC" in the artifact itself
+    # instead of BASELINE.md prose.
+    device_busy = None
+    try:
+        device_busy = _device_busy_fraction(jax, fused_call, state)
+    except Exception as e:  # profiling must never sink the bench
+        print(f"bench: device-busy capture failed: {e!r}", file=sys.stderr)
+
     flops = bilstm_induction_train_flops(cfg)
     peak = peak_flops_per_chip(
         jax.devices()[0].device_kind, cfg.compute_dtype
@@ -209,9 +245,56 @@ def main() -> int:
         "unit": "episodes/s/chip",
         "vs_baseline": round(vs, 3),
         "mfu": mfu,
+        "device_busy": device_busy,
         "flops_per_episode": flops["per_episode"],
     }))
     return 0
+
+
+def _device_busy_fraction(jax, fused_call, state) -> float | None:
+    """Trace ONE fused call and return device-busy seconds / wall seconds.
+
+    Busy time = the largest per-line total duration on the device XPlane
+    (the "XLA Modules" line — module executions don't overlap on a chip's
+    compute stream). Returns None when no device plane exists (CPU runs).
+    """
+    import glob
+    import shutil
+    import tempfile
+
+    tmpdir = tempfile.mkdtemp(prefix="bench_xplane_")
+    try:
+        jax.profiler.start_trace(tmpdir)
+        try:
+            t0 = time.monotonic()
+            state, metrics = fused_call(state)
+            _ = float(jax.device_get(metrics["loss"])[-1])  # hard sync
+            wall = time.monotonic() - t0
+        finally:
+            # Close the global profiler session on EVERY path — a raise
+            # here is swallowed by the caller, and an orphaned session
+            # writing into the removed tmpdir would poison the rest of
+            # the bench.
+            jax.profiler.stop_trace()
+
+        files = glob.glob(tmpdir + "/**/*.xplane.pb", recursive=True)
+        if not files:
+            return None
+        data = jax.profiler.ProfileData.from_file(files[0])
+        busy_ns = 0
+        for plane in data.planes:
+            if "/device:" not in plane.name:
+                continue
+            per_line = [
+                sum(e.duration_ns for e in line.events)
+                for line in plane.lines
+            ]
+            busy_ns = max([busy_ns, *per_line]) if per_line else busy_ns
+        if busy_ns <= 0:
+            return None
+        return round(min(busy_ns / 1e9 / wall, 1.0), 4)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 if __name__ == "__main__":

@@ -85,9 +85,11 @@ class ExperimentConfig:
     loss: str = "mse"         # mse (paper §3.4) | ce (toolkit forks)
     optimizer: str = "adam"   # adam | sgd
     # Word-embedding table optimizer: "shared" (reference parity — the main
-    # optimizer updates the table densely), "sgd" (stateless scatter update;
-    # measured +15% end-to-end at 400k vocab, -160MB moment state), "frozen"
-    # (stop_gradient: no table grad exists at all).
+    # optimizer updates the table densely), "lazy" (EXACT dense-Adam
+    # trajectory, weight decay excluded on the table, per-step cost
+    # proportional to touched rows — train/lazy_embed.py), "sgd" (stateless
+    # scatter update; measured +15% end-to-end at 400k vocab, -160MB moment
+    # state), "frozen" (stop_gradient: no table grad exists at all).
     embed_optimizer: str = "shared"
     lr: float = 1e-3
     weight_decay: float = 1e-5

@@ -42,7 +42,18 @@ class Embedding(nn.Module):
             init = lambda *_: jnp.asarray(self.glove_init, jnp.float32)
         else:
             init = nn.initializers.normal(0.1)
-        word_table = self.param("word_embedding", init, (self.vocab_size, self.word_dim))
+        if self.has_variable("lazy_embed", "rows"):
+            # embed_optimizer=lazy (train/lazy_embed.py): the step body
+            # passes the batch's CAUGHT-UP unique rows [U, word_dim] via
+            # this collection, with word ids already remapped into them —
+            # autodiff then yields a compact [U, word_dim] cotangent
+            # instead of a dense [vocab, word_dim] scatter. The param
+            # below still exists; it is simply not read on this path.
+            word_table = self.get_variable("lazy_embed", "rows")
+        else:
+            word_table = self.param(
+                "word_embedding", init, (self.vocab_size, self.word_dim)
+            )
         if self.freeze_word_table:
             word_table = jax.lax.stop_gradient(word_table)
         pos1_table = self.param(

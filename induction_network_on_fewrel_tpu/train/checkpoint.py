@@ -18,17 +18,33 @@ import orbax.checkpoint as ocp
 from induction_network_on_fewrel_tpu.config import ExperimentConfig
 
 
+_jit_copy = None
+
+
 def _device_snapshot(state: Any) -> Any:
     """Copy device arrays on-device (HBM-speed, async dispatch) so the
     snapshot is decoupled from buffer donation: the next train step donates
     the live state's buffers, and the d2h transfer happens later on the
-    saver thread from this copy. Host arrays pass through untouched."""
+    saver thread from this copy. Host arrays pass through untouched.
+
+    Leaves that span hosts (--zero_opt moments dp-sharded over a pod) are
+    copied through a jitted identity: multi-controller JAX restricts eager
+    ops on non-fully-addressable arrays, and jit is the legal path — output
+    sharding is inferred from the input, so the snapshot keeps the leaf's
+    layout (advisor finding, round 2)."""
     import jax
     import jax.numpy as jnp
 
-    return jax.tree.map(
-        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, state
-    )
+    global _jit_copy
+    if _jit_copy is None:
+        _jit_copy = jax.jit(jnp.copy)
+
+    def snap(x):
+        if not isinstance(x, jax.Array):
+            return x
+        return jnp.copy(x) if x.is_fully_addressable else _jit_copy(x)
+
+    return jax.tree.map(snap, state)
 
 # Parameter-tree layout version, stored next to config.json. Bump whenever a
 # module's param structure changes incompatibly so restores fail with THIS
@@ -140,13 +156,52 @@ class CheckpointManager:
         # old synchronous save() handled by construction.
         import atexit
 
+        self._closed = False
         atexit.register(self._flush_at_exit)
 
     def _flush_at_exit(self) -> None:
+        # Bounded, not wait(): an unbounded Queue.join() here could hang
+        # interpreter exit on a wedged device fetch — the very case the
+        # daemon-thread choice exists for (advisor finding, round 2).
+        if self._closed:
+            return
+        deadline = 60.0
         try:
-            self.wait()
+            import time
+
+            t0 = time.monotonic()
+            while (
+                self._q.unfinished_tasks
+                and time.monotonic() - t0 < deadline
+            ):
+                time.sleep(0.1)
+            self.mngr.wait_until_finished()
+            self.latest_mngr.wait_until_finished()
         except Exception:  # noqa: BLE001 — best-effort at interpreter exit
             pass
+
+    def close(self) -> None:
+        """Flush pending saves, stop the saver thread, and release the atexit
+        handle. Idempotent. Without this, each instance pins a thread plus
+        its queued HBM snapshots for process lifetime — test suites and
+        repeated runs in one interpreter leak per instance (advisor finding,
+        round 2)."""
+        import atexit
+
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.wait()
+        finally:
+            self._q.put(None)
+            self._worker.join(timeout=30.0)
+            self.mngr.close()
+            self.latest_mngr.close()
+            try:
+                atexit.unregister(self._flush_at_exit)
+            except Exception:  # noqa: BLE001 — unregister is best-effort
+                pass
 
     def _drain(self) -> None:
         import jax

@@ -132,6 +132,17 @@ class FewShotTrainer:
                     f"{reason}; training runs per-step dispatch",
                     stacklevel=2,
                 )
+        # Lazy-embed mode (train/lazy_embed.py): the word table is stale for
+        # rows outside recent batches; materialize (exact catch-up of every
+        # row) before anything that reads the table outside training —
+        # eval, checkpoint saves, and the returned state.
+        self._materialize = None
+        if cfg.embed_optimizer == "lazy":
+            from induction_network_on_fewrel_tpu.train.lazy_embed import (
+                make_materialize,
+            )
+
+            self._materialize = make_materialize(cfg)
         # Fused eval: an injected fused step (the cached paths bind their
         # table into one — cli._wire_index_cache), else the stock
         # steps.make_multi_eval_step when the stock eval path is in use.
@@ -270,6 +281,11 @@ class FewShotTrainer:
                 and step // cfg.val_step > prev // cfg.val_step
             )
             if self.val_sampler is not None and crossed_val:
+                if self._materialize is not None:
+                    # Catch every table row up to the current step so eval
+                    # and the boundary checkpoints see the exact
+                    # dense-equivalent table (lazy-embed mode).
+                    state = self._materialize(state)
                 val_metrics = self.evaluate(
                     state.params, cfg.val_iter, return_metrics=True
                 )
@@ -312,9 +328,15 @@ class FewShotTrainer:
                         # they hold the dead-zone state, and orbax refuses
                         # re-saves at <= its latest step, so a later
                         # --resume would otherwise restore the collapse.
-                        for s in self.ckpt.latest_mngr.all_steps():
-                            if best_step is None or s > best_step:
-                                self.ckpt.latest_mngr.delete(s)
+                        # If no best checkpoint exists (e.g. the async best
+                        # save failed), skip the purge — a ring slot with the
+                        # collapse is still the only restorable state, and
+                        # deleting it would leave the dir empty (advisor
+                        # finding, round 2).
+                        if best_step is not None:
+                            for s in self.ckpt.latest_mngr.all_steps():
+                                if s > best_step:
+                                    self.ckpt.latest_mngr.delete(s)
                         self.logger.log(
                             step, "divergence_stop",
                             restored_step=float(
@@ -327,6 +349,11 @@ class FewShotTrainer:
                 last_logged = step
         if profiling:
             jax.profiler.stop_trace()  # run ended inside the trace window
+        if self._materialize is not None and not diverged_stop:
+            # The returned state (and the final ring save) must hold the
+            # fully caught-up table; a diverged-stop state was restored
+            # from a checkpoint and is already materialized.
+            state = self._materialize(state)
         if self.ckpt is not None:
             if not diverged_stop:
                 # Final ring save (no-op if the last val boundary already
@@ -340,6 +367,17 @@ class FewShotTrainer:
             # run's contract is that returning implies durable checkpoints.
             self.ckpt.wait()
         return state
+
+    def close(self) -> None:
+        """Release the checkpoint manager's saver thread + atexit handle and
+        any native sampler handles. Safe to call repeatedly; trainers used
+        as context-free objects in tests should call this to avoid leaking
+        one thread / C++ handle per instance (advisor finding, round 2)."""
+        if self.ckpt is not None:
+            self.ckpt.close()
+        for s in (self.train_sampler, self.val_sampler):
+            if hasattr(s, "close"):
+                s.close()
 
     def evaluate(self, params, num_episodes: int, sampler=None,
                  return_metrics: bool = False):

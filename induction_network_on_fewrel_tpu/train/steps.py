@@ -33,6 +33,18 @@ class TrainState(train_state.TrainState):
     """Params + optimizer state + step; flax TrainState is already a pytree."""
 
 
+class LazyEmbedTrainState(TrainState):
+    """TrainState + the lazy word-table Adam state (train/lazy_embed.py):
+    per-row first/second moments and the update count each row is current
+    through. Rides the same pytree everywhere (scan carries, donation,
+    orbax checkpoints); embed_optimizer is an ARCHITECTURE_FIELD, so
+    restores always rebuild the matching tree."""
+
+    emb_m: Any = None
+    emb_v: Any = None
+    emb_last: Any = None
+
+
 def make_optimizer(cfg: ExperimentConfig) -> optax.GradientTransformation:
     """clip -> (adam|sgd) with StepLR-style staircase decay (SURVEY.md §2.1).
 
@@ -75,7 +87,13 @@ def make_optimizer(cfg: ExperimentConfig) -> optax.GradientTransformation:
         return optax.chain(clip, opt)
     if cfg.embed_optimizer == "sgd":
         emb = optax.sgd(schedule)  # stateless: no moments to densify
-    elif cfg.embed_optimizer == "frozen":
+    elif cfg.embed_optimizer in ("frozen", "lazy"):
+        # frozen: the table never moves (Embedding stop_gradients it too).
+        # lazy: the table IS updated, but by the sparse exact-parity path in
+        # train/lazy_embed.py — optax must leave it alone here, and the
+        # global-norm clip is replicated inside the lazy body (it has to
+        # scale the dense emb cotangent before the row update), so the lazy
+        # chain carries no clip of its own.
         emb = optax.set_to_zero()
     else:
         raise ValueError(f"unknown embed_optimizer {cfg.embed_optimizer!r}")
@@ -97,6 +115,11 @@ def make_optimizer(cfg: ExperimentConfig) -> optax.GradientTransformation:
             )
         return labels
 
+    if cfg.embed_optimizer == "lazy":
+        # No clip in the chain: the lazy body applies the identical
+        # global-norm clip manually so the emb row update sees the same
+        # scaled gradient the main partition does.
+        return optax.multi_transform({"main": opt, "emb": emb}, label_fn)
     # Clip OUTSIDE the split so the global norm covers every gradient,
     # exactly as in "shared" mode — the split changes only which update
     # rule each partition gets, not what --grad_clip means.
@@ -132,6 +155,13 @@ def make_update_body(model, cfg: ExperimentConfig):
     ``(state, (support, query, label)) -> (state, metrics)`` — the scan-body
     calling convention.
     """
+
+    if cfg.embed_optimizer == "lazy":
+        from induction_network_on_fewrel_tpu.train.lazy_embed import (
+            make_lazy_update_body,
+        )
+
+        return make_lazy_update_body(model, cfg)
 
     aux_w = cfg.moe_aux_weight if cfg.moe_experts > 0 else 0.0
 
@@ -223,6 +253,18 @@ def make_multi_eval_step(model, cfg: ExperimentConfig):
 def init_state(model, cfg: ExperimentConfig, support, query, rng=None) -> TrainState:
     rng = rng if rng is not None else jax.random.key(cfg.seed)
     params = model.init(rng, support, query)
+    if cfg.embed_optimizer == "lazy":
+        from induction_network_on_fewrel_tpu.train.lazy_embed import (
+            find_emb_path,
+            tree_get,
+        )
+
+        table = tree_get(params, find_emb_path(params))
+        return LazyEmbedTrainState.create(
+            apply_fn=model.apply, params=params, tx=make_optimizer(cfg),
+            emb_m=jnp.zeros_like(table), emb_v=jnp.zeros_like(table),
+            emb_last=jnp.zeros((table.shape[0],), jnp.int32),
+        )
     return TrainState.create(
         apply_fn=model.apply, params=params, tx=make_optimizer(cfg)
     )

@@ -58,7 +58,21 @@ def tokenize_dataset(
 
 
 def _gather(table: dict[str, Any], idx):
-    return {k: v[idx] for k, v in table.items()}
+    # "uids" is table-level metadata (the lazy-embed corpus vocabulary,
+    # lazy_embed.augment_token_table), not a per-row column — never gather
+    # it by row index.
+    return {k: v[idx] for k, v in table.items() if k != "uids"}
+
+
+def _lazy_cached(model, cfg):
+    """The token-cache lazy-embed body, or None when cfg doesn't use it."""
+    if getattr(cfg, "embed_optimizer", "shared") != "lazy":
+        return None
+    from induction_network_on_fewrel_tpu.train.lazy_embed import (
+        make_lazy_cached_update_body,
+    )
+
+    return make_lazy_cached_update_body(model, cfg)
 
 
 def make_token_cached_train_step(model, cfg, mesh=None, state_example=None):
@@ -72,10 +86,14 @@ def make_token_cached_train_step(model, cfg, mesh=None, state_example=None):
 
     from induction_network_on_fewrel_tpu.train.steps import make_update_body
 
-    body = make_update_body(model, cfg)
+    lazy = _lazy_cached(model, cfg)
+    body = make_update_body(model, cfg) if lazy is None else None
 
     def step(state, table, sup_idx, qry_idx, label):
-        return body(state, (_gather(table, sup_idx), _gather(table, qry_idx), label))
+        sup, qry = _gather(table, sup_idx), _gather(table, qry_idx)
+        if lazy is not None:
+            return lazy(state, (sup, qry, label, table["uids"]))
+        return body(state, (sup, qry, label))
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,))
@@ -90,12 +108,16 @@ def make_token_cached_multi_train_step(model, cfg, mesh=None, state_example=None
 
     from induction_network_on_fewrel_tpu.train.steps import make_update_body
 
-    body = make_update_body(model, cfg)
+    lazy = _lazy_cached(model, cfg)
+    body = make_update_body(model, cfg) if lazy is None else None
 
     def multi_step(state, table, sup_idx_s, qry_idx_s, label_s):
         def scan_body(st, xs):
             si, qi, lab = xs
-            return body(st, (_gather(table, si), _gather(table, qi), lab))
+            sup, qry = _gather(table, si), _gather(table, qi)
+            if lazy is not None:
+                return lazy(st, (sup, qry, lab, table["uids"]))
+            return body(st, (sup, qry, lab))
 
         return jax.lax.scan(scan_body, state, (sup_idx_s, qry_idx_s, label_s))
 

@@ -1,0 +1,245 @@
+"""Exact-parity proof for the lazy word-table Adam (train/lazy_embed.py).
+
+VERDICT round-2 item 3: the lazy scheme must be mathematically equivalent
+to dense Adam on the table — verified here at 1e-6 over >=12 steps against
+the dense optimizer, INCLUDING untouched rows and rows with momentum tails
+(touched early, then skipped for many steps). The staircase LR schedule is
+set to cross boundaries inside catch-up windows so the schedule replication
+is exercised, not just constant-lr decay.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    GloveTokenizer,
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+from induction_network_on_fewrel_tpu.train.lazy_embed import (
+    find_emb_path,
+    make_materialize,
+    tree_get,
+)
+from induction_network_on_fewrel_tpu.train.steps import (
+    init_state,
+    make_multi_train_step,
+    make_train_step,
+)
+
+VOCAB = 52  # 50 GloVe words + UNK/BLANK; the synthetic corpus uses only 20
+CFG = ExperimentConfig(
+    encoder="cnn", n=3, k=2, q=2, batch_size=2, max_length=12,
+    vocab_size=VOCAB, hidden_size=16, lr=3e-3, lr_step_size=3,  # staircase
+    weight_decay=0.0, grad_clip=10.0,                            # inside run
+)
+STEPS = 12
+
+
+@pytest.fixture(scope="module")
+def fixture():
+    vocab = make_synthetic_glove(vocab_size=VOCAB - 2)
+    # Small per-relation pools + tiny episodes => each batch touches only a
+    # slice of the 20 active words: real gaps form, and rows 22..51 are
+    # never touched at all.
+    ds = make_synthetic_fewrel(
+        num_relations=6, instances_per_relation=6, vocab_size=35
+    )
+    tok = GloveTokenizer(vocab, max_length=CFG.max_length)
+    sampler = EpisodeSampler(ds, tok, CFG.n, CFG.k, CFG.q, CFG.batch_size, seed=3)
+    batches = [batch_to_model_inputs(sampler.sample_batch()) for _ in range(STEPS)]
+    model = build_model(CFG, glove_init=vocab.vectors)
+    return model, vocab, batches
+
+
+def _run(model, cfg, batches, state=None):
+    step = make_train_step(model, cfg)
+    state = state if state is not None else init_state(
+        model, cfg, batches[0][0], batches[0][1]
+    )
+    for sup, qry, lab in batches:
+        state, _ = step(state, sup, qry, lab)
+    return state
+
+
+def _assert_trees_close(a, b, atol):
+    flat_a = jax.tree_util.tree_flatten_with_path(a)[0]
+    flat_b = dict(
+        (jax.tree_util.keystr(p), v)
+        for p, v in jax.tree_util.tree_flatten_with_path(b)[0]
+    )
+    for path, va in flat_a:
+        vb = flat_b[jax.tree_util.keystr(path)]
+        np.testing.assert_allclose(
+            np.asarray(va), np.asarray(vb), atol=atol, rtol=0,
+            err_msg=f"param {jax.tree_util.keystr(path)} diverged",
+        )
+
+
+def test_lazy_equals_dense_adam(fixture):
+    """Lazy trajectory == dense shared-Adam trajectory at 1e-6 (wd=0, so
+    the two configs define the SAME optimizer), every param including the
+    full table: touched rows, momentum-tail rows, and never-touched rows."""
+    model, vocab, batches = fixture
+    dense = _run(model, CFG.replace(embed_optimizer="shared"), batches)
+    lazy_cfg = CFG.replace(embed_optimizer="lazy")
+    raw = _run(model, lazy_cfg, batches)
+    # Gap evidence BEFORE materialize (which catches every row up): some
+    # row was touched at an earlier step but not the last one — its
+    # catch-up loop ran with gap > 0 during training.
+    last = np.asarray(raw.emb_last)
+    assert ((last > 0) & (last < STEPS)).any(), "no gapped rows exercised"
+    lazy = make_materialize(lazy_cfg)(raw)
+
+    path = find_emb_path(dense.params)
+    table_d = np.asarray(tree_get(dense.params, path))
+    table_l = np.asarray(tree_get(lazy.params, path))
+    np.testing.assert_allclose(table_l, table_d, atol=1e-6, rtol=0)
+    # Never-touched rows stayed EXACTLY at init in both modes (m=v=0 =>
+    # zero Adam update) — the structural fact laziness exploits.
+    touched = np.zeros(VOCAB, bool)
+    for sup, qry, _ in batches:
+        touched[np.asarray(sup["word"]).ravel()] = True
+        touched[np.asarray(qry["word"]).ravel()] = True
+    assert (~touched).sum() >= 10, "fixture lost its untouched rows"
+    np.testing.assert_array_equal(
+        table_l[~touched], np.asarray(vocab.vectors)[~touched]
+    )
+    # The non-embedding params went through the identical optax path.
+    _assert_trees_close(lazy.params, dense.params, atol=1e-6)
+
+
+def test_lazy_with_weight_decay_matches_nowd_table_twin(fixture):
+    """With wd>0, lazy == the dense twin that applies wd everywhere EXCEPT
+    the table (the documented lazy semantics): coupled-L2 Adam on the main
+    partition, plain Adam on the table."""
+    model, _, batches = fixture
+    wd = 1e-2  # large enough that a wd mismatch would exceed 1e-6 in 1 step
+    lazy_cfg = CFG.replace(embed_optimizer="lazy", weight_decay=wd)
+    lazy = _run(model, lazy_cfg, batches)
+    lazy = make_materialize(lazy_cfg)(lazy)
+
+    schedule = optax.exponential_decay(
+        init_value=CFG.lr, transition_steps=CFG.lr_step_size,
+        decay_rate=CFG.lr_gamma, staircase=True,
+    )
+
+    def label_fn(params):
+        return jax.tree_util.tree_map_with_path(
+            lambda p, _: "emb" if any(
+                getattr(k, "key", None) == "word_embedding" for k in p
+            ) else "main",
+            params,
+        )
+
+    twin_tx = optax.chain(
+        optax.clip_by_global_norm(CFG.grad_clip),
+        optax.multi_transform(
+            {
+                "main": optax.chain(
+                    optax.add_decayed_weights(wd), optax.adam(schedule)
+                ),
+                "emb": optax.adam(schedule),
+            },
+            label_fn,
+        ),
+    )
+    from induction_network_on_fewrel_tpu.train.steps import TrainState
+
+    params = model.init(jax.random.key(CFG.seed), batches[0][0], batches[0][1])
+    twin_state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=twin_tx
+    )
+    twin = _run(
+        model, CFG.replace(embed_optimizer="shared", weight_decay=wd),
+        batches, state=twin_state,
+    )
+    _assert_trees_close(lazy.params, twin.params, atol=1e-6)
+
+
+def test_lazy_fused_scan_matches_per_step(fixture):
+    """The steps_per_call scan threads the lazy state through its carry:
+    4 fused calls of 3 steps == 12 per-step calls, bitwise-close."""
+    model, _, batches = fixture
+    lazy_cfg = CFG.replace(embed_optimizer="lazy", steps_per_call=3)
+    per_step = _run(model, lazy_cfg, batches)
+
+    multi = make_multi_train_step(model, lazy_cfg)
+    state = init_state(model, lazy_cfg, batches[0][0], batches[0][1])
+    for i in range(0, STEPS, 3):
+        sup_s, qry_s, lab_s = jax.tree.map(
+            lambda *xs: np.stack(xs), *batches[i : i + 3]
+        )
+        state, _ = multi(state, sup_s, qry_s, lab_s)
+
+    mat = make_materialize(lazy_cfg)
+    _assert_trees_close(
+        mat(state).params, mat(per_step).params, atol=1e-6
+    )
+
+
+def test_lazy_token_cache_matches_dense(fixture):
+    """The token-cache lazy body (static corpus remap, no per-step dedup)
+    computes the identical trajectory as the dense cached step — same
+    index stream, params equal at 1e-6 after 10 steps."""
+    from induction_network_on_fewrel_tpu.data import (
+        GloveTokenizer,
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.native.sampler import (
+        make_index_sampler,
+    )
+    from induction_network_on_fewrel_tpu.train.lazy_embed import (
+        augment_token_table,
+    )
+    from induction_network_on_fewrel_tpu.train.token_cache import (
+        make_token_cached_train_step,
+        tokenize_dataset,
+    )
+
+    model, vocab, batches = fixture
+    ds = make_synthetic_fewrel(
+        num_relations=6, instances_per_relation=6, vocab_size=35, seed=9
+    )
+    tok = GloveTokenizer(vocab, max_length=CFG.max_length)
+    table_np, sizes = tokenize_dataset(ds, tok)
+    aug, uids = augment_token_table(table_np)
+    lazy_table = {**aug, "uids": uids}
+    sampler = make_index_sampler(
+        sizes, CFG.n, CFG.k, CFG.q, batch_size=CFG.batch_size, seed=4,
+        backend="python",
+    )
+    idx_batches = [sampler.sample_batch() for _ in range(10)]
+
+    def run(cfg, table):
+        step = make_token_cached_train_step(model, cfg)
+        state = init_state(model, cfg, batches[0][0], batches[0][1])
+        for b in idx_batches:
+            state, _ = step(state, table, b.support_idx, b.query_idx, b.label)
+        return state
+
+    dense = run(CFG.replace(embed_optimizer="shared"), table_np)
+    lazy_cfg = CFG.replace(embed_optimizer="lazy")
+    lazy = make_materialize(lazy_cfg)(run(lazy_cfg, lazy_table))
+    _assert_trees_close(lazy.params, dense.params, atol=1e-6)
+
+
+def test_materialize_is_idempotent(fixture):
+    model, _, batches = fixture
+    lazy_cfg = CFG.replace(embed_optimizer="lazy")
+    state = _run(model, lazy_cfg, batches)
+    mat = make_materialize(lazy_cfg)
+    once = mat(state)
+    twice = mat(jax.tree.map(jnp.copy, once))
+    _assert_trees_close(twice.params, once.params, atol=0)
+    np.testing.assert_array_equal(
+        np.asarray(twice.emb_last), np.asarray(once.emb_last)
+    )

@@ -7,6 +7,12 @@ device_get of the loss scalar, because on this tunneled backend
 docstring — block-based timings measure dispatch, not training). One JSON
 line per config on stdout; bench.py stays the single-line driver contract,
 this is the full table for BASELINE.md.
+
+INTERLEAVED A/B (round-2 VERDICT weak item 3): configs are timed in
+GROUPS — a live config and its token-cache twin (or the embed-optimizer
+variants) alternate chunks within ONE tunnel session, so a difference
+between rows in a group is a real effect, not tunnel weather. Each row
+reports median ± spread over its chunks, not just the best.
 """
 
 from __future__ import annotations
@@ -21,11 +27,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 BATCH = 8
 WARMUP = 5
 CHUNK = 20
-MAX_CHUNKS = 6
-MAX_SECONDS = 45.0
+ROUNDS = 5  # interleaved chunks per config per group
+MAX_SECONDS = 45.0  # per config within a group
 
 
-def run_config(name: str, cfg, adv: bool = False) -> dict:
+def prepare_config(name: str, cfg, adv: bool = False):
     import jax
 
     from induction_network_on_fewrel_tpu.data import (
@@ -84,6 +90,13 @@ def run_config(name: str, cfg, adv: bool = False) -> dict:
         if hasattr(sampler, "close"):
             sampler.close()
         table_np, sizes = tokenize_dataset(ds, tok)
+        if cfg.embed_optimizer == "lazy":
+            from induction_network_on_fewrel_tpu.train.lazy_embed import (
+                augment_token_table,
+            )
+
+            table_np, uids = augment_token_table(table_np)
+            table_np = {**table_np, "uids": uids}
         table = jax.device_put(table_np)
         # Same sampler policy as the production CLI path: C++ index
         # sampler when the toolchain is present.
@@ -99,7 +112,8 @@ def run_config(name: str, cfg, adv: bool = False) -> dict:
             si, qi, ls = isampler.sample_fused(S)
             return multi(st, table, si, qi, ls)
 
-        return _time_loop(name, cfg, step_once, state, eff=S)
+        return _prepared(name, cfg, step_once, state, eff=S,
+                         closers=[isampler])
     if cfg.feature_cache:
         # Index mode: device-resident table, int32 indices per step, fused
         # scan — the production cached path (train/feature_cache.py).
@@ -139,8 +153,7 @@ def run_config(name: str, cfg, adv: bool = False) -> dict:
             st, m = multi(st, table, si, qi, ls)
             return st, m
 
-        pack = state
-        return _time_loop(name, cfg, step_once, pack, eff=S)
+        return _prepared(name, cfg, step_once, state, eff=S)
     state = init_state(model, cfg, sup, qry)
 
     if adv:
@@ -217,48 +230,107 @@ def run_config(name: str, cfg, adv: bool = False) -> dict:
         pack = state
 
     eff = cfg.steps_per_call if cfg.steps_per_call > 1 else 1
-    result = _time_loop(name, cfg, step_once, pack, eff=eff)
-    if hasattr(sampler, "close"):
-        sampler.close()
-    return result
+    closers = [sampler] if hasattr(sampler, "close") else []
+    return _prepared(name, cfg, step_once, pack, eff=eff, closers=closers)
 
 
-def _time_loop(name, cfg, step_once, pack, eff=1):
-    """Warm up, then chunked hard-synced timing; returns the result row."""
+def _prepared(name, cfg, step_once, pack, eff=1, closers=()):
+    return {
+        "name": name, "cfg": cfg, "step_once": step_once, "pack": pack,
+        "eff": eff, "closers": list(closers), "rates": [], "warmup_s": None,
+    }
+
+
+def _hard_sync(metrics):
+    # A value fetch, NOT block_until_ready: the tunneled backend's block
+    # returns before execution finishes (bench.py docstring).
     import jax
     import numpy as np
 
-    def hard_sync(metrics):
-        # A value fetch, NOT block_until_ready: the tunneled backend's block
-        # returns before execution finishes (bench.py docstring).
-        _ = float(np.ravel(jax.device_get(metrics["loss"]))[-1])
+    _ = float(np.ravel(jax.device_get(metrics["loss"]))[-1])
 
-    t0 = time.monotonic()
-    for _ in range(WARMUP):
-        pack, metrics = step_once(pack)
-    hard_sync(metrics)
-    compile_s = time.monotonic() - t0
+
+def _one_chunk(p) -> float:
+    """Run one hard-synced chunk of config ``p``; returns eps/s/chip."""
+    import jax
 
     n_chips = max(jax.local_device_count(), 1)
-    # One step_once = ``eff`` optimizer steps on fused paths.
+    eff = p["eff"]
     calls = max(CHUNK // eff, 2) if eff > 1 else CHUNK
-    best = 0.0
-    start = time.monotonic()
-    chunks = 0
-    while chunks < MAX_CHUNKS and time.monotonic() - start < MAX_SECONDS:
-        t0 = time.monotonic()
-        for _ in range(calls):
-            pack, metrics = step_once(pack)
-        hard_sync(metrics)
-        rate = calls * eff * cfg.batch_size / (time.monotonic() - t0) / n_chips
-        best = max(best, rate)
-        chunks += 1
-    return {
-        "config": name,
-        "episodes_per_s_per_chip": round(best, 1),
-        "warmup_s": round(compile_s, 1),
-        "backend": jax.default_backend(),
-    }
+    t0 = time.monotonic()
+    pack = p["pack"]
+    for _ in range(calls):
+        pack, metrics = p["step_once"](pack)
+    _hard_sync(metrics)
+    p["pack"] = pack
+    return calls * eff * p["cfg"].batch_size / (time.monotonic() - t0) / n_chips
+
+
+def run_group(members, rounds: int = ROUNDS):
+    """Prepare every member, then ALTERNATE chunks across them within this
+    one tunnel session (A/B/A/B...), so cross-member differences are real
+    effects, not tunnel weather. Emits one JSON row per member with
+    median ± spread over its chunks."""
+    import gc
+    import statistics
+
+    import jax
+
+    def close_member(p):
+        for c in p["closers"]:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — best-effort release
+                pass
+        p["closers"] = []
+
+    prepared = []
+    for name, cfg, adv in members:
+        p = None
+        try:
+            p = prepare_config(name, cfg, adv)
+            t0 = time.monotonic()
+            for _ in range(WARMUP):
+                p["pack"], metrics = p["step_once"](p["pack"])
+            _hard_sync(metrics)
+            p["warmup_s"] = round(time.monotonic() - t0, 1)
+            prepared.append(p)
+        except Exception as e:  # keep sweeping; report the failure
+            print(json.dumps({"config": name, "error": repr(e)[:300]}),
+                  flush=True)
+            if p is not None:
+                close_member(p)
+
+    spent = {id(p): 0.0 for p in prepared}
+    for _ in range(rounds):
+        for p in prepared:  # the interleave: one chunk each, round-robin
+            if spent[id(p)] >= MAX_SECONDS or "error" in p:
+                continue
+            t0 = time.monotonic()
+            try:
+                p["rates"].append(_one_chunk(p))
+            except Exception as e:  # the member fails; the GROUP sweeps on
+                p["error"] = repr(e)[:300]
+            spent[id(p)] += time.monotonic() - t0
+
+    for p in prepared:
+        rates = p["rates"]
+        row = {
+            "config": p["name"],
+            "episodes_per_s_per_chip": round(statistics.median(rates), 1)
+            if rates else None,
+            "spread": [round(min(rates), 1), round(max(rates), 1)]
+            if rates else None,
+            "chunks": len(rates),
+            "warmup_s": p["warmup_s"],
+            "backend": jax.default_backend(),
+        }
+        if "error" in p:
+            row["error"] = p["error"]
+        print(json.dumps(row), flush=True)
+        close_member(p)
+    prepared.clear()
+    gc.collect()  # release each group's device tables before the next
 
 
 def main() -> int:
@@ -275,56 +347,73 @@ def main() -> int:
 
     base = dict(batch_size=BATCH, max_length=40, vocab_size=2002,
                 compute_dtype="bfloat16", steps_per_call=64)
-    configs = [
-        ("1: 5w1s cnn", ExperimentConfig(
-            encoder="cnn", n=5, k=1, q=5, **base), False),
-        ("2: 5w5s bilstm", ExperimentConfig(
-            encoder="bilstm", n=5, k=5, q=5, **base), False),
-        ("3: 10w5s bilstm", ExperimentConfig(
+    tc = lambda **kw: ExperimentConfig(
+        token_cache=True, **{**base, "steps_per_call": 512, **kw}
+    )
+    # GROUPS interleave within one tunnel session: each live config rides
+    # next to its token-cache twin, so live-vs-cached is a real A/B.
+    groups = [
+        [("1: 5w1s cnn", ExperimentConfig(encoder="cnn", n=5, k=1, q=5, **base), False),
+         ("1t: 5w1s cnn token_cache", tc(encoder="cnn", n=5, k=1, q=5), False)],
+        [("2: 5w5s bilstm", ExperimentConfig(encoder="bilstm", n=5, k=5, q=5, **base), False),
+         ("2t: 5w5s bilstm token_cache", tc(encoder="bilstm", n=5, k=5, q=5), False)],
+        [("3: 10w5s bilstm", ExperimentConfig(
             encoder="bilstm", train_n=10, n=10, k=5, q=5, **base), False),
-        ("4: 5w5s bert-base frozen", ExperimentConfig(
+         ("3t: 10w5s bilstm token_cache",
+          tc(encoder="bilstm", train_n=10, n=10, k=5, q=5), False)],
+        [("4: 5w5s bert-base frozen", ExperimentConfig(
             encoder="bert", n=5, k=5, q=5, bert_frozen=True,
             **{**base, "batch_size": 2, "steps_per_call": 8}), False),
-        ("4b: 5w5s bert-base frozen + feature_cache", ExperimentConfig(
+         ("4b: 5w5s bert-base frozen + feature_cache", ExperimentConfig(
             encoder="bert", n=5, k=5, q=5, bert_frozen=True,
-            feature_cache=True, **{**base, "batch_size": 2}), False),
-        ("5: 5w5s bilstm na_rate=5 +adv (FewRel2.0)", ExperimentConfig(
+            feature_cache=True, **{**base, "batch_size": 2}), False)],
+        [("5: 5w5s bilstm na_rate=5 +adv (FewRel2.0)", ExperimentConfig(
             encoder="bilstm", n=5, k=5, q=5, na_rate=5, adv=True,
             **base), True),
-        # Token-cache twins of the GloVe configs (--token_cache, spc=512):
-        # the production fast path bench.py records for the flagship.
-        ("1t: 5w1s cnn token_cache", ExperimentConfig(
-            encoder="cnn", n=5, k=1, q=5, token_cache=True,
-            **{**base, "steps_per_call": 512}), False),
-        ("2t: 5w5s bilstm token_cache", ExperimentConfig(
-            encoder="bilstm", n=5, k=5, q=5, token_cache=True,
-            **{**base, "steps_per_call": 512}), False),
-        ("3t: 10w5s bilstm token_cache", ExperimentConfig(
-            encoder="bilstm", train_n=10, n=10, k=5, q=5, token_cache=True,
-            **{**base, "steps_per_call": 512}), False),
-        ("5t: 5w5s bilstm na_rate=5 token_cache (NOTA)", ExperimentConfig(
-            encoder="bilstm", n=5, k=5, q=5, na_rate=5, token_cache=True,
-            **{**base, "steps_per_call": 512}), False),
-        # NOTA fraction = na_rate/(n + na_rate): row 5t above is the 50%
-        # mix (na_rate=5 at 5-way); this row adds the light 1/6 mix.
-        ("5n: 5w5s bilstm na_rate=1 token_cache (NOTA 1:6)", ExperimentConfig(
-            encoder="bilstm", n=5, k=5, q=5, na_rate=1, token_cache=True,
-            **{**base, "steps_per_call": 512}), False),
+         ("5t: 5w5s bilstm na_rate=5 token_cache (NOTA)",
+          tc(encoder="bilstm", n=5, k=5, q=5, na_rate=5), False),
+         # NOTA fraction = na_rate/(n + na_rate): 5t is the 50% mix; this
+         # row adds the light 1/6 mix.
+         ("5n: 5w5s bilstm na_rate=1 token_cache (NOTA 1:6)",
+          tc(encoder="bilstm", n=5, k=5, q=5, na_rate=1), False)],
+        # Reference-shaped embed-optimizer A/B (VERDICT round-2 item 3):
+        # full 400k table, dense Adam vs the exact-parity lazy row update
+        # vs stateless sgd — interleaved so the lazy win is tunnel-proof.
+        # Model-zoo throughput (VERDICT round-2 item 6): every sibling
+        # few-shot model on the production token-cache path, interleaved so
+        # the ranking is tunnel-proof. Induction rides along as the anchor.
+        [(f"7-{m}: 5w5s {m} token_cache",
+          tc(encoder="cnn", n=5, k=5, q=5, model=m, steps_per_call=64), False)
+         for m in ("induction", "proto", "proto_hatt", "siamese",
+                   "gnn", "snail", "metanet")],
+        [("6s: 400k-vocab B64 embed=shared (dense Adam)",
+          tc(encoder="bilstm", n=5, k=5, q=5, batch_size=64, vocab_size=400002,
+             steps_per_call=256, embed_optimizer="shared"), False),
+         ("6l: 400k-vocab B64 embed=lazy (exact-parity sparse)",
+          tc(encoder="bilstm", n=5, k=5, q=5, batch_size=64, vocab_size=400002,
+             steps_per_call=256, embed_optimizer="lazy"), False),
+         ("6g: 400k-vocab B64 embed=sgd",
+          tc(encoder="bilstm", n=5, k=5, q=5, batch_size=64, vocab_size=400002,
+             steps_per_call=256, embed_optimizer="sgd"), False)],
     ]
     only = sys.argv[1:] or None
-    for name, cfg, adv in configs:
-        # Match on the numeric prefix ("1".."5") or a substring of the rest;
-        # a bare-substring match would make "1" also select "3: 10w5s".
-        if only and not any(
-            name.startswith(s + ":")
-            or (not s.isdigit() and s in name.split(":", 1)[1])
+
+    def matches(name: str) -> bool:
+        # Numeric selectors match the row's id prefix ("6" hits 6s/6l/6g,
+        # "1" hits 1/1t but not "3: 10w5s"); non-numeric selectors are
+        # substring matches on the description.
+        if not only:
+            return True
+        rid = name.split(":", 1)[0]
+        return any(
+            rid.startswith(s) if s[0].isdigit() else s in name
             for s in only
-        ):
-            continue
-        try:
-            print(json.dumps(run_config(name, cfg, adv)), flush=True)
-        except Exception as e:  # keep sweeping; report the failure
-            print(json.dumps({"config": name, "error": repr(e)[:300]}), flush=True)
+        )
+
+    for group in groups:
+        group = [m for m in group if matches(m[0])]
+        if group:
+            run_group(group)
     return 0
 
 
