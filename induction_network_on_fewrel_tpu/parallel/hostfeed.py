@@ -1,0 +1,167 @@
+"""Per-host data feeding for multi-host pods (SURVEY.md §2.2 DP row).
+
+On a pod, every process runs this same program over its own addressable
+devices. The reference's DataParallel has no analog for this (single
+process); the TPU-native shape is:
+
+1. **Stream partitioning** — each process samples ONLY the episodes its
+   devices own. The global batch axis is sharded ``P('dp', ...)``; this
+   module computes, from the mesh's device->process ownership, which
+   contiguous row range of the global batch belongs to the calling process
+   (``local_episode_range``). Each process builds its sampler with that
+   local batch size and a process-strided seed (``process_seed``) so hosts
+   draw disjoint episode streams — the samplers are pure functions of
+   (seed, batch index), so the global stream is deterministic for a given
+   process layout.
+2. **Global array assembly** — ``GlobalBatchAssembler`` turns the local
+   numpy rows into global ``jax.Array``s via
+   ``jax.make_array_from_process_local_data``: every process contributes
+   its shard, no host ever materializes (or transfers) the full global
+   batch, and jit consumes the result without any resharding.
+
+Single-process runs take the identical code path (local == global), which
+is how the integration is tested on the 8-virtual-device CPU mesh; a real
+pod changes only ``jax.process_count()``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+from induction_network_on_fewrel_tpu.parallel.sharding import (
+    episode_batch_shardings,
+)
+
+
+def episode_ranges_by_process(
+    mesh: Mesh, global_batch: int, process_of=None
+) -> dict[int, tuple[int, int]]:
+    """{process_index: (start_row, num_rows)} of the global episode axis
+    under ``P('dp')`` sharding.
+
+    Pure function of the mesh layout — ``process_of`` (device -> process
+    index, default the real attribute) is injectable so the multi-process
+    partition math is unit-testable on a single-process CPU mesh.
+    Episode rows are contiguous per process for standard pod meshes
+    (devices enumerate process-major); a scrambled layout raises rather
+    than silently feeding interleaved rows.
+    """
+    process_of = process_of or (lambda d: d.process_index)
+    sharding = NamedSharding(mesh, P("dp"))
+    dp = mesh.shape.get("dp", 1)
+    if global_batch % max(dp, 1):
+        raise ValueError(
+            f"global batch {global_batch} must divide over dp={dp}"
+        )
+    rows: dict[int, set] = {}
+    for dev, idx in sharding.devices_indices_map((global_batch,)).items():
+        sl = idx[0]
+        start = sl.start or 0
+        stop = sl.stop if sl.stop is not None else global_batch
+        rows.setdefault(process_of(dev), set()).update(range(start, stop))
+    out = {}
+    for pid, owned in rows.items():
+        lo, hi = min(owned), max(owned) + 1
+        if len(owned) != hi - lo:
+            raise ValueError(
+                f"process {pid} owns non-contiguous episode rows {sorted(owned)}; "
+                f"per-host feeding needs a process-major 'dp' device order"
+            )
+        out[pid] = (lo, hi - lo)
+    return out
+
+
+def local_episode_range(mesh: Mesh, global_batch: int) -> tuple[int, int]:
+    """(start_row, num_rows) of the global episode batch THIS process owns."""
+    return episode_ranges_by_process(mesh, global_batch)[jax.process_index()]
+
+
+def process_seed(seed: int) -> int:
+    """Disjoint per-process sampler stream: the samplers (numpy and C++)
+    derive their whole stream from the seed, so striding it by process
+    index gives each host an independent episode source — episodes are iid
+    draws, so any disjoint assignment of streams to hosts yields the same
+    global distribution."""
+    return seed + 7919 * jax.process_index()  # prime stride: no overlap
+
+
+class GlobalBatchAssembler:
+    """Local (support, query, label) numpy rows -> global jax.Arrays.
+
+    Uses ``jax.make_array_from_process_local_data`` against the SAME
+    episode-batch shardings the sharded steps declare (parallel/sharding);
+    jit then consumes the arrays with zero resharding. ``index_mode``
+    switches to the cached-path layout (int32 index batches, generic
+    leading-axis-over-dp specs).
+    """
+
+    def __init__(self, mesh: Mesh, global_batch: int, index_mode: bool = False):
+        self.mesh = mesh
+        self.global_batch = global_batch
+        if index_mode:
+            self._shardings = None  # generic leading-dp, built per-leaf
+        else:
+            self._shardings = episode_batch_shardings(mesh)
+
+    def _leaf_sharding(self, leaf):
+        ndim = np.ndim(leaf)
+        return NamedSharding(
+            self.mesh, P(*(("dp",) + (None,) * (ndim - 1))) if ndim else P()
+        )
+
+    def _assemble_leaf(self, sharding, local):
+        global_shape = (self.global_batch,) + tuple(local.shape[1:])
+        return jax.make_array_from_process_local_data(
+            sharding, np.ascontiguousarray(local), global_shape
+        )
+
+    def __call__(self, support, query, label):
+        if self._shardings is None:
+            asm = lambda x: self._assemble_leaf(self._leaf_sharding(x), x)
+            return (
+                jax.tree.map(asm, support),
+                jax.tree.map(asm, query),
+                asm(label),
+            )
+        sup_sh, qry_sh, lab_sh = self._shardings
+        sup = {k: self._assemble_leaf(sup_sh[k], v) for k, v in support.items()}
+        qry = {k: self._assemble_leaf(qry_sh[k], v) for k, v in query.items()}
+        return sup, qry, self._assemble_leaf(lab_sh, label)
+
+
+class _AssembledBatch:
+    """Duck-types the pass-through branch of batch_to_model_inputs."""
+
+    def __init__(self, support, query, label):
+        self.support, self.query, self.label = support, query, label
+
+
+class PerHostSampler:
+    """Wraps a process-LOCAL sampler; every ``sample_batch`` returns the
+    assembled GLOBAL batch. ``batch_size`` reports the global size (the
+    training framework computes episode counts from it)."""
+
+    def __init__(self, local_sampler, assembler: GlobalBatchAssembler):
+        self.local = local_sampler
+        self.assembler = assembler
+        self.batch_size = assembler.global_batch
+
+    @property
+    def total_q(self):
+        return self.local.total_q
+
+    def sample_batch(self):
+        sup, qry, lab = batch_to_model_inputs(self.local.sample_batch())
+        return _AssembledBatch(*self.assembler(sup, qry, lab))
+
+    def __iter__(self):
+        while True:
+            yield self.sample_batch()
+
+    def close(self):
+        if hasattr(self.local, "close"):
+            self.local.close()

@@ -1,0 +1,157 @@
+"""Per-host data feeding (parallel/hostfeed.py).
+
+A real pod cannot be spawned here, so the two halves are verified
+separately on the 8-virtual-device CPU mesh (VERDICT round-2 item 2's
+prescribed fallback): the episode-partition math with injected
+device->process maps, and the global-array assembly + trainer integration
+on a single process (identical code path; only jax.process_count()
+changes on a pod).
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    GloveTokenizer,
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+from induction_network_on_fewrel_tpu.parallel import make_mesh
+from induction_network_on_fewrel_tpu.parallel.hostfeed import (
+    GlobalBatchAssembler,
+    PerHostSampler,
+    episode_ranges_by_process,
+    local_episode_range,
+    process_seed,
+)
+from induction_network_on_fewrel_tpu.parallel.sharding import (
+    make_sharded_train_step,
+)
+from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+from induction_network_on_fewrel_tpu.train.steps import init_state
+
+CFG = ExperimentConfig(
+    encoder="cnn", n=3, k=2, q=2, batch_size=8, max_length=12,
+    vocab_size=52, hidden_size=16, dp=8,
+)
+
+
+def _fixture():
+    vocab = make_synthetic_glove(vocab_size=50)
+    ds = make_synthetic_fewrel(
+        num_relations=6, instances_per_relation=8, vocab_size=35
+    )
+    tok = GloveTokenizer(vocab, max_length=CFG.max_length)
+    model = build_model(CFG, glove_init=vocab.vectors)
+    return vocab, ds, tok, model
+
+
+def test_episode_partition_math_simulated_processes():
+    """With a simulated 2-process (and 4-process) layout, each process owns
+    a contiguous, disjoint, covering slice of the global episode axis."""
+    mesh = make_mesh(dp=8)
+    for n_proc in (2, 4):
+        per = 8 // n_proc
+        ranges = episode_ranges_by_process(
+            mesh, 16, process_of=lambda d: d.id // per
+        )
+        assert set(ranges) == set(range(n_proc))
+        rows = []
+        for pid in range(n_proc):
+            start, count = ranges[pid]
+            assert count == 16 // n_proc
+            rows.extend(range(start, start + count))
+        assert sorted(rows) == list(range(16))  # disjoint + covering
+        # contiguity per process in process-major order
+        assert ranges[0][0] == 0
+        for pid in range(1, n_proc):
+            assert ranges[pid][0] == ranges[pid - 1][0] + ranges[pid - 1][1]
+
+
+def test_interleaved_device_order_refused():
+    mesh = make_mesh(dp=8)
+    with pytest.raises(ValueError, match="non-contiguous"):
+        episode_ranges_by_process(mesh, 16, process_of=lambda d: d.id % 2)
+
+
+def test_single_process_owns_everything():
+    mesh = make_mesh(dp=8)
+    assert local_episode_range(mesh, 16) == (0, 16)
+    assert process_seed(5) == 5  # process 0: stream unchanged
+
+
+def test_assembler_values_and_sharding():
+    mesh = make_mesh(dp=8)
+    _, ds, tok, _ = _fixture()
+    sampler = EpisodeSampler(ds, tok, CFG.n, CFG.k, CFG.q, CFG.batch_size, seed=0)
+    sup, qry, lab = batch_to_model_inputs(sampler.sample_batch())
+    asm = GlobalBatchAssembler(mesh, CFG.batch_size)
+    g_sup, g_qry, g_lab = asm(sup, qry, lab)
+    for name, local, global_ in (
+        ("word", sup["word"], g_sup["word"]),
+        ("mask", qry["mask"], g_qry["mask"]),
+        ("label", lab, g_lab),
+    ):
+        assert isinstance(global_, jax.Array), name
+        np.testing.assert_array_equal(np.asarray(global_), local)
+        assert global_.sharding.spec[0] == "dp", name
+
+
+def test_assembler_index_mode():
+    mesh = make_mesh(dp=8)
+    asm = GlobalBatchAssembler(mesh, 8, index_mode=True)
+    sup = np.arange(8 * 3 * 2, dtype=np.int32).reshape(8, 3, 2)
+    qry = np.arange(8 * 6, dtype=np.int32).reshape(8, 6)
+    lab = np.zeros((8, 6), np.int32)
+    g_sup, g_qry, g_lab = asm(sup, qry, lab)
+    np.testing.assert_array_equal(np.asarray(g_sup), sup)
+    assert g_qry.sharding.spec[0] == "dp"
+
+
+def test_per_host_sampler_matches_direct_feed():
+    """Training through PerHostSampler (assembled global arrays) computes
+    the IDENTICAL trajectory as feeding the same sampler's numpy batches
+    straight into the sharded step."""
+    vocab, ds, tok, model = _fixture()
+    mesh = make_mesh(dp=8)
+
+    def make_local():
+        return EpisodeSampler(
+            ds, tok, CFG.n, CFG.k, CFG.q, CFG.batch_size,
+            seed=process_seed(CFG.seed),
+        )
+
+    base = make_local()
+    sup, qry, _ = batch_to_model_inputs(base.sample_batch())
+    state = init_state(model, CFG, sup, qry)
+    from induction_network_on_fewrel_tpu.parallel.sharding import shard_state
+
+    step = make_sharded_train_step(model, CFG, mesh, state)
+
+    wrapped = PerHostSampler(
+        make_local(), GlobalBatchAssembler(mesh, CFG.batch_size)
+    )
+    assert wrapped.batch_size == CFG.batch_size
+
+    import jax.numpy as jnp
+
+    s0 = shard_state(state, mesh)
+    # Two leaf-copies of ONE state: init_state builds a fresh optimizer
+    # closure each call, and the jitted step is traced against this exact
+    # pytree (function identities included).
+    s_a = jax.tree.map(jnp.copy, s0)
+    s_b = jax.tree.map(jnp.copy, s0)
+    direct = make_local()
+    for _ in range(3):
+        ds_sup, ds_qry, ds_lab = batch_to_model_inputs(direct.sample_batch())
+        s_a, m_a = step(s_a, ds_sup, ds_qry, ds_lab)
+        w_sup, w_qry, w_lab = batch_to_model_inputs(wrapped.sample_batch())
+        s_b, m_b = step(s_b, w_sup, w_qry, w_lab)
+    assert float(m_a["loss"]) == float(m_b["loss"])
+    for a, b in zip(jax.tree.leaves(s_a.params), jax.tree.leaves(s_b.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
