@@ -103,10 +103,13 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--loss", default="mse", choices=["mse", "ce"])
     p.add_argument("--optimizer", default="adam", choices=["adam", "adamw", "sgd"])
     p.add_argument("--embed_optimizer", default="shared",
-                   choices=["shared", "sgd", "frozen"],
+                   choices=["shared", "lazy", "sgd", "frozen"],
                    help="word-embedding table optimizer: shared = main "
                         "optimizer (reference parity; dense Adam touches "
-                        "the whole 400k-row table every step), sgd = "
+                        "the whole 400k-row table every step), lazy = "
+                        "EXACT same Adam trajectory (weight decay excluded "
+                        "on the table) with per-step cost proportional to "
+                        "touched rows (train/lazy_embed.py), sgd = "
                         "stateless scatter update, frozen = fixed GloVe")
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--weight_decay", type=float, default=1e-5)
@@ -117,10 +120,18 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
         p.add_argument("--val_iter", type=int, default=1000)
         p.add_argument("--val_step", type=int, default=1000)
         p.add_argument(
-            "--steps_per_call", type=int, default=1,
-            help="optimizer steps fused into one dispatch (lax.scan); "
-                 "identical updates, amortized host/transfer latency",
+            "--force", action="store_true",
+            help="run configs BASELINE.md documents as degenerate "
+                 "(e.g. --loss mse with --na_rate >= 3)",
         )
+    # On both parsers: test.py's eval loop fuses batches per dispatch too
+    # (a 3000-episode test at per-batch dispatch pays hundreds of ~50 ms
+    # tunnel round-trips that fused eval amortizes).
+    p.add_argument(
+        "--steps_per_call", type=int, default=1,
+        help="optimizer steps (or eval batches) fused into one dispatch "
+             "(lax.scan); identical results, amortized host/transfer latency",
+    )
     p.add_argument("--test_iter", type=int, default=3000)
     # data
     p.add_argument("--train_file", default=None, help="FewRel-schema JSON; synthetic if omitted")
@@ -194,6 +205,24 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         raise ValueError(
             "--token_cache and --feature_cache are exclusive (the feature "
             "cache already runs in index mode)"
+        )
+    # Degenerate-config guard (BASELINE.md round-2 finding): MSE loss at
+    # na_rate >= 3 falls into the all-NOTA optimum and stays there (train
+    # accuracy pinned at the NOTA fraction). Training runs must opt in
+    # explicitly; eval-only invocations (test.py) compute no loss.
+    if (
+        getattr(args, "train_iter", 0)
+        and not getattr(args, "only_test", False)
+        and args.loss == "mse"
+        and args.na_rate >= 3
+        and not getattr(args, "force", False)
+    ):
+        raise ValueError(
+            f"--loss mse with --na_rate {args.na_rate} is a known-degenerate "
+            f"combination (BASELINE.md: the sigmoid-MSE objective's all-NOTA "
+            f"optimum dominates at high NOTA rates and training collapses "
+            f"to it). Use --loss ce, lower --na_rate, or pass --force to "
+            f"run it anyway"
         )
     compute = "bfloat16" if (args.bf16 or args.fp16) else "float32"
     train_iter = getattr(args, "train_iter", 0)
@@ -355,14 +384,21 @@ def _wire_index_cache(cfg, model, cache_mesh, state, only_test,
             fused_eval = lambda p, si, qi, l: _multi_ev(p, table_va, si, qi, l)
 
     def test_eval(test_ds):
-        """(sampler, eval_step) for a test split: its own device-resident
-        table bound to the shared cached eval step."""
+        """(sampler, eval_step, fused_eval) for a test split: its own
+        device-resident table bound to the shared cached eval step, plus —
+        when steps_per_call > 1 — a fused instance bound to the SAME test
+        table (never the val-bound one above; binding per table is what
+        keeps the val/test split drift hazard closed)."""
         table_te, sizes_te = build_table(test_ds)
         ts = make_index_sampler(
             sizes_te, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size,
             na_rate=cfg.na_rate, seed=cfg.seed + 2, backend=eval_backend,
         )
-        return ts, (lambda p, si, qi, l: _eval(p, table_te, si, qi, l))
+        fused_te = None
+        if cfg.steps_per_call > 1:
+            _multi_te = factories["multi_eval"](model, cfg, cache_mesh, state)
+            fused_te = lambda p, si, qi, l: _multi_te(p, table_te, si, qi, l)
+        return ts, (lambda p, si, qi, l: _eval(p, table_te, si, qi, l)), fused_te
 
     return (train_sampler, val_sampler, train_step, eval_step, fused_step,
             fused_eval, test_eval)
@@ -452,6 +488,26 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         (cfg.dp == 0 and n_dev > 1) or cfg.dp > 1 or cfg.tp > 1
         or cfg.sp > 1 or cfg.pp > 1 or cfg.ep > 1
     )
+    if cfg.embed_optimizer == "lazy":
+        # The lazy exact-parity table update (train/lazy_embed.py) serves
+        # the single-device and token-cache paths — the headline configs.
+        # The sharded/adversarial/feature-cache step factories keep the
+        # dense reference path; refuse with guidance instead of tracing
+        # into a state tree those factories were not built for.
+        reasons = {
+            "a device mesh (--dp/--tp/--sp/--pp/--ep)": use_mesh,
+            "--adv (the DANN step)": cfg.adv,
+            "--feature_cache (head-only state, no word table)":
+                cfg.feature_cache,
+            "--encoder bert (owns its embedding; no GloVe table)":
+                cfg.encoder == "bert",
+        }
+        for what, hit in reasons.items():
+            if hit:
+                raise ValueError(
+                    f"--embed_optimizer lazy does not combine with {what}; "
+                    f"use --embed_optimizer shared there"
+                )
     train_step = eval_step = fused_step = fused_eval = state = mesh = None
     attn_impl = pipeline_impl = None
     if use_mesh:
@@ -524,6 +580,53 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
                 mesh, microbatches=cfg.pp_microbatches,
                 batch_axis="dp" if mesh.shape["dp"] > 1 else None,
             )
+    if jax.process_count() > 1:
+        # Multi-host pod: every process runs this same function. Feed each
+        # host ONLY its own episode rows (parallel/hostfeed.py) — disjoint
+        # per-process sampler streams assembled into global arrays with
+        # jax.make_array_from_process_local_data. Without this every host
+        # would sample the identical global batch (replicated, not
+        # sharded, inputs).
+        if not use_mesh:
+            raise ValueError(
+                "multi-host run without a device mesh; pass --dp 0 (all "
+                "devices) or explicit mesh axes"
+            )
+        if caching or cfg.adv or cfg.steps_per_call > 1:
+            raise ValueError(
+                "per-host data feeding currently serves the live per-step "
+                "path: drop --token_cache/--feature_cache/--adv and use "
+                "--steps_per_call 1 on pods (step fusion amortizes a "
+                "tunneled dispatch boundary that real pod hosts don't have)"
+            )
+        from induction_network_on_fewrel_tpu.parallel.hostfeed import (
+            GlobalBatchAssembler,
+            PerHostSampler,
+            local_episode_range,
+            process_seed,
+        )
+
+        _, local_b = local_episode_range(mesh, cfg.batch_size)
+        for s in (train_sampler, val_sampler):
+            if hasattr(s, "close"):
+                s.close()
+        train_sampler = PerHostSampler(
+            make_sampler(
+                train_ds, tok, cfg.train_n, cfg.k, cfg.q, local_b,
+                na_rate=cfg.na_rate, seed=process_seed(cfg.seed),
+                backend=live_backend, prefetch=live_prefetch,
+                num_threads=cfg.sampler_threads,
+            ),
+            GlobalBatchAssembler(mesh, cfg.batch_size),
+        )
+        val_sampler = PerHostSampler(
+            make_sampler(
+                val_ds, tok, cfg.n, cfg.k, cfg.q, local_b,
+                na_rate=cfg.na_rate, seed=process_seed(cfg.seed + 1),
+                backend=eval_backend, prefetch=0, num_threads=1,
+            ),
+            GlobalBatchAssembler(mesh, cfg.batch_size),
+        )
     model = build_model(
         cfg, glove_init=vocab.vectors if vocab is not None else None,
         attn_impl=attn_impl, pipeline_impl=pipeline_impl,
@@ -656,8 +759,19 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
             state = shard_state(state, cache_mesh, zero_opt=cfg.zero_opt)
 
         def build_table(ds):
-            """Tokenize a split once -> device-resident token dict + sizes."""
+            """Tokenize a split once -> device-resident token dict + sizes.
+
+            Lazy-embed runs also carry the precomputed corpus remap
+            (winv per token row + the static uids vector) so the cached
+            lazy body never dedups at step time."""
             tab, sizes = tokenize_dataset(ds, tok)
+            if cfg.embed_optimizer == "lazy":
+                from induction_network_on_fewrel_tpu.train.lazy_embed import (
+                    augment_token_table,
+                )
+
+                tab, uids = augment_token_table(tab)
+                tab = {**tab, "uids": uids}
             return {k: _tput(v) for k, v in tab.items()}, sizes
 
         (train_sampler, val_sampler, train_step, eval_step, fused_step,
@@ -807,16 +921,24 @@ def _test_accuracy(args, cfg: ExperimentConfig, trainer, state) -> float:
     token sampler's dicts would not even trace)."""
     if trainer.cached_test_eval is not None:
         test_ds = load_data(args, cfg, "test")
-        sampler, eval_step = trainer.cached_test_eval(test_ds)
+        sampler, eval_step, fused_eval = trainer.cached_test_eval(test_ds)
         trainer.eval_step = eval_step
         # CRITICAL: any existing fused eval is bound to the VALIDATION
         # split's table (cli._wire_index_cache closes over table_va), so
         # reusing it here would silently score test indices against val
-        # rows. The per-batch eval_step above is bound to the test table.
-        trainer._fused_eval = None
-        return trainer.evaluate(state.params, cfg.test_iter, sampler=sampler)
+        # rows. Both steps installed here are bound to the TEST table.
+        trainer._fused_eval = fused_eval
+        try:
+            return trainer.evaluate(state.params, cfg.test_iter, sampler=sampler)
+        finally:
+            if hasattr(sampler, "close"):
+                sampler.close()
     sampler = make_test_sampler(args, cfg, trainer.tokenizer)
-    return trainer.evaluate(state.params, cfg.test_iter, sampler=sampler)
+    try:
+        return trainer.evaluate(state.params, cfg.test_iter, sampler=sampler)
+    finally:
+        if hasattr(sampler, "close"):
+            sampler.close()
 
 
 def _merge_ckpt_architecture(cfg: ExperimentConfig, src: str) -> ExperimentConfig:
@@ -844,6 +966,13 @@ def train_main(argv=None) -> int:
         cfg = _merge_ckpt_architecture(cfg, args.load_ckpt)
     select_device(cfg)
     trainer = make_trainer(args, cfg)
+    try:
+        return _run_train(args, trainer)
+    finally:
+        trainer.close()  # saver thread + native sampler handles
+
+
+def _run_train(args, trainer) -> int:
     cfg = trainer.cfg  # make_trainer may pin tokenizer-derived fields
 
     state = trainer.init_state()
@@ -860,6 +989,7 @@ def train_main(argv=None) -> int:
         from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
 
         src = args.load_ckpt or args.save_ckpt
+        mngr = None
         try:
             mngr = CheckpointManager(src, cfg)
             state, start_step = (
@@ -871,6 +1001,9 @@ def train_main(argv=None) -> int:
             if args.load_ckpt:
                 raise
             print(f"no checkpoint in {src}; starting fresh", file=sys.stderr)
+        finally:
+            if mngr is not None:
+                mngr.close()  # restore-only manager: stop its saver thread
 
     if args.only_test:
         acc = _test_accuracy(args, cfg, trainer, state)
@@ -914,16 +1047,25 @@ def test_main(argv=None) -> int:
     cfg = _merge_ckpt_architecture(cfg, args.load_ckpt or args.save_ckpt)
     select_device(cfg)
     trainer = make_trainer(args, cfg, only_test=True)
-    cfg = trainer.cfg
+    try:
+        cfg = trainer.cfg
 
-    from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
+        from induction_network_on_fewrel_tpu.train.checkpoint import (
+            CheckpointManager,
+        )
 
-    src = args.load_ckpt or args.save_ckpt
-    state = trainer.init_state()
-    state, step = CheckpointManager(src, cfg).restore_best(state)
-    state = trainer.reshard_state(state)
-    print(f"loaded best checkpoint step={step} from {src}", file=sys.stderr)
+        src = args.load_ckpt or args.save_ckpt
+        state = trainer.init_state()
+        mngr = CheckpointManager(src, cfg)
+        try:
+            state, step = mngr.restore_best(state)
+        finally:
+            mngr.close()
+        state = trainer.reshard_state(state)
+        print(f"loaded best checkpoint step={step} from {src}", file=sys.stderr)
 
-    acc = _test_accuracy(args, cfg, trainer, state)
-    print(f'{{"test_accuracy": {acc:.4f}}}')
-    return 0
+        acc = _test_accuracy(args, cfg, trainer, state)
+        print(f'{{"test_accuracy": {acc:.4f}}}')
+        return 0
+    finally:
+        trainer.close()

@@ -154,6 +154,57 @@ def test_parallel_flag_validation_in_process():
                     "--sampler", "python"])
 
 
+def test_degenerate_mse_nota_guard():
+    """--loss mse with --na_rate >= 3 is refused for training runs (the
+    BASELINE.md all-NOTA collapse) unless --force; eval-only paths and
+    --loss ce are unaffected."""
+    from induction_network_on_fewrel_tpu.cli import (
+        build_arg_parser,
+        config_from_args,
+    )
+
+    train_p = build_arg_parser(train=True)
+    with pytest.raises(ValueError, match="degenerate"):
+        config_from_args(train_p.parse_args(["--loss", "mse", "--na_rate", "3"]))
+    # explicit opt-in runs it anyway
+    config_from_args(
+        train_p.parse_args(["--loss", "mse", "--na_rate", "3", "--force"])
+    )
+    # CE does not collapse; na_rate below the threshold is fine
+    config_from_args(train_p.parse_args(["--loss", "ce", "--na_rate", "5"]))
+    config_from_args(train_p.parse_args(["--loss", "mse", "--na_rate", "2"]))
+    # eval-only invocations compute no training loss
+    config_from_args(
+        train_p.parse_args(["--loss", "mse", "--na_rate", "5", "--only_test"])
+    )
+    test_p = build_arg_parser(train=False)
+    config_from_args(test_p.parse_args(["--loss", "mse", "--na_rate", "5"]))
+
+
+def test_token_cache_fused_test_eval_parity(tmp_path):
+    """test.py on the token-cache path: fused eval (bound to the TEST
+    table) scores identically to per-batch eval — same seed, same episode
+    stream, tail padding sliced off."""
+    ckpt = str(tmp_path / "ck")
+    run_cli(
+        "train.py", "--model", "induction", "--encoder", "cnn",
+        "--token_cache", *TINY, "--train_iter", "40", "--val_step", "20",
+        "--val_iter", "6", "--steps_per_call", "4", "--save_ckpt", ckpt,
+    )
+    out_fused, _ = run_cli(
+        "test.py", *TINY, "--token_cache", "--test_iter", "20",
+        "--steps_per_call", "4", "--load_ckpt", ckpt,
+    )
+    out_single, _ = run_cli(
+        "test.py", *TINY, "--token_cache", "--test_iter", "20",
+        "--load_ckpt", ckpt,
+    )
+    assert (
+        last_json(out_fused)["test_accuracy"]
+        == last_json(out_single)["test_accuracy"]
+    )
+
+
 def test_new_flags_reach_config():
     """--zero_opt/--vocab_size/--divergence_guard land in ExperimentConfig."""
     from induction_network_on_fewrel_tpu.cli import (

@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""Model-zoo quality sweep: best synthetic-val accuracy per few-shot model.
+
+VERDICT round-2 item 6: every zoo model needs a quality number next to its
+correctness test. Runs the production CLI (train.py) once per model at the
+flagship quality recipe (5w5s, token cache, damped LR staircase — the
+round-2 BASELINE.md recipe that avoids the synthetic overfit walk) and
+emits one JSON line per model: {model, final_val_accuracy, train_eps_s}.
+
+Synthetic corpus only (no FewRel on disk) — the numbers bound the TASK,
+not FewRel; their value is relative: a zoo model far below its siblings
+has a head/geometry bug, not a data problem.
+
+Usage: python tools/zoo_quality.py [model ...]  (default: all)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ZOO = ("induction", "proto", "proto_hatt", "siamese", "gnn", "snail", "metanet")
+
+COMMON = [
+    "--encoder", "cnn", "--N", "5", "--K", "5", "--Q", "5",
+    "--batch_size", "8", "--max_length", "40", "--vocab_size", "2002",
+    "--token_cache", "--steps_per_call", "64", "--bf16",
+    "--loss", "ce",  # uniform across the zoo: several heads (metric-based
+    # logits) sit far from the MSE-sigmoid calibration the induction paper
+    # assumes; CE ranks them on equal footing
+    "--lr", "1e-3", "--lr_step_size", "500",  # round-2 damped recipe
+    "--train_iter", "4000", "--val_step", "500", "--val_iter", "200",
+    "--divergence_guard", "stop",
+]
+
+
+def run_model(model: str, extra=()) -> dict:
+    ckpt = tempfile.mkdtemp(prefix=f"zoo_{model}_")
+    cmd = [sys.executable, os.path.join(REPO, "train.py"), "--model", model,
+           *COMMON, *extra, "--save_ckpt", ckpt]
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=3600, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+    row = {"model": model}
+    if proc.returncode != 0:
+        row["error"] = proc.stderr[-400:]
+        return row
+    try:
+        last = json.loads(proc.stdout.strip().splitlines()[-1])
+        row.update(last)
+    except Exception:
+        row["error"] = "no final JSON: " + proc.stdout[-200:]
+    # steady-state train eps/s from the metrics log (median of the last
+    # half of train windows — skips compile and early-ckpt noise)
+    try:
+        rates = []
+        with open(os.path.join(ckpt, "metrics.jsonl")) as fh:
+            for line in fh:
+                rec = json.loads(line)
+                if rec.get("kind") == "train" and "episodes_per_s" in rec:
+                    rates.append(rec["episodes_per_s"])
+        tail = sorted(rates[len(rates) // 2:])
+        if tail:
+            row["train_eps_s_median"] = round(tail[len(tail) // 2], 1)
+    except OSError:
+        pass
+    return row
+
+
+def main() -> int:
+    picks = sys.argv[1:] or ZOO
+    for model in picks:
+        print(json.dumps(run_model(model)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
