@@ -26,6 +26,9 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--K", type=int, default=5, help="K-shot")
     p.add_argument("--Q", type=int, default=5, help="queries per class")
     p.add_argument("--na_rate", type=int, default=0, help="NOTA negatives ratio (FewRel 2.0)")
+    p.add_argument("--nota_head", default="scalar", choices=["scalar", "stats"],
+                   help="NOTA threshold head: one global learned logit, or a "
+                        "per-query learned affine over class-score statistics")
     p.add_argument("--batch_size", type=int, default=4, help="episodes per step")
     # model
     p.add_argument("--model", default="induction",
@@ -197,6 +200,21 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     return p
 
 
+def _check_degenerate(loss: str, na_rate: int, force: bool) -> None:
+    """BASELINE.md round-2 finding: MSE loss at na_rate >= 3 falls into the
+    all-NOTA optimum and stays (train accuracy pinned at the NOTA
+    fraction). Training runs must opt in explicitly with --force;
+    eval-only invocations compute no training loss and are exempt."""
+    if loss == "mse" and na_rate >= 3 and not force:
+        raise ValueError(
+            f"--loss mse with --na_rate {na_rate} is a known-degenerate "
+            f"combination (BASELINE.md: the sigmoid-MSE objective's all-NOTA "
+            f"optimum dominates at high NOTA rates and training collapses "
+            f"to it). Use --loss ce, lower --na_rate, or pass --force to "
+            f"run it anyway"
+        )
+
+
 def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
     if getattr(args, "feature_cache", False) and getattr(args, "token_cache", False):
         # Checked here, not in make_trainer: the feature-cache block runs
@@ -206,23 +224,15 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             "--token_cache and --feature_cache are exclusive (the feature "
             "cache already runs in index mode)"
         )
-    # Degenerate-config guard (BASELINE.md round-2 finding): MSE loss at
-    # na_rate >= 3 falls into the all-NOTA optimum and stays there (train
-    # accuracy pinned at the NOTA fraction). Training runs must opt in
-    # explicitly; eval-only invocations (test.py) compute no loss.
+    # Degenerate-config guard — on the raw flags here, and AGAIN in
+    # train_main on the checkpoint-merged config (_merge_ckpt_architecture
+    # can flip loss back to mse from a restored config.json).
     if (
         getattr(args, "train_iter", 0)
         and not getattr(args, "only_test", False)
-        and args.loss == "mse"
-        and args.na_rate >= 3
-        and not getattr(args, "force", False)
     ):
-        raise ValueError(
-            f"--loss mse with --na_rate {args.na_rate} is a known-degenerate "
-            f"combination (BASELINE.md: the sigmoid-MSE objective's all-NOTA "
-            f"optimum dominates at high NOTA rates and training collapses "
-            f"to it). Use --loss ce, lower --na_rate, or pass --force to "
-            f"run it anyway"
+        _check_degenerate(
+            args.loss, args.na_rate, getattr(args, "force", False)
         )
     compute = "bfloat16" if (args.bf16 or args.fp16) else "float32"
     train_iter = getattr(args, "train_iter", 0)
@@ -231,6 +241,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
     return ExperimentConfig(
         train_n=args.trainN or args.N,
         n=args.N, k=args.K, q=args.Q, na_rate=args.na_rate,
+        nota_head=args.nota_head,
         batch_size=args.batch_size, max_length=args.max_length,
         vocab_size=getattr(args, "vocab_size", 400002),
         model=args.model, proto_metric=args.proto_metric,
@@ -322,7 +333,8 @@ def load_data(args, cfg: ExperimentConfig, split: str):
 
 def _wire_index_cache(cfg, model, cache_mesh, state, only_test,
                       train_ds, val_ds, train_sampler, val_sampler,
-                      build_table, factories):
+                      build_table, factories, feeder=None, local_batch=None,
+                      seed_fn=lambda s: s):
     """Shared wiring for the index-transfer cache paths (feature cache and
     token cache): build per-split device-resident tables, swap the live
     samplers for index samplers with identical episode statistics, and bind
@@ -340,6 +352,11 @@ def _wire_index_cache(cfg, model, cache_mesh, state, only_test,
     Returns (train_sampler, val_sampler, train_step, eval_step, fused_step,
     fused_eval, test_eval_factory) — fused_eval is bound to the VAL table
     (test evals must not reuse it; see _test_accuracy).
+
+    Multi-host pods (parallel/hostfeed.py): ``local_batch`` sizes each
+    process's index sampler to the episode rows it owns, ``seed_fn``
+    strides the sampler streams per process, and ``feeder`` wraps each
+    sampler so batches assemble into global arrays.
     """
     from induction_network_on_fewrel_tpu.native.sampler import (
         make_index_sampler,
@@ -358,20 +375,23 @@ def _wire_index_cache(cfg, model, cache_mesh, state, only_test,
     # pins to "python" unless a backend was chosen explicitly, so eval
     # streams are reproducible whether or not a toolchain is present.
     eval_backend = "python" if cfg.sampler == "auto" else cfg.sampler
+    bsz = local_batch or cfg.batch_size
+    wrap = feeder or (lambda s: s)
     if not only_test:
         table_tr, sizes_tr = build_table(train_ds)
         table_va, sizes_va = build_table(val_ds)
         for s in (train_sampler, val_sampler):
             if hasattr(s, "close"):
                 s.close()
-        train_sampler = make_index_sampler(
-            sizes_tr, cfg.train_n, cfg.k, cfg.q, batch_size=cfg.batch_size,
-            na_rate=cfg.na_rate, seed=cfg.seed, backend=cfg.sampler,
-        )
-        val_sampler = make_index_sampler(
-            sizes_va, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size,
-            na_rate=cfg.na_rate, seed=cfg.seed + 1, backend=eval_backend,
-        )
+        train_sampler = wrap(make_index_sampler(
+            sizes_tr, cfg.train_n, cfg.k, cfg.q, batch_size=bsz,
+            na_rate=cfg.na_rate, seed=seed_fn(cfg.seed), backend=cfg.sampler,
+        ))
+        val_sampler = wrap(make_index_sampler(
+            sizes_va, cfg.n, cfg.k, cfg.q, batch_size=bsz,
+            na_rate=cfg.na_rate, seed=seed_fn(cfg.seed + 1),
+            backend=eval_backend,
+        ))
         _train = factories["train"](model, cfg, cache_mesh, state)
         train_step = lambda st, si, qi, l: _train(st, table_tr, si, qi, l)
         eval_step = lambda p, si, qi, l: _eval(p, table_va, si, qi, l)
@@ -380,8 +400,12 @@ def _wire_index_cache(cfg, model, cache_mesh, state, only_test,
             fused_step = lambda st, si, qi, l: _multi(st, table_tr, si, qi, l)
             # Fused eval: one dispatch per steps_per_call val batches (the
             # per-batch cached eval costs a full tunnel round-trip each).
-            _multi_ev = factories["multi_eval"](model, cfg, cache_mesh, state)
-            fused_eval = lambda p, si, qi, l: _multi_ev(p, table_va, si, qi, l)
+            # Pods keep per-batch eval: the trainer's eval loop stacks
+            # host-side batches with np.stack, which global jax.Arrays
+            # (the per-host assembler's output) do not support.
+            if feeder is None:
+                _multi_ev = factories["multi_eval"](model, cfg, cache_mesh, state)
+                fused_eval = lambda p, si, qi, l: _multi_ev(p, table_va, si, qi, l)
 
     def test_eval(test_ds):
         """(sampler, eval_step, fused_eval) for a test split: its own
@@ -390,12 +414,13 @@ def _wire_index_cache(cfg, model, cache_mesh, state, only_test,
         table (never the val-bound one above; binding per table is what
         keeps the val/test split drift hazard closed)."""
         table_te, sizes_te = build_table(test_ds)
-        ts = make_index_sampler(
-            sizes_te, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size,
-            na_rate=cfg.na_rate, seed=cfg.seed + 2, backend=eval_backend,
-        )
+        ts = wrap(make_index_sampler(
+            sizes_te, cfg.n, cfg.k, cfg.q, batch_size=bsz,
+            na_rate=cfg.na_rate, seed=seed_fn(cfg.seed + 2),
+            backend=eval_backend,
+        ))
         fused_te = None
-        if cfg.steps_per_call > 1:
+        if cfg.steps_per_call > 1 and feeder is None:  # pods: per-batch eval
             _multi_te = factories["multi_eval"](model, cfg, cache_mesh, state)
             fused_te = lambda p, si, qi, l: _multi_te(p, table_te, si, qi, l)
         return ts, (lambda p, si, qi, l: _eval(p, table_te, si, qi, l)), fused_te
@@ -580,6 +605,8 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
                 mesh, microbatches=cfg.pp_microbatches,
                 batch_axis="dp" if mesh.shape["dp"] > 1 else None,
             )
+    cache_feeder = cache_local_batch = None
+    cache_seed_fn = lambda s: s  # noqa: E731 — identity off-pod
     if jax.process_count() > 1:
         # Multi-host pod: every process runs this same function. Feed each
         # host ONLY its own episode rows (parallel/hostfeed.py) — disjoint
@@ -592,12 +619,11 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
                 "multi-host run without a device mesh; pass --dp 0 (all "
                 "devices) or explicit mesh axes"
             )
-        if caching or cfg.adv or cfg.steps_per_call > 1:
+        if cfg.adv:
             raise ValueError(
-                "per-host data feeding currently serves the live per-step "
-                "path: drop --token_cache/--feature_cache/--adv and use "
-                "--steps_per_call 1 on pods (step fusion amortizes a "
-                "tunneled dispatch boundary that real pod hosts don't have)"
+                "per-host data feeding does not cover --adv yet (the DANN "
+                "domain samplers stream separate unlabeled instances); "
+                "drop --adv on pods"
             )
         from induction_network_on_fewrel_tpu.parallel.hostfeed import (
             GlobalBatchAssembler,
@@ -607,26 +633,35 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         )
 
         _, local_b = local_episode_range(mesh, cfg.batch_size)
-        for s in (train_sampler, val_sampler):
-            if hasattr(s, "close"):
-                s.close()
-        train_sampler = PerHostSampler(
-            make_sampler(
-                train_ds, tok, cfg.train_n, cfg.k, cfg.q, local_b,
-                na_rate=cfg.na_rate, seed=process_seed(cfg.seed),
-                backend=live_backend, prefetch=live_prefetch,
-                num_threads=cfg.sampler_threads,
-            ),
-            GlobalBatchAssembler(mesh, cfg.batch_size),
-        )
-        val_sampler = PerHostSampler(
-            make_sampler(
-                val_ds, tok, cfg.n, cfg.k, cfg.q, local_b,
-                na_rate=cfg.na_rate, seed=process_seed(cfg.seed + 1),
-                backend=eval_backend, prefetch=0, num_threads=1,
-            ),
-            GlobalBatchAssembler(mesh, cfg.batch_size),
-        )
+        if caching:
+            # The cache paths replace the samplers in _wire_index_cache;
+            # hand them the per-host pieces instead of rebuilding here.
+            cache_local_batch = local_b
+            cache_seed_fn = process_seed
+            cache_feeder = lambda s: PerHostSampler(
+                s, GlobalBatchAssembler(mesh, cfg.batch_size, index_mode=True)
+            )
+        else:
+            for s in (train_sampler, val_sampler):
+                if hasattr(s, "close"):
+                    s.close()
+            train_sampler = PerHostSampler(
+                make_sampler(
+                    train_ds, tok, cfg.train_n, cfg.k, cfg.q, local_b,
+                    na_rate=cfg.na_rate, seed=process_seed(cfg.seed),
+                    backend=live_backend, prefetch=live_prefetch,
+                    num_threads=cfg.sampler_threads,
+                ),
+                GlobalBatchAssembler(mesh, cfg.batch_size),
+            )
+            val_sampler = PerHostSampler(
+                make_sampler(
+                    val_ds, tok, cfg.n, cfg.k, cfg.q, local_b,
+                    na_rate=cfg.na_rate, seed=process_seed(cfg.seed + 1),
+                    backend=eval_backend, prefetch=0, num_threads=1,
+                ),
+                GlobalBatchAssembler(mesh, cfg.batch_size),
+            )
     model = build_model(
         cfg, glove_init=vocab.vectors if vocab is not None else None,
         attn_impl=attn_impl, pipeline_impl=pipeline_impl,
@@ -720,6 +755,8 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
              "multi": make_cached_multi_train_step,
              "eval": make_cached_eval_step,
              "multi_eval": make_cached_multi_eval_step},
+            feeder=cache_feeder, local_batch=cache_local_batch,
+            seed_fn=cache_seed_fn,
         )
     if cfg.token_cache:
         # Device-resident token cache (train/token_cache.py): upload the
@@ -782,6 +819,8 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
              "multi": make_token_cached_multi_train_step,
              "eval": make_token_cached_eval_step,
              "multi_eval": make_token_cached_multi_eval_step},
+            feeder=cache_feeder, local_batch=cache_local_batch,
+            seed_fn=cache_seed_fn,
         )
 
     if use_mesh and not cfg.feature_cache and not cfg.token_cache:
@@ -964,6 +1003,10 @@ def train_main(argv=None) -> int:
     cfg = config_from_args(args)
     if args.load_ckpt:
         cfg = _merge_ckpt_architecture(cfg, args.load_ckpt)
+        # Re-check on the MERGED config: the checkpoint's config.json can
+        # flip loss back to mse and re-create the refused combination.
+        if not args.only_test:
+            _check_degenerate(cfg.loss, cfg.na_rate, args.force)
     select_device(cfg)
     trainer = make_trainer(args, cfg)
     try:

@@ -22,6 +22,10 @@ class ExperimentConfig:
     k: int = 5                # K-shot
     q: int = 5                # queries per class per episode
     na_rate: int = 0          # NOTA: na_rate*Q extra none-of-the-above queries
+    # NOTA head (models/base.py append_nota): "scalar" = one global learned
+    # threshold logit; "stats" = per-query learned affine over the class-
+    # score distribution (max/mean/std). Swept in BASELINE.md round 3.
+    nota_head: str = "scalar"
     batch_size: int = 4       # episodes per optimizer step (vmapped in-device)
 
     # --- tokenization / embedding ---
@@ -186,8 +190,9 @@ class ExperimentConfig:
         # the tree.
         "moe_experts", "moe_every", "tfm_stacked",
         # embed_optimizer changes the optimizer-state tree (multi_transform
-        # wrapper), so resume requires it to match.
-        "loss", "optimizer", "embed_optimizer",
+        # wrapper), so resume requires it to match. nota_head changes the
+        # NOTA params (scalar logit vs stats affine).
+        "loss", "optimizer", "embed_optimizer", "nota_head",
         # feature_cache changes the state tree itself (head-only params), so
         # a cached checkpoint can only restore into a cached runtime — and
         # that runtime must rebuild the SAME backbone: frozen flag and

@@ -26,6 +26,12 @@ class FewShotModel(nn.Module):
     embedding: nn.Module
     encoder: nn.Module
     nota: bool = False
+    # NOTA head style: "scalar" = one learned global threshold logit (the
+    # round-1/2 head); "stats" = a learned affine over each query's class-
+    # score statistics (max/mean/std) — a query whose best class score is
+    # low RELATIVE to its own score distribution is none-of-the-above,
+    # which a global constant cannot express. Swept in BASELINE.md.
+    nota_head: str = "scalar"
     compute_dtype: jnp.dtype = jnp.float32
 
     def encode(self, word, pos1, pos2, mask) -> jnp.ndarray:
@@ -63,13 +69,38 @@ class FewShotModel(nn.Module):
         """
         if not self.nota:
             return logits
+        B, TQ, _ = logits.shape
+        if self.nota_head == "stats":
+            # Per-query threshold from the class-score distribution. The
+            # f32 cast matters: std of near-equal bf16 logits quantizes to
+            # zero and the head loses its discriminative feature.
+            lf = logits.astype(jnp.float32)
+            feats = jnp.stack(
+                [lf.max(-1), lf.mean(-1), lf.std(-1)], axis=-1
+            )  # [B, TQ, 3]
+            w = getattr(self, "nota_stats_w", None)
+            if w is None:  # compact models create lazily; setup-style via
+                w = self.param("nota_stats_w", nn.initializers.zeros, (3,))
+                b = self.param("nota_stats_b", nn.initializers.zeros, (1,))
+            else:          # ...make_nota_param (attr assignment is illegal
+                b = self.nota_stats_b  # in compact, param() in setup-less)
+            na = (feats @ w + b).astype(logits.dtype)[..., None]
+            return jnp.concatenate([logits, na], axis=-1)
         nota_logit = getattr(self, "nota_logit", None)
         if nota_logit is None:
             nota_logit = self.param("nota_logit", nn.initializers.zeros, (1,))
-        B, TQ, _ = logits.shape
         na = jnp.broadcast_to(nota_logit.astype(logits.dtype), (B, TQ, 1))
         return jnp.concatenate([logits, na], axis=-1)
 
     def make_nota_param(self):
-        if self.nota:
+        if not self.nota:
+            return
+        if self.nota_head == "stats":
+            self.nota_stats_w = self.param(
+                "nota_stats_w", nn.initializers.zeros, (3,)
+            )
+            self.nota_stats_b = self.param(
+                "nota_stats_b", nn.initializers.zeros, (1,)
+            )
+        else:
             self.nota_logit = self.param("nota_logit", nn.initializers.zeros, (1,))

@@ -68,6 +68,14 @@ def build_model(
             )
         from induction_network_on_fewrel_tpu.models.pair import PairModel
 
+        if cfg.nota_head != "scalar":
+            # PairModel scores pairs through its own backbone head and
+            # only implements the scalar NOTA logit; silently recording
+            # nota_head='stats' in the checkpoint while saving scalar
+            # params would corrupt the architecture contract.
+            raise ValueError(
+                "--model pair supports only --nota_head scalar"
+            )
         return PairModel(
             vocab_size=cfg.bert_vocab_size,
             num_layers=cfg.bert_layers,
@@ -162,6 +170,7 @@ def build_model(
             routing_iters=cfg.routing_iters,
             ntn_slices=cfg.ntn_slices,
             nota=cfg.na_rate > 0,
+            nota_head=cfg.nota_head,
             compute_dtype=dtype,
             head_dtype=_DTYPES[cfg.head_dtype],
         )
@@ -169,6 +178,7 @@ def build_model(
         embedding=embedding,
         encoder=encoder,
         nota=cfg.na_rate > 0,
+        nota_head=cfg.nota_head,
         compute_dtype=dtype,
     )
     if cfg.model == "proto":
