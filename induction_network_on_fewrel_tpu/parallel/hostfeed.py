@@ -132,6 +132,42 @@ class GlobalBatchAssembler:
         qry = {k: self._assemble_leaf(qry_sh[k], v) for k, v in query.items()}
         return sup, qry, self._assemble_leaf(lab_sh, label)
 
+    def _assemble_stacked_leaf(self, base_sharding, local):
+        """[S, B_local, ...] -> global [S, B_global, ...]: the scan axis is
+        never partitioned, dp moves to axis 1 — the exact input layout the
+        fused sharded steps declare (sharding.make_sharded_multi_train_step
+        and the cached _shard stacked specs)."""
+        spec = P(None, *base_sharding.spec)
+        sharding = NamedSharding(self.mesh, spec)
+        global_shape = (
+            local.shape[0], self.global_batch, *local.shape[2:]
+        )
+        return jax.make_array_from_process_local_data(
+            sharding, np.ascontiguousarray(local), global_shape
+        )
+
+    def assemble_stacked(self, support, query, label):
+        """Stacked twin of __call__ for steps_per_call-fused batches."""
+        if self._shardings is None:
+            asm = lambda x: self._assemble_stacked_leaf(
+                self._leaf_sharding(x[0]), x
+            )
+            return (
+                jax.tree.map(asm, support),
+                jax.tree.map(asm, query),
+                asm(label),
+            )
+        sup_sh, qry_sh, lab_sh = self._shardings
+        sup = {
+            k: self._assemble_stacked_leaf(sup_sh[k], v)
+            for k, v in support.items()
+        }
+        qry = {
+            k: self._assemble_stacked_leaf(qry_sh[k], v)
+            for k, v in query.items()
+        }
+        return sup, qry, self._assemble_stacked_leaf(lab_sh, label)
+
 
 class _AssembledBatch:
     """Duck-types the pass-through branch of batch_to_model_inputs."""
@@ -154,9 +190,28 @@ class PerHostSampler:
     def total_q(self):
         return self.local.total_q
 
+    @property
+    def return_indices(self):
+        return getattr(self.local, "return_indices", True)
+
     def sample_batch(self):
         sup, qry, lab = batch_to_model_inputs(self.local.sample_batch())
         return _AssembledBatch(*self.assembler(sup, qry, lab))
+
+    def sample_fused(self, s: int):
+        """S stacked local batches assembled into global [S, B_global, ...]
+        arrays — keeps steps_per_call fusion available on pods."""
+        local = self.local
+        if hasattr(local, "sample_fused"):
+            sup, qry, lab = local.sample_fused(s)
+        else:
+            batches = [
+                batch_to_model_inputs(local.sample_batch()) for _ in range(s)
+            ]
+            sup, qry, lab = jax.tree.map(
+                lambda *xs: np.stack(xs), *batches
+            )
+        return self.assembler.assemble_stacked(sup, qry, lab)
 
     def __iter__(self):
         while True:

@@ -232,6 +232,44 @@ def test_lazy_token_cache_matches_dense(fixture):
     _assert_trees_close(lazy.params, dense.params, atol=1e-6)
 
 
+def test_lazy_checkpoint_resume_trajectory(fixture, tmp_path):
+    """Save-at-boundary + restore + continue == uninterrupted run: the
+    checkpoint stores the MATERIALIZED table plus the lazy Adam state, so
+    the resumed catch-up math continues exactly."""
+    from induction_network_on_fewrel_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+
+    model, _, batches = fixture
+    lazy_cfg = CFG.replace(embed_optimizer="lazy")
+    mat = make_materialize(lazy_cfg)
+    step = make_train_step(model, lazy_cfg)
+
+    # Uninterrupted: 12 steps.
+    full = init_state(model, lazy_cfg, batches[0][0], batches[0][1])
+    for sup, qry, lab in batches:
+        full, _ = step(full, sup, qry, lab)
+
+    # Interrupted at 6: materialize (as the trainer does at boundaries),
+    # save, restore into a fresh state, continue 6 more.
+    half = init_state(model, lazy_cfg, batches[0][0], batches[0][1])
+    for sup, qry, lab in batches[:6]:
+        half, _ = step(half, sup, qry, lab)
+    half = mat(half)
+    mgr = CheckpointManager(tmp_path, lazy_cfg)
+    mgr.save(6, half, val_accuracy=0.5)
+    target = jax.device_get(
+        init_state(model, lazy_cfg, batches[0][0], batches[0][1])
+    )
+    restored, step_no = mgr.restore_best(target)
+    mgr.close()
+    assert step_no == 6
+    for sup, qry, lab in batches[6:]:
+        restored, _ = step(restored, sup, qry, lab)
+
+    _assert_trees_close(mat(restored).params, mat(full).params, atol=1e-6)
+
+
 def test_materialize_is_idempotent(fixture):
     model, _, batches = fixture
     lazy_cfg = CFG.replace(embed_optimizer="lazy")

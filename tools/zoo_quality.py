@@ -43,11 +43,20 @@ def run_model(model: str, extra=()) -> dict:
     ckpt = tempfile.mkdtemp(prefix=f"zoo_{model}_")
     cmd = [sys.executable, os.path.join(REPO, "train.py"), "--model", model,
            *COMMON, *extra, "--save_ckpt", ckpt]
-    proc = subprocess.run(
-        cmd, capture_output=True, text=True, timeout=3600, cwd=REPO,
-        env={**os.environ, "PYTHONPATH": REPO},
-    )
+    # APPEND to PYTHONPATH: this image's axon TPU plugin is delivered via
+    # PYTHONPATH (/root/.axon_site); replacing the variable silently drops
+    # the TPU backend from child processes.
+    pp = os.pathsep.join(filter(None, [REPO, os.environ.get("PYTHONPATH")]))
     row = {"model": model}
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=3600, cwd=REPO,
+            env={**os.environ, "PYTHONPATH": pp},
+        )
+    except subprocess.TimeoutExpired:
+        # One wedged tunnel run must not abort the whole zoo sweep.
+        row["error"] = "timeout after 3600s"
+        return row
     if proc.returncode != 0:
         row["error"] = proc.stderr[-400:]
         return row
