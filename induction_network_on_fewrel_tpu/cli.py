@@ -197,6 +197,11 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
         p.add_argument("--debug_nans", action="store_true",
                        help="checkify the train step: raise on NaN/inf/OOB "
                             "(debug runs; costs fusion boundaries)")
+        p.add_argument("--fault_step", type=int, default=0,
+                       help="failure injection: crash once the step counter "
+                            "reaches N on a FRESH run (resumed runs ignore "
+                            "it, so crash -> --resume completes; exercises "
+                            "the recovery ring; debug)")
     return p
 
 
@@ -269,6 +274,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         feature_cache=getattr(args, "feature_cache", False),
         token_cache=getattr(args, "token_cache", False),
         divergence_guard=getattr(args, "divergence_guard", "none"),
+        fault_step=getattr(args, "fault_step", 0),
         zero_opt=getattr(args, "zero_opt", False),
         device=args.device, compute_dtype=compute, seed=args.seed,
         dp=args.dp, tp=args.tp, sp=args.sp, pp=args.pp, ep=args.ep,
@@ -515,12 +521,16 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
     )
     if cfg.embed_optimizer == "lazy":
         # The lazy exact-parity table update (train/lazy_embed.py) serves
-        # the single-device and token-cache paths — the headline configs.
-        # The sharded/adversarial/feature-cache step factories keep the
-        # dense reference path; refuse with guidance instead of tracing
-        # into a state tree those factories were not built for.
+        # the single-device paths and the token-cache path on a mesh (its
+        # precomputed-remap body partitions under GSPMD like any other
+        # cached step; tested equal to single-device in
+        # tests/test_lazy_embed.py). The live-mesh/adversarial/
+        # feature-cache step factories keep the dense reference path;
+        # refuse with guidance instead of tracing into a state tree those
+        # factories were not built for.
         reasons = {
-            "a device mesh (--dp/--tp/--sp/--pp/--ep)": use_mesh,
+            "a device mesh on the LIVE token path (combine --dp/--tp/... "
+            "with --token_cache instead)": use_mesh and not cfg.token_cache,
             "--adv (the DANN step)": cfg.adv,
             "--feature_cache (head-only state, no word table)":
                 cfg.feature_cache,

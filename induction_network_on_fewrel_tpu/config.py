@@ -125,6 +125,10 @@ class ExperimentConfig:
     # immune). "none": log a divergence event and keep going (reference
     # behavior); "stop": restore the best checkpoint and end the run.
     divergence_guard: str = "none"
+    # Failure injection (SURVEY.md §5.3): raise a RuntimeError once the
+    # step counter reaches this value — exercises the crash/recovery path
+    # (recovery ring + --resume) end-to-end. 0 = off. Debug-only knob.
+    fault_step: int = 0
 
     # --- FewRel 2.0 adversarial domain adaptation (training-time only) ---
     adv: bool = False         # train encoder against a domain discriminator

@@ -33,6 +33,12 @@ class FewShotModel(nn.Module):
     # which a global constant cannot express. Swept in BASELINE.md.
     nota_head: str = "scalar"
     compute_dtype: jnp.dtype = jnp.float32
+    # Episode-head dtype (cfg.head_dtype): distance/metric logits reach
+    # magnitudes where bf16's spacing swamps O(1) class-score differences
+    # (the round-2 induction finding, measured again on the zoo in round
+    # 3: proto_hatt 0.365 -> fixed by f32 heads). f32 default; the knob
+    # exists so the bf16-vs-f32 head A/B stays runnable.
+    head_dtype: jnp.dtype = jnp.float32
 
     def encode(self, word, pos1, pos2, mask) -> jnp.ndarray:
         """[..., L] token features -> [..., H] sentence vectors."""

@@ -180,6 +180,7 @@ def build_model(
         nota=cfg.na_rate > 0,
         nota_head=cfg.nota_head,
         compute_dtype=dtype,
+        head_dtype=_DTYPES[cfg.head_dtype],
     )
     if cfg.model == "proto":
         if cfg.proto_metric not in ("euclid", "dot"):

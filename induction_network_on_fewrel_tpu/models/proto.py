@@ -34,12 +34,18 @@ class PrototypicalNetwork(FewShotModel):
         with jax.named_scope("encoder"):
             sup_enc, qry_enc = self.encode_episode(support, query)
         with jax.named_scope("proto"):
-            proto = jnp.mean(sup_enc, axis=2)                    # [B, N, H]
-            dots = jnp.einsum("bqh,bnh->bqn", qry_enc, proto)    # MXU contraction
+            # head_dtype (f32 default) scoring: -||q - p||^2 logits reach
+            # magnitudes of hundreds at H=230, where bf16's spacing is ~2.0
+            # — class-score differences of O(1) quantize away and training
+            # stalls (the round-2 induction-head noise floor). The encoder
+            # stays in compute_dtype; this einsum pair is negligible.
+            qry_f = qry_enc.astype(self.head_dtype)
+            proto = jnp.mean(sup_enc.astype(self.head_dtype), axis=2)
+            dots = jnp.einsum("bqh,bnh->bqn", qry_f, proto)
             if self.metric == "dot":
                 logits = dots
             elif self.metric == "euclid":
-                q2 = jnp.sum(jnp.square(qry_enc), axis=-1)       # [B, TQ]
+                q2 = jnp.sum(jnp.square(qry_f), axis=-1)         # [B, TQ]
                 p2 = jnp.sum(jnp.square(proto), axis=-1)         # [B, N]
                 logits = 2.0 * dots - q2[..., None] - p2[:, None, :]
             else:

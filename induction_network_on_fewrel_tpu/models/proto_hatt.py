@@ -81,8 +81,18 @@ class ProtoHATT(FewShotModel):
             proto = jnp.einsum("btnk,bnkh->btnh", alpha, sup_enc)
 
         with jax.named_scope("distance"):
-            diff = proto - qry_enc[:, :, None, :]              # [B, TQ, N, H]
-            logits = -jnp.einsum("btnh,bnh->btn", jnp.square(diff), fea_att)
+            # head_dtype distance (see models/proto.py): the fea_att-
+            # weighted squared distance reaches magnitudes where bf16
+            # spacing (~2.0 at 256) swamps the O(1) class-score differences
+            # — measured as a quality collapse (0.365 vs proto's 0.69 at
+            # the round-3 flagship recipe) before this cast.
+            hd = self.head_dtype
+            diff = (
+                proto.astype(hd) - qry_enc.astype(hd)[:, :, None, :]
+            )                                                   # [B, TQ, N, H]
+            logits = -jnp.einsum(
+                "btnh,bnh->btn", jnp.square(diff), fea_att.astype(hd)
+            )
 
         logits = self.append_nota(logits.astype(jnp.float32))
         return logits.astype(jnp.float32)

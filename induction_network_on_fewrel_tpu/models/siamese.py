@@ -34,7 +34,11 @@ class SiameseNetwork(FewShotModel):
         with jax.named_scope("encoder"):
             sup_enc, qry_enc = self.encode_episode(support, query)
         B, N, K, H = sup_enc.shape
-        dt = self.compute_dtype
+        # head_dtype metric (see models/proto.py): the weighted-distance
+        # logits reach bf16's coarse-spacing range at H=230 and the O(1)
+        # class-score differences quantize away. The encoder keeps
+        # compute_dtype; these small einsums do not move the step time.
+        dt = self.head_dtype
         w = self.param("metric_w", nn.initializers.ones, (H,)).astype(dt)
         v = self.param("metric_v", nn.initializers.zeros, (H,)).astype(dt)
         b = self.param("metric_b", nn.initializers.zeros, ()).astype(dt)

@@ -111,11 +111,18 @@ def state_shardings(state: Any, mesh: Mesh, zero_opt: bool = False):
     def assign(path, leaf):
         p = _path_str(path)
         spec = _spec_for_path(p, leaf)
+        # Moment leaves: optax Adam's mu/nu under opt_state, plus the
+        # lazy-embed table moments (LazyEmbedTrainState.emb_m/emb_v — the
+        # [vocab, word_dim] pair that dominates optimizer HBM on the
+        # 400k-vocab flagship; masked out of opt_state by design, so the
+        # path rule above would miss them).
+        is_moment = ("opt_state" in p and ("/mu/" in p or "/nu/" in p)) or (
+            p.endswith("emb_m") or p.endswith("emb_v")
+        )
         if (
             zero_opt
             and dp > 1
-            and "opt_state" in p
-            and ("/mu/" in p or "/nu/" in p)
+            and is_moment
             and _effectively_replicated(spec)
         ):
             for ax, size in enumerate(getattr(leaf, "shape", ())):

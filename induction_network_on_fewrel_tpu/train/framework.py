@@ -276,6 +276,19 @@ class FewShotTrainer:
                 )
                 t0 = time.monotonic()
                 last_logged = step
+            if cfg.fault_step and start_step == 0 and step >= cfg.fault_step:
+                # Failure injection (SURVEY.md §5.3): simulate a crash
+                # mid-run. Raised BEFORE the val boundary below, so the
+                # latest recovery-ring checkpoint predates the fault —
+                # exactly the state a real crash leaves behind. Fires only
+                # on FRESH runs (start_step == 0): a --resume of the
+                # crashed run continues past the fault step instead of
+                # looping crash/resume forever.
+                raise RuntimeError(
+                    f"injected fault at step {step} (--fault_step "
+                    f"{cfg.fault_step}); resume with --resume (resumed "
+                    f"runs ignore the injection)"
+                )
             crossed_val = (
                 cfg.val_step
                 and step // cfg.val_step > prev // cfg.val_step

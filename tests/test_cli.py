@@ -154,6 +154,30 @@ def test_parallel_flag_validation_in_process():
                     "--sampler", "python"])
 
 
+def test_fault_injection_then_resume(tmp_path):
+    """--fault_step crashes the run mid-training; --resume restores the
+    newest recovery-ring checkpoint and completes (SURVEY.md §5.3 failure
+    detection / recovery, driven end-to-end through the real CLI)."""
+    ckpt = str(tmp_path / "ck")
+    args = ["--model", "induction", "--encoder", "cnn", *TINY,
+            "--train_iter", "80", "--val_step", "20", "--val_iter", "6",
+            "--save_ckpt", ckpt]
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "train.py"), *args,
+         "--fault_step", "45"],
+        capture_output=True, text=True, timeout=240, env=ENV, cwd=REPO,
+    )
+    assert proc.returncode != 0 and "injected fault" in proc.stderr
+    # Resume with the SAME command line (fault flag included): the
+    # injection fires only on fresh runs, so the resume completes instead
+    # of looping crash/resume.
+    out, err = run_cli("train.py", *args, "--fault_step", "45", "--resume")
+    assert "final_val_accuracy" in last_json(out)
+    # Resumed from the ring slot written at the last val boundary (40),
+    # not from scratch.
+    assert "restored checkpoint step=40" in err, err[-1500:]
+
+
 def test_degenerate_mse_nota_guard():
     """--loss mse with --na_rate >= 3 is refused for training runs (the
     BASELINE.md all-NOTA collapse) unless --force; eval-only paths and
