@@ -1,0 +1,116 @@
+"""Worker process for the REAL 2-process jax.distributed hostfeed test.
+
+Run as ``python hostfeed_worker.py <process_id> <coordinator_port>``.
+Each process owns 4 virtual CPU devices; the two of them form one
+8-device dp mesh via jax.distributed (Gloo over localhost). The process
+samples ONLY its own episode rows (parallel/hostfeed.py), assembles
+global index batches with jax.make_array_from_process_local_data, and
+runs 3 mesh-sharded token-cached train steps. Emits one JSON line
+{pid, loss, norm}; the spawning test asserts both processes agree —
+which can only happen if the cross-process collectives and the per-host
+feed composed correctly.
+"""
+
+import json
+import os
+import sys
+
+
+def main(pid: int, port: int) -> None:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # before any backend init
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+        process_id=pid, local_device_ids=list(range(4)),
+    )
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.data import (
+        GloveTokenizer,
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.models.build import (
+        batch_to_model_inputs,
+    )
+    from induction_network_on_fewrel_tpu.native.sampler import (
+        make_index_sampler,
+    )
+    from induction_network_on_fewrel_tpu.parallel import make_mesh
+    from induction_network_on_fewrel_tpu.parallel.hostfeed import (
+        GlobalBatchAssembler,
+        PerHostSampler,
+        local_episode_range,
+        process_seed,
+    )
+    from induction_network_on_fewrel_tpu.parallel.sharding import shard_state
+    from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+    from induction_network_on_fewrel_tpu.train.steps import init_state
+    from induction_network_on_fewrel_tpu.train.token_cache import (
+        make_token_cached_train_step,
+        tokenize_dataset,
+    )
+
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+    cfg = ExperimentConfig(
+        encoder="cnn", n=3, k=2, q=2, batch_size=8, max_length=12,
+        vocab_size=52, hidden_size=16, dp=8, sampler="python",
+    )
+    vocab = make_synthetic_glove(vocab_size=50)
+    ds = make_synthetic_fewrel(
+        num_relations=6, instances_per_relation=8, vocab_size=35
+    )
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    model = build_model(cfg, glove_init=vocab.vectors)
+    mesh = make_mesh(dp=8)
+
+    _, local_b = local_episode_range(mesh, cfg.batch_size)
+    assert local_b == cfg.batch_size // 2, local_b
+    table_np, sizes = tokenize_dataset(ds, tok)
+    table = jax.device_put(
+        table_np, jax.tree.map(lambda _: NamedSharding(mesh, P()), table_np)
+    )
+    sampler = PerHostSampler(
+        make_index_sampler(
+            sizes, cfg.n, cfg.k, cfg.q, batch_size=local_b,
+            seed=process_seed(0), backend="python",
+        ),
+        GlobalBatchAssembler(mesh, cfg.batch_size, index_mode=True),
+    )
+
+    base = EpisodeSampler(ds, tok, cfg.n, cfg.k, cfg.q, 2, seed=0)
+    sup, qry, _ = batch_to_model_inputs(base.sample_batch())
+    state = init_state(model, cfg, sup, qry)
+    step = make_token_cached_train_step(model, cfg, mesh, state)
+    state = shard_state(state, mesh)
+    for _ in range(3):
+        si, qi, lab = batch_to_model_inputs(sampler.sample_batch())
+        state, m = step(state, table, si, qi, lab)
+
+    @jax.jit
+    def global_norm(params):
+        return sum(
+            jnp.sum(jnp.square(x.astype(jnp.float32)))
+            for x in jax.tree.leaves(params)
+        )
+
+    # float() on fully-replicated multihost outputs is legal; identical
+    # values across processes require the collectives to have agreed.
+    print(json.dumps({
+        "pid": pid,
+        "loss": float(m["loss"]),
+        "norm": float(global_norm(state.params)),
+    }), flush=True)
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), int(sys.argv[2]))

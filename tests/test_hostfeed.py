@@ -8,6 +8,8 @@ on a single process (identical code path; only jax.process_count()
 changes on a pod).
 """
 
+import os
+
 import jax
 import numpy as np
 import pytest
@@ -157,6 +159,70 @@ def test_per_host_index_sampler_feeds_cached_mesh_step():
     assert float(m_a["loss"]) == float(m_b["loss"])
     for a, b in zip(jax.tree.leaves(s_a.params), jax.tree.leaves(s_b.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_real_two_process_distributed_cluster():
+    """The REAL thing (not the single-process simulation): spawn TWO
+    processes, each with 4 virtual CPU devices, joined into one 8-device
+    dp mesh via jax.distributed (Gloo over localhost). Each samples only
+    its own episode rows and assembles global batches; 3 mesh-sharded
+    cached train steps later both processes must agree bitwise on the
+    loss and the global param norm — impossible unless the per-host feed
+    and the cross-process collectives composed correctly."""
+    import json
+    import socket
+    import subprocess
+    import sys as _sys
+
+    with socket.socket() as s:  # free localhost port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "hostfeed_worker.py")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    # Drain each worker on its own thread: sequential communicate() leaves
+    # the sibling's pipes unread, and a full stderr pipe would block it
+    # mid-collective, deadlocking both. The finally reaps BOTH workers on
+    # any failure so no orphan holds the coordinator for the rest of the
+    # pytest session.
+    import threading
+
+    procs = [
+        subprocess.Popen(
+            [_sys.executable, worker, str(pid), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    results: dict[int, tuple] = {}
+
+    def drain(i):
+        try:
+            results[i] = procs[i].communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            results[i] = None
+
+    try:
+        threads = [threading.Thread(target=drain, args=(i,)) for i in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        outs = []
+        for i, p in enumerate(procs):
+            assert results[i] is not None, f"worker {i} timed out"
+            out, err = results[i]
+            assert p.returncode == 0, err[-3000:]
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+        assert outs[0]["loss"] == outs[1]["loss"], outs
+        assert outs[0]["norm"] == outs[1]["norm"], outs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
 
 
 def test_per_host_fused_stack_assembly():
