@@ -1,0 +1,169 @@
+#!/usr/bin/env python3
+"""Convert a lazy-embed checkpoint directory to a dense (shared) one.
+
+``--embed_optimizer lazy`` checkpoints carry a different state tree than
+dense runs (LazyEmbedTrainState: table moments as ``emb_m``/``emb_v``
+fields, the table's optax slot masked out), so the architecture merge
+refuses to restore one into a shared-mode runtime. This tool performs the
+FAITHFUL conversion: materialize the table, then rebuild the dense optax
+state with every Adam moment carried over — the main partition's moments
+from the lazy chain's masked inner state, the word table's from
+emb_m/emb_v, and all optax step counters set to the checkpoint step — so
+training continued in shared mode computes the exact trajectory dense
+training would have (proven at 1e-6 in tests/test_lazy_embed.py).
+
+Caveat: lazy mode excludes weight decay from the table; a converted run
+continued in shared mode with weight_decay > 0 starts applying the
+coupled-L2 term to the table from the conversion point on — exact
+continuation holds for wd=0 (or for the main partition always).
+
+Usage: python tools/convert_lazy_ckpt.py SRC_DIR DST_DIR
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
+        for p in path
+    )
+
+
+def _moment_suffix(p: str) -> str | None:
+    """For an opt-state leaf path containing .../mu/... or .../nu/...,
+    return 'mu:<param-suffix>' — the key both trees share."""
+    for tag in ("mu", "nu"):
+        marker = f"/{tag}/"
+        if marker in p:
+            return f"{tag}:{p.split(marker, 1)[1]}"
+    return None
+
+
+def convert_state(lazy_state, model, dense_cfg, emb_path):
+    """LazyEmbedTrainState -> dense TrainState with moments carried over."""
+    import jax
+    import jax.numpy as jnp
+
+    from induction_network_on_fewrel_tpu.train.lazy_embed import tree_get
+    from induction_network_on_fewrel_tpu.train.steps import (
+        TrainState,
+        make_optimizer,
+    )
+
+    dense = TrainState.create(
+        apply_fn=model.apply, params=lazy_state.params,
+        tx=make_optimizer(dense_cfg),
+    )
+    # Harvest the lazy chain's moments by param-path suffix. MaskedNode
+    # placeholders (the masked-out emb slot) are not arrays and are
+    # skipped by the isinstance check.
+    lazy_moments: dict[str, object] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+        lazy_state.opt_state
+    )[0]:
+        key = _moment_suffix(_path_str(path))
+        if key and hasattr(leaf, "shape"):
+            lazy_moments[key] = leaf
+
+    emb_suffix = "/".join(emb_path)
+    step = jnp.asarray(lazy_state.step)
+
+    def fill(path, leaf):
+        p = _path_str(path)
+        key = _moment_suffix(p)
+        if key is not None:
+            suffix = key.split(":", 1)[1]
+            if suffix.endswith(emb_suffix):
+                return (
+                    lazy_state.emb_m if key.startswith("mu:")
+                    else lazy_state.emb_v
+                )
+            if key in lazy_moments:
+                return lazy_moments[key]
+            raise KeyError(f"no lazy moment found for {p}")
+        if p.endswith("count"):
+            # Adam bias-correction and schedule counters both advance once
+            # per update in either mode.
+            return jnp.asarray(step, dtype=leaf.dtype)
+        return leaf
+
+    opt_state = jax.tree_util.tree_map_with_path(fill, dense.opt_state)
+    return dense.replace(step=lazy_state.step, opt_state=opt_state)
+
+
+def main(src: str, dst: str) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # conversion is host work
+    from induction_network_on_fewrel_tpu.data import (
+        GloveTokenizer,
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.models.build import (
+        batch_to_model_inputs,
+    )
+    from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+    from induction_network_on_fewrel_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+    from induction_network_on_fewrel_tpu.train.lazy_embed import (
+        find_emb_path,
+        make_materialize,
+    )
+    from induction_network_on_fewrel_tpu.train.steps import init_state
+
+    cfg = CheckpointManager.load_config(src)
+    if cfg.embed_optimizer != "lazy":
+        print(f"{src} is not a lazy-embed checkpoint "
+              f"(embed_optimizer={cfg.embed_optimizer})", file=sys.stderr)
+        return 2
+    # Shape-only synthetic batch to build the restore target.
+    vocab = make_synthetic_glove(vocab_size=cfg.vocab_size - 2,
+                                 word_dim=cfg.word_dim)
+    ds = make_synthetic_fewrel(
+        num_relations=max(cfg.train_n, cfg.n) * 2,
+        instances_per_relation=max(cfg.k + cfg.q + 5, 20),
+        vocab_size=cfg.vocab_size - 2,
+    )
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    sampler = EpisodeSampler(
+        ds, tok, cfg.train_n, cfg.k, cfg.q, cfg.batch_size, seed=cfg.seed
+    )
+    sup, qry, _ = batch_to_model_inputs(sampler.sample_batch())
+    model = build_model(cfg, glove_init=vocab.vectors)
+
+    src_mngr = CheckpointManager(src, cfg)
+    target = jax.device_get(init_state(model, cfg, sup, qry))
+    state, step = src_mngr.restore_best(target)
+    # Carry the source's best-val metric: saving the converted state with
+    # a zero metric would let ANY later val eval in the dst dir replace it
+    # (best_fn keeps the max), silently discarding the better weights.
+    metrics = src_mngr.mngr.metrics(step) or {}
+    best_val = float(metrics.get("val_accuracy", 0.0))
+    src_mngr.close()
+    state = make_materialize(cfg)(state)
+
+    dense_cfg = cfg.replace(embed_optimizer="shared")
+    dense = convert_state(state, model, dense_cfg, find_emb_path(state.params))
+
+    dst_mngr = CheckpointManager(dst, dense_cfg)
+    dst_mngr.save(step, dense, val_accuracy=best_val)
+    dst_mngr.close()
+    print(f"converted step {step} (best_val {best_val:.4f}): "
+          f"{src} (lazy) -> {dst} (shared)")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 3:
+        print(__doc__, file=sys.stderr)
+        sys.exit(2)
+    sys.exit(main(sys.argv[1], sys.argv[2]))
