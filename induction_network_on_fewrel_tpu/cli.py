@@ -193,6 +193,10 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
         p.add_argument("--profile", default=None, metavar="DIR",
                        help="write a TensorBoard XPlane trace of steps "
                             "2..2+profile_steps to DIR")
+        p.add_argument("--tensorboard", default=None, metavar="DIR",
+                       help="also mirror train/val scalars to TensorBoard "
+                            "event files in DIR (metrics.jsonl is always "
+                            "written)")
         p.add_argument("--profile_steps", type=int, default=10)
         p.add_argument("--debug_nans", action="store_true",
                        help="checkify the train step: raise on NaN/inf/OOB "
@@ -925,7 +929,9 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
     trainer = FewShotTrainer(
         model, cfg, train_sampler, val_sampler,
         ckpt_dir=None if only_test else args.save_ckpt,
-        logger=MetricsLogger(run_dir),
+        logger=MetricsLogger(
+            run_dir, tensorboard_dir=getattr(args, "tensorboard", None)
+        ),
         train_step=train_step, eval_step=eval_step, fused_step=fused_step,
         fused_eval=fused_eval,
         initial_state=state,

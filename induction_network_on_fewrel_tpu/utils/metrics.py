@@ -14,13 +14,23 @@ from pathlib import Path
 
 
 class MetricsLogger:
-    def __init__(self, out_dir: str | Path | None = None, quiet: bool = False):
+    def __init__(self, out_dir: str | Path | None = None, quiet: bool = False,
+                 tensorboard_dir: str | Path | None = None):
         self.quiet = quiet
         self.path: Path | None = None
         if out_dir is not None:
             out = Path(out_dir)
             out.mkdir(parents=True, exist_ok=True)
             self.path = out / "metrics.jsonl"
+        # Optional TensorBoard scalars (SURVEY.md §5.5). tensorflow is a
+        # heavyweight import (~6 s), so it loads only when a dir is given;
+        # metrics.jsonl stays the always-on machine-readable record.
+        self._tb = None
+        if tensorboard_dir is not None:
+            import tensorflow as tf  # deferred on purpose
+
+            self._tb = tf.summary.create_file_writer(str(tensorboard_dir))
+            self._tf = tf
         self._t0 = time.monotonic()
 
     def log(self, step: int, kind: str = "train", **scalars: float) -> None:
@@ -33,6 +43,13 @@ class MetricsLogger:
         if self.path is not None:
             with open(self.path, "a") as f:
                 f.write(json.dumps(rec) + "\n")
+        if self._tb is not None:
+            with self._tb.as_default():
+                for k, v in scalars.items():
+                    self._tf.summary.scalar(
+                        f"{kind}/{k}", float(v), step=int(step)
+                    )
+            self._tb.flush()
         if not self.quiet:
             fields = " ".join(f"{k}={v:.4g}" for k, v in scalars.items())
             print(f"[{kind}] step={step} {fields}", file=sys.stderr, flush=True)

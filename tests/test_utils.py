@@ -1,4 +1,5 @@
-"""Aux subsystems: checkify sanitizer, finite assertion, profiling timer."""
+"""Aux subsystems: checkify sanitizer, finite assertion, profiling timer,
+metrics logging (jsonl + TensorBoard mirror)."""
 
 import jax.numpy as jnp
 import numpy as np
@@ -6,6 +7,25 @@ import pytest
 
 from induction_network_on_fewrel_tpu.utils.debug import assert_all_finite, checkify_step
 from induction_network_on_fewrel_tpu.utils.profiling import timed_call
+
+
+@pytest.mark.slow  # tensorflow import dominates (~6 s, only on this path)
+def test_metrics_logger_tensorboard_mirror(tmp_path):
+    from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+    logger = MetricsLogger(
+        out_dir=tmp_path, quiet=True, tensorboard_dir=tmp_path / "tb"
+    )
+    logger.log(10, "train", loss=0.5, accuracy=0.9)
+    logger.log(20, "val", accuracy=0.8)
+    # jsonl record is always on
+    lines = (tmp_path / "metrics.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 2
+    # TB event files exist and contain our scalar tags
+    events = list((tmp_path / "tb").glob("events.out.tfevents.*"))
+    assert events, "no TensorBoard event file written"
+    data = events[0].read_bytes()
+    assert b"train/loss" in data and b"val/accuracy" in data
 
 
 def test_checkify_catches_nan():

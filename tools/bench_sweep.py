@@ -366,7 +366,13 @@ def main() -> int:
             **{**base, "batch_size": 2, "steps_per_call": 8}), False),
          ("4b: 5w5s bert-base frozen + feature_cache", ExperimentConfig(
             encoder="bert", n=5, k=5, q=5, bert_frozen=True,
-            feature_cache=True, **{**base, "batch_size": 2}), False)],
+            feature_cache=True, **{**base, "batch_size": 2}), False),
+         # BERT-PAIR scores token-level (query, support) sequence pairs
+         # through the backbone — N*K forwards per query; the heaviest
+         # model in the zoo by construction (the FewRel 2.0 NOTA baseline).
+         ("4p: 5w5s BERT-PAIR (bert-base)", ExperimentConfig(
+            encoder="bert", model="pair", n=5, k=5, q=5,
+            **{**base, "batch_size": 1, "steps_per_call": 2}), False)],
         [("5: 5w5s bilstm na_rate=5 +adv (FewRel2.0)", ExperimentConfig(
             encoder="bilstm", n=5, k=5, q=5, na_rate=5, adv=True,
             **base), True),
