@@ -57,7 +57,12 @@ import time
 # legacy first-honest-run bar is the fallback for unrecorded configs.
 BASELINES_EPS_TPU = {
     (400002, 64, 256, "shared"): 3538.0,  # BENCH_r02 (round-2 headline)
-    (400002, 64, 256, "lazy"): 4497.0,    # round-3 first recorded run
+    # Round-3 profile-driven level (BASELINE.md "profile-driven step
+    # optimization"): matmul-grad embeddings + time-major LSTM + divisor
+    # tiles. Best chunk observed 9,634; bar set at the lower edge of the
+    # observed 9.1-9.6k spread so tunnel weather doesn't read as a
+    # regression. (The pre-optimization round-3 bar was 4,497.)
+    (400002, 64, 256, "lazy"): 9135.0,
     (2002, 8, 512, "shared"): 5185.0,     # round-1 best (legacy config)
 }
 BASELINE_EPS_FALLBACK = 1264.0  # first honest hard-synced run ever (r1)

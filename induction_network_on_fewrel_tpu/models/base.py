@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import flax.linen as nn
 import jax.numpy as jnp
+import numpy as np
 
 
 class FewShotModel(nn.Module):
@@ -58,12 +59,22 @@ class FewShotModel(nn.Module):
         """
         if not isinstance(support, dict):
             return jnp.asarray(support), jnp.asarray(query)
-        sup_enc = self.encode(
-            support["word"], support["pos1"], support["pos2"], support["mask"]
+        # ONE encoder call over support ⧺ query rows (the encoders are
+        # row-independent, so concat-encode-split is exact): halves the
+        # kernel/embedding/projection dispatches per step and doubles the
+        # row count each MXU op sees — measured win on the fused headline
+        # path where per-op overhead is comparable to the op itself.
+        L = support["word"].shape[-1]
+        sup_lead = support["word"].shape[:-1]
+        qry_lead = query["word"].shape[:-1]
+        flat = lambda x: x.reshape(-1, L)  # noqa: E731
+        cat = lambda k: jnp.concatenate(  # noqa: E731
+            [flat(support[k]), flat(query[k])], axis=0
         )
-        qry_enc = self.encode(
-            query["word"], query["pos1"], query["pos2"], query["mask"]
-        )
+        enc = self.encode(cat("word"), cat("pos1"), cat("pos2"), cat("mask"))
+        ns = int(np.prod(sup_lead)) if sup_lead else 1
+        sup_enc = enc[:ns].reshape(*sup_lead, -1)
+        qry_enc = enc[ns:].reshape(*qry_lead, -1)
         return sup_enc, qry_enc
 
     def append_nota(self, logits: jnp.ndarray) -> jnp.ndarray:

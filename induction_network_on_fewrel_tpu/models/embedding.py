@@ -6,7 +6,14 @@ from the GloVe 50-d matrix (+2 rows UNK/BLANK), concatenated with two
 yielding (word_dim + 2*pos_dim)-d token vectors.
 
 Gathers are HBM-bandwidth ops, not MXU ops; XLA fuses the three gathers and
-the concat into the consumer, so no custom kernel is warranted here.
+the concat into the consumer. The gathers' BACKWARD is the expensive part:
+autodiff transposes them into serialized scatter-adds (profiled at ~19% of
+headline device time — tools/profile_headline.py), so the small-table
+lookups (position tables always; the word table when it is compact, i.e.
+the lazy-embed rows or a small vocab) route through
+``ops.segsum.lookup_matmul_grad``, whose gradient is a one-hot MXU matmul
+instead. The full 400k GloVe table keeps the native scatter — at that row
+count the one-hot matmul loses (see ops/segsum.py).
 """
 
 from __future__ import annotations
@@ -15,6 +22,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from induction_network_on_fewrel_tpu.ops.segsum import (
+    MATMUL_GRAD_MAX_ROWS,
+    lookup_matmul_grad,
+)
 
 
 class Embedding(nn.Module):
@@ -62,8 +74,20 @@ class Embedding(nn.Module):
         pos2_table = self.param(
             "pos2_embedding", nn.initializers.normal(0.1), (2 * self.max_length, self.pos_dim)
         )
+        # Matmul-gradient lookups where the table is small enough to win
+        # (see module docstring); frozen tables have no backward at all, so
+        # the plain gather is strictly simpler there.
+        if word_table.shape[0] <= MATMUL_GRAD_MAX_ROWS and not self.freeze_word_table:
+            word_vecs = lookup_matmul_grad(word_table, word)
+        else:
+            word_vecs = word_table[word]
         out = jnp.concatenate(
-            [word_table[word], pos1_table[pos1], pos2_table[pos2]], axis=-1
+            [
+                word_vecs,
+                lookup_matmul_grad(pos1_table, pos1),
+                lookup_matmul_grad(pos2_table, pos2),
+            ],
+            axis=-1,
         )
         return out.astype(self.compute_dtype)
 

@@ -9,18 +9,21 @@ and MXU-shaped.
   max-pool over time — thunlp defaults, hidden=230.
 * BiLSTM + self-attention (paper §3.1): bidirectional LSTM, then structured
   self-attention ``a = softmax(w2 · tanh(W1 · Hᵀ))``, sentence vector
-  ``e = Σ aₜ hₜ``. TPU decomposition (ops/lstm.py): the input projection is
-  hoisted out of the recurrence into ONE tall [M·L, D] x [D, 8u] MXU matmul
-  against the direction-concatenated weights (the reverse direction's time
-  flip commutes with the per-timestep projection, so it is applied to the
-  projected gates); only the true recurrence runs per-step — as a ``lax.scan`` or
-  as the fused Pallas kernel that keeps h/c in VMEM for all L steps
-  (``lstm_backend``). The two directions have INDEPENDENT weights (matching
-  torch ``nn.LSTM(bidirectional=True)``'s separate ``*_reverse`` tensors —
-  params carry a leading direction axis [2, ...]) and still run in one
-  fused dispatch via the grouped recurrence. The two backends share the
-  same parameters: checkpoints are interchangeable and equality is
-  testable.
+  ``e = Σ aₜ hₜ``. TPU decomposition (ops/lstm.py): the whole body runs
+  TIME-MAJOR — one cheap [M, L, D] -> [L, M, D] transpose of the 60-wide
+  embedding, then the input projection as ONE tall [L·M, D] x [D, 8u] MXU
+  matmul against the direction-concatenated weights, the recurrence via
+  ``bilstm_recurrence_tm`` (the reverse direction's time flip and the
+  direction select live in the Pallas kernel's BlockSpec index maps — no
+  stack/flip/transpose of the 512-wide gates ever materializes), and the
+  attention directly over the natural-time [L, M, 2u] hidden states. Only
+  the true recurrence runs per-step — as a ``lax.scan`` or as the fused
+  Pallas kernel that keeps h/c in VMEM for all L steps (``lstm_backend``).
+  The two directions have INDEPENDENT weights (matching torch
+  ``nn.LSTM(bidirectional=True)``'s separate ``*_reverse`` tensors — params
+  carry a leading direction axis [2, ...]) and still run in one fused
+  dispatch. The backends share the same parameters: checkpoints are
+  interchangeable and equality is testable.
 """
 
 from __future__ import annotations
@@ -30,7 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from induction_network_on_fewrel_tpu.ops import masked_max, masked_softmax
-from induction_network_on_fewrel_tpu.ops.lstm import lstm_recurrence_grouped
+from induction_network_on_fewrel_tpu.ops.lstm import bilstm_recurrence_tm
 
 
 def _per_direction(init):
@@ -100,35 +103,40 @@ class BiLSTMSelfAttnEncoder(nn.Module):
             lambda key, shape: jnp.zeros(shape).at[:, u : 2 * u].set(1.0),
             (2, 4 * u),
         )
+        # The whole encoder body runs TIME-MAJOR. Transposing the 60-wide
+        # embedding [M, L, D] -> [L, M, D] costs ~1/8 the bytes of
+        # transposing the 512-wide projected gates — everything downstream
+        # (projection, recurrence, attention) is layout-free in time-major
+        # form, so this is the ONLY transpose in the encoder (profiled:
+        # the former stack/flip/pad/transpose pipeline around the grouped
+        # kernel was ~25% of headline device time).
+        emb_t = jnp.swapaxes(emb, 0, 1)                       # [L, M, D]
         # Sequential-free input projection as ONE tall MXU matmul against
-        # the direction-concatenated weights: [M·L, D] x [D, 8u]. The time
-        # flip for the reverse direction commutes with the per-timestep
-        # projection, so it applies to the projected gates, not the input —
-        # no duplicated [2, M, L, D] operand in HBM.
+        # the direction-concatenated weights: [L·M, D] x [D, 8u]. The
+        # reverse direction's gates stay in natural time order — the
+        # kernel's index maps walk them backwards (ops/lstm.py tm entry).
         w_cat = jnp.concatenate([w_ih[0], w_ih[1]], axis=-1)  # [D, 8u]
-        xg_all = emb @ w_cat.astype(self.compute_dtype)       # [M, L, 8u]
-        bc = b.astype(self.compute_dtype)
-        xg = jnp.stack([
-            xg_all[..., : 4 * u] + bc[0],
-            jnp.flip(xg_all[..., 4 * u :], axis=1) + bc[1],
-        ])                                                    # [2, M, L, 4u]
-        # [2, M, L, u] in xg's dtype (pallas; f32 internal recurrence) or
-        # f32 (scan) — consumers see compute_dtype either way.
-        hs = lstm_recurrence_grouped(xg, w_hh, backend=self.lstm_backend)
-        hs = hs.astype(self.compute_dtype)
-        h_fwd, h_bwd = hs[0], jnp.flip(hs[1], axis=1)
-        H = jnp.concatenate([h_fwd, h_bwd], axis=-1)   # [M, L, 2u]
+        b_cat = jnp.concatenate([b[0], b[1]], axis=-1)        # [8u]
+        xg_t = (
+            emb_t @ w_cat.astype(self.compute_dtype)
+            + b_cat.astype(self.compute_dtype)
+        )                                                     # [L, M, 8u]
+        # [L, M, 2u] hidden states, both directions, natural time order.
+        H = bilstm_recurrence_tm(xg_t, w_hh, backend=self.lstm_backend)
+        H = H.astype(self.compute_dtype)
 
         # Structured self-attention (Lin et al. 2017 form used by the paper):
-        # scores = w2 · tanh(W1 hᵀ), masked softmax over L.
+        # scores = w2 · tanh(W1 hᵀ), masked softmax over L (axis 0 here).
         proj = nn.Dense(
             self.att_dim, use_bias=False, dtype=self.compute_dtype, param_dtype=jnp.float32
         )(H)
         scores = nn.Dense(
             1, use_bias=False, dtype=self.compute_dtype, param_dtype=jnp.float32
-        )(jnp.tanh(proj))[..., 0]                      # [M, L]
-        att = masked_softmax(scores.astype(jnp.float32), mask, axis=-1)
-        return jnp.einsum("ml,mlh->mh", att.astype(self.compute_dtype), H)
+        )(jnp.tanh(proj))[..., 0]                      # [L, M]
+        att = masked_softmax(
+            scores.astype(jnp.float32), jnp.swapaxes(mask, 0, 1), axis=0
+        )
+        return jnp.einsum("lm,lmh->mh", att.astype(self.compute_dtype), H)
 
     @property
     def output_dim(self) -> int:

@@ -26,6 +26,16 @@ the BiLSTM gives its forward and backward directions INDEPENDENT recurrent
 weights (torch ``nn.LSTM(bidirectional=True)`` has separate ``*_reverse``
 tensors) without giving up the fused single-dispatch structure.
 
+**Time-major recurrence** (``bilstm_recurrence_tm`` — the production
+encoder path): same kernel bodies, but the input is the natural-time
+[L, M, 8u] direction-concatenated projection and the per-direction time
+reversal + direction-slab select live entirely in the BlockSpec index maps.
+The grouped entry's host-side stack/flip/pad/transpose pipeline (profiled
+at ~25% of headline device time) disappears, the hidden states come out
+already concatenated [L, M, 2u] in natural time order, and the row tile is
+chosen per shape to divide M exactly when possible (``_pick_tm``), removing
+the pad copies too.
+
 Gate order is [i, f, g, o] (sigmoid, sigmoid, tanh, sigmoid) — the same
 convention as torch.nn.LSTM, which the golden test exploits. All recurrence
 arithmetic is float32: bf16 cell state drifts over long sequences.
@@ -391,6 +401,215 @@ def lstm_recurrence_grouped(
     if backend == "interpret":
         return _lstm_pallas(xg, whh.astype(jnp.float32), True)
     raise ValueError(f"unknown lstm backend {backend!r}")
+
+
+# ---------------------------------------------------------------------------
+# Time-major bidirectional entry. The grouped API above wants [Gc, M, L, 4u]
+# with the reverse direction's gates pre-flipped in time — building that
+# layout from the encoder's natural [M, L, 8u] projection cost a stack, a
+# flip, a pad and a [*, 512]-wide transpose per encoder call (profiled at
+# ~25% of headline device time, tools/profile_headline.py). Here the SAME
+# kernel bodies run over a natural-time [L, M, 8u] array: the per-direction
+# time reversal and the direction-slab select live entirely in the BlockSpec
+# index maps (block col g picks the direction's 4u gate columns; block time
+# is t for the forward direction and L-1-t for the reverse), and the hidden
+# states come out already direction-concatenated [L, M, 2u] in natural time
+# order. No data movement outside the kernel at all beyond a row pad to the
+# tile size.
+# ---------------------------------------------------------------------------
+
+
+def _pick_tm(M: int, u: int, itemsize: int) -> int:
+    """Row-tile for the time-major kernels: avoid padding when possible.
+
+    The TPU grid runs sequentially (pipelined), so fewer, larger row tiles
+    are strictly better until VMEM pressure — and a tile that divides M
+    exactly (or covers the full row axis, which the (8,128)-divisibility
+    rule exempts) removes the M -> ceil(M/128)*128 pad copies entirely
+    (profiled at ~10% of headline device time at M=1600). Candidates are
+    sublane-aligned divisors of M plus the full axis, capped by a bwd-kernel
+    VMEM estimate; fallback is the classic pad-to-_TM path.
+    """
+    q = 16 if itemsize == 2 else 8
+    cap = 8 * 2**20  # leave VMEM headroom for the compiler's own buffers
+
+    def fits(tm: int) -> bool:
+        # bwd kernel, double-buffered blocks: 4x [tm, u] state/cot ins,
+        # [tm, 4u] xg in + dxg out, plus f32 scratch 2x[tm, u] + [u, 4u].
+        blocks = (4 * tm * u + 2 * tm * 4 * u) * itemsize * 2
+        scratch = (2 * tm * u + 4 * u * u) * 4
+        return blocks + scratch <= cap
+
+    cands = [tm for tm in range(q, min(M, 1024) + 1, q) if M % tm == 0 and fits(tm)]
+    if M <= 1024 and fits(M):
+        cands.append(M)  # full-axis block: no divisibility constraint
+    return max(cands) if cands else _TM
+
+
+def _tm_dims(xg_t: jnp.ndarray, whh: jnp.ndarray, tm: int):
+    L, Mp, G2 = xg_t.shape
+    Gc, u, G = whh.shape
+    if G2 != Gc * G:
+        raise ValueError(f"xg last dim {G2} != Gc*4u {Gc * G}")
+    H = Mp // tm
+    return L, Mp, Gc, u, G, H
+
+
+def _tm_fwd_specs(L, u, G, H, tm):
+    def xg_idx(i, t):
+        g = i // H
+        return (jnp.where(g == 1, L - 1 - t, t), i % H, g)
+
+    whh_idx = lambda i, t: (i // H, 0, 0)  # noqa: E731
+    out_idx = xg_idx  # hs/cs blocks: same (nat-time, row, direction) walk
+    in_specs = [
+        pl.BlockSpec((1, tm, G), xg_idx),
+        pl.BlockSpec((1, u, G), whh_idx),
+    ]
+    out_spec = pl.BlockSpec((1, tm, u), out_idx)
+    return in_specs, out_spec
+
+
+def _fwd_call_tm(xg_t: jnp.ndarray, whh: jnp.ndarray, interpret: bool, tm: int):
+    L, Mp, Gc, u, G, H = _tm_dims(xg_t, whh, tm)
+    dt = xg_t.dtype
+    in_specs, out_spec = _tm_fwd_specs(L, u, G, H, tm)
+    hs, cs = pl.pallas_call(
+        _fwd_kernel,
+        grid=(Gc * H, L),
+        in_specs=in_specs,
+        out_specs=[out_spec, out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((L, Mp, Gc * u), dt),  # hs, nat time
+            jax.ShapeDtypeStruct((L, Mp, Gc * u), dt),  # cs, nat time
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tm, u), jnp.float32),
+            pltpu.VMEM((tm, u), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xg_t, whh.astype(jnp.float32))
+    return hs, cs
+
+
+def _fwd_call_tm_infer(xg_t: jnp.ndarray, whh: jnp.ndarray, interpret: bool, tm: int):
+    L, Mp, Gc, u, G, H = _tm_dims(xg_t, whh, tm)
+    in_specs, out_spec = _tm_fwd_specs(L, u, G, H, tm)
+    return pl.pallas_call(
+        _fwd_kernel_infer,
+        grid=(Gc * H, L),
+        in_specs=in_specs,
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct((L, Mp, Gc * u), xg_t.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((tm, u), jnp.float32),
+            pltpu.VMEM((tm, u), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xg_t, whh.astype(jnp.float32))
+
+
+def _bwd_call_tm(dhs, xg_t, cs, hs, whh, interpret: bool, tm: int):
+    """All tensors natural-time: dhs [L, Mp, Gc*u]; xg_t [L, Mp, Gc*4u];
+    cs/hs the forward's residuals [L, Mp, Gc*u]."""
+    L, Mp, Gc, u, G, H = _tm_dims(xg_t, whh, tm)
+    ntiles = Gc * H
+
+    # Backward grid step t undoes kernel time kt = L-1-t. The natural-time
+    # position of kt is kt for the forward direction and L-1-kt = t for the
+    # reverse one; the prev-state (kernel time kt-1) position clamps at the
+    # sequence edge, where the kernel masks the state to zero anyway.
+    def p_idx(i, t):
+        g = i // H
+        return (jnp.where(g == 1, t, L - 1 - t), i % H, g)
+
+    def p_prev_idx(i, t):
+        g = i // H
+        nat = jnp.where(
+            g == 1, jnp.minimum(t + 1, L - 1), jnp.maximum(L - 2 - t, 0)
+        )
+        return (nat, i % H, g)
+
+    whh_idx = lambda i, t: (i // H, 0, 0)  # noqa: E731
+    dxg, dwhh_p = pl.pallas_call(
+        _bwd_kernel,
+        grid=(ntiles, L),
+        in_specs=[
+            pl.BlockSpec((1, tm, u), p_idx),       # dhs
+            pl.BlockSpec((1, tm, G), p_idx),       # xg (gates recomputed)
+            pl.BlockSpec((1, tm, u), p_idx),       # cs
+            pl.BlockSpec((1, tm, u), p_prev_idx),  # cs_{kt-1}
+            pl.BlockSpec((1, tm, u), p_prev_idx),  # hs_{kt-1}
+            pl.BlockSpec((1, u, G), whh_idx),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tm, G), p_idx),
+            pl.BlockSpec((1, u, G), lambda i, t: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((L, Mp, Gc * G), xg_t.dtype),
+            jax.ShapeDtypeStruct((ntiles, u, G), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tm, u), jnp.float32),
+            pltpu.VMEM((tm, u), jnp.float32),
+            pltpu.VMEM((u, G), jnp.float32),
+        ],
+        interpret=interpret,
+    )(dhs, xg_t, cs, cs, hs, whh.astype(jnp.float32))
+    dwhh = dwhh_p.reshape(Gc, H, u, G).sum(axis=1)
+    return dxg, dwhh
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _bilstm_pallas_tm(xg_t, whh, interpret=False, tm=_TM):
+    return _fwd_call_tm_infer(xg_t, whh, interpret, tm)
+
+
+def _bilstm_tm_fwd(xg_t, whh, interpret, tm):
+    hs, cs = _fwd_call_tm(xg_t, whh, interpret, tm)
+    return hs, (xg_t, hs, cs, whh)
+
+
+def _bilstm_tm_bwd(interpret, tm, res, dhs):
+    xg_t, hs, cs, whh = res
+    return _bwd_call_tm(dhs, xg_t, cs, hs, whh, interpret, tm)
+
+
+_bilstm_pallas_tm.defvjp(_bilstm_tm_fwd, _bilstm_tm_bwd)
+
+
+def bilstm_recurrence_tm(
+    xg_t: jnp.ndarray, whh: jnp.ndarray, backend: str = "scan"
+) -> jnp.ndarray:
+    """Bidirectional recurrence over natural-time gate inputs.
+
+    xg_t: [L, M, 8u] — the direction-concatenated input projection in
+    natural time order (cols [0:4u] forward gates, [4u:8u] reverse gates;
+    the reverse direction is NOT pre-flipped — the kernel walks it
+    backwards via its index maps). whh: [2, u, 4u] per-direction recurrent
+    weights. Returns [L, M, 2u]: both directions' hidden states in natural
+    time order (cols [0:u] forward, [u:2u] reverse), in xg's dtype for the
+    pallas/interpret backends and float32 for scan.
+    """
+    L, M, G2 = xg_t.shape
+    Gc, u, G = whh.shape
+    if backend == "scan":
+        fwd = jnp.swapaxes(xg_t[..., :G], 0, 1)                # [M, L, 4u]
+        bwd = jnp.swapaxes(jnp.flip(xg_t[..., G:], 0), 0, 1)   # reversed
+        h_f = lstm_scan(fwd, whh[0])
+        h_b = jnp.flip(lstm_scan(bwd, whh[1]), axis=1)         # nat time
+        return jnp.swapaxes(jnp.concatenate([h_f, h_b], -1), 0, 1)
+    if backend not in ("pallas", "interpret"):
+        raise ValueError(f"unknown lstm backend {backend!r}")
+    tm = _pick_tm(M, u, jnp.dtype(xg_t.dtype).itemsize)
+    pad = (-M) % tm
+    if pad:
+        xg_t = jnp.pad(xg_t, ((0, 0), (0, pad), (0, 0)))
+    out = _bilstm_pallas_tm(
+        xg_t, whh.astype(jnp.float32), backend == "interpret", tm
+    )
+    return out[:, :M] if pad else out
 
 
 def lstm_recurrence(

@@ -1,0 +1,97 @@
+"""Embedding lookup whose BACKWARD is an MXU matmul, not a scatter-add.
+
+Autodiff's transpose of ``table[ids]`` is ``zeros.at[ids].add(cot)`` — a
+serialized scatter. Profiled on the headline config (tools/profile_headline.py,
+v5e): the four position-table scatters ([64000 tokens] -> [80, 5]) cost
+111 ms EACH per 256-step fused call and the two lazy word-table scatters
+([64000] -> [~1.7k, 50]) 119 ms each — together ~19% of device time, more
+than the whole LSTM forward. A segment-sum over U rows is algebraically
+``one_hot(ids, U)ᵀ @ cot``: for small/medium U that matmul is trivial MXU
+work (2·T·U·D FLOPs), so ``lookup_matmul_grad`` wraps the gather in a
+custom VJP whose backward builds the one-hot in chunks (bounding the
+[chunk, U] intermediate) and accumulates with a ``lax.scan``.
+
+Crossover: the matmul costs O(T·U·D) vs the scatter's O(T·D) serialized
+updates — a win while U stays in the tens of thousands (measured: U=80
+scatter 111 ms -> sub-ms; U=1654 119 ms -> ~2 ms). ``MATMUL_GRAD_MAX_ROWS``
+gates callers that see data-dependent table sizes; the full 400k GloVe
+table must keep the native scatter (5 TFLOP of one-hot matmul loses).
+
+Forward semantics are exactly ``table[ids]``; backward sums the same
+per-token cotangent terms as the scatter, in f32, in a different order —
+bitwise-different but within float tolerance (pinned by
+tests/test_segsum.py against the scatter reference).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Above this many rows the one-hot matmul's O(T*U*D) FLOPs stop beating the
+# scatter's serialized O(T*D) updates (headroom: at U=32k, T=64k tokens the
+# matmul is ~200 GFLOP ~= a few ms on v5e, still well under the measured
+# 119 ms scatter; at U=400k it is ~5 TFLOP and loses).
+MATMUL_GRAD_MAX_ROWS = 32768
+
+# Tokens per one-hot chunk: bounds the [chunk, U] intermediate (bf16, U=32k
+# -> 64 MB; U<=2k -> <4 MB) while keeping the matmul tall enough for the MXU.
+_CHUNK = 1024
+
+
+def _segment_sum_matmul(cot: jnp.ndarray, ids: jnp.ndarray, num_rows: int) -> jnp.ndarray:
+    """sum_t one_hot(ids[t]) * cot[t] -> [num_rows, D], f32, via chunked matmul."""
+    cot2 = cot.reshape(-1, cot.shape[-1])
+    flat = ids.reshape(-1)
+    T, D = cot2.shape
+    pad = (-T) % _CHUNK
+    if pad:
+        cot2 = jnp.pad(cot2, ((0, pad), (0, 0)))
+        # Padded ids point at row 0 but their cotangent rows are zero.
+        flat = jnp.pad(flat, (0, pad))
+    n_chunks = (T + pad) // _CHUNK
+    ids_c = flat.reshape(n_chunks, _CHUNK)
+    cot_c = cot2.reshape(n_chunks, _CHUNK, D)
+
+    def body(acc, chunk):
+        cids, ccot = chunk
+        onehot = jax.nn.one_hot(cids, num_rows, dtype=ccot.dtype)  # [C, U]
+        acc = acc + jax.lax.dot_general(
+            onehot, ccot, (((0,), (0,)), ((), ())),  # onehotᵀ @ cot
+            preferred_element_type=jnp.float32,
+        )
+        return acc, None
+
+    init = jnp.zeros((num_rows, D), jnp.float32)
+    out, _ = jax.lax.scan(body, init, (ids_c, cot_c))
+    return out
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _lookup(num_rows: int, dtype_name: str, table, ids):
+    return table[ids]
+
+
+def _lookup_fwd(num_rows, dtype_name, table, ids):
+    return table[ids], ids
+
+
+def _lookup_bwd(num_rows, dtype_name, ids, cot):
+    dtable = _segment_sum_matmul(cot, ids, num_rows).astype(dtype_name)
+    return dtable, np.zeros(ids.shape, jax.dtypes.float0)
+
+
+_lookup.defvjp(_lookup_fwd, _lookup_bwd)
+
+
+def lookup_matmul_grad(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """``table[ids]`` with a matmul (not scatter) gradient for the table.
+
+    table: [U, D] float; ids: int array of any shape. Returns
+    ``table[ids]`` with shape ``ids.shape + (D,)``. Use only when
+    ``U <= MATMUL_GRAD_MAX_ROWS`` (see module docstring for the crossover).
+    """
+    return _lookup(table.shape[0], jnp.dtype(table.dtype).name, table, ids)

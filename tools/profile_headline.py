@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""Profile the headline fused call and rank device ops by total time.
+
+Builds the exact bench.py headline config (token cache, lazy embed Adam,
+vocab 400,002, B=64, spc=256 — override with the same BENCH_* env vars),
+traces ONE fused call with jax.profiler, then walks the device XPlane and
+prints the top ops aggregated by (fused-op) name. This answers "where does
+the remaining step time go after lazy-embed removed the dense table term"
+with measurements instead of guesses.
+
+Usage:  python tools/profile_headline.py [--top 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import re
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument("--spc", type=int, default=int(os.environ.get("BENCH_SPC", "256")))
+    args = ap.parse_args()
+
+    import jax
+
+    import bench
+
+    bench.STEPS_PER_CALL = args.spc
+
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.data import (
+        GloveTokenizer,
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.native.sampler import make_index_sampler
+    from induction_network_on_fewrel_tpu.train.steps import init_state
+    from induction_network_on_fewrel_tpu.train.token_cache import (
+        make_token_cached_multi_train_step,
+        tokenize_dataset,
+    )
+
+    cfg = ExperimentConfig(
+        encoder="bilstm", n=5, k=5, q=5, batch_size=bench.BATCH, max_length=40,
+        vocab_size=bench.VOCAB, compute_dtype="bfloat16",
+        steps_per_call=args.spc, token_cache=True,
+        embed_optimizer=bench.EMBED_OPT,
+    )
+    vocab = make_synthetic_glove(vocab_size=cfg.vocab_size - 2)
+    ds = make_synthetic_fewrel(
+        num_relations=20, instances_per_relation=cfg.k + cfg.q + 5,
+        vocab_size=min(cfg.vocab_size - 2, 2000),
+    )
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    table_np, sizes = tokenize_dataset(ds, tok)
+    if cfg.embed_optimizer == "lazy":
+        from induction_network_on_fewrel_tpu.train.lazy_embed import (
+            augment_token_table,
+        )
+
+        table_np, uids = augment_token_table(table_np)
+        table_np = {**table_np, "uids": uids}
+    table = jax.device_put(table_np)
+    sampler = make_index_sampler(
+        sizes, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size, seed=0
+    )
+    model = build_model(cfg, glove_init=vocab.vectors)
+
+    b0s, b0q, _ = sampler.sample_fused(1)
+    sup = {k: v[b0s[0]] for k, v in table_np.items() if k != "uids"}
+    qry = {k: v[b0q[0]] for k, v in table_np.items() if k != "uids"}
+    state = init_state(model, cfg, sup, qry)
+    multi_step = make_token_cached_multi_train_step(model, cfg)
+
+    def fused_call(state):
+        si, qi, lab = sampler.sample_fused(args.spc)
+        return multi_step(state, table, si, qi, lab)
+
+    t0 = time.monotonic()
+    for _ in range(2):
+        state, metrics = fused_call(state)
+    _ = float(jax.device_get(metrics["loss"])[-1])
+    print(f"warmup(+compile) {time.monotonic() - t0:.1f}s", file=sys.stderr)
+
+    tmpdir = tempfile.mkdtemp(prefix="profile_headline_")
+    jax.profiler.start_trace(tmpdir)
+    t0 = time.monotonic()
+    state, metrics = fused_call(state)
+    _ = float(jax.device_get(metrics["loss"])[-1])
+    wall = time.monotonic() - t0
+    jax.profiler.stop_trace()
+    steps = args.spc * bench.BATCH
+    print(f"traced call: {wall:.3f}s wall -> {steps / wall:.0f} eps/s", file=sys.stderr)
+
+    files = glob.glob(tmpdir + "/**/*.xplane.pb", recursive=True)
+    data = jax.profiler.ProfileData.from_file(files[0])
+    for plane in data.planes:
+        if "/device:" not in plane.name:
+            continue
+        print(f"\n=== plane: {plane.name} ===")
+        for line in plane.lines:
+            per_op: dict[str, tuple[float, int]] = {}
+            total = 0
+            for e in line.events:
+                # Collapse fusion instance suffixes: "fusion.123" -> "fusion"
+                name = re.sub(r"[.\d]+$", "", e.name)
+                ns, cnt = per_op.get(name, (0.0, 0))
+                per_op[name] = (ns + e.duration_ns, cnt + 1)
+                total += e.duration_ns
+            if not per_op or total == 0:
+                continue
+            print(f"\n-- line: {line.name}  total {total / 1e6:.1f} ms "
+                  f"({total / 1e9 / wall:.1%} of wall)")
+            ranked = sorted(per_op.items(), key=lambda kv: -kv[1][0])
+            for name, (ns, cnt) in ranked[: args.top]:
+                print(f"  {ns / 1e6:9.2f} ms  {cnt:6d}x  {100 * ns / total:5.1f}%  {name}")
+    sampler.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
