@@ -1117,11 +1117,19 @@ def test_main(argv=None) -> int:
         state = trainer.init_state()
         mngr = CheckpointManager(src, cfg)
         try:
-            state, step = mngr.restore_best(state)
+            try:
+                state, step = mngr.restore_best(state)
+                which = "best"
+            except FileNotFoundError:
+                # A run trained with --val_step 0 never writes a best-val
+                # checkpoint, but train() always leaves a final recovery-
+                # ring save — evaluate that instead of refusing.
+                state, step = mngr.restore_latest(state)
+                which = "latest (no best-val checkpoint in this dir)"
         finally:
             mngr.close()
         state = trainer.reshard_state(state)
-        print(f"loaded best checkpoint step={step} from {src}", file=sys.stderr)
+        print(f"loaded {which} checkpoint step={step} from {src}", file=sys.stderr)
 
         acc = _test_accuracy(args, cfg, trainer, state)
         print(f'{{"test_accuracy": {acc:.4f}}}')

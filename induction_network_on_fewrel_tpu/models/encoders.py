@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from induction_network_on_fewrel_tpu.ops import masked_max, masked_softmax
-from induction_network_on_fewrel_tpu.ops.lstm import bilstm_recurrence_tm
+from induction_network_on_fewrel_tpu.ops.lstm import bilstm_encoder_tm
 
 
 def _per_direction(init):
@@ -111,18 +111,12 @@ class BiLSTMSelfAttnEncoder(nn.Module):
         # the former stack/flip/pad/transpose pipeline around the grouped
         # kernel was ~25% of headline device time).
         emb_t = jnp.swapaxes(emb, 0, 1)                       # [L, M, D]
-        # Sequential-free input projection as ONE tall MXU matmul against
-        # the direction-concatenated weights: [L·M, D] x [D, 8u]. The
-        # reverse direction's gates stay in natural time order — the
-        # kernel's index maps walk them backwards (ops/lstm.py tm entry).
-        w_cat = jnp.concatenate([w_ih[0], w_ih[1]], axis=-1)  # [D, 8u]
-        b_cat = jnp.concatenate([b[0], b[1]], axis=-1)        # [8u]
-        xg_t = (
-            emb_t @ w_cat.astype(self.compute_dtype)
-            + b_cat.astype(self.compute_dtype)
-        )                                                     # [L, M, 8u]
-        # [L, M, 2u] hidden states, both directions, natural time order.
-        H = bilstm_recurrence_tm(xg_t, w_hh, backend=self.lstm_backend)
+        # Projection + recurrence in one fused kernel (ops/lstm.py): the
+        # projected gates never materialize in HBM on the pallas path; the
+        # scan path computes them explicitly with identical math.
+        H = bilstm_encoder_tm(
+            emb_t, w_ih, b[:, None, :], w_hh, backend=self.lstm_backend
+        )                                                     # [L, M, 2u]
         H = H.astype(self.compute_dtype)
 
         # Structured self-attention (Lin et al. 2017 form used by the paper):

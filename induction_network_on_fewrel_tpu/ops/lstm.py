@@ -612,6 +612,326 @@ def bilstm_recurrence_tm(
     return out[:, :M] if pad else out
 
 
+# ---------------------------------------------------------------------------
+# Fully-fused time-major BiLSTM: input projection + recurrence in ONE kernel.
+# The split design materializes the projected gates xg [L, M, 8u] in HBM
+# (262 MB bf16 at the headline shape) and then streams them through the
+# recurrence kernel forward AND backward, plus separate dxg / dW / db
+# passes — profiled at >50% of remaining step time, all bandwidth. Here the
+# kernels read the D-wide embedding block (D=60: ~17x fewer bytes than 8u),
+# compute the gate pre-activations on the fly (one extra [tm, D] x [D, 4u]
+# MXU matmul per step), and accumulate dW_ih / db / dW_hh in VMEM scratch —
+# xg, dxg, and the dW/db reduction passes never exist in HBM at all.
+# ---------------------------------------------------------------------------
+
+
+def _fused_fwd_kernel(emb_ref, wih_ref, b_ref, whh_ref, hs_ref, cs_ref, h_scr, c_scr):
+    t = pl.program_id(1)
+    u = whh_ref.shape[1]
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[...] = jnp.zeros_like(h_scr)
+        c_scr[...] = jnp.zeros_like(c_scr)
+
+    a = (
+        jnp.dot(emb_ref[0], wih_ref[0], preferred_element_type=jnp.float32)
+        + b_ref[0]
+        + jnp.dot(h_scr[...], whh_ref[0], preferred_element_type=jnp.float32)
+    )
+    i, f, g, o = _gates(a, u)
+    c = f * c_scr[...] + i * g
+    h = o * jnp.tanh(c)
+    h_scr[...] = h
+    c_scr[...] = c
+    hs_ref[0] = h.astype(hs_ref.dtype)
+    cs_ref[0] = c.astype(cs_ref.dtype)
+
+
+def _fused_fwd_kernel_infer(emb_ref, wih_ref, b_ref, whh_ref, hs_ref, h_scr, c_scr):
+    t = pl.program_id(1)
+    u = whh_ref.shape[1]
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[...] = jnp.zeros_like(h_scr)
+        c_scr[...] = jnp.zeros_like(c_scr)
+
+    a = (
+        jnp.dot(emb_ref[0], wih_ref[0], preferred_element_type=jnp.float32)
+        + b_ref[0]
+        + jnp.dot(h_scr[...], whh_ref[0], preferred_element_type=jnp.float32)
+    )
+    i, f, g, o = _gates(a, u)
+    c = f * c_scr[...] + i * g
+    h = o * jnp.tanh(c)
+    h_scr[...] = h
+    c_scr[...] = c
+    hs_ref[0] = h.astype(hs_ref.dtype)
+
+
+def _fused_bwd_kernel(
+    dhs_ref, emb_ref, cs_ref, cs_prev_ref, hs_prev_ref, wih_ref, b_ref, whh_ref,
+    demb_ref, dwih_ref, db_ref, dwhh_ref,
+    dh_scr, dc_scr, dwih_scr, db_scr, dwhh_scr,
+):
+    t = pl.program_id(1)
+    L = pl.num_programs(1)
+    rt = L - 1 - t  # kernel time being undone
+    u = whh_ref.shape[1]
+
+    @pl.when(t == 0)
+    def _():
+        dh_scr[...] = jnp.zeros_like(dh_scr)
+        dc_scr[...] = jnp.zeros_like(dc_scr)
+        dwih_scr[...] = jnp.zeros_like(dwih_scr)
+        db_scr[...] = jnp.zeros_like(db_scr)
+        dwhh_scr[...] = jnp.zeros_like(dwhh_scr)
+
+    c_t = cs_ref[0].astype(jnp.float32)
+    tc = jnp.tanh(c_t)
+    first = (rt == 0).astype(jnp.float32)
+    c_prev = cs_prev_ref[0].astype(jnp.float32) * (1.0 - first)
+    h_prev = hs_prev_ref[0].astype(jnp.float32) * (1.0 - first)
+
+    emb = emb_ref[0]
+    a = (
+        jnp.dot(emb, wih_ref[0], preferred_element_type=jnp.float32)
+        + b_ref[0]
+        + jnp.dot(h_prev, whh_ref[0], preferred_element_type=jnp.float32)
+    )
+    i, f, g, o = _gates(a, u)
+
+    dh_t = dhs_ref[0].astype(jnp.float32) + dh_scr[...]
+    da_o = dh_t * tc * o * (1.0 - o)
+    dct = dc_scr[...] + dh_t * o * (1.0 - tc * tc)
+    da_i = dct * g * i * (1.0 - i)
+    da_g = dct * i * (1.0 - g * g)
+    da_f = dct * c_prev * f * (1.0 - f)
+    da = jnp.concatenate([da_i, da_f, da_g, da_o], axis=-1)  # [tm, 4u]
+
+    demb_ref[0, 0] = jax.lax.dot_general(
+        da, wih_ref[0], (((1,), (1,)), ((), ())),  # da @ wihᵀ -> [tm, D]
+        preferred_element_type=jnp.float32,
+    ).astype(demb_ref.dtype)
+    dwih_scr[...] += jax.lax.dot_general(
+        emb.astype(jnp.float32), da, (((0,), (0,)), ((), ())),  # embᵀ @ da
+        preferred_element_type=jnp.float32,
+    )
+    db_scr[...] += jnp.sum(da, axis=0, keepdims=True)
+    dh_scr[...] = jax.lax.dot_general(
+        da, whh_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dc_scr[...] = dct * f
+    dwhh_scr[...] += jax.lax.dot_general(
+        h_prev, da, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dwih_ref[0] = dwih_scr[...]
+    db_ref[0] = db_scr[...]
+    dwhh_ref[0] = dwhh_scr[...]
+
+
+def _fused_specs(L, D, u, G, H, tm):
+    def emb_idx(i, t):
+        g = i // H
+        return (jnp.where(g == 1, L - 1 - t, t), i % H, 0)
+
+    def out_idx(i, t):
+        g = i // H
+        return (jnp.where(g == 1, L - 1 - t, t), i % H, g)
+
+    per_dir = lambda i, t: (i // H, 0, 0)  # noqa: E731
+    in_specs = [
+        pl.BlockSpec((1, tm, D), emb_idx),
+        pl.BlockSpec((1, D, G), per_dir),   # wih
+        pl.BlockSpec((1, 1, G), per_dir),   # bias
+        pl.BlockSpec((1, u, G), per_dir),   # whh
+    ]
+    return in_specs, out_idx, emb_idx, per_dir
+
+
+def _fused_fwd_call(emb_t, wih, b, whh, interpret: bool, tm: int):
+    L, Mp, D = emb_t.shape
+    Gc, u, G = whh.shape
+    H = Mp // tm
+    dt = emb_t.dtype
+    in_specs, out_idx, _, _ = _fused_specs(L, D, u, G, H, tm)
+    out_spec = pl.BlockSpec((1, tm, u), out_idx)
+    hs, cs = pl.pallas_call(
+        _fused_fwd_kernel,
+        grid=(Gc * H, L),
+        in_specs=in_specs,
+        out_specs=[out_spec, out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((L, Mp, Gc * u), dt),
+            jax.ShapeDtypeStruct((L, Mp, Gc * u), dt),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tm, u), jnp.float32),
+            pltpu.VMEM((tm, u), jnp.float32),
+        ],
+        interpret=interpret,
+    )(emb_t, wih, b, whh.astype(jnp.float32))
+    return hs, cs
+
+
+def _fused_fwd_call_infer(emb_t, wih, b, whh, interpret: bool, tm: int):
+    L, Mp, D = emb_t.shape
+    Gc, u, G = whh.shape
+    H = Mp // tm
+    in_specs, out_idx, _, _ = _fused_specs(L, D, u, G, H, tm)
+    return pl.pallas_call(
+        _fused_fwd_kernel_infer,
+        grid=(Gc * H, L),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, tm, u), out_idx),
+        out_shape=jax.ShapeDtypeStruct((L, Mp, Gc * u), emb_t.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((tm, u), jnp.float32),
+            pltpu.VMEM((tm, u), jnp.float32),
+        ],
+        interpret=interpret,
+    )(emb_t, wih, b, whh.astype(jnp.float32))
+
+
+def _fused_bwd_call(dhs, emb_t, cs, hs, wih, b, whh, interpret: bool, tm: int):
+    L, Mp, D = emb_t.shape
+    Gc, u, G = whh.shape
+    H = Mp // tm
+    ntiles = Gc * H
+
+    def p_idx(i, t):
+        g = i // H
+        return (jnp.where(g == 1, t, L - 1 - t), i % H, g)
+
+    def p_emb_idx(i, t):
+        g = i // H
+        return (jnp.where(g == 1, t, L - 1 - t), i % H, 0)
+
+    def p_prev_idx(i, t):
+        g = i // H
+        nat = jnp.where(
+            g == 1, jnp.minimum(t + 1, L - 1), jnp.maximum(L - 2 - t, 0)
+        )
+        return (nat, i % H, g)
+
+    def p_demb_idx(i, t):
+        g = i // H
+        return (g, jnp.where(g == 1, t, L - 1 - t), i % H, 0)
+
+    per_dir = lambda i, t: (i // H, 0, 0)  # noqa: E731
+    per_tile = lambda i, t: (i, 0, 0)      # noqa: E731
+    demb, dwih_p, db_p, dwhh_p = pl.pallas_call(
+        _fused_bwd_kernel,
+        grid=(ntiles, L),
+        in_specs=[
+            pl.BlockSpec((1, tm, u), p_idx),       # dhs
+            pl.BlockSpec((1, tm, D), p_emb_idx),   # emb (gates recomputed)
+            pl.BlockSpec((1, tm, u), p_idx),       # cs
+            pl.BlockSpec((1, tm, u), p_prev_idx),  # cs_{kt-1}
+            pl.BlockSpec((1, tm, u), p_prev_idx),  # hs_{kt-1}
+            pl.BlockSpec((1, D, G), per_dir),      # wih
+            pl.BlockSpec((1, 1, G), per_dir),      # bias
+            pl.BlockSpec((1, u, G), per_dir),      # whh
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, tm, D), p_demb_idx),
+            pl.BlockSpec((1, D, G), per_tile),
+            pl.BlockSpec((1, 1, G), per_tile),
+            pl.BlockSpec((1, u, G), per_tile),
+        ],
+        out_shape=[
+            # Per-direction demb slabs; both directions read the SAME emb,
+            # so their contributions sum OUTSIDE the kernel (an output
+            # block may not be revisited across non-adjacent grid steps).
+            jax.ShapeDtypeStruct((Gc, L, Mp, D), emb_t.dtype),
+            jax.ShapeDtypeStruct((ntiles, D, G), jnp.float32),
+            jax.ShapeDtypeStruct((ntiles, 1, G), jnp.float32),
+            jax.ShapeDtypeStruct((ntiles, u, G), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tm, u), jnp.float32),
+            pltpu.VMEM((tm, u), jnp.float32),
+            pltpu.VMEM((D, G), jnp.float32),
+            pltpu.VMEM((1, G), jnp.float32),
+            pltpu.VMEM((u, G), jnp.float32),
+        ],
+        interpret=interpret,
+    )(dhs, emb_t, cs, cs, hs, wih, b, whh.astype(jnp.float32))
+    demb = demb[0] + demb[1]                                  # [L, Mp, D]
+    dwih = dwih_p.reshape(Gc, H, D, G).sum(axis=1)            # [Gc, D, G]
+    db = db_p.reshape(Gc, H, G).sum(axis=1)                   # [Gc, G]
+    dwhh = dwhh_p.reshape(Gc, H, u, G).sum(axis=1)            # [Gc, u, G]
+    return demb, dwih.astype(wih.dtype), db, dwhh
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _bilstm_fused_tm(emb_t, wih, b, whh, interpret=False, tm=_TM):
+    return _fused_fwd_call_infer(emb_t, wih, b, whh, interpret, tm)
+
+
+def _bilstm_fused_fwd(emb_t, wih, b, whh, interpret, tm):
+    hs, cs = _fused_fwd_call(emb_t, wih, b, whh, interpret, tm)
+    return hs, (emb_t, hs, cs, wih, b, whh)
+
+
+def _bilstm_fused_bwd(interpret, tm, res, dhs):
+    emb_t, hs, cs, wih, b, whh = res
+    demb, dwih, db, dwhh = _fused_bwd_call(
+        dhs, emb_t, cs, hs, wih, b, whh, interpret, tm
+    )
+    return demb, dwih, db.reshape(b.shape), dwhh
+
+
+_bilstm_fused_tm.defvjp(_bilstm_fused_fwd, _bilstm_fused_bwd)
+
+
+def bilstm_encoder_tm(
+    emb_t: jnp.ndarray,
+    wih: jnp.ndarray,
+    b: jnp.ndarray,
+    whh: jnp.ndarray,
+    backend: str = "scan",
+) -> jnp.ndarray:
+    """Projection + bidirectional recurrence over natural-time embeddings.
+
+    emb_t: [L, M, D] time-major token embeddings; wih: [2, D, 4u]
+    per-direction input projections; b: [2, 1, 4u] biases; whh: [2, u, 4u].
+    Returns [L, M, 2u] natural-time hidden states (cols [0:u] forward,
+    [u:2u] reverse). The pallas/interpret backends never materialize the
+    projected gates in HBM (see the fused-kernel section comment); the
+    scan backend computes them explicitly and reuses the tm scan twin —
+    identical math, different fp rounding order.
+    """
+    L, M, D = emb_t.shape
+    Gc, u, G = whh.shape
+    if backend == "scan":
+        w_cat = jnp.concatenate([wih[0], wih[1]], axis=-1)    # [D, 8u]
+        b_cat = jnp.concatenate([b[0, 0], b[1, 0]], axis=-1)  # [8u]
+        xg_t = emb_t @ w_cat.astype(emb_t.dtype) + b_cat.astype(emb_t.dtype)
+        return bilstm_recurrence_tm(xg_t, whh, backend="scan")
+    if backend not in ("pallas", "interpret"):
+        raise ValueError(f"unknown lstm backend {backend!r}")
+    tm = _pick_tm(M, u, jnp.dtype(emb_t.dtype).itemsize)
+    pad = (-M) % tm
+    if pad:
+        # Pad rows feed zero embeddings through the recurrence; their
+        # nonzero (bias-driven) hidden states are sliced off and their
+        # cotangents are zero, so gradients are untouched.
+        emb_t = jnp.pad(emb_t, ((0, 0), (0, pad), (0, 0)))
+    out = _bilstm_fused_tm(
+        emb_t,
+        wih.astype(emb_t.dtype),
+        b.astype(jnp.float32),
+        whh.astype(jnp.float32),
+        backend == "interpret",
+        tm,
+    )
+    return out[:, :M] if pad else out
+
+
 def lstm_recurrence(
     xg: jnp.ndarray, whh: jnp.ndarray, backend: str = "scan"
 ) -> jnp.ndarray:

@@ -37,9 +37,15 @@ import numpy as np
 # 119 ms scatter; at U=400k it is ~5 TFLOP and loses).
 MATMUL_GRAD_MAX_ROWS = 32768
 
-# Tokens per one-hot chunk: bounds the [chunk, U] intermediate (bf16, U=32k
-# -> 64 MB; U<=2k -> <4 MB) while keeping the matmul tall enough for the MXU.
-_CHUNK = 1024
+# One-hot intermediate budget. The chunk length adapts to the table: a
+# fixed small chunk turns the backward into hundreds of scan iterations
+# whose per-iteration overhead dwarfs the matmul (profiled: 125 chunks x
+# 32000 grid trips for the [80, 5] position tables cost ~60 ms/table per
+# fused call — more than the matmul work by orders of magnitude). Budgeting
+# the [chunk, U] one-hot at ~32 MB gives ONE chunk for tiny tables and a
+# handful for the compact word table, with the same math.
+_ONEHOT_BYTES = 32 * 2**20
+_MIN_CHUNK = 1024
 
 
 def _segment_sum_matmul(cot: jnp.ndarray, ids: jnp.ndarray, num_rows: int) -> jnp.ndarray:
@@ -47,20 +53,27 @@ def _segment_sum_matmul(cot: jnp.ndarray, ids: jnp.ndarray, num_rows: int) -> jn
     cot2 = cot.reshape(-1, cot.shape[-1])
     flat = ids.reshape(-1)
     T, D = cot2.shape
-    pad = (-T) % _CHUNK
+    chunk = max(_MIN_CHUNK, _ONEHOT_BYTES // (num_rows * cot2.dtype.itemsize))
+    if chunk >= T:
+        onehot = jax.nn.one_hot(flat, num_rows, dtype=cot2.dtype)  # [T, U]
+        return jax.lax.dot_general(
+            onehot, cot2, (((0,), (0,)), ((), ())),  # onehotᵀ @ cot
+            preferred_element_type=jnp.float32,
+        )
+    pad = (-T) % chunk
     if pad:
         cot2 = jnp.pad(cot2, ((0, pad), (0, 0)))
         # Padded ids point at row 0 but their cotangent rows are zero.
         flat = jnp.pad(flat, (0, pad))
-    n_chunks = (T + pad) // _CHUNK
-    ids_c = flat.reshape(n_chunks, _CHUNK)
-    cot_c = cot2.reshape(n_chunks, _CHUNK, D)
+    n_chunks = (T + pad) // chunk
+    ids_c = flat.reshape(n_chunks, chunk)
+    cot_c = cot2.reshape(n_chunks, chunk, D)
 
-    def body(acc, chunk):
-        cids, ccot = chunk
+    def body(acc, ch):
+        cids, ccot = ch
         onehot = jax.nn.one_hot(cids, num_rows, dtype=ccot.dtype)  # [C, U]
         acc = acc + jax.lax.dot_general(
-            onehot, ccot, (((0,), (0,)), ((), ())),  # onehotᵀ @ cot
+            onehot, ccot, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return acc, None
