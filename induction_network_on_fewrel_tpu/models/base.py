@@ -46,8 +46,19 @@ class FewShotModel(nn.Module):
         lead = word.shape[:-1]
         L = word.shape[-1]
         flat = lambda x: x.reshape(-1, L)
-        emb = self.embedding(flat(word), flat(pos1), flat(pos2))
-        enc = self.encoder(emb, flat(mask))
+        if getattr(self.encoder, "wants_time_major", False):
+            # Transpose the int IDS to time-major BEFORE the gathers, not
+            # the gathered embeddings after: [M, L] int32 is ~25x fewer
+            # bytes than [M, L, D] bf16, and the gather then lands directly
+            # in the [L, M, D] layout the time-major encoder consumes —
+            # profiled: the post-gather [3200, 40, 50] layout-copy chains
+            # were ~15% of headline device time (tools/profile_headline.py).
+            tmj = lambda x: jnp.swapaxes(flat(x), 0, 1)  # noqa: E731
+            emb_t = self.embedding(tmj(word), tmj(pos1), tmj(pos2))
+            enc = self.encoder(emb_t, flat(mask), time_major=True)
+        else:
+            emb = self.embedding(flat(word), flat(pos1), flat(pos2))
+            enc = self.encoder(emb, flat(mask))
         return enc.reshape(*lead, -1)
 
     def encode_episode(self, support, query) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -65,6 +76,11 @@ class FewShotModel(nn.Module):
         # row count each MXU op sees — measured win on the fused headline
         # path where per-op overhead is comparable to the op itself.
         L = support["word"].shape[-1]
+        if query["word"].shape[-1] != L:
+            raise ValueError(
+                f"support/query sequence lengths differ: {L} vs "
+                f"{query['word'].shape[-1]} — concat-encode would garble rows"
+            )
         sup_lead = support["word"].shape[:-1]
         qry_lead = query["word"].shape[:-1]
         flat = lambda x: x.reshape(-1, L)  # noqa: E731

@@ -78,10 +78,21 @@ class BiLSTMSelfAttnEncoder(nn.Module):
     att_dim: int = 64
     lstm_backend: str = "scan"  # scan | pallas | interpret (ops/lstm.py)
     compute_dtype: jnp.dtype = jnp.float32
+    # Callers that can supply embeddings already time-major ([L, M, D])
+    # should: FewShotModel.encode then transposes the int IDS before the
+    # gathers instead of the gathered bf16 embeddings after (~25x fewer
+    # transposed bytes, and the layout-copy chains XLA emitted to feed the
+    # kernel disappear — profiled in tools/profile_headline.py).
+    wants_time_major = True
 
     @nn.compact
-    def __call__(self, emb: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
-        M, L, D = emb.shape
+    def __call__(
+        self, emb: jnp.ndarray, mask: jnp.ndarray, time_major: bool = False
+    ) -> jnp.ndarray:
+        if time_major:
+            L, M, D = emb.shape
+        else:
+            M, L, D = emb.shape
         u = self.lstm_hidden
         emb = emb.astype(self.compute_dtype)
 
@@ -103,14 +114,13 @@ class BiLSTMSelfAttnEncoder(nn.Module):
             lambda key, shape: jnp.zeros(shape).at[:, u : 2 * u].set(1.0),
             (2, 4 * u),
         )
-        # The whole encoder body runs TIME-MAJOR. Transposing the 60-wide
-        # embedding [M, L, D] -> [L, M, D] costs ~1/8 the bytes of
-        # transposing the 512-wide projected gates — everything downstream
-        # (projection, recurrence, attention) is layout-free in time-major
-        # form, so this is the ONLY transpose in the encoder (profiled:
-        # the former stack/flip/pad/transpose pipeline around the grouped
-        # kernel was ~25% of headline device time).
-        emb_t = jnp.swapaxes(emb, 0, 1)                       # [L, M, D]
+        # The whole encoder body runs TIME-MAJOR. Preferred entry is
+        # time_major=True (embeddings gathered straight into [L, M, D] from
+        # transposed ids — see wants_time_major); the [M, L, D] entry keeps
+        # working for direct callers and transposes the 60-wide embedding
+        # here, still ~1/8 the bytes of transposing the 512-wide projected
+        # gates that the pre-time-major layout moved.
+        emb_t = emb if time_major else jnp.swapaxes(emb, 0, 1)  # [L, M, D]
         # Projection + recurrence in one fused kernel (ops/lstm.py): the
         # projected gates never materialize in HBM on the pallas path; the
         # scan path computes them explicitly with identical math.
