@@ -343,40 +343,30 @@ def augment_token_table(table_np: dict) -> tuple[dict, "np.ndarray"]:
     return {**table_np, "winv": winv}, uids
 
 
-def make_lazy_cached_update_body(model, cfg: ExperimentConfig):
-    """Token-cache twin of make_lazy_update_body: batch =
-    ``(support, query, label, uids)`` where support/query carry the
-    precomputed ``winv`` remapped ids and ``uids [U]`` is the STATIC
-    sorted corpus vocabulary (augment_token_table).
-
-    Exactness: every corpus row is "touched" every step — rows absent from
-    the batch get the zero-gradient Adam update, which is EXACTLY what
-    dense Adam applies to them (their momentum tail); non-corpus rows can
-    never receive a gradient, and with weight decay excluded from the
-    table their dense-Adam update is exactly zero forever. The catch-up
-    loop therefore runs only on the first step after a restore (gap > 0)
-    and is a no-op at steady state.
-    """
-    from induction_network_on_fewrel_tpu.train.steps import loss_and_metrics
-
+def _require_adam(cfg: ExperimentConfig):
     if cfg.optimizer != "adam":
         raise ValueError(
             "embed_optimizer=lazy replicates dense Adam's momentum tail; "
             f"it requires --optimizer adam (got {cfg.optimizer!r})"
         )
-    hp = make_hyper(cfg)
+
+
+def _make_compact_step(model, cfg: ExperimentConfig, hp: LazyHyper):
+    """One fwd/bwd/update on the COMPACT [U, D] leaf: ``(state, rows,
+    (support, query, label)) -> (state, rows, metrics)`` where rows =
+    (W_r, m_r, v_r) are the caught-up corpus rows and support/query carry
+    the precomputed ``winv`` remap. The single source of the cached lazy
+    step math — the per-step body and the hoisted fused scan both wrap it,
+    so they cannot diverge."""
+    from induction_network_on_fewrel_tpu.train.steps import loss_and_metrics
+
     aux_w = cfg.moe_aux_weight if cfg.moe_experts > 0 else 0.0
 
-    def body(state, batch):
-        support, query, label, uids = batch
+    def compact_step(state, rows, batch):
+        support, query, label = batch
+        W_r, m_r, v_r = rows
         path = find_emb_path(state.params)
-        table = tree_get(state.params, path)
         t = state.step.astype(jnp.int32)
-
-        W_r, m_r, v_r = decay_catchup(
-            table[uids], state.emb_m[uids], state.emb_v[uids],
-            state.emb_last[uids], t, hp,
-        )
 
         sup2 = {**support, "word": support["winv"]}
         qry2 = {**query, "word": query["winv"]}
@@ -398,13 +388,78 @@ def make_lazy_cached_update_body(model, cfg: ExperimentConfig):
 
         grads_main = {k: v for k, v in grads.items() if k != "lazy_embed"}
         state = state.apply_gradients(grads=grads_main)
-        state = state.replace(
-            params=tree_set(state.params, path, table.at[uids].set(W_new)),
-            emb_m=state.emb_m.at[uids].set(m_new),
-            emb_v=state.emb_v.at[uids].set(v_new),
-            emb_last=state.emb_last.at[uids].set(t + 1),
+        return state, (W_new, m_new, v_new), metrics
+
+    return compact_step
+
+
+def make_lazy_cached_scan_fns(model, cfg: ExperimentConfig):
+    """(prologue, compact_step, epilogue) for HOISTED fused token-cache
+    scans. ``uids`` is static across a fused call, so the dense-table
+    work moves to the call boundary: ``prologue(state, uids) -> rows``
+    gathers + catches up the corpus rows ONCE, the compact rows then ride
+    the ``lax.scan`` carry through S ``compact_step`` calls, and
+    ``epilogue(state, rows, uids) -> state`` scatters rows/moments back
+    once. Profiled motivation: the per-step body's three dense
+    [400002, 50] scatter fusions were ~9% of headline device time
+    (tools/profile_headline.py) for round-trips that are the identity
+    inside the call (scatter(uids) then gather(uids) of the same rows).
+    Equivalence with the per-step body is pinned at 1e-6 in
+    tests/test_lazy_embed.py.
+    """
+    _require_adam(cfg)
+    hp = make_hyper(cfg)
+    compact = _make_compact_step(model, cfg, hp)
+
+    def prologue(state, uids):
+        path = find_emb_path(state.params)
+        table = tree_get(state.params, path)
+        t = state.step.astype(jnp.int32)
+        return decay_catchup(
+            table[uids], state.emb_m[uids], state.emb_v[uids],
+            state.emb_last[uids], t, hp,
         )
-        return state, metrics
+
+    def epilogue(state, rows, uids):
+        W, m, v = rows
+        path = find_emb_path(state.params)
+        table = tree_get(state.params, path)
+        t = state.step.astype(jnp.int32)  # post-update count of the rows
+        return state.replace(
+            params=tree_set(state.params, path, table.at[uids].set(W)),
+            emb_m=state.emb_m.at[uids].set(m),
+            emb_v=state.emb_v.at[uids].set(v),
+            emb_last=state.emb_last.at[uids].set(t),
+        )
+
+    return prologue, compact, epilogue
+
+
+def make_lazy_cached_update_body(model, cfg: ExperimentConfig):
+    """Token-cache twin of make_lazy_update_body: batch =
+    ``(support, query, label, uids)`` where support/query carry the
+    precomputed ``winv`` remapped ids and ``uids [U]`` is the STATIC
+    sorted corpus vocabulary (augment_token_table).
+
+    Exactness: every corpus row is "touched" every step — rows absent from
+    the batch get the zero-gradient Adam update, which is EXACTLY what
+    dense Adam applies to them (their momentum tail); non-corpus rows can
+    never receive a gradient, and with weight decay excluded from the
+    table their dense-Adam update is exactly zero forever. The catch-up
+    loop therefore runs only on the first step after a restore (gap > 0)
+    and is a no-op at steady state.
+
+    This body pays the dense gather/scatter round-trip EVERY step; fused
+    callers should prefer make_lazy_cached_scan_fns, which hoists it to
+    the call boundary (identical trajectory).
+    """
+    prologue, compact, epilogue = make_lazy_cached_scan_fns(model, cfg)
+
+    def body(state, batch):
+        support, query, label, uids = batch
+        rows = prologue(state, uids)
+        state, rows, metrics = compact(state, rows, (support, query, label))
+        return epilogue(state, rows, uids), metrics
 
     return body
 

@@ -103,20 +103,55 @@ def make_token_cached_train_step(model, cfg, mesh=None, state_example=None):
 
 
 def make_token_cached_multi_train_step(model, cfg, mesh=None, state_example=None):
-    """steps_per_call twin: scan S stacked index batches against one table."""
+    """steps_per_call twin: scan S stacked index batches against one table.
+
+    Lazy-embed mode uses the HOISTED scan (lazy_embed.make_lazy_cached_scan_fns):
+    the dense-table gather/catch-up/scatter runs once per fused call instead
+    of per step, with the compact corpus rows riding the scan carry —
+    identical trajectory (the per-step round-trip is the identity inside the
+    call), ~9% of headline device time removed.
+    """
     import jax
 
     from induction_network_on_fewrel_tpu.train.steps import make_update_body
 
-    lazy = _lazy_cached(model, cfg)
-    body = make_update_body(model, cfg) if lazy is None else None
+    if getattr(cfg, "embed_optimizer", "shared") == "lazy":
+        from induction_network_on_fewrel_tpu.train.lazy_embed import (
+            make_lazy_cached_scan_fns,
+        )
+
+        prologue, compact, epilogue = make_lazy_cached_scan_fns(model, cfg)
+
+        def multi_step(state, table, sup_idx_s, qry_idx_s, label_s):
+            uids = table["uids"]
+            rows = prologue(state, uids)
+
+            def scan_body(carry, xs):
+                st, rw = carry
+                si, qi, lab = xs
+                st, rw, metrics = compact(
+                    st, rw, (_gather(table, si), _gather(table, qi), lab)
+                )
+                return (st, rw), metrics
+
+            (state, rows), metrics = jax.lax.scan(
+                scan_body, (state, rows), (sup_idx_s, qry_idx_s, label_s)
+            )
+            return epilogue(state, rows, uids), metrics
+
+        if mesh is None:
+            return jax.jit(multi_step, donate_argnums=(0,))
+        return _shard(
+            multi_step, mesh, state_example, stacked=True,
+            zero_opt=getattr(cfg, "zero_opt", False),
+        )
+
+    body = make_update_body(model, cfg)
 
     def multi_step(state, table, sup_idx_s, qry_idx_s, label_s):
         def scan_body(st, xs):
             si, qi, lab = xs
             sup, qry = _gather(table, si), _gather(table, qi)
-            if lazy is not None:
-                return lazy(st, (sup, qry, lab, table["uids"]))
             return body(st, (sup, qry, lab))
 
         return jax.lax.scan(scan_body, state, (sup_idx_s, qry_idx_s, label_s))
