@@ -75,6 +75,14 @@ class FewShotTrainer:
         self.eval_step = eval_step or make_eval_step(model, cfg)
         self.ckpt = CheckpointManager(ckpt_dir, cfg) if ckpt_dir else None
         self.best_val = -1.0
+        # Divergence-guard arming threshold, CONFIG-RELATIVE (a hardcoded
+        # 0.5 left the guard inert exactly where collapse risk is highest:
+        # 10-way and heavy-NOTA configs legitimately peak below 0.5). Arm
+        # once best_val clears 2x the random-guess floor 1/(N + has_nota),
+        # capped at the floor/1.0 midpoint so tiny-N configs (N=2: floor
+        # 0.5) can still arm.
+        guard_floor = 1.0 / (cfg.n + (1 if cfg.na_rate > 0 else 0))
+        self.guard_arm = min(2.0 * guard_floor, 0.5 * (1.0 + guard_floor))
         self._initial_state = initial_state
         # Mesh the injected steps were built for (None = single device);
         # restored checkpoints must be re-placed onto it (see reshard_state).
@@ -323,7 +331,7 @@ class FewShotTrainer:
                 # see config.divergence_guard). Detect the collapse at the
                 # val boundary; optionally restore the best checkpoint and
                 # end the run instead of burning the remaining steps.
-                if self.best_val > 0.5 and val_acc < 0.5 * self.best_val:
+                if self.best_val > self.guard_arm and val_acc < 0.5 * self.best_val:
                     self.logger.log(
                         step, "divergence",
                         val_accuracy=val_acc, best_val=self.best_val,
