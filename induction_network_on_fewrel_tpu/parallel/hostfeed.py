@@ -80,13 +80,38 @@ def local_episode_range(mesh: Mesh, global_batch: int) -> tuple[int, int]:
     return episode_ranges_by_process(mesh, global_batch)[jax.process_index()]
 
 
+_MASK64 = (1 << 64) - 1
+
+
+def _splitmix64(x: int) -> int:
+    """One splitmix64 output — the same mixer the C++ sampler uses for its
+    own (seed, batch) expansion (native/episode_sampler.cpp:35)."""
+    x = (x + 0x9E3779B97F4A7C15) & _MASK64
+    z = x
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK64
+    return z ^ (z >> 31)
+
+
 def process_seed(seed: int) -> int:
-    """Disjoint per-process sampler stream: the samplers (numpy and C++)
-    derive their whole stream from the seed, so striding it by process
-    index gives each host an independent episode source — episodes are iid
-    draws, so any disjoint assignment of streams to hosts yields the same
-    global distribution."""
-    return seed + 7919 * jax.process_index()  # prime stride: no overlap
+    """Per-process sampler stream seed, splitmix64 domain-separated.
+
+    Process 0 keeps the base seed unchanged (single-process runs remain
+    bit-identical to the non-pod path); process p > 0 gets a splitmix64
+    avalanche of (seed, p). What this guarantees: distinct, decorrelated
+    64-bit seeds per process (and, through the samplers' own splitmix64 /
+    PCG64 seed expansion, statistically independent episode streams). What
+    it does NOT guarantee: provably disjoint stream trajectories — no seed
+    derivation can (both RNG state spaces are finite). That is also not
+    needed: episodes are iid draws, so any assignment of independent
+    streams to hosts yields the same global distribution."""
+    pid = jax.process_index()
+    if pid == 0:
+        return seed
+    # Absorb seed and pid through two dependent splitmix64 rounds (the
+    # second input depends on the first's avalanche, so (seed, pid) pairs
+    # cannot cancel additively the way a linear stride could).
+    return _splitmix64(_splitmix64(seed & _MASK64) ^ (pid & _MASK64))
 
 
 class GlobalBatchAssembler:
