@@ -419,7 +419,7 @@ def lstm_recurrence_grouped(
 # ---------------------------------------------------------------------------
 
 
-def _pick_tm(M: int, u: int, itemsize: int) -> int:
+def _pick_tm(M: int, u: int, itemsize: int, D: int = 0) -> int:
     """Row-tile for the time-major kernels: avoid padding when possible.
 
     The TPU grid runs sequentially (pipelined), so fewer, larger row tiles
@@ -429,15 +429,34 @@ def _pick_tm(M: int, u: int, itemsize: int) -> int:
     (profiled at ~10% of headline device time at M=1600). Candidates are
     sublane-aligned divisors of M plus the full axis, capped by a bwd-kernel
     VMEM estimate; fallback is the classic pad-to-_TM path.
+
+    ``D > 0`` models the FUSED projection+recurrence backward (the caller
+    is bilstm_encoder_tm): its kernel additionally holds emb/demb [tm, D]
+    blocks, the wih/b/whh weight blocks with their f32 cotangent outputs,
+    and (D, 4u)+(1, 4u) accumulator scratch. ``D = 0`` models the split
+    recurrence backward (xg in + dxg out). At the flagship shape the 8 MB
+    cap's slack absorbed the difference, but a larger embedding dim could
+    otherwise pick a tile that exceeds VMEM at compile time (advisor
+    finding, round 3).
     """
     q = 16 if itemsize == 2 else 8
     cap = 8 * 2**20  # leave VMEM headroom for the compiler's own buffers
 
     def fits(tm: int) -> bool:
-        # bwd kernel, double-buffered blocks: 4x [tm, u] state/cot ins,
-        # [tm, 4u] xg in + dxg out, plus f32 scratch 2x[tm, u] + [u, 4u].
-        blocks = (4 * tm * u + 2 * tm * 4 * u) * itemsize * 2
-        scratch = (2 * tm * u + 4 * u * u) * 4
+        G = 4 * u
+        if D:
+            # fused bwd, double-buffered: 4x [tm, u] state/cot ins, emb in
+            # + demb out [tm, D], weight ins (emb-dtype wih + f32 b/whh ~
+            # itemsize each, conservatively f32) with f32 dwih/db/dwhh
+            # outs; scratch includes the dwih/db accumulators.
+            blocks = (4 * tm * u + 2 * tm * D) * itemsize * 2
+            blocks += (D * G + G + u * G) * (itemsize + 4) * 2
+            scratch = (2 * tm * u + u * G + D * G + G) * 4
+        else:
+            # split bwd: 4x [tm, u] state/cot ins, [tm, 4u] xg in + dxg
+            # out, plus f32 scratch 2x[tm, u] + [u, 4u].
+            blocks = (4 * tm * u + 2 * tm * G) * itemsize * 2
+            scratch = (2 * tm * u + u * G) * 4
         return blocks + scratch <= cap
 
     cands = [tm for tm in range(q, min(M, 1024) + 1, q) if M % tm == 0 and fits(tm)]
@@ -914,7 +933,7 @@ def bilstm_encoder_tm(
         return bilstm_recurrence_tm(xg_t, whh, backend="scan")
     if backend not in ("pallas", "interpret"):
         raise ValueError(f"unknown lstm backend {backend!r}")
-    tm = _pick_tm(M, u, jnp.dtype(emb_t.dtype).itemsize)
+    tm = _pick_tm(M, u, jnp.dtype(emb_t.dtype).itemsize, D=D)
     pad = (-M) % tm
     if pad:
         # Pad rows feed zero embeddings through the recurrence; their

@@ -436,6 +436,28 @@ def test_nota_threshold_learns_on_overfit():
     assert passed is not None, f"no chunk cleared all bars; last={m}"
 
 
+def test_nota_stats_head_mse_smoke():
+    """MSE + stats head is a LEGAL config (the cli guard only refuses mse
+    at na_rate >= 3): it must run without NaN/crash even though its
+    convergence is a documented coin flip (see the CE test below). Smoke
+    only — no convergence bar (advisor finding, round 3)."""
+    cfg = ExperimentConfig(
+        encoder="cnn", train_n=2, n=2, k=2, q=2, na_rate=1, batch_size=4,
+        max_length=L, vocab_size=302, compute_dtype="float32", lr=5e-3,
+        loss="mse", val_step=0, weight_decay=0.0, nota_head="stats",
+    )
+    model, sampler = _setup(cfg, num_relations=5)
+    trainer = FewShotTrainer(model, cfg, sampler)
+    state = trainer.train(num_iters=60)
+    m = trainer.evaluate(
+        state.params, num_episodes=24, sampler=sampler, return_metrics=True
+    )
+    assert np.isfinite(m["accuracy"]), m
+    assert all(
+        np.all(np.isfinite(leaf)) for leaf in jax.tree.leaves(state.params)
+    )
+
+
 def test_nota_stats_head_learns_on_overfit():
     """--nota_head stats (per-query affine over class-score statistics)
     learns NOTA detection on the overfit fixture; its params live under

@@ -113,7 +113,16 @@ def main() -> int:
             total = 0
             for e in line.events:
                 # Collapse fusion instance suffixes: "fusion.123" -> "fusion"
-                name = re.sub(r"[.\d]+$", "", e.name)
+                # Collapse only dot-number fusion-instance suffixes
+                # (possibly stacked, e.g. ".clone.2.1"): a bare [.\d]+
+                # also stripped digits that are part of the op name itself
+                # and merged genuinely distinct ops (advisor, round 3).
+                name = e.name
+                while True:
+                    stripped = re.sub(r"\.\d+$", "", name)
+                    if stripped == name:
+                        break
+                    name = stripped
                 ns, cnt = per_op.get(name, (0.0, 0))
                 per_op[name] = (ns + e.duration_ns, cnt + 1)
                 total += e.duration_ns
