@@ -38,46 +38,155 @@ def peak_flops_per_chip(device_kind: str, compute_dtype: str) -> float | None:
     return None
 
 
-def bilstm_induction_train_flops(cfg: ExperimentConfig) -> dict:
-    """Matmul FLOPs per optimizer step of the flagship BiLSTM induction
-    network (batch_size episodes, train-shape rows).
+def _geometry(cfg: ExperimentConfig):
+    B = cfg.batch_size
+    N, K = cfg.train_n, cfg.k
+    TQ = cfg.train_n * cfg.q + cfg.na_rate * cfg.q
+    Ms = B * N * K
+    Mq = B * TQ
+    return B, N, K, TQ, Ms, Mq
 
-    Returns {"forward": F, "train": 3F, "per_episode": 3F/B}.
+
+def encoder_forward_flops(cfg: ExperimentConfig, M: float, L: int | None = None) -> float:
+    """Forward matmul FLOPs of ``cfg.encoder`` over ``M`` rows of length
+    ``L`` (default cfg.max_length). Shapes mirror models/encoders.py,
+    models/transformer.py, and models/bert.py."""
+    L = L if L is not None else cfg.max_length
+    D = cfg.word_dim + 2 * cfg.pos_dim
+    if cfg.encoder == "cnn":
+        # encoders.py CNNEncoder: Conv1d window 3, D -> hidden_size.
+        return 2.0 * M * L * 3 * D * cfg.hidden_size
+    if cfg.encoder == "bilstm":
+        u, A, H = cfg.lstm_hidden, cfg.att_dim, 2 * cfg.lstm_hidden
+        f = 2.0 * M * L * D * (8 * u)            # input projection
+        f += 2.0 * M * L * u * (4 * u) * 2       # recurrence, both dirs
+        f += 2.0 * M * L * H * A + 2.0 * M * L * A + 2.0 * M * L * H  # attn
+        return f
+    if cfg.encoder == "transformer":
+        dm, ff, nl = cfg.tfm_model, cfg.tfm_ff, cfg.tfm_layers
+        f = 2.0 * M * L * D * dm                 # input projection
+        per = 4 * 2.0 * M * L * dm * dm          # qkv + out proj
+        per += 2 * 2.0 * M * L * L * dm          # scores + att·v
+        per += 2 * 2.0 * M * L * dm * ff         # MLP (MoE top-k ~ same
+        return f + nl * per                      # per-token ff work)
+    if cfg.encoder == "bert":
+        dm, ff, nl = cfg.bert_hidden, cfg.bert_intermediate, cfg.bert_layers
+        per = 4 * 2.0 * M * L * dm * dm
+        per += 2 * 2.0 * M * L * L * dm
+        per += 2 * 2.0 * M * L * dm * ff
+        return nl * per + 2.0 * M * dm * dm      # + pooler
+    raise ValueError(f"no FLOPs model for encoder {cfg.encoder!r}")
+
+
+def head_forward_flops(cfg: ExperimentConfig, H: float) -> float:
+    """Forward matmul FLOPs of the episode head ``cfg.model`` given encoder
+    output dim ``H``. Shapes mirror the models/*.py einsums; tiny readouts
+    kept, elementwise excluded (MFU convention)."""
+    B, N, K, TQ, Ms, Mq = _geometry(cfg)
+    m = cfg.model
+    if m == "induction":
+        C, S = cfg.induction_dim, cfg.ntn_slices
+        f = 2.0 * Ms * H * C + 2.0 * Mq * H * C
+        f += cfg.routing_iters * 2 * (2.0 * B * N * K * C)
+        f += 2.0 * B * N * S * C * C + 2.0 * B * N * S * C * TQ
+        f += 2.0 * B * TQ * N * S
+        return f
+    if m == "proto":
+        return 2.0 * B * TQ * N * H
+    if m == "siamese":
+        return 2.0 * B * TQ * N * K * H
+    if m == "proto_hatt":
+        k = K
+        f = 2.0 * B * N * K * H * k * 32          # conv 1 -> 32
+        f += 2.0 * B * N * K * H * k * 32 * 64    # conv 32 -> 64
+        f += 2.0 * B * N * H * k * 64             # strided conv 64 -> 1
+        f += 2.0 * (Ms + Mq) * H * H              # shared g() projection
+        f += 2 * 2.0 * B * TQ * N * K * H         # scores + weighted proto
+        f += 2.0 * B * TQ * N * H                 # weighted distance
+        return f
+    if m == "metanet":
+        f = 2.0 * Ms * H * N                      # slow logits on supports
+        f += 2.0 * Ms * H * N                     # meta-gradient outer prod
+        f += 2.0 * B * TQ * N * K * H             # cosine memory read
+        f += 2.0 * B * TQ * N * K * H * N         # fast-weight mix
+        f += 2 * 2.0 * Mq * H * N                 # slow + fast logits
+        return f
+    if m == "gnn":
+        G, T = B * TQ, N * K + 1
+        adj_hidden, F = 64, H + N                 # models/gnn.py defaults
+        f = 0.0
+        for _ in range(cfg.gnn_blocks + 1):       # blocks + readout layer
+            f += 2.0 * G * T * T * F * adj_hidden           # adjacency MLP
+            f += 2.0 * G * T * T * adj_hidden * adj_hidden
+            f += 2.0 * G * T * T * adj_hidden
+            f += 2.0 * G * T * T * F                        # A @ x
+            f += 2.0 * G * T * (2 * F) * cfg.gnn_dim        # gc dense
+            F += cfg.gnn_dim
+        return f
+    if m == "snail":
+        import math
+
+        G, T = B * TQ, N * K + 1
+        F = H + N
+        f = 0.0
+        levels = max(1, math.ceil(math.log2(T)))
+        for kd, vd in ((64, 32), (256, 128), (512, 256)):  # att blocks
+            f += 2.0 * G * T * F * (2 * kd + vd)
+            f += 2 * 2.0 * G * T * T * (kd + vd)
+            F += vd
+            if (kd, vd) == (512, 256):
+                break
+            for _ in range(levels):               # TC block after att 1/2
+                f += 2 * 2.0 * G * T * 2 * F * cfg.snail_tc_filters
+                F += cfg.snail_tc_filters
+        f += 2.0 * G * F * N                      # readout (query position)
+        return f
+    if m == "pair":
+        return 2.0 * B * TQ * N * K * cfg.bert_hidden  # match head, [CLS]
+    raise ValueError(f"no FLOPs model for model {cfg.model!r}")
+
+
+def train_step_flops(cfg: ExperimentConfig) -> dict:
+    """Analytic matmul FLOPs per optimizer step for ANY (encoder, model)
+    config in the zoo. Returns {"forward", "train", "per_episode"}.
+
+    Train multipliers: 3x forward for everything trainable; a FROZEN BERT
+    backbone on the token path costs 1x (forward only, no backward); with
+    the feature cache the backbone is excluded entirely (encoded once at
+    cache build, amortized to ~0 per step).
     """
+    B, N, K, TQ, Ms, Mq = _geometry(cfg)
+    if cfg.model == "pair":
+        # B·TQ·N·K token-level pairs of length 2L through the backbone.
+        M_pairs = B * TQ * N * K
+        enc = encoder_forward_flops(cfg, M_pairs, L=2 * cfg.max_length)
+        head = head_forward_flops(cfg, cfg.bert_hidden)
+        enc_mult = 1.0 if cfg.bert_frozen else 3.0
+        f_train = enc_mult * enc + 3.0 * head
+        return {"forward": enc + head, "train": f_train,
+                "per_episode": f_train / B}
+    M = Ms + Mq
+    enc = encoder_forward_flops(cfg, M)
+    H = (2 * cfg.lstm_hidden if cfg.encoder == "bilstm"
+         else cfg.tfm_model if cfg.encoder == "transformer"
+         else cfg.bert_hidden if cfg.encoder == "bert"
+         else cfg.hidden_size)
+    head = head_forward_flops(cfg, H)
+    if cfg.encoder == "bert" and cfg.bert_frozen:
+        enc_mult = 0.0 if cfg.feature_cache else 1.0
+    else:
+        enc_mult = 3.0
+    f_train = enc_mult * enc + 3.0 * head
+    return {"forward": enc + head, "train": f_train,
+            "per_episode": f_train / B}
+
+
+def bilstm_induction_train_flops(cfg: ExperimentConfig) -> dict:
+    """Flagship wrapper (bench.py's headline contract): the general
+    train_step_flops restricted to the bilstm induction config."""
     if cfg.encoder != "bilstm" or cfg.model != "induction":
         raise ValueError(
             "analytic FLOPs are derived for the bilstm induction flagship; "
             f"got encoder={cfg.encoder!r} model={cfg.model!r}"
         )
-    B = cfg.batch_size
-    N, K = cfg.train_n, cfg.k
-    TQ = cfg.train_n * cfg.q + cfg.na_rate * cfg.q
-    L = cfg.max_length
-    D = cfg.word_dim + 2 * cfg.pos_dim          # embedded token dim
-    u = cfg.lstm_hidden
-    A = cfg.att_dim
-    H = 2 * u                                   # encoder output dim
-    C = cfg.induction_dim
-    S = cfg.ntn_slices
-
-    Ms = B * N * K                              # support rows
-    Mq = B * TQ                                 # query rows
-    M = Ms + Mq                                 # rows through the encoder
-
-    f = 0.0
-    # encoders.py: input projection [M*L, D] x [D, 8u] (both directions).
-    f += 2.0 * M * L * D * (8 * u)
-    # ops/lstm.py recurrence: per timestep per direction [*, u] x [u, 4u].
-    f += 2.0 * M * L * u * (4 * u) * 2
-    # encoders.py structured attention: W1 proj, w2 scores, weighted sum.
-    f += 2.0 * M * L * H * A + 2.0 * M * L * A + 2.0 * M * L * H
-    # induction.py: shared squash transform on support rows [Ms, H] x [H, C],
-    # and query_proj on query rows [Mq, H] x [H, C] (InductionNetwork.setup).
-    f += 2.0 * Ms * H * C
-    f += 2.0 * Mq * H * C
-    # induction.py routing: riters x (d·e_hat and e_hat·c contractions).
-    f += cfg.routing_iters * 2 * (2.0 * B * N * K * C)
-    # induction.py NTN: bnc,hcd->bnhd then bnhd,bqd->bqnh, plus readout.
-    f += 2.0 * B * N * S * C * C + 2.0 * B * N * S * C * TQ
-    f += 2.0 * B * TQ * N * S
-    return {"forward": f, "train": 3.0 * f, "per_episode": 3.0 * f / B}
+    return train_step_flops(cfg)

@@ -241,6 +241,35 @@ def _prepared(name, cfg, step_once, pack, eff=1, closers=()):
     }
 
 
+def _row_mfu(cfg, rates):
+    """Median-rate MFU from the generalized analytic FLOPs model
+    (utils/flops.train_step_flops — matmul terms only, 3x-forward
+    convention, frozen backbones at 1x/0x). None off-TPU or for configs
+    the model doesn't cover (the --adv DANN extra pass is uncounted, so
+    adversarial rows report the few-shot-only lower bound)."""
+    import statistics
+
+    import jax
+
+    from induction_network_on_fewrel_tpu.utils.flops import (
+        peak_flops_per_chip,
+        train_step_flops,
+    )
+
+    if not rates:
+        return None
+    try:
+        peak = peak_flops_per_chip(
+            jax.devices()[0].device_kind, cfg.compute_dtype
+        )
+        if not peak:
+            return None
+        per_ep = train_step_flops(cfg)["per_episode"]
+        return round(statistics.median(rates) * per_ep / peak, 4)
+    except Exception:  # noqa: BLE001 — accounting must never sink a row
+        return None
+
+
 def _hard_sync(metrics):
     # A value fetch, NOT block_until_ready: the tunneled backend's block
     # returns before execution finishes (bench.py docstring).
@@ -324,6 +353,7 @@ def run_group(members, rounds: int = ROUNDS):
             "chunks": len(rates),
             "warmup_s": p["warmup_s"],
             "backend": jax.default_backend(),
+            "mfu": _row_mfu(p["cfg"], rates),
         }
         if "error" in p:
             row["error"] = p["error"]
@@ -400,7 +430,21 @@ def main() -> int:
              steps_per_call=256, embed_optimizer="lazy"), False),
          ("6g: 400k-vocab B64 embed=sgd",
           tc(encoder="bilstm", n=5, k=5, q=5, batch_size=64, vocab_size=400002,
-             steps_per_call=256, embed_optimizer="sgd"), False)],
+             steps_per_call=256, embed_optimizer="sgd"), False),
+         # LIVE-path lazy (round-3 VERDICT item 3): the per-step
+         # sort/dedup body on live token batches vs its dense twin — the
+         # CLI accepts this combination, so its cost must be on record
+         # (cli warns when it loses; see BASELINE.md round 4).
+         ("6Ls: 400k-vocab B64 embed=shared LIVE (no cache)",
+          ExperimentConfig(
+              encoder="bilstm", n=5, k=5, q=5, vocab_size=400002,
+              max_length=40, compute_dtype="bfloat16", batch_size=64,
+              steps_per_call=64, embed_optimizer="shared"), False),
+         ("6Ll: 400k-vocab B64 embed=lazy LIVE (no cache)",
+          ExperimentConfig(
+              encoder="bilstm", n=5, k=5, q=5, vocab_size=400002,
+              max_length=40, compute_dtype="bfloat16", batch_size=64,
+              steps_per_call=64, embed_optimizer="lazy"), False)],
     ]
     only = sys.argv[1:] or None
 
