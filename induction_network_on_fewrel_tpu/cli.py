@@ -135,6 +135,24 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
         help="optimizer steps (or eval batches) fused into one dispatch "
              "(lax.scan); identical results, amortized host/transfer latency",
     )
+    p.add_argument(
+        "--eval_steps_per_call", type=int, default=0,
+        help="eval batches fused per dispatch at val/test boundaries "
+             "(0 = auto: min(steps_per_call, 16) — right-sizes boundary "
+             "evals instead of padding small val splits to the training "
+             "scan width)",
+    )
+    p.add_argument(
+        "--metric_window_calls", type=int, default=4,
+        help="fused train calls between metric fetches (each fetch is a "
+             "real device sync on tunneled backends)",
+    )
+    p.add_argument(
+        "--ckpt_stage", default="auto", choices=["auto", "off"],
+        help="checkpoint tmpfs staging: orbax writes to /dev/shm, a mover "
+             "thread drains to --save_ckpt (auto falls back to direct "
+             "writes without /dev/shm or on multi-host runs)",
+    )
     p.add_argument("--test_iter", type=int, default=3000)
     # data
     p.add_argument("--train_file", default=None, help="FewRel-schema JSON; synthetic if omitted")
@@ -275,6 +293,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         grad_clip=args.grad_clip, train_iter=train_iter,
         val_iter=val_iter, val_step=val_step, test_iter=args.test_iter,
         steps_per_call=getattr(args, "steps_per_call", 1),
+        eval_steps_per_call=getattr(args, "eval_steps_per_call", 0),
+        metric_window_calls=getattr(args, "metric_window_calls", 4),
+        ckpt_stage=getattr(args, "ckpt_stage", "auto"),
         feature_cache=getattr(args, "feature_cache", False),
         token_cache=getattr(args, "token_cache", False),
         divergence_guard=getattr(args, "divergence_guard", "none"),

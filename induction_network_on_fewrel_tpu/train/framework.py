@@ -207,8 +207,9 @@ class FewShotTrainer:
         last_logged = start_step
         # Metric logging fetches values (a real device sync on tunneled
         # backends — see bench.py's hard-sync note); with fused calls, log
-        # every few calls rather than every one so the sync amortizes.
-        window = max(50, 4 * cfg.steps_per_call)
+        # every metric_window_calls calls rather than every one so the
+        # sync amortizes.
+        window = max(50, cfg.metric_window_calls * cfg.steps_per_call)
         adv = self.adv
         profiling = profile_done = False
         diverged_stop = False
@@ -412,7 +413,11 @@ class FewShotTrainer:
         collected: dict[str, list] = {}
         n_batches = max(1, num_episodes // sampler.batch_size)
         it: Iterator = iter(sampler)
-        spc = self.cfg.steps_per_call
+        # Right-sized eval fusion width (cfg.eval_steps_per_call; 0 = auto):
+        # the TRAINING scan width (e.g. 256) is the wrong unit for a small
+        # val split — see the config-field comment. One extra compile per
+        # distinct width, paid once.
+        spc = self.cfg.eval_steps_per_call or min(self.cfg.steps_per_call, 16)
         remaining = n_batches
 
         def collect(out):

@@ -638,3 +638,55 @@ def test_evaluate_fused_tail_padding_exact():
         )
         assert abs(acc_fused - acc_plain) < 1e-6, (n_batches, acc_fused, acc_plain)
     assert fused._fused_eval is not None and plain._fused_eval is None
+
+
+def test_ckpt_tmpfs_staging_drains_to_real_dir(tmp_path):
+    """ckpt_stage=auto (round-3 VERDICT item 7): orbax writes land in
+    /dev/shm staging, the mover drains them to the real dir — wait()
+    means durable in the REAL dir; a fresh manager on the real dir alone
+    (staging wiped, simulating a reboot) restores every save; retention
+    GC mirrors to the real dir."""
+    import shutil
+
+    from induction_network_on_fewrel_tpu.train.checkpoint import (
+        CheckpointManager,
+        _stage_root_for,
+    )
+
+    if _stage_root_for(tmp_path / "d", "auto") is None:
+        pytest.skip("no /dev/shm on this host")
+    cfg = ExperimentConfig(
+        encoder="cnn", n=2, k=2, q=2, batch_size=2, max_length=L,
+        vocab_size=302, compute_dtype="float32",
+    )
+    model, sampler = _setup(cfg)
+    sup, qry, _ = batch_to_model_inputs(sampler.sample_batch())
+    state = jax.device_get(init_state(model, cfg, sup, qry))
+
+    d = tmp_path / "d"
+    mgr = CheckpointManager(d, cfg)
+    stage = mgr._stage_root
+    assert stage is not None and str(stage).startswith("/dev/shm")
+    mgr.save(5, state, val_accuracy=0.5)
+    mgr.save_latest(7, state)
+    mgr.wait()
+    # Durable in the REAL dir, not just tmpfs.
+    assert (d / "5").is_dir()
+    assert (d / "latest" / "7").is_dir()
+    mgr.close()
+
+    # Reboot simulation: staging wiped, only the real dir survives.
+    shutil.rmtree(stage)
+    mgr2 = CheckpointManager(d, cfg)
+    restored, step = mgr2.restore_latest(state)
+    assert step == 7
+    _, best = mgr2.restore_best(state)
+    assert best == 5
+    # Retention GC mirrors: save 3 more bests (max_to_keep=3) and the
+    # oldest real-dir step dir disappears after the drain.
+    for s, acc in ((8, 0.6), (9, 0.7), (10, 0.8)):
+        mgr2.save(s, restored, val_accuracy=acc)
+    mgr2.wait()
+    assert not (d / "5").is_dir()
+    assert (d / "10").is_dir()
+    mgr2.close()
