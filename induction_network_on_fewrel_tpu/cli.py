@@ -75,6 +75,9 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--ntn_slices", type=int, default=100)
     p.add_argument("--bert_frozen", action="store_true", help="freeze BERT backbone")
     p.add_argument("--bert_layers", type=int, default=12)
+    p.add_argument("--bert_hidden", type=int, default=768)
+    p.add_argument("--bert_heads", type=int, default=12)
+    p.add_argument("--bert_intermediate", type=int, default=3072)
     p.add_argument("--bert_vocab", default=None, help="vocab.txt for WordPiece (hash fallback if absent)")
     p.add_argument("--bert_vocab_size", type=int, default=30522, help="embedding rows in hash-fallback mode")
     p.add_argument("--bert_weights", default=None, help=".npz of bert-base-uncased weights")
@@ -293,6 +296,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         induction_dim=args.induction_dim,
         routing_iters=args.routing_iters, ntn_slices=args.ntn_slices,
         bert_frozen=args.bert_frozen, bert_layers=args.bert_layers,
+        bert_hidden=getattr(args, "bert_hidden", 768),
+        bert_heads=getattr(args, "bert_heads", 12),
+        bert_intermediate=getattr(args, "bert_intermediate", 3072),
         bert_vocab_size=args.bert_vocab_size, bert_vocab_path=args.bert_vocab,
         bert_remat=args.bert_remat, bert_weights=args.bert_weights,
         loss=args.loss, optimizer=args.optimizer,
