@@ -380,11 +380,12 @@ class FewShotTrainer:
             if not diverged_stop:
                 # Final ring save (no-op if the last val boundary already
                 # wrote this step): --resume continues from the end of this
-                # run. Skipped after a divergence stop — the returned state
-                # is the restored BEST (an earlier step), and stamping it
-                # with the diverged run's step number would corrupt resume
-                # ordering.
-                self.ckpt.save_latest(step, state)
+                # run. force=True — the adaptive in-flight skip must not
+                # drop the run's terminal state. Skipped after a divergence
+                # stop — the returned state is the restored BEST (an
+                # earlier step), and stamping it with the diverged run's
+                # step number would corrupt resume ordering.
+                self.ckpt.save_latest(step, state, force=True)
             # Saves are async (off the val-boundary critical path); the
             # run's contract is that returning implies durable checkpoints.
             self.ckpt.wait()

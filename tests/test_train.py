@@ -369,7 +369,9 @@ def test_stale_checkpoint_dir_guard(tmp_path):
 
     mgr = CheckpointManager(tmp_path, cfg)
     mgr.save(500, state, val_accuracy=0.9)  # prior run's best, step 500
-    mgr.save_latest(700, state)             # prior run's ring, saved later
+    # force=True: a prior run's TERMINAL ring save (the trainer forces its
+    # end-of-run save past the adaptive in-flight skip).
+    mgr.save_latest(700, state, force=True)  # prior run's ring, saved later
 
     with pytest.raises(ValueError, match="resume"):
         mgr.check_start_step(0)             # fresh fine-tune into old dir
@@ -668,7 +670,7 @@ def test_ckpt_tmpfs_staging_drains_to_real_dir(tmp_path):
     stage = mgr._stage_root
     assert stage is not None and str(stage).startswith("/dev/shm")
     mgr.save(5, state, val_accuracy=0.5)
-    mgr.save_latest(7, state)
+    mgr.save_latest(7, state, force=True)  # past the adaptive in-flight skip
     mgr.wait()
     # Durable in the REAL dir, not just tmpfs.
     assert (d / "5").is_dir()
