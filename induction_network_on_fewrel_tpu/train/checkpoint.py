@@ -194,7 +194,29 @@ class CheckpointManager:
         # save whichever side it durably lives on.
         root = self.dir
         if self._stage_root is not None:
+            import shutil
+            import uuid
+
             self._stage_root.mkdir(parents=True, exist_ok=True)
+            # Incarnation nonce: staging outlives a deleted-and-recreated
+            # real dir (tmpfs vs disk lifetimes differ), and a stale
+            # staging tree would shadow the fresh run — its old steps
+            # would seed the dedupe ledger and silently swallow new saves
+            # (caught live in round 4). The nonce ties a staging tree to
+            # ONE real-dir incarnation: mismatch (or a fresh real dir)
+            # discards staging; a crash-before-drain keeps both nonces
+            # equal, so tmpfs durability across process crashes is kept.
+            nonce_f = self.dir / ".staging_nonce"
+            s_nonce_f = self._stage_root / ".staging_nonce"
+            nonce = nonce_f.read_text() if nonce_f.exists() else None
+            if nonce is None:
+                nonce = uuid.uuid4().hex
+                nonce_f.write_text(nonce)
+            s_nonce = s_nonce_f.read_text() if s_nonce_f.exists() else None
+            if s_nonce != nonce:
+                shutil.rmtree(self._stage_root, ignore_errors=True)
+                self._stage_root.mkdir(parents=True, exist_ok=True)
+                s_nonce_f.write_text(nonce)
             if any(p.name.isdigit() for p in self.dir.iterdir() if p.is_dir()) or (
                 self.dir / "latest"
             ).exists():

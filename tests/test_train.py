@@ -692,3 +692,15 @@ def test_ckpt_tmpfs_staging_drains_to_real_dir(tmp_path):
     assert not (d / "5").is_dir()
     assert (d / "10").is_dir()
     mgr2.close()
+
+    # Stale-staging shadow (round-4 live bug): the REAL dir is wiped and
+    # recreated while the tmpfs staging survives — the old staging steps
+    # must NOT seed the new incarnation's dedupe ledger (they silently
+    # swallowed fresh saves before the incarnation nonce).
+    shutil.rmtree(d)
+    mgr3 = CheckpointManager(d, cfg)
+    assert mgr3.mngr.latest_step() is None  # stale staging discarded
+    mgr3.save(1, state, val_accuracy=0.1)
+    mgr3.wait()
+    assert (d / "1").is_dir()
+    mgr3.close()
