@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from induction_network_on_fewrel_tpu.config import ExperimentConfig
 from induction_network_on_fewrel_tpu.data import (
@@ -94,6 +95,7 @@ def test_disc_state_stays_out_of_model_state():
     assert jax.tree_util.tree_structure(plain.params) == jax.tree_util.tree_structure(adv.params)
 
 
+@pytest.mark.slow
 def test_sharded_adv_step_matches_single_device():
     """GSPMD DANN step on a dp=4 mesh == the single-device step (same
     inputs, same init): loss/metrics equal, updated params equal."""

@@ -70,6 +70,7 @@ def test_wordpiece_with_vocab(tmp_path):
     assert ids[-1] == vocab.index("[SEP]")
 
 
+@pytest.mark.slow
 def test_bert_forward_shapes(episode):
     sup, qry, label = episode
     model = build_model(CFG)
@@ -190,6 +191,7 @@ def _torch_hidden(hf_model, ids, mask):
 TINY_GOLD = dict(vocab_size=64, hidden=32, layers=3, heads=4, intermediate=64)
 
 
+@pytest.mark.slow
 def test_golden_hf_backbone(tmp_path):
     """BertBackbone matches transformers.BertModel last_hidden_state at 1e-4
     (f32 compute, random init exported through the real weight mapping)."""
@@ -269,6 +271,7 @@ def test_golden_hf_backbone_base_shape(tmp_path):
 
 
 @pytest.mark.parametrize("ln_style", [("gamma", "beta"), ("weight", "bias")])
+@pytest.mark.slow
 def test_hf_weight_mapping_roundtrip(tmp_path, ln_style):
     """load_hf_weights maps a synthetic HF-style npz onto the param tree and
     the fused qkv equals the concatenation of q/k/v. Both TF-era

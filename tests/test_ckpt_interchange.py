@@ -60,6 +60,7 @@ def _round_trip(tmp_path, cfg, state):
     return restored
 
 
+@pytest.mark.slow
 def test_interchange_scan_vs_pallas_backend(tmp_path):
     """A scan-backend checkpoint drives the pallas(interpret) encoder to
     identical outputs — kernels are interchangeable over one param tree."""
@@ -166,6 +167,7 @@ def test_interchange_single_device_vs_mesh(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_interchange_pp1_vs_pp4(tmp_path):
     """A pp=1 layer-stacked-transformer checkpoint restores and runs under
     a (dp=2, pp=4) GPipe mesh with identical eval results."""

@@ -39,6 +39,7 @@ def last_json(stdout: str) -> dict:
     return json.loads(stdout.strip().splitlines()[-1])
 
 
+@pytest.mark.slow
 def test_train_then_test_cycle(tmp_path):
     ckpt = str(tmp_path / "ck")
     out, _ = run_cli(
@@ -55,6 +56,7 @@ def test_train_then_test_cycle(tmp_path):
     assert "test_accuracy" in last_json(out)
 
 
+@pytest.mark.slow
 def test_feature_cache_cycle(tmp_path):
     ckpt = str(tmp_path / "ck")
     bert = ["--encoder", "bert", "--bert_frozen", "--bert_layers", "2",
@@ -71,6 +73,7 @@ def test_feature_cache_cycle(tmp_path):
     assert "test_accuracy" in last_json(out)
 
 
+@pytest.mark.slow
 def test_adv_fused_and_mesh(tmp_path):
     out, _ = run_cli(
         "train.py", "--model", "proto", "--encoder", "cnn", "--loss", "ce",
@@ -90,6 +93,7 @@ def test_adv_fused_and_mesh(tmp_path):
     assert "final_val_accuracy" in last_json(out)
 
 
+@pytest.mark.slow
 def test_bad_flag_combinations_fail_fast(tmp_path):
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "train.py"), "--model", "pair",
@@ -108,6 +112,7 @@ def test_bad_flag_combinations_fail_fast(tmp_path):
     assert proc.returncode != 0 and "feature_cache" in proc.stderr
 
 
+@pytest.mark.slow
 def test_real_glove_txt_pins_embedding_shape(tmp_path):
     """A loaded GloVe decides vocab_size/word_dim: the CLI must pin the
     embedding table to it (regression: default 400002x50 vs real file)."""
@@ -154,6 +159,7 @@ def test_parallel_flag_validation_in_process():
                     "--sampler", "python"])
 
 
+@pytest.mark.slow
 def test_fault_injection_then_resume(tmp_path):
     """--fault_step crashes the run mid-training; --resume restores the
     newest recovery-ring checkpoint and completes (SURVEY.md §5.3 failure
@@ -205,6 +211,7 @@ def test_degenerate_mse_nota_guard():
     config_from_args(test_p.parse_args(["--loss", "mse", "--na_rate", "5"]))
 
 
+@pytest.mark.slow
 def test_token_cache_fused_test_eval_parity(tmp_path):
     """test.py on the token-cache path: fused eval (bound to the TEST
     table) scores identically to per-batch eval — same seed, same episode

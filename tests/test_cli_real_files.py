@@ -58,6 +58,7 @@ def corpus_files(tmp_path):
     return glove, train, val
 
 
+@pytest.mark.slow
 def test_train_and_test_from_real_files(corpus_files, tmp_path):
     glove, train, val = corpus_files
     ckpt = tmp_path / "ckpt"

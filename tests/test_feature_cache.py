@@ -160,6 +160,7 @@ def test_index_mode_matches_feature_mode():
     np.testing.assert_array_equal(a.query, fi.table[b.query_idx])
 
 
+@pytest.mark.slow
 def test_cached_steps_match_feature_steps(setup):
     """Device-side gather (make_cached_train_step) == materialized-feature
     step: same updates, same metrics; fused twin matches sequential."""

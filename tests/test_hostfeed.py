@@ -149,6 +149,7 @@ def test_assembler_index_mode():
     assert g_qry.sharding.spec[0] == "dp"
 
 
+@pytest.mark.slow
 def test_per_host_index_sampler_feeds_cached_mesh_step():
     """The token-cache (index) path under per-host feeding: assembled
     global index batches drive the mesh-sharded cached step identically to
@@ -296,6 +297,7 @@ def test_per_host_fused_stack_assembly():
     assert sup_s["word"].sharding.spec[1] == "dp"
 
 
+@pytest.mark.slow
 def test_per_host_sampler_matches_direct_feed():
     """Training through PerHostSampler (assembled global arrays) computes
     the IDENTICAL trajectory as feeding the same sampler's numpy batches

@@ -83,6 +83,7 @@ def _assert_trees_close(a, b, atol):
         )
 
 
+@pytest.mark.slow
 def test_lazy_equals_dense_adam(fixture):
     """Lazy trajectory == dense shared-Adam trajectory at 1e-6 (wd=0, so
     the two configs define the SAME optimizer), every param including the
@@ -116,6 +117,7 @@ def test_lazy_equals_dense_adam(fixture):
     _assert_trees_close(lazy.params, dense.params, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_lazy_with_weight_decay_matches_nowd_table_twin(fixture):
     """With wd>0, lazy == the dense twin that applies wd everywhere EXCEPT
     the table (the documented lazy semantics): coupled-L2 Adam on the main
@@ -164,6 +166,7 @@ def test_lazy_with_weight_decay_matches_nowd_table_twin(fixture):
     _assert_trees_close(lazy.params, twin.params, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_lazy_fused_scan_matches_per_step(fixture):
     """The steps_per_call scan threads the lazy state through its carry:
     4 fused calls of 3 steps == 12 per-step calls, bitwise-close."""
@@ -185,6 +188,7 @@ def test_lazy_fused_scan_matches_per_step(fixture):
     )
 
 
+@pytest.mark.slow
 def test_lazy_token_cache_matches_dense(fixture):
     """The token-cache lazy body (static corpus remap, no per-step dedup)
     computes the identical trajectory as the dense cached step — same
@@ -232,6 +236,7 @@ def test_lazy_token_cache_matches_dense(fixture):
     _assert_trees_close(lazy.params, dense.params, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_lazy_checkpoint_resume_trajectory(fixture, tmp_path):
     """Save-at-boundary + restore + continue == uninterrupted run: the
     checkpoint stores the MATERIALIZED table plus the lazy Adam state, so
@@ -270,6 +275,7 @@ def test_lazy_checkpoint_resume_trajectory(fixture, tmp_path):
     _assert_trees_close(mat(restored).params, mat(full).params, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_lazy_token_cache_on_mesh_matches_dense_on_mesh(fixture):
     """The cached lazy body under GSPMD (dp=8 mesh) == the DENSE cached
     step on the same mesh at 1e-6 — the apples-to-apples equivalence
@@ -333,6 +339,7 @@ def test_lazy_token_cache_on_mesh_matches_dense_on_mesh(fixture):
     _assert_trees_close(lazy.params, dense.params, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_convert_lazy_to_dense_continues_exactly(fixture):
     """tools/convert_lazy_ckpt.convert_state: a lazy run converted to a
     dense TrainState mid-stream and continued in SHARED mode reproduces

@@ -162,6 +162,7 @@ def test_moe_transformer_end_to_end_step(moe_episode_setup):
         assert moved, f"{frag} params did not update"
 
 
+@pytest.mark.slow
 def test_moe_ep_sharded_step_matches_single_device(moe_episode_setup):
     """GSPMD (dp=2, ep=4) training step == single-device step, metrics and
     params, on the virtual 8-CPU mesh."""

@@ -67,6 +67,7 @@ def _params_allclose(a, b, atol):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=atol, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_gspmd_matches_single_device(setup):
     model, batches, state0 = setup
     single = make_train_step(model, CFG)

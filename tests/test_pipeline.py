@@ -51,6 +51,7 @@ def test_gpipe_forward_matches_sequential():
     )
 
 
+@pytest.mark.slow
 def test_gpipe_gradient_matches_sequential():
     seq, piped = _encoders(pp=4)
     emb = jax.random.normal(jax.random.key(2), (8, 12, 20))
@@ -68,6 +69,7 @@ def test_gpipe_gradient_matches_sequential():
         )
 
 
+@pytest.mark.slow
 def test_gpipe_bubble_ticks_do_not_pollute():
     """Microbatches > stages and microbatches == stages both stay exact
     (inject/drain bubbles carry zeros that must never reach outputs)."""
@@ -105,6 +107,7 @@ def pp_episode_setup():
     return cfg, vocab, sampler
 
 
+@pytest.mark.slow
 def test_pp_sharded_training_matches_single_device(pp_episode_setup):
     """Full GSPMD train step with the pipeline executor on a (dp=2, pp=4)
     mesh == single-device sequential-scan training, for 3 steps."""
