@@ -42,10 +42,18 @@ class FewShotModel(nn.Module):
     head_dtype: jnp.dtype = jnp.float32
 
     def encode(self, word, pos1, pos2, mask) -> jnp.ndarray:
-        """[..., L] token features -> [..., H] sentence vectors."""
+        """[..., L] token features -> [..., H] sentence vectors.
+
+        ``pos1``/``pos2`` may arrive one rank BELOW ``word`` — the
+        token-cache per-sentence position OFFSETS (full ids are exactly
+        ``off + l``; train/token_cache._compact_pos_offsets). They flatten
+        to [M] and the Embedding reconstructs the vectors via its windowed
+        matmul instead of per-token gathers."""
         lead = word.shape[:-1]
         L = word.shape[-1]
         flat = lambda x: x.reshape(-1, L)
+        off_mode = pos1.ndim == word.ndim - 1
+        fpos = (lambda x: x.reshape(-1)) if off_mode else flat
         if getattr(self.encoder, "wants_time_major", False):
             # Transpose the int IDS to time-major BEFORE the gathers, not
             # the gathered embeddings after: [M, L] int32 is ~25x fewer
@@ -54,10 +62,13 @@ class FewShotModel(nn.Module):
             # profiled: the post-gather [3200, 40, 50] layout-copy chains
             # were ~15% of headline device time (tools/profile_headline.py).
             tmj = lambda x: jnp.swapaxes(flat(x), 0, 1)  # noqa: E731
-            emb_t = self.embedding(tmj(word), tmj(pos1), tmj(pos2))
+            tpos = fpos if off_mode else tmj
+            emb_t = self.embedding(
+                tmj(word), tpos(pos1), tpos(pos2), time_major=True
+            )
             enc = self.encoder(emb_t, flat(mask), time_major=True)
         else:
-            emb = self.embedding(flat(word), flat(pos1), flat(pos2))
+            emb = self.embedding(flat(word), fpos(pos1), fpos(pos2))
             enc = self.encoder(emb, flat(mask))
         return enc.reshape(*lead, -1)
 
@@ -83,10 +94,17 @@ class FewShotModel(nn.Module):
             )
         sup_lead = support["word"].shape[:-1]
         qry_lead = query["word"].shape[:-1]
-        flat = lambda x: x.reshape(-1, L)  # noqa: E731
-        cat = lambda k: jnp.concatenate(  # noqa: E731
-            [flat(support[k]), flat(query[k])], axis=0
-        )
+        word_rank = support["word"].ndim
+
+        def cat(k):
+            # Offset-form pos leaves (rank word-1) flatten to [M]; token
+            # leaves to [M, L].
+            f = (
+                (lambda x: x.reshape(-1))
+                if support[k].ndim == word_rank - 1
+                else (lambda x: x.reshape(-1, L))
+            )
+            return jnp.concatenate([f(support[k]), f(query[k])], axis=0)
         enc = self.encode(cat("word"), cat("pos1"), cat("pos2"), cat("mask"))
         ns = int(np.prod(sup_lead)) if sup_lead else 1
         sup_enc = enc[:ns].reshape(*sup_lead, -1)

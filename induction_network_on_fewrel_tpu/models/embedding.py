@@ -43,8 +43,27 @@ class Embedding(nn.Module):
     freeze_word_table: bool = False
 
     @nn.compact
-    def __call__(self, word: jnp.ndarray, pos1: jnp.ndarray, pos2: jnp.ndarray) -> jnp.ndarray:
-        """[..., L] int32 ids -> [..., L, word_dim + 2*pos_dim]."""
+    def __call__(
+        self,
+        word: jnp.ndarray,
+        pos1: jnp.ndarray,
+        pos2: jnp.ndarray,
+        time_major: bool = False,
+    ) -> jnp.ndarray:
+        """[..., L] int32 ids -> [..., L, word_dim + 2*pos_dim].
+
+        OFFSET position form: when ``pos1``/``pos2`` arrive with one rank
+        LESS than ``word`` they are per-SENTENCE start offsets (the
+        token-cache compaction, train/token_cache._compact_pos_offsets:
+        full ids are exactly ``off + l``). The position vectors are then
+        reconstructed as ``one_hot(off, L+1) @ windows(P)`` — a [rows,
+        L+1] x [L+1, L*pos_dim] MXU matmul over windows of the position
+        table instead of a per-token row gather (the windows themselves
+        are a tiny [L+1, L] gather of the [2L, pos_dim] table). Row
+        selection by an exact 0/1 one-hot in f32 reproduces the gathered
+        values BITWISE, so the two forms are interchangeable per episode.
+        ``time_major`` orients the reconstruction: word [L, M] (time
+        first) vs [M, L]."""
         if self.glove_init is not None:
             if self.glove_init.shape != (self.vocab_size, self.word_dim):
                 raise ValueError(
@@ -81,15 +100,34 @@ class Embedding(nn.Module):
             word_vecs = lookup_matmul_grad(word_table, word)
         else:
             word_vecs = word_table[word]
-        out = jnp.concatenate(
-            [
-                word_vecs,
-                lookup_matmul_grad(pos1_table, pos1),
-                lookup_matmul_grad(pos2_table, pos2),
-            ],
-            axis=-1,
-        )
+        offset_mode = pos1.ndim == word.ndim - 1
+        if offset_mode:
+            L = word.shape[0] if time_major else word.shape[-1]
+            pos1_vecs = self._pos_from_offsets(pos1_table, pos1, L, time_major)
+            pos2_vecs = self._pos_from_offsets(pos2_table, pos2, L, time_major)
+        else:
+            pos1_vecs = lookup_matmul_grad(pos1_table, pos1)
+            pos2_vecs = lookup_matmul_grad(pos2_table, pos2)
+        out = jnp.concatenate([word_vecs, pos1_vecs, pos2_vecs], axis=-1)
         return out.astype(self.compute_dtype)
+
+    @staticmethod
+    def _pos_from_offsets(table, off, L, time_major):
+        """[rows] offsets -> position vectors [L, rows, P] (time_major) or
+        [rows, L, P]: one_hot(off, L+1) @ windows(table). Window base o
+        covers off's exact range [0, L] (the tokenizer's ids are
+        (l - head) + L with head in [0, L), so off = L - head in [1, L];
+        base 0 is headroom, never out of table: max index L + L-1 =
+        2L - 1). f32 throughout: exact row selection, bitwise equal to the
+        gather form."""
+        win_idx = (
+            jnp.arange(L + 1, dtype=jnp.int32)[:, None]
+            + jnp.arange(L, dtype=jnp.int32)[None, :]
+        )                                               # [L+1, L] in [0, 2L)
+        win = lookup_matmul_grad(table, win_idx)        # [L+1, L, P]
+        oh = jax.nn.one_hot(off, L + 1, dtype=jnp.float32)
+        pat = "mo,olp->lmp" if time_major else "mo,olp->mlp"
+        return jnp.einsum(pat, oh, win.astype(jnp.float32))
 
     @property
     def output_dim(self) -> int:

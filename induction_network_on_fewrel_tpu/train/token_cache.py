@@ -54,7 +54,30 @@ def tokenize_dataset(
         "pos2": np.stack([t.pos2 for t in toks]).astype(np.int16),
         "mask": np.stack([t.mask for t in toks]).astype(np.int8),
     }
-    return table, rel_sizes
+    return _compact_pos_offsets(table), rel_sizes
+
+
+def _compact_pos_offsets(table: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Collapse per-token position ids to per-SENTENCE offsets when exact.
+
+    The GloVe tokenizer's ids are ``pos[l] = clip(l - head, -L, L-1) + L``
+    with head clamped into [0, L), so the clip NEVER binds and
+    ``pos[l] == pos[0] + l`` holds for every row — verified numerically
+    here, never assumed (the BERT tokenizer's entity markers break it, in
+    which case the table is returned unchanged). With the offsets form the
+    embedding layer reconstructs position vectors via a tiny windowed
+    one-hot matmul over the [2L, pos_dim] table (models/embedding.py)
+    instead of a [tokens]-row gather — profiled: the two full-width pos
+    gathers were ~9% of headline device time (tools/profile_headline.py,
+    round 4)."""
+    L = table["pos1"].shape[-1]
+    idx = np.arange(L, dtype=np.int32)
+    out = dict(table)
+    for key, off_key in (("pos1", "off1"), ("pos2", "off2")):
+        pos = table[key].astype(np.int32)
+        if np.array_equal(pos, pos[:, :1] + idx):
+            out[key] = pos[:, 0].astype(np.int16)  # rank-1 = offset form
+    return out
 
 
 def _gather(table: dict[str, Any], idx):

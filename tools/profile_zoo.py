@@ -1,0 +1,120 @@
+#!/usr/bin/env python3
+"""Profile one zoo-outlier config and rank its device ops (round-3 VERDICT
+weak item 6: back the "architecture-inherent" explanation for the gnn /
+snail / BERT-PAIR throughput outliers with a trace instead of prose).
+
+Usage: python tools/profile_zoo.py {gnn|snail|pair} [--top 20]
+
+Reuses bench_sweep's prepare_config so the traced program IS the sweep
+row's program; prints the top device ops for the traced fused call plus
+the analytic MFU at the measured rate (utils/flops.train_step_flops).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import re
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _collapse(name: str) -> str:
+    while True:
+        stripped = re.sub(r"\.\d+$", "", name)
+        if stripped == name:
+            return name
+        name = stripped
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model", choices=["gnn", "snail", "pair"])
+    ap.add_argument("--top", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.utils.flops import (
+        peak_flops_per_chip,
+        train_step_flops,
+    )
+    from bench_sweep import prepare_config
+
+    base = dict(batch_size=8, max_length=40, vocab_size=2002,
+                compute_dtype="bfloat16")
+    if args.model == "pair":
+        cfg = ExperimentConfig(
+            encoder="bert", model="pair", n=5, k=5, q=5,
+            **{**base, "batch_size": 1, "steps_per_call": 2},
+        )
+    else:
+        cfg = ExperimentConfig(
+            encoder="cnn", model=args.model, n=5, k=5, q=5, token_cache=True,
+            steps_per_call=64, **base,
+        )
+    p = prepare_config(f"profile:{args.model}", cfg)
+
+    t0 = time.monotonic()
+    for _ in range(3):
+        p["pack"], metrics = p["step_once"](p["pack"])
+    loss = metrics["loss"]
+    import numpy as np
+
+    _ = float(np.ravel(jax.device_get(loss))[-1])
+    print(f"warmup(+compile) {time.monotonic() - t0:.1f}s", file=sys.stderr)
+
+    tmpdir = tempfile.mkdtemp(prefix=f"profile_{args.model}_")
+    jax.profiler.start_trace(tmpdir)
+    t0 = time.monotonic()
+    p["pack"], metrics = p["step_once"](p["pack"])
+    _ = float(np.ravel(jax.device_get(metrics["loss"]))[-1])
+    wall = time.monotonic() - t0
+    jax.profiler.stop_trace()
+    eps = p["eff"] * cfg.batch_size / wall
+    flops = train_step_flops(cfg)["per_episode"]
+    peak = peak_flops_per_chip(jax.devices()[0].device_kind, cfg.compute_dtype)
+    mfu = eps * flops / peak if peak else None
+    print(
+        f"traced call: {wall:.3f}s -> {eps:.0f} eps/s/chip; analytic "
+        f"{flops / 1e9:.2f} GFLOP/episode -> mfu "
+        f"{mfu:.3f}" if mfu is not None else "mfu n/a",
+    )
+
+    files = glob.glob(tmpdir + "/**/*.xplane.pb", recursive=True)
+    data = jax.profiler.ProfileData.from_file(files[0])
+    for plane in data.planes:
+        if "/device:" not in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            per_op: dict[str, tuple[float, int]] = {}
+            total = 0
+            for e in line.events:
+                name = _collapse(e.name)
+                ns, cnt = per_op.get(name, (0.0, 0))
+                per_op[name] = (ns + e.duration_ns, cnt + 1)
+                total += e.duration_ns
+            if not per_op:
+                continue
+            print(f"\n== {plane.name} / XLA Ops, total {total / 1e6:.1f} ms")
+            for name, (ns, cnt) in sorted(
+                per_op.items(), key=lambda kv: -kv[1][0]
+            )[: args.top]:
+                print(
+                    f"  {ns / 1e6:9.2f} ms {cnt:6d}x {100 * ns / total:5.1f}%  "
+                    f"{name[:160]}"
+                )
+    for c in p["closers"]:
+        c.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
