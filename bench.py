@@ -59,10 +59,11 @@ BASELINES_EPS_TPU = {
     (400002, 64, 256, "shared"): 3538.0,  # BENCH_r02 (round-2 headline)
     # Round-4 level (BASELINE.md round 4): projection-fused Pallas kernels
     # (driver-validated at 11,432 in BENCH_r03) + time-major gathers +
-    # hoisted lazy scan -> best chunk 14,276. Bar at the lower edge of the
-    # observed band so tunnel weather doesn't read as a regression.
-    # (History: r3 in-session bar 9,135; pre-optimization 4,497.)
-    (400002, 64, 256, "lazy"): 13400.0,
+    # hoisted lazy scan + position offsets -> best chunk 16,217. Bar at
+    # the lower edge of the observed band so tunnel weather doesn't read
+    # as a regression. (History: r3 in-session bar 9,135; r4 mid-round
+    # 13,400; pre-optimization 4,497.)
+    (400002, 64, 256, "lazy"): 15300.0,
     (2002, 8, 512, "shared"): 5185.0,     # round-1 best (legacy config)
 }
 BASELINE_EPS_FALLBACK = 1264.0  # first honest hard-synced run ever (r1)
