@@ -160,8 +160,8 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--ckpt_stage", default="auto", choices=["auto", "off"],
-        help="checkpoint tmpfs staging: orbax writes to /dev/shm, a mover "
-             "thread drains to --save_ckpt (auto falls back to direct "
+        help="checkpoint tmpfs staging: orbax writes to /dev/shm and the "
+             "async saver thread drains to --save_ckpt (auto falls back to direct "
              "writes without /dev/shm or on multi-host runs)",
     )
     p.add_argument("--test_iter", type=int, default=3000)

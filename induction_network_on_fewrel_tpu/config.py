@@ -121,8 +121,8 @@ class ExperimentConfig:
     # max(50, metric_window_calls * steps_per_call).
     metric_window_calls: int = 4
     # Checkpoint tmpfs staging (train/checkpoint.py _stage_root_for):
-    # "auto" = orbax writes to /dev/shm staging, a mover thread drains
-    # completed saves to the real --save_ckpt dir (measured: host-disk
+    # "auto" = orbax writes to /dev/shm staging and the async saver
+    # thread drains completed saves to the real --save_ckpt dir (measured: host-disk
     # destinations cost ~38% of sustained soak throughput vs tmpfs,
     # BASELINE.md round-3 decomposition); "off" = write directly.
     ckpt_stage: str = "auto"

@@ -85,8 +85,8 @@ def _stage_root_for(real_dir: Path, mode: str) -> Path | None:
     Round-3 soak decomposition (BASELINE.md): with the async saver, the
     checkpoint DESTINATION still cost ~38% of sustained throughput on host
     disk vs tmpfs (the d2h fetch and the file writes contend on the host
-    side). Staging keeps orbax writing at tmpfs speed while a mover thread
-    drains completed saves to the real directory — the durability contract
+    side). Staging keeps orbax writing at tmpfs speed; the saver thread
+    then drains each completed save to the real directory — the durability contract
     (wait() implies durable in ``real_dir``) is unchanged.
 
     "auto" enables staging when /dev/shm exists, the process is the only
@@ -134,19 +134,27 @@ def _sync_tree(src: Path, dst: Path, mirror_deletes: bool = True) -> None:
             if p.is_dir() and p.name.isdigit() and p.name not in src_names:
                 shutil.rmtree(p, ignore_errors=True)
     for p in src.iterdir():
+        if ".orbax-checkpoint-tmp" in p.name:
+            continue  # in-progress orbax write: never drain partial steps
         q = dst / p.name
-        if p.is_dir():
-            _sync_tree(p, q, mirror_deletes)
-        else:
-            s = p.stat()
-            if (
-                not q.exists()
-                or q.stat().st_size != s.st_size
-                or q.stat().st_mtime < s.st_mtime
-            ):
-                tmp = q.with_name(q.name + ".staging_tmp")
-                shutil.copy2(p, tmp)
-                tmp.replace(q)
+        try:
+            if p.is_dir():
+                _sync_tree(p, q, mirror_deletes)
+            else:
+                s = p.stat()
+                if (
+                    not q.exists()
+                    or q.stat().st_size != s.st_size
+                    or q.stat().st_mtime < s.st_mtime
+                ):
+                    tmp = q.with_name(q.name + ".staging_tmp")
+                    shutil.copy2(p, tmp)
+                    tmp.replace(q)
+        except FileNotFoundError:
+            # Concurrent retention GC removed it mid-walk (belt-and-
+            # suspenders: the drain is serialized with saves, but a
+            # vanished source must never poison the run).
+            continue
 
 
 class CheckpointManager:
@@ -188,8 +196,8 @@ class CheckpointManager:
         if not (self.dir / "config.json").exists():
             (self.dir / "config.json").write_text(cfg.to_json())
         # tmpfs staging (see _stage_root_for): orbax managers operate on the
-        # staging root; completed saves drain to self.dir on the mover
-        # thread. Seeding staging from the real dir (union merge — staging
+        # staging root; each completed save is drained to self.dir on the
+        # saver thread (inline, serialized with orbax writes). Seeding staging from the real dir (union merge — staging
         # wins, it is never behind) makes resumes/restores see every prior
         # save whichever side it durably lives on.
         root = self.dir
@@ -264,17 +272,6 @@ class CheckpointManager:
         }
         self._worker = threading.Thread(target=self._drain, daemon=True)
         self._worker.start()
-        # Mover thread (staging mode): drains completed orbax saves from
-        # tmpfs staging to the real dir. Signalled once per finished save;
-        # coalesces naturally (a full sync covers every pending step).
-        self._mover_q: queue.Queue | None = None
-        self._mover: threading.Thread | None = None
-        if self._stage_root is not None:
-            self._mover_q = queue.Queue()
-            self._mover = threading.Thread(
-                target=self._drain_to_real, daemon=True
-            )
-            self._mover.start()
         # Durability on abnormal exits: the worker is a daemon (a wedged
         # device fetch must not block interpreter exit forever), so flush
         # enqueued saves at exit — covers exceptions and SIGINT, which the
@@ -302,12 +299,6 @@ class CheckpointManager:
                 time.sleep(0.1)
             self.mngr.wait_until_finished()
             self.latest_mngr.wait_until_finished()
-            while (
-                self._mover_q is not None
-                and self._mover_q.unfinished_tasks
-                and time.monotonic() - t0 < deadline
-            ):
-                time.sleep(0.1)
         except Exception:  # noqa: BLE001 — best-effort at interpreter exit
             pass
 
@@ -327,30 +318,12 @@ class CheckpointManager:
         finally:
             self._q.put(None)
             self._worker.join(timeout=30.0)
-            if self._mover_q is not None:
-                self._mover_q.put(None)
-                self._mover.join(timeout=30.0)
             self.mngr.close()
             self.latest_mngr.close()
             try:
                 atexit.unregister(self._flush_at_exit)
             except Exception:  # noqa: BLE001 — unregister is best-effort
                 pass
-
-    def _drain_to_real(self) -> None:
-        """Mover thread: staging -> real dir after each completed save.
-        The orbax manager must be idle for a consistent sync, so the
-        signal comes from _drain AFTER wait_until_finished."""
-        while True:
-            item = self._mover_q.get()
-            try:
-                if item is None:
-                    return
-                _sync_tree(self._stage_root, self.dir)
-            except Exception as e:  # noqa: BLE001 — surfaced by wait()
-                self._save_error = e
-            finally:
-                self._mover_q.task_done()
 
     def _drain(self) -> None:
         import jax
@@ -385,12 +358,17 @@ class CheckpointManager:
                     self.latest_mngr.save(
                         step, args=ocp.args.StandardSave(host)
                     )
-                if self._mover_q is not None:
-                    # The sync needs a quiescent staging tree: let orbax
-                    # finish (tmpfs-fast) before signalling the mover.
+                if self._stage_root is not None:
+                    # Drain staging -> real INLINE on this thread: the
+                    # sync must see a quiescent staging tree, and a
+                    # separate mover thread would race the NEXT save's
+                    # orbax writes/retention GC (review finding, round
+                    # 4). Serializing stretches per-save latency by the
+                    # disk copy, which the adaptive ring-save skip
+                    # already absorbs; saves still never block training.
                     (self.mngr if kind == "best"
                      else self.latest_mngr).wait_until_finished()
-                    self._mover_q.put(kind)
+                    _sync_tree(self._stage_root, self.dir)
             except Exception as e:  # noqa: BLE001 — surfaced by wait()
                 self._save_error = e
             finally:
@@ -441,8 +419,6 @@ class CheckpointManager:
         self._q.join()
         self.mngr.wait_until_finished()
         self.latest_mngr.wait_until_finished()
-        if self._mover_q is not None:
-            self._mover_q.join()
         self._check_save_error()
 
     def _check_save_error(self) -> None:

@@ -73,7 +73,7 @@ def _compact_pos_offsets(table: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
     L = table["pos1"].shape[-1]
     idx = np.arange(L, dtype=np.int32)
     out = dict(table)
-    for key, off_key in (("pos1", "off1"), ("pos2", "off2")):
+    for key in ("pos1", "pos2"):
         pos = table[key].astype(np.int32)
         if np.array_equal(pos, pos[:, :1] + idx):
             out[key] = pos[:, 0].astype(np.int16)  # rank-1 = offset form

@@ -83,7 +83,7 @@ def main() -> int:
     print(
         f"traced call: {wall:.3f}s -> {eps:.0f} eps/s/chip; analytic "
         f"{flops / 1e9:.2f} GFLOP/episode -> mfu "
-        f"{mfu:.3f}" if mfu is not None else "mfu n/a",
+        + (f"{mfu:.3f}" if mfu is not None else "n/a")
     )
 
     files = glob.glob(tmpdir + "/**/*.xplane.pb", recursive=True)
