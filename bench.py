@@ -59,21 +59,30 @@ BASELINES_EPS_TPU = {
     (400002, 64, 256, "shared"): 3538.0,  # BENCH_r02 (round-2 headline)
     # Round-4 level (BASELINE.md round 4): projection-fused Pallas kernels
     # (driver-validated at 11,432 in BENCH_r03) + time-major gathers +
-    # hoisted lazy scan + position offsets -> best chunk 16,217. Bar at
-    # the lower edge of the observed band so tunnel weather doesn't read
-    # as a regression. (History: r3 in-session bar 9,135; r4 mid-round
+    # hoisted lazy scan + position offsets -> 16,217 at spc=256; the
+    # spc re-sweep then settled the default at 512 -> 17,083. Bars at the
+    # lower edge of the observed bands so tunnel weather doesn't read as
+    # a regression. (History: r3 in-session bar 9,135; r4 mid-round
     # 13,400; pre-optimization 4,497.)
+    (400002, 64, 512, "lazy"): 16200.0,
     (400002, 64, 256, "lazy"): 15300.0,
+    # Dense-parity twin at the new spc default (same session as the lazy
+    # 512 bar: cached shared was 6,466 at spc=256 interleaved; bar set
+    # below it because shared's per-step dense table update amortizes
+    # LESS with spc, not more — without this entry a BENCH_EMBED=shared
+    # run would silently fall back to the 1,264 legacy bar).
+    (400002, 64, 512, "shared"): 6000.0,
     (2002, 8, 512, "shared"): 5185.0,     # round-1 best (legacy config)
 }
 BASELINE_EPS_FALLBACK = 1264.0  # first honest hard-synced run ever (r1)
 
 VOCAB = int(os.environ.get("BENCH_VOCAB", "400002"))
 BATCH = int(os.environ.get("BENCH_B", "64"))
-# Optimizer steps fused per dispatch (lax.scan). At B=64 a 256-step call is
-# 16k episodes — big enough to amortize dispatch, small enough to keep
-# chunks under a few seconds.
-STEPS_PER_CALL = int(os.environ.get("BENCH_SPC", "256"))
+# Optimizer steps fused per dispatch (lax.scan). Round-4 re-sweep at the
+# 16k-eps/s balance: 128 -> 15,193, 256 -> 16,221, 512 -> 17,083 (the
+# per-call fixed terms — lazy prologue/epilogue, dispatch RPC, hard-sync
+# fetch — keep amortizing); 512 keeps chunks under ~4 s.
+STEPS_PER_CALL = int(os.environ.get("BENCH_SPC", "512"))
 # "lazy" = the exact-parity sparse table Adam (train/lazy_embed.py,
 # equivalence proven at 1e-6 in tests/test_lazy_embed.py) — the production
 # recommendation and round-3 headline: 4,497 vs dense-shared's 3,532

@@ -230,3 +230,59 @@ def test_fused_cached_eval_matches_per_batch():
                 rtol=1e-6, atol=1e-6,
             )
     assert "nota_tp" in fused  # NOTA metrics ride the fused path too
+
+
+def test_pos_offsets_bitwise_equal_full_ids():
+    """_compact_pos_offsets + the Embedding's windowed-matmul
+    reconstruction produce BITWISE-identical embeddings to the full-id
+    gather form (the one-hot row selection is exact in f32), for both the
+    time-major (bilstm) and batch-major (cnn) entries; and the compaction
+    refuses non-linear position ids."""
+    from induction_network_on_fewrel_tpu.models.base import FewShotModel
+    from induction_network_on_fewrel_tpu.train.token_cache import (
+        _compact_pos_offsets,
+    )
+
+    vocab = make_synthetic_glove(vocab_size=80)
+    ds = make_synthetic_fewrel(
+        num_relations=4, instances_per_relation=6, vocab_size=60
+    )
+    tok = GloveTokenizer(vocab, max_length=10)
+    table, _ = tokenize_dataset(ds, tok)
+    assert table["pos1"].ndim == 1, "tokenizer ids are linear -> compacted"
+    # Reconstruct the full ids the compaction removed.
+    full1 = table["pos1"].astype(np.int32)[:, None] + np.arange(10)
+    full2 = table["pos2"].astype(np.int32)[:, None] + np.arange(10)
+
+    for enc in ("bilstm", "cnn"):
+        cfg = ExperimentConfig(
+            encoder=enc, n=2, k=2, q=1, batch_size=1, max_length=10,
+            vocab_size=82, compute_dtype="float32", lstm_hidden=8,
+            att_dim=4, hidden_size=8, induction_dim=4, ntn_slices=2,
+        )
+        model = build_model(cfg, glove_init=vocab.vectors)
+        idx = np.arange(4)
+        kw = dict(method=FewShotModel.encode)
+        args_full = (
+            table["word"][idx], full1[idx], full2[idx], table["mask"][idx]
+        )
+        args_off = (
+            table["word"][idx], table["pos1"][idx], table["pos2"][idx],
+            table["mask"][idx],
+        )
+        params = model.init(jax.random.key(0), *args_off, **kw)
+        out_off = model.apply(params, *args_off, **kw)
+        out_full = model.apply(params, *args_full, **kw)
+        np.testing.assert_array_equal(
+            np.asarray(out_off), np.asarray(out_full), err_msg=enc
+        )
+
+    # Non-linear ids (a BERT-marker-style jump) must NOT compact.
+    broken = dict(table)
+    broken["pos1"] = full1.astype(np.int16)
+    broken["pos1"][0, 5] += 3
+    out = _compact_pos_offsets(
+        {**broken, "pos2": full2.astype(np.int16)}
+    )
+    assert out["pos1"].ndim == 2  # left as full ids
+    assert out["pos2"].ndim == 1  # still-linear sibling compacts
