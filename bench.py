@@ -76,6 +76,17 @@ BASELINES_EPS_TPU = {
 }
 BASELINE_EPS_FALLBACK = 1264.0  # first honest hard-synced run ever (r1)
 
+# Driver-recorded END-OF-ROUND numbers (BENCH_r{N}.json), per config.
+# ``vs_prev_round`` divides by these, so the artifact itself carries the
+# cross-round trajectory: vs_baseline is re-barred within a round (honest
+# about tunnel weather, silent about progress — round-4 VERDICT weak item
+# 5), while this ratio is pinned to what the driver measured LAST round.
+PREV_ROUND_EPS_TPU = {
+    (400002, 64, 512, "lazy"): 16471.25,   # BENCH_r04
+    (400002, 64, 256, "lazy"): 11432.68,   # BENCH_r03
+    (400002, 64, 256, "shared"): 3538.24,  # BENCH_r02
+}
+
 VOCAB = int(os.environ.get("BENCH_VOCAB", "400002"))
 BATCH = int(os.environ.get("BENCH_B", "64"))
 # Optimizer steps fused per dispatch (lax.scan). Round-4 re-sweep at the
@@ -266,6 +277,10 @@ def _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips) -> i
         (VOCAB, BATCH, STEPS_PER_CALL, EMBED_OPT), BASELINE_EPS_FALLBACK
     )
     vs = best_rate / bar if comparable else 1.0
+    prev = PREV_ROUND_EPS_TPU.get((VOCAB, BATCH, STEPS_PER_CALL, EMBED_OPT))
+    vs_prev = (
+        round(best_rate / prev, 3) if (comparable and prev) else None
+    )
     print(json.dumps({
         "metric": (
             f"train_episodes_per_sec_per_chip"
@@ -275,6 +290,7 @@ def _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips) -> i
         "value": round(best_rate, 2),
         "unit": "episodes/s/chip",
         "vs_baseline": round(vs, 3),
+        "vs_prev_round": vs_prev,
         "mfu": mfu,
         "device_busy": device_busy,
         "flops_per_episode": flops["per_episode"],

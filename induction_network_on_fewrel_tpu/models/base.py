@@ -52,8 +52,12 @@ class FewShotModel(nn.Module):
         lead = word.shape[:-1]
         L = word.shape[-1]
         flat = lambda x: x.reshape(-1, L)
-        off_mode = pos1.ndim == word.ndim - 1
-        fpos = (lambda x: x.reshape(-1)) if off_mode else flat
+        # Each pos key carries its own form: _compact_pos_offsets compacts
+        # pos1/pos2 INDEPENDENTLY, so a mixed offset/token pair is a valid
+        # producer output (advisor finding, round 4) — decide per leaf, not
+        # from pos1's rank alone.
+        word_rank = word.ndim
+        fpos = lambda x: x.reshape(-1) if x.ndim == word_rank - 1 else flat(x)
         if getattr(self.encoder, "wants_time_major", False):
             # Transpose the int IDS to time-major BEFORE the gathers, not
             # the gathered embeddings after: [M, L] int32 is ~25x fewer
@@ -62,7 +66,9 @@ class FewShotModel(nn.Module):
             # profiled: the post-gather [3200, 40, 50] layout-copy chains
             # were ~15% of headline device time (tools/profile_headline.py).
             tmj = lambda x: jnp.swapaxes(flat(x), 0, 1)  # noqa: E731
-            tpos = fpos if off_mode else tmj
+            tpos = lambda x: (
+                x.reshape(-1) if x.ndim == word_rank - 1 else tmj(x)
+            )
             emb_t = self.embedding(
                 tmj(word), tpos(pos1), tpos(pos2), time_major=True
             )

@@ -100,14 +100,18 @@ class Embedding(nn.Module):
             word_vecs = lookup_matmul_grad(word_table, word)
         else:
             word_vecs = word_table[word]
-        offset_mode = pos1.ndim == word.ndim - 1
-        if offset_mode:
-            L = word.shape[0] if time_major else word.shape[-1]
-            pos1_vecs = self._pos_from_offsets(pos1_table, pos1, L, time_major)
-            pos2_vecs = self._pos_from_offsets(pos2_table, pos2, L, time_major)
-        else:
-            pos1_vecs = lookup_matmul_grad(pos1_table, pos1)
-            pos2_vecs = lookup_matmul_grad(pos2_table, pos2)
+        # Offset form is decided PER KEY: the token-cache compacts pos1 and
+        # pos2 independently, so one may arrive as per-sentence offsets
+        # while the other stays per-token (advisor finding, round 4).
+        L = word.shape[0] if time_major else word.shape[-1]
+
+        def pos_vecs(table, pos):
+            if pos.ndim == word.ndim - 1:
+                return self._pos_from_offsets(table, pos, L, time_major)
+            return lookup_matmul_grad(table, pos)
+
+        pos1_vecs = pos_vecs(pos1_table, pos1)
+        pos2_vecs = pos_vecs(pos2_table, pos2)
         out = jnp.concatenate([word_vecs, pos1_vecs, pos2_vecs], axis=-1)
         return out.astype(self.compute_dtype)
 

@@ -108,12 +108,23 @@ def _stage_root_for(real_dir: Path, mode: str) -> Path | None:
 
         if jax.process_count() > 1:
             return None
-    except Exception:  # noqa: BLE001 — no jax yet: single process
-        pass
+    except RuntimeError:
+        # Backend init failed — cannot PROVE single-process, so stay off.
+        # (Do not swallow broadly: a manager constructed before
+        # jax.distributed.initialize on a pod would wrongly enable staging
+        # and land multi-host orbax saves on non-shared local tmpfs; the
+        # save-time re-check in _check_staging_safety backstops the case
+        # where distributed init happens after construction.)
+        return None
     import hashlib
+    import os
 
-    tag = hashlib.md5(real.encode()).hexdigest()[:16]
-    return shm / f"inftpu_ckpt_stage_{tag}"
+    # Per-user tag + 0o700 creation (in __init__): the staging root must
+    # be neither predictable-shared across users nor writable by others on
+    # a multi-user host (advisor finding, round 4).
+    uid = os.getuid()
+    tag = hashlib.md5(f"{uid}:{real}".encode()).hexdigest()[:16]
+    return shm / f"inftpu_ckpt_stage_u{uid}_{tag}"
 
 
 def _sync_tree(src: Path, dst: Path, mirror_deletes: bool = True) -> None:
@@ -202,10 +213,31 @@ class CheckpointManager:
         # save whichever side it durably lives on.
         root = self.dir
         if self._stage_root is not None:
+            import os
             import shutil
             import uuid
 
-            self._stage_root.mkdir(parents=True, exist_ok=True)
+            self._stage_root.mkdir(mode=0o700, parents=True, exist_ok=True)
+            # exist_ok leaves a pre-existing path unchecked: a hostile
+            # pre-create by another user (the tag is computable) must
+            # disable staging, not hand it our checkpoint bytes. lstat, not
+            # stat: a pre-planted SYMLINK to a victim-owned directory would
+            # pass the uid check while redirecting every staging write (and
+            # the drain's mirror-deletes) into the target.
+            st = self._stage_root.lstat()
+            import stat as stat_mod
+
+            if not stat_mod.S_ISDIR(st.st_mode) or st.st_uid != os.getuid():
+                import warnings
+
+                warnings.warn(
+                    f"staging root {self._stage_root} is a symlink/non-dir "
+                    "or owned by another user; disabling tmpfs checkpoint "
+                    "staging",
+                    stacklevel=2,
+                )
+                self._stage_root = None
+        if self._stage_root is not None:
             # Incarnation nonce: staging outlives a deleted-and-recreated
             # real dir (tmpfs vs disk lifetimes differ), and a stale
             # staging tree would shadow the fresh run — its old steps
@@ -380,6 +412,7 @@ class CheckpointManager:
         critical path. Durability points: restore_*() and wait() block
         first; the trainer calls wait() at run end."""
         self._check_save_error()
+        self._check_staging_safety()
         self._enqueued["best"] = step
         self._q.put(
             ("best", step, _device_snapshot(state), float(val_accuracy))
@@ -405,6 +438,7 @@ class CheckpointManager:
         callers that REQUIRE this exact step durable (the trainer's
         end-of-run save) pass ``force=True``."""
         self._check_save_error()
+        self._check_staging_safety()
         if step in self._enqueued.values():
             return
         if not force and self._q.unfinished_tasks > 0:
@@ -425,6 +459,25 @@ class CheckpointManager:
         if self._save_error is not None:
             err, self._save_error = self._save_error, None
             raise RuntimeError("async checkpoint save failed") from err
+
+    def _check_staging_safety(self) -> None:
+        """Staging decided single-process at construction; if the process
+        joined a multi-host cluster since (distributed init AFTER the
+        manager was built), tmpfs staging would land multi-host orbax
+        saves on non-shared local tmpfs — fail loudly at the first save
+        instead of corrupting the checkpoint (advisor finding, round 4)."""
+        if self._stage_root is None:
+            return
+        import jax
+
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                f"tmpfs checkpoint staging is active but jax.process_count()"
+                f"=={jax.process_count()}: this CheckpointManager was "
+                "constructed before jax.distributed.initialize. Construct "
+                "it after distributed init (staging auto-disables), or "
+                "pass stage='off'."
+            )
 
     def check_start_step(self, start_step: int) -> None:
         """Guard a run numbering steps from ``start_step`` against a dir
