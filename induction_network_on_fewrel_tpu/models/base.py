@@ -16,6 +16,8 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 
+from induction_network_on_fewrel_tpu.models.embedding import is_offset_form
+
 
 class FewShotModel(nn.Module):
     """Base: encoder plumbing + NOTA logit for episode models.
@@ -52,12 +54,12 @@ class FewShotModel(nn.Module):
         lead = word.shape[:-1]
         L = word.shape[-1]
         flat = lambda x: x.reshape(-1, L)
-        # Each pos key carries its own form: _compact_pos_offsets compacts
-        # pos1/pos2 INDEPENDENTLY, so a mixed offset/token pair is a valid
-        # producer output (advisor finding, round 4) — decide per leaf, not
-        # from pos1's rank alone.
+        # Each pos key carries its own form (see is_offset_form): decide
+        # per leaf, not from pos1's rank alone.
         word_rank = word.ndim
-        fpos = lambda x: x.reshape(-1) if x.ndim == word_rank - 1 else flat(x)
+        fpos = lambda x: (
+            x.reshape(-1) if is_offset_form(x, word_rank) else flat(x)
+        )
         if getattr(self.encoder, "wants_time_major", False):
             # Transpose the int IDS to time-major BEFORE the gathers, not
             # the gathered embeddings after: [M, L] int32 is ~25x fewer
@@ -67,7 +69,7 @@ class FewShotModel(nn.Module):
             # were ~15% of headline device time (tools/profile_headline.py).
             tmj = lambda x: jnp.swapaxes(flat(x), 0, 1)  # noqa: E731
             tpos = lambda x: (
-                x.reshape(-1) if x.ndim == word_rank - 1 else tmj(x)
+                x.reshape(-1) if is_offset_form(x, word_rank) else tmj(x)
             )
             emb_t = self.embedding(
                 tmj(word), tpos(pos1), tpos(pos2), time_major=True
@@ -103,11 +105,10 @@ class FewShotModel(nn.Module):
         word_rank = support["word"].ndim
 
         def cat(k):
-            # Offset-form pos leaves (rank word-1) flatten to [M]; token
-            # leaves to [M, L].
+            # Offset-form pos leaves flatten to [M]; token leaves to [M, L].
             f = (
                 (lambda x: x.reshape(-1))
-                if support[k].ndim == word_rank - 1
+                if is_offset_form(support[k], word_rank)
                 else (lambda x: x.reshape(-1, L))
             )
             return jnp.concatenate([f(support[k]), f(query[k])], axis=0)

@@ -29,6 +29,17 @@ from induction_network_on_fewrel_tpu.ops.segsum import (
 )
 
 
+def is_offset_form(pos: jnp.ndarray, word_rank: int) -> bool:
+    """True when a position leaf is in per-sentence OFFSET form (one rank
+    below ``word``; full per-token ids are exactly ``off + l`` —
+    train/token_cache._compact_pos_offsets). The producer compacts pos1
+    and pos2 INDEPENDENTLY, so every consumer must test each leaf with
+    this predicate rather than letting pos1's rank decide for both
+    (advisor finding, round 4). Single definition: the form contract has
+    exactly one owner."""
+    return pos.ndim == word_rank - 1
+
+
 class Embedding(nn.Module):
     vocab_size: int
     word_dim: int = 50
@@ -106,7 +117,7 @@ class Embedding(nn.Module):
         L = word.shape[0] if time_major else word.shape[-1]
 
         def pos_vecs(table, pos):
-            if pos.ndim == word.ndim - 1:
+            if is_offset_form(pos, word.ndim):
                 return self._pos_from_offsets(table, pos, L, time_major)
             return lookup_matmul_grad(table, pos)
 

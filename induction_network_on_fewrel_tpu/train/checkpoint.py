@@ -127,6 +127,46 @@ def _stage_root_for(real_dir: Path, mode: str) -> Path | None:
     return shm / f"inftpu_ckpt_stage_u{uid}_{tag}"
 
 
+def _claim_stage_root(path: Path) -> Path | None:
+    """Create-or-validate the staging root; None when it cannot be owned.
+
+    The path is computable by any local user (uid + real dir are not
+    secret), so every way another user can pre-occupy it must degrade to
+    staging OFF (slower checkpoints), never to a crash and never to
+    writing checkpoint bytes somewhere attacker-chosen:
+
+    * regular file / dangling symlink -> mkdir raises FileExistsError;
+    * symlink to a victim-owned dir -> would pass a stat() uid check, so
+      the check uses lstat and rejects any non-directory;
+    * dir owned by someone else -> uid mismatch.
+
+    Creation uses mode 0o700 — checkpoint bytes in world-shared /dev/shm
+    must not be world-readable (advisor finding, round 4).
+    """
+    import os
+    import stat as stat_mod
+    import warnings
+
+    try:
+        path.mkdir(mode=0o700, parents=True, exist_ok=True)
+        st = path.lstat()
+    except OSError as e:  # FileExistsError (file/dangling-symlink), perms
+        warnings.warn(
+            f"staging root {path} unusable ({e}); disabling tmpfs "
+            "checkpoint staging",
+            stacklevel=3,
+        )
+        return None
+    if not stat_mod.S_ISDIR(st.st_mode) or st.st_uid != os.getuid():
+        warnings.warn(
+            f"staging root {path} is a symlink/non-dir or owned by "
+            "another user; disabling tmpfs checkpoint staging",
+            stacklevel=3,
+        )
+        return None
+    return path
+
+
 def _sync_tree(src: Path, dst: Path, mirror_deletes: bool = True) -> None:
     """Copy files newer-or-missing from src -> dst. With
     ``mirror_deletes`` (the drain direction), NUMERIC step directories in
@@ -213,30 +253,10 @@ class CheckpointManager:
         # save whichever side it durably lives on.
         root = self.dir
         if self._stage_root is not None:
-            import os
             import shutil
             import uuid
 
-            self._stage_root.mkdir(mode=0o700, parents=True, exist_ok=True)
-            # exist_ok leaves a pre-existing path unchecked: a hostile
-            # pre-create by another user (the tag is computable) must
-            # disable staging, not hand it our checkpoint bytes. lstat, not
-            # stat: a pre-planted SYMLINK to a victim-owned directory would
-            # pass the uid check while redirecting every staging write (and
-            # the drain's mirror-deletes) into the target.
-            st = self._stage_root.lstat()
-            import stat as stat_mod
-
-            if not stat_mod.S_ISDIR(st.st_mode) or st.st_uid != os.getuid():
-                import warnings
-
-                warnings.warn(
-                    f"staging root {self._stage_root} is a symlink/non-dir "
-                    "or owned by another user; disabling tmpfs checkpoint "
-                    "staging",
-                    stacklevel=2,
-                )
-                self._stage_root = None
+            self._stage_root = _claim_stage_root(self._stage_root)
         if self._stage_root is not None:
             # Incarnation nonce: staging outlives a deleted-and-recreated
             # real dir (tmpfs vs disk lifetimes differ), and a stale
@@ -255,8 +275,13 @@ class CheckpointManager:
             s_nonce = s_nonce_f.read_text() if s_nonce_f.exists() else None
             if s_nonce != nonce:
                 shutil.rmtree(self._stage_root, ignore_errors=True)
-                self._stage_root.mkdir(parents=True, exist_ok=True)
-                s_nonce_f.write_text(nonce)
+                # Recreate through the same claim path as the first mkdir:
+                # keeps 0o700 and re-validates ownership — the rmtree ->
+                # mkdir window reopens the hostile pre-create race.
+                self._stage_root = _claim_stage_root(self._stage_root)
+                if self._stage_root is not None:
+                    s_nonce_f.write_text(nonce)
+        if self._stage_root is not None:
             if any(p.name.isdigit() for p in self.dir.iterdir() if p.is_dir()) or (
                 self.dir / "latest"
             ).exists():

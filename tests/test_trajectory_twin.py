@@ -1,0 +1,314 @@
+"""Full-model TRAINING-TRAJECTORY golden twin (round-5 VERDICT item 1).
+
+The per-module torch goldens (test_golden_torch.py, test_lstm.py) pin each
+forward in isolation; this file pins the COMPOSITION UNDER TRAINING — the
+strongest accuracy-parity statement available while the reference mount is
+empty (SURVEY.md §4.2, §7: loss choice, optimizer coupling, LR schedule and
+init distributions are exactly the levers that move FewRel accuracy by
+>=0.3 pt).
+
+A torch-CPU twin of the complete flagship model — embedding (word table ⧺
+dual position embeds) -> BiLSTM + structured self-attention -> induction
+routing -> NTN relation scorer — is written from the paper equations /
+torch conventions (manual LSTM loop, NOT our JAX code transliterated),
+loaded with IDENTICAL weights, then driven for 20 steps of
+Adam(weight_decay) + global-norm clip + StepLR on IDENTICAL episode
+batches. Asserts, per step, that the loss trajectories track, and at the
+end that every parameter tensor still matches.
+
+Semantics pinned here (each mirrors a specific config knob):
+  * loss: BOTH mse (sigmoid-vs-onehot, paper §3.4) and ce — flag-selected.
+  * optimizer: optax chain(clip_by_global_norm, add_decayed_weights, adam)
+    == torch clip_grad_norm_ then Adam(weight_decay=...) — COUPLED L2
+    (decay enters before moment normalization), torch's convention.
+  * schedule: optax exponential_decay(staircase) == torch StepLR stepped
+    once per optimizer step; the 20-step run crosses two decay boundaries
+    (step_size=7), so an off-by-one in either schedule fails the test.
+  * single LSTM bias: our BiLSTM carries ONE bias per direction; the twin's
+    manual cell does too (a torch nn.LSTM twin would train bias_ih AND
+    bias_hh — that deviation is exactly what a trajectory test must not
+    hide, so the twin avoids the module).
+
+Intentional deviations from exactness (documented, not hidden): op
+ordering differs between XLA and torch (einsum contraction order, scan vs
+python loop), so trajectories diverge at f32 rounding rate. Measured over
+20 steps on these shapes: per-step loss drift stays under ~1e-5 relative;
+the assertions use 20x headroom (rtol 2e-4 on losses, 1e-3 absolute on
+final params whose magnitudes are O(1e-1..1)).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    GloveTokenizer,
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+from induction_network_on_fewrel_tpu.sampling.episodes import EpisodeSampler
+from induction_network_on_fewrel_tpu.train.steps import init_state, make_train_step
+
+pytestmark = pytest.mark.slow
+
+STEPS = 20
+
+
+def _cfg(loss: str) -> ExperimentConfig:
+    return ExperimentConfig(
+        encoder="bilstm", model="induction", loss=loss,
+        n=3, k=2, q=2, batch_size=2, max_length=12,
+        vocab_size=62, word_dim=16, pos_dim=4,
+        lstm_hidden=12, att_dim=8, induction_dim=10, ntn_slices=6,
+        routing_iters=3, lstm_backend="scan",
+        compute_dtype="float32", head_dtype="float32",
+        optimizer="adam", embed_optimizer="shared",
+        lr=2e-3, weight_decay=1e-4, grad_clip=1.0,
+        lr_step_size=7, lr_gamma=0.5,
+    )
+
+
+def _episode_stream(cfg, n_steps: int):
+    vocab = make_synthetic_glove(
+        vocab_size=cfg.vocab_size - 2, word_dim=cfg.word_dim
+    )
+    ds = make_synthetic_fewrel(
+        num_relations=6, instances_per_relation=cfg.k + cfg.q + 4,
+        vocab_size=cfg.vocab_size - 2, sentence_len=(6, cfg.max_length),
+    )
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    sampler = EpisodeSampler(
+        ds, tok, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size,
+        na_rate=cfg.na_rate, seed=123,
+    )
+    return [batch_to_model_inputs(sampler.sample_batch()) for _ in range(n_steps)]
+
+
+def torch_squash(x, eps=1e-12):
+    sq = (x**2).sum(-1, keepdim=True)
+    return (sq / (1 + sq)) * x / torch.sqrt(sq + eps)
+
+
+class TorchFlagshipTwin:
+    """The complete flagship model + training loop in torch, from equations.
+
+    Parameters are copied from the JAX init (flax Dense kernels are [in,
+    out]; torch matmul uses the same layout here, so no transposes — the
+    twin multiplies x @ W exactly as the flax modules do).
+    """
+
+    def __init__(self, jp, cfg):
+        g = lambda *ks: torch.nn.Parameter(
+            torch.tensor(np.asarray(_get(jp, ks)), dtype=torch.float32)
+        )
+        self.word = g("embedding", "word_embedding")
+        self.pos1 = g("embedding", "pos1_embedding")
+        self.pos2 = g("embedding", "pos2_embedding")
+        self.w_ih = g("encoder", "w_ih")        # [2, D, 4u]
+        self.w_hh = g("encoder", "w_hh")        # [2, u, 4u]
+        self.bias = g("encoder", "bias")        # [2, 4u]  (single bias!)
+        self.att_W1 = g("encoder", "Dense_0", "kernel")   # [2u, A]
+        self.att_w2 = g("encoder", "Dense_1", "kernel")   # [A, 1]
+        self.ind_W = g("induction", "Dense_0", "kernel")  # [2u, C]
+        self.ind_b = g("induction", "Dense_0", "bias")
+        self.qp_W = g("query_proj", "kernel")             # [2u, C]
+        self.qp_b = g("query_proj", "bias")
+        self.ntn_M = g("relation", "tensor_slices")       # [H, C, C]
+        self.ntn_W = g("relation", "Dense_0", "kernel")   # [H, 1]
+        self.ntn_b = g("relation", "Dense_0", "bias")
+        self.params = [
+            self.word, self.pos1, self.pos2, self.w_ih, self.w_hh,
+            self.bias, self.att_W1, self.att_w2, self.ind_W, self.ind_b,
+            self.qp_W, self.qp_b, self.ntn_M, self.ntn_W, self.ntn_b,
+        ]
+        self.cfg = cfg
+
+    # -- model ----------------------------------------------------------
+    def _lstm_dir(self, x, d):
+        """Manual LSTM over [M, L, D] for direction d (gate order i,f,g,o,
+        single bias, zero init state, f32 — torch.nn.LSTM conventions)."""
+        M, L, _ = x.shape
+        u = self.w_hh.shape[1]
+        xs = x if d == 0 else torch.flip(x, dims=(1,))
+        h = torch.zeros(M, u)
+        c = torch.zeros(M, u)
+        hs = []
+        for t in range(L):
+            a = xs[:, t] @ self.w_ih[d] + h @ self.w_hh[d] + self.bias[d]
+            i = torch.sigmoid(a[:, :u])
+            f = torch.sigmoid(a[:, u : 2 * u])
+            gg = torch.tanh(a[:, 2 * u : 3 * u])
+            o = torch.sigmoid(a[:, 3 * u :])
+            c = f * c + i * gg
+            h = o * torch.tanh(c)
+            hs.append(h)
+        H = torch.stack(hs, dim=1)              # [M, L, u]
+        return H if d == 0 else torch.flip(H, dims=(1,))
+
+    def encode(self, dct):
+        word = torch.tensor(np.asarray(dct["word"], np.int64))
+        p1 = torch.tensor(np.asarray(dct["pos1"], np.int64))
+        p2 = torch.tensor(np.asarray(dct["pos2"], np.int64))
+        mask = torch.tensor(np.asarray(dct["mask"], np.float32))
+        lead = word.shape[:-1]
+        L = word.shape[-1]
+        word, p1, p2, mask = (
+            t.reshape(-1, L) for t in (word, p1, p2, mask)
+        )
+        emb = torch.cat(
+            [self.word[word], self.pos1[p1], self.pos2[p2]], dim=-1
+        )                                         # [M, L, D]
+        H = torch.cat(
+            [self._lstm_dir(emb, 0), self._lstm_dir(emb, 1)], dim=-1
+        )                                         # [M, L, 2u]
+        scores = (torch.tanh(H @ self.att_W1) @ self.att_w2)[..., 0]
+        # exact masked-softmax twin of ops.core.masked_softmax
+        s = torch.where(mask > 0, scores, torch.tensor(-1e30))
+        s = s - s.max(dim=-1, keepdim=True).values
+        e = torch.exp(s) * (mask > 0)
+        att = e / (e.sum(dim=-1, keepdim=True) + 1e-13)
+        out = torch.einsum("ml,mlh->mh", att, H)
+        return out.reshape(*lead, -1)
+
+    def forward(self, support, query):
+        sup = self.encode(support)                # [B, N, K, 2u]
+        qry = self.encode(query)                  # [B, TQ, 2u]
+        e_hat = torch_squash(sup @ self.ind_W + self.ind_b)
+        B, N, K, _ = e_hat.shape
+        b = torch.zeros(B, N, K)
+        for _ in range(self.cfg.routing_iters):
+            d = torch.softmax(b, dim=-1)
+            c = torch_squash(torch.einsum("bnk,bnkc->bnc", d, e_hat))
+            b = b + torch.einsum("bnkc,bnc->bnk", e_hat, c)
+        d = torch.softmax(b, dim=-1)
+        c = torch_squash(torch.einsum("bnk,bnkc->bnc", d, e_hat))
+        qc = qry @ self.qp_W + self.qp_b
+        cM = torch.einsum("bnc,hcd->bnhd", c, self.ntn_M)
+        v = torch.relu(torch.einsum("bnhd,bqd->bqnh", cM, qc))
+        return (v @ self.ntn_W + self.ntn_b)[..., 0]   # [B, TQ, N]
+
+    def loss(self, logits, label):
+        label = torch.tensor(np.asarray(label, np.int64))
+        if self.cfg.loss == "mse":
+            onehot = torch.nn.functional.one_hot(
+                label, logits.shape[-1]
+            ).float()
+            return ((torch.sigmoid(logits) - onehot) ** 2).mean()
+        return torch.nn.functional.cross_entropy(
+            logits.reshape(-1, logits.shape[-1]), label.reshape(-1)
+        )
+
+    # -- training loop --------------------------------------------------
+    def train(self, batches):
+        cfg = self.cfg
+        opt = torch.optim.Adam(
+            self.params, lr=cfg.lr, betas=(0.9, 0.999), eps=1e-8,
+            weight_decay=cfg.weight_decay,
+        )
+        sched = torch.optim.lr_scheduler.StepLR(
+            opt, step_size=cfg.lr_step_size, gamma=cfg.lr_gamma
+        )
+        losses = []
+        for support, query, label in batches:
+            opt.zero_grad()
+            out = self.loss(self.forward(support, query), label)
+            out.backward()
+            torch.nn.utils.clip_grad_norm_(self.params, cfg.grad_clip)
+            opt.step()
+            sched.step()
+            losses.append(float(out.detach()))
+        return losses
+
+
+def _get(tree, keys):
+    for k in keys:
+        tree = tree[k]
+    return tree
+
+
+@pytest.mark.parametrize("loss", ["mse", "ce"])
+def test_training_trajectory_matches_torch(loss):
+    cfg = _cfg(loss)
+    batches = _episode_stream(cfg, STEPS)
+    model = build_model(cfg)
+
+    sup0, qry0, _ = batches[0]
+    state = init_state(model, cfg, sup0, qry0)
+    p_init = jax.tree.map(np.asarray, state.params["params"])
+    twin = TorchFlagshipTwin(p_init, cfg)
+
+    step = make_train_step(model, cfg)
+    jax_losses = []
+    for support, query, label in batches:
+        state, metrics = step(state, support, query, jnp.asarray(label))
+        jax_losses.append(float(metrics["loss"]))
+
+    torch_losses = twin.train(batches)
+
+    # Per-step losses: the trajectory must TRACK, not just end close —
+    # optimizer coupling / schedule / clip bugs show up mid-trajectory.
+    np.testing.assert_allclose(
+        jax_losses, torch_losses, rtol=2e-4, atol=1e-6,
+        err_msg=f"loss trajectory diverged ({loss})",
+    )
+    # Anti-triviality: a frozen model would "match" trivially. MSE has
+    # strong gradient at the near-zero-logit init (sigmoid(0)=0.5 vs
+    # one-hot) so its loss visibly falls; CE at near-uniform logits is
+    # QUADRATICALLY insensitive (measured flat to ~1e-6 over 20 steps on
+    # these shapes) — there the meaningful movement is in the parameters,
+    # which Adam advances at ~lr per step regardless of gradient scale
+    # (measured max |Δparam| ≈ 2.4e-2). Both regimes assert the model
+    # actually trained before comparing final params.
+    if loss == "mse":
+        assert jax_losses[-1] < jax_losses[0]
+    jp_now = jax.tree.map(np.asarray, state.params["params"])
+    moved = max(
+        float(np.abs(a - b).max())
+        for a, b in zip(jax.tree.leaves(p_init), jax.tree.leaves(jp_now))
+    )
+    assert moved > 1e-3, f"params barely moved ({moved:.2e}) — dead model?"
+
+    # Final parameters: every tensor, after 20 coupled Adam+StepLR updates.
+    jp = jax.tree.map(np.asarray, state.params["params"])
+    pairs = {
+        "word_embedding": (("embedding", "word_embedding"), twin.word),
+        "pos1_embedding": (("embedding", "pos1_embedding"), twin.pos1),
+        "pos2_embedding": (("embedding", "pos2_embedding"), twin.pos2),
+        "w_ih": (("encoder", "w_ih"), twin.w_ih),
+        "w_hh": (("encoder", "w_hh"), twin.w_hh),
+        "bias": (("encoder", "bias"), twin.bias),
+        "att_W1": (("encoder", "Dense_0", "kernel"), twin.att_W1),
+        "att_w2": (("encoder", "Dense_1", "kernel"), twin.att_w2),
+        "ind_W": (("induction", "Dense_0", "kernel"), twin.ind_W),
+        "ind_b": (("induction", "Dense_0", "bias"), twin.ind_b),
+        "qp_W": (("query_proj", "kernel"), twin.qp_W),
+        "qp_b": (("query_proj", "bias"), twin.qp_b),
+        "ntn_M": (("relation", "tensor_slices"), twin.ntn_M),
+        "ntn_W": (("relation", "Dense_0", "kernel"), twin.ntn_W),
+        "ntn_b": (("relation", "Dense_0", "bias"), twin.ntn_b),
+    }
+    for name, (keys, t) in pairs.items():
+        np.testing.assert_allclose(
+            _get(jp, keys), t.detach().numpy(), rtol=1e-3, atol=1e-3,
+            err_msg=f"param {name} diverged after {STEPS} steps ({loss})",
+        )
+
+
+def test_schedule_decay_boundaries_crossed():
+    """Self-check on the test's own regime: with step_size=7 over 20 steps
+    the staircase must decay twice — guards against a future config edit
+    silently removing the schedule from what the twin pins."""
+    cfg = _cfg("mse")
+    import optax
+
+    sched = optax.exponential_decay(
+        cfg.lr, cfg.lr_step_size, cfg.lr_gamma, staircase=True
+    )
+    lrs = {float(sched(i)) for i in range(STEPS)}
+    assert len(lrs) == 3  # init, /2, /4
