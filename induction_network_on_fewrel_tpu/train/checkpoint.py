@@ -274,11 +274,26 @@ class CheckpointManager:
                 nonce_f.write_text(nonce)
             s_nonce = s_nonce_f.read_text() if s_nonce_f.exists() else None
             if s_nonce != nonce:
-                shutil.rmtree(self._stage_root, ignore_errors=True)
-                # Recreate through the same claim path as the first mkdir:
-                # keeps 0o700 and re-validates ownership — the rmtree ->
-                # mkdir window reopens the hostile pre-create race.
-                self._stage_root = _claim_stage_root(self._stage_root)
+                # rmtree must actually SUCCEED: a partial failure silently
+                # tolerated here would leave stale step dirs which the new
+                # nonce then legitimizes — exactly the shadow-the-new-run
+                # bug the nonce exists to stop. On any failure: staging off.
+                try:
+                    shutil.rmtree(self._stage_root)
+                except OSError as e:
+                    import warnings
+
+                    warnings.warn(
+                        f"could not clear stale staging {self._stage_root} "
+                        f"({e}); disabling tmpfs checkpoint staging",
+                        stacklevel=2,
+                    )
+                    self._stage_root = None
+                else:
+                    # Recreate through the same claim path as the first
+                    # mkdir: keeps 0o700 and re-validates ownership — the
+                    # rmtree -> mkdir window reopens the pre-create race.
+                    self._stage_root = _claim_stage_root(self._stage_root)
                 if self._stage_root is not None:
                     s_nonce_f.write_text(nonce)
         if self._stage_root is not None:

@@ -153,6 +153,100 @@ def test_sharded_fused_step_matches_sequential(setup):
     _params_allclose(state_b, state_c, atol=1e-5)
 
 
+@pytest.mark.slow
+def test_pallas_interpret_under_mesh():
+    """The PRODUCTION kernel composed with the PRODUCTION distribution
+    (round-5 VERDICT item 2): the Pallas BiLSTM — via the interpreter, the
+    same kernel code that compiles on TPU — runs under the 8-device dp
+    GSPMD mesh and produces the SAME trajectory as the scan backend.
+    Checkpoints are backend-interchangeable, so identical params must give
+    identical losses/params whichever backend the mesh step compiles."""
+    cfg = ExperimentConfig(
+        encoder="bilstm", n=3, k=2, q=2, batch_size=8, max_length=L,
+        vocab_size=302, compute_dtype="float32", lstm_hidden=16, att_dim=8,
+        induction_dim=16, ntn_slices=8, lr=1e-3, weight_decay=0.0,
+        lstm_backend="interpret", dp=8,
+    )
+    vocab = make_synthetic_glove(vocab_size=300)
+    ds = make_synthetic_fewrel(
+        num_relations=6, instances_per_relation=12, vocab_size=300
+    )
+    tok = GloveTokenizer(vocab, max_length=L)
+    sampler = EpisodeSampler(
+        ds, tok, cfg.n, cfg.k, cfg.q, cfg.batch_size, seed=0
+    )
+    model = build_model(cfg, glove_init=vocab.vectors)
+    batches = [batch_to_model_inputs(sampler.sample_batch()) for _ in range(2)]
+    state0 = init_state(model, cfg, batches[0][0], batches[0][1])
+    mesh = make_mesh(dp=8)
+
+    step = make_sharded_train_step(model, cfg, mesh, state0)
+    s_pl, m_pl = _run_steps(step, _copy_state(state0), batches)
+
+    cfg_s = cfg.replace(lstm_backend="scan")
+    model_s = build_model(cfg_s, glove_init=vocab.vectors)
+    step_s = make_sharded_train_step(model_s, cfg_s, mesh, state0)
+    s_sc, m_sc = _run_steps(step_s, _copy_state(state0), batches)
+
+    assert abs(float(m_pl["loss"]) - float(m_sc["loss"])) < 1e-5
+    _params_allclose(s_pl, s_sc, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_sharded_fused_eval_nota_matches_single_device():
+    """Mesh-sharded fused eval with NOTA (round-5 VERDICT item 7): the
+    production eval path — token-cache fused lax.map eval, episode axis
+    over dp, NOTA confusion fractions aggregated across devices — equals
+    the single-device fused eval metric-for-metric (incl. nota_tp/pred/
+    true, whose shared denominator makes aggregation exact)."""
+    from induction_network_on_fewrel_tpu.native.sampler import (
+        make_index_sampler,
+    )
+    from induction_network_on_fewrel_tpu.train.token_cache import (
+        make_token_cached_multi_eval_step,
+        tokenize_dataset,
+    )
+
+    cfg = CFG.replace(encoder="bilstm", lstm_hidden=16, att_dim=8,
+                      induction_dim=16, ntn_slices=8, na_rate=2,
+                      token_cache=True, steps_per_call=3, dp=8)
+    vocab = make_synthetic_glove(vocab_size=300)
+    ds = make_synthetic_fewrel(
+        num_relations=8, instances_per_relation=12, vocab_size=300
+    )
+    tok = GloveTokenizer(vocab, max_length=L)
+    table_np, sizes = tokenize_dataset(ds, tok)
+    model = build_model(cfg, glove_init=vocab.vectors)
+    idx = make_index_sampler(
+        sizes, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size,
+        na_rate=cfg.na_rate, seed=3, backend="python",
+    )
+    si, qi, lab = idx.sample_fused(cfg.steps_per_call)
+    sup = {k: v[si[0]] for k, v in table_np.items()}
+    qry = {k: v[qi[0]] for k, v in table_np.items()}
+    state = init_state(model, cfg, sup, qry)
+    assert lab.max() == cfg.n  # NOTA label present in the sampled batches
+
+    single = make_token_cached_multi_eval_step(model, cfg)
+    ref = jax.device_get(single(state.params, table_np, si, qi, lab))
+
+    mesh = make_mesh(dp=8)
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P_
+
+    table_dev = {
+        k: jax.device_put(v, NamedSharding(mesh, P_()))
+        for k, v in table_np.items()
+    }
+    sharded = make_token_cached_multi_eval_step(model, cfg, mesh, state)
+    out = jax.device_get(sharded(state.params, table_dev, si, qi, lab))
+
+    assert set(out) == set(ref) >= {"loss", "accuracy", "nota_tp",
+                                    "nota_pred", "nota_true"}
+    for k in ref:
+        np.testing.assert_allclose(out[k], ref[k], atol=1e-6, err_msg=k)
+
+
 def test_distributed_init_failure_is_clean(monkeypatch):
     """A failed pod rendezvous surfaces as an actionable RuntimeError, not a
     raw gRPC traceback (SURVEY.md §5.3 failure detection)."""

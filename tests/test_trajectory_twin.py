@@ -275,7 +275,7 @@ def test_training_trajectory_matches_torch(loss):
     assert moved > 1e-3, f"params barely moved ({moved:.2e}) — dead model?"
 
     # Final parameters: every tensor, after 20 coupled Adam+StepLR updates.
-    jp = jax.tree.map(np.asarray, state.params["params"])
+    jp = jp_now
     pairs = {
         "word_embedding": (("embedding", "word_embedding"), twin.word),
         "pos1_embedding": (("embedding", "pos1_embedding"), twin.pos1),

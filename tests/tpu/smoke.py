@@ -84,6 +84,40 @@ def main() -> int:
         f"warm step {min(warm):.2f}s suspiciously close to compile "
         f"{compile_s:.2f}s — recompiling?"
     )
+
+    # Kernel x GSPMD on silicon (round-5 VERDICT item 2): the SAME pallas
+    # backend compiled through the mesh-sharded step on a 1-device mesh —
+    # proves the compiled-kernel + GSPMD-partitioner composition on TPU
+    # (the 8-virtual-device equality half runs in tests/test_parallel.py
+    # via the interpreter; this half is the real-toolchain compile).
+    from induction_network_on_fewrel_tpu.parallel import make_mesh
+    from induction_network_on_fewrel_tpu.parallel.sharding import (
+        make_sharded_train_step,
+    )
+
+    cfg_m = cfg.replace(dp=1)
+    mesh = make_mesh(dp=1, devices=jax.devices()[:1])
+    state_m = init_state(model, cfg_m, sup, qry)
+    sstep = make_sharded_train_step(model, cfg_m, mesh, state_m)
+    t0 = time.monotonic()
+    state_m, m_m = sstep(state_m, sup, qry, label)
+    loss_m = float(jax.device_get(m_m["loss"]))
+    print(f"sharded pallas step 1 (compile): {time.monotonic() - t0:.1f}s, "
+          f"loss={loss_m:.4f}")
+    assert loss_m == loss_m, "sharded pallas loss is NaN"
+    sh_cache = None
+    for i in range(3):
+        sup, qry, label = batch_to_model_inputs(sampler.sample_batch())
+        state_m, m_m = sstep(state_m, sup, qry, label)
+        loss_m = float(jax.device_get(m_m["loss"]))
+        assert loss_m == loss_m, f"sharded pallas NaN at warm step {i}"
+        if sh_cache is None:
+            sh_cache = sstep._cache_size()
+    assert sstep._cache_size() == sh_cache, (
+        f"sharded pallas step recompiled ({sh_cache} -> "
+        f"{sstep._cache_size()})"
+    )
+    print(f"sharded pallas warm steps stable (cache entries: {sh_cache})")
     print("TPU SMOKE OK")
     return 0
 
