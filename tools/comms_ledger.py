@@ -1,0 +1,270 @@
+#!/usr/bin/env python3
+"""Per-step collective-communication ledger from compiled HLO (round-5
+VERDICT item 8).
+
+For each parallelism leg the dryrun exercises (dp, dp+tp, sp/ring, ep/MoE,
+pp/GPipe, ZeRO-1, and the production token-cache fused path), jit-compile
+the sharded train step on the 8-virtual-device CPU mesh
+(``jit(...).lower(...).compile()``), walk the SPMD-partitioned HLO text,
+and sum the output bytes of every collective op (all-reduce, all-gather,
+reduce-scatter, collective-permute, all-to-all). The result is
+bytes/step/device of ICI traffic as the COMPILER actually scheduled it —
+arithmetic, not design claims ("scales over ICI").
+
+Bytes are per-device per-step at the dryrun's tiny shapes; the ledger also
+re-derives the dominant term analytically (gradient allreduce ~= 2x param
+bytes for ring allreduce) so BASELINE.md can project to flagship shapes
+and v4-8 scale. Run:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/comms_ledger.py [--json out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1, "c64": 8, "c128": 16,
+}
+
+# `f32[4,128]{1,0}` or scalar `f32[]` — shapes as HLO prints them.
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+_COLLECTIVES = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+)
+
+
+def _shape_bytes(shape_str: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def collective_bytes(hlo_text: str) -> dict[str, dict[str, int]]:
+    """HLO text -> {collective op kind: {count, bytes}} from op OUTPUT
+    shapes (ring all-reduce moves ~2x this on the wire; the ledger reports
+    payload bytes and lets the projection apply the algorithm factor)."""
+    out: dict[str, dict[str, int]] = {}
+    for line in hlo_text.splitlines():
+        line = line.strip()
+        # Skip fusion/computation headers; match `<shape> <op>(`  e.g.
+        # `%ar = f32[128]{0} all-reduce(...)`. Async pairs: the base op is
+        # captured LAZILY so `-start`/`-done` land in the suffix group
+        # (a greedy `[a-z\-]+` would swallow them and the op-name lookup
+        # would silently drop every async collective — review finding,
+        # round 5); `-done` ops are skipped, `-start` carries the shape.
+        m = re.match(r"%?[\w.\-]+\s*=\s*(\([^)]*\)|[\w\[\],{}: ]+?)\s+"
+                     r"([a-z\-]+?)(-start|-done)?\(", line)
+        if not m:
+            continue
+        shape_str, op, suffix = m.groups()
+        if op not in _COLLECTIVES or suffix == "-done":
+            continue
+        entry = out.setdefault(op, {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += _shape_bytes(shape_str)
+    return out
+
+
+def _tiny(**kw):
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+
+    base = dict(
+        encoder="bilstm", train_n=3, n=3, k=2, q=2, batch_size=8,
+        max_length=16, vocab_size=302, compute_dtype="float32",
+        lstm_hidden=32, att_dim=16, induction_dim=32, ntn_slices=16,
+    )
+    base.update(kw)
+    return ExperimentConfig(**base)
+
+
+def _legs():
+    """[(name, cfg, make mesh, build step+args)] — mirrors the dryrun legs."""
+    import jax
+
+    import __graft_entry__ as ge
+    from induction_network_on_fewrel_tpu.parallel import make_mesh
+    from induction_network_on_fewrel_tpu.parallel.sharding import (
+        make_sharded_train_step,
+    )
+    from induction_network_on_fewrel_tpu.train.steps import init_state
+
+    def plain(cfg, mesh):
+        model, params, sup, qry, label = ge._build(cfg)
+        state = init_state(model, cfg, sup, qry)
+        step = make_sharded_train_step(model, cfg, mesh, state)
+        return step, (state, sup, qry, label)
+
+    legs = []
+
+    cfg = _tiny(dp=8)
+    legs.append(("dp8", cfg, make_mesh(dp=8), plain))
+
+    cfg = _tiny(dp=4, tp=2)
+    legs.append(("dp4_tp2", cfg, make_mesh(dp=4, tp=2), plain))
+
+    cfg = _tiny(dp=8, zero_opt=True)
+    legs.append(("dp8_zero1", cfg, make_mesh(dp=8), plain))
+
+    def sp_leg(cfg, mesh):
+        from induction_network_on_fewrel_tpu.parallel.ring import (
+            make_ring_attention,
+        )
+
+        model, params, sup, qry, label = ge._build(
+            cfg, attn_impl=make_ring_attention(mesh)
+        )
+        state = init_state(model, cfg, sup, qry)
+        step = make_sharded_train_step(model, cfg, mesh, state)
+        return step, (state, sup, qry, label)
+
+    cfg = _tiny(model="proto", encoder="transformer", tfm_layers=2,
+                tfm_model=32, tfm_heads=2, tfm_ff=64, dp=2, sp=4,
+                batch_size=2)
+    legs.append(("dp2_sp4_ring", cfg, make_mesh(dp=2, sp=4), sp_leg))
+
+    cfg = _tiny(model="proto", encoder="transformer", tfm_layers=2,
+                tfm_model=32, tfm_heads=2, tfm_ff=64, moe_experts=4,
+                moe_top_k=2, moe_every=2, dp=2, ep=4, batch_size=2)
+    legs.append(("dp2_ep4_moe", cfg, make_mesh(dp=2, ep=4), plain))
+
+    def pp_leg(cfg, mesh):
+        from induction_network_on_fewrel_tpu.parallel.pipeline import (
+            make_gpipe,
+        )
+
+        gp = make_gpipe(mesh, microbatches=cfg.pp_microbatches,
+                        batch_axis="dp" if mesh.shape["dp"] > 1 else None)
+        model, params, sup, qry, label = ge._build(cfg, pipeline_impl=gp)
+        state = init_state(model, cfg, sup, qry)
+        step = make_sharded_train_step(model, cfg, mesh, state)
+        return step, (state, sup, qry, label)
+
+    cfg = _tiny(model="proto", encoder="transformer", tfm_layers=4,
+                tfm_model=32, tfm_heads=2, tfm_ff=64, tfm_stacked=True,
+                dp=2, pp=4, pp_microbatches=2, batch_size=4)
+    legs.append(("dp2_pp4_gpipe", cfg, make_mesh(dp=2, pp=4), pp_leg))
+
+    def cached_leg(cfg, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from induction_network_on_fewrel_tpu.data import (
+            GloveTokenizer,
+            make_synthetic_fewrel,
+            make_synthetic_glove,
+        )
+        from induction_network_on_fewrel_tpu.models import build_model
+        from induction_network_on_fewrel_tpu.native.sampler import (
+            make_index_sampler,
+        )
+        from induction_network_on_fewrel_tpu.train.lazy_embed import (
+            augment_token_table,
+        )
+        from induction_network_on_fewrel_tpu.train.token_cache import (
+            make_token_cached_multi_train_step,
+            tokenize_dataset,
+        )
+
+        vocab = make_synthetic_glove(vocab_size=cfg.vocab_size - 2)
+        ds = make_synthetic_fewrel(
+            num_relations=6, instances_per_relation=cfg.k + cfg.q + 2,
+            vocab_size=cfg.vocab_size - 2,
+        )
+        tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+        table_np, sizes = tokenize_dataset(ds, tok)
+        if cfg.embed_optimizer == "lazy":
+            table_np, uids = augment_token_table(table_np)
+            table_np = {**table_np, "uids": uids}
+        table = {
+            k: jax.device_put(v, NamedSharding(mesh, PartitionSpec()))
+            for k, v in table_np.items()
+        }
+        idx = make_index_sampler(
+            sizes, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size, seed=0,
+            backend="python",
+        )
+        model = build_model(cfg, glove_init=vocab.vectors)
+        si, qi, lab = idx.sample_fused(cfg.steps_per_call)
+        sup = {k: v[si[0]] for k, v in table_np.items() if k != "uids"}
+        qry = {k: v[qi[0]] for k, v in table_np.items() if k != "uids"}
+        state = init_state(model, cfg, sup, qry)
+        step = make_token_cached_multi_train_step(model, cfg, mesh, state)
+        return step, (state, table, si, qi, lab)
+
+    # steps_per_call=1 deliberately: a fused scan's in-loop collectives
+    # print ONCE in static HLO but execute per iteration — dividing a
+    # static count by S would undercount (review finding, round 5). The
+    # S=1 compile gives the exact per-step bytes of the same body.
+    cfg = _tiny(dp=8, token_cache=True, steps_per_call=1,
+                embed_optimizer="lazy")
+    legs.append(("dp8_tokencache_lazy", cfg, make_mesh(dp=8), cached_leg))
+
+    return legs
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    import jax
+
+    if "xla_force_host_platform_device_count" in os.environ["XLA_FLAGS"]:
+        jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) >= 8, "need 8 virtual devices"
+
+    def param_count(params) -> int:
+        return sum(x.size for x in jax.tree.leaves(params))
+
+    results = {}
+    for name, cfg, mesh, build in _legs():
+        step, fn_args = build(cfg, mesh)
+        lowered = step.lower(*fn_args)
+        compiled = lowered.compile()
+        per_op = collective_bytes(compiled.as_text())
+        total = sum(v["bytes"] for v in per_op.values())
+        n_params = None
+        try:
+            n_params = param_count(fn_args[0].params)
+        except Exception:
+            pass
+        results[name] = {
+            "mesh": dict(mesh.shape),
+            "collectives": per_op,
+            "total_bytes_per_step_per_device": total,
+            "param_count": n_params,
+            "param_bytes_f32": (4 * n_params) if n_params else None,
+        }
+        print(f"{name}: {total} B/step/device, "
+              f"{ {k: v['count'] for k, v in per_op.items()} }")
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1)
+        print(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
