@@ -192,6 +192,14 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--sampler_threads", type=int, default=2, help="native sampler worker threads")
     # device / parallelism
     p.add_argument("--device", default="tpu", choices=["tpu", "cpu"])
+    p.add_argument(
+        "--compile_cache", default="auto", metavar="DIR|off",
+        help="persistent XLA compilation cache dir. Warm restarts then "
+             "skip the backend compile of the fused step (measured round "
+             "5: first call 14.2s cold -> 7.7s warm on the flagship "
+             "program; tracing/lowering still runs). 'auto' = "
+             "~/.cache/induction_tpu_xla; 'off' disables.",
+    )
     p.add_argument("--dp", type=int, default=0, help="data-parallel mesh axis (0 = all devices)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis")
     p.add_argument("--sp", type=int, default=1,
@@ -328,8 +336,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
     )
 
 
-def select_device(cfg: ExperimentConfig) -> None:
-    """Apply --device before any jax backend init.
+def select_device(cfg: ExperimentConfig, compile_cache: str = "auto") -> None:
+    """Apply --device (and the persistent compile cache) before any jax
+    backend init.
 
     --device=cpu must use the config-update path: this image's axon
     sitecustomize overrides jax_platforms, so the env var alone would still
@@ -339,6 +348,20 @@ def select_device(cfg: ExperimentConfig) -> None:
 
     if cfg.device == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    if compile_cache != "off":
+        path = (
+            os.path.expanduser("~/.cache/induction_tpu_xla")
+            if compile_cache == "auto" else compile_cache
+        )
+        try:
+            os.makedirs(path, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", path)
+            # The flagship fused program compiles in ~13 s — always worth
+            # caching; the default min-compile-time gate would skip the
+            # small eval programs, which cost little either way.
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        except Exception as e:  # noqa: BLE001 — cache is an optimization
+            print(f"compile cache disabled ({e})", file=sys.stderr)
 
 
 def load_vocab(args, cfg: ExperimentConfig):
@@ -1076,7 +1099,7 @@ def train_main(argv=None) -> int:
         # flip loss back to mse and re-create the refused combination.
         if not args.only_test:
             _check_degenerate(cfg.loss, cfg.na_rate, args.force)
-    select_device(cfg)
+    select_device(cfg, args.compile_cache)
     trainer = make_trainer(args, cfg)
     try:
         return _run_train(args, trainer)
@@ -1157,7 +1180,7 @@ def test_main(argv=None) -> int:
         return 2
     cfg = config_from_args(args)
     cfg = _merge_ckpt_architecture(cfg, args.load_ckpt or args.save_ckpt)
-    select_device(cfg)
+    select_device(cfg, args.compile_cache)
     trainer = make_trainer(args, cfg, only_test=True)
     try:
         cfg = trainer.cfg

@@ -113,12 +113,18 @@ def head_forward_flops(cfg: ExperimentConfig, H: float) -> float:
         return f
     if m == "gnn":
         G, T = B * TQ, N * K + 1
+        P = T * (T - 1) // 2                      # unordered pairs: the
+        # adjacency MLP runs the symmetric upper triangle only (round-5
+        # one-hot-matmul form, models/gnn.py); selection/reconstruction
+        # one-hot matmuls counted too.
         adj_hidden, F = 64, H + N                 # models/gnn.py defaults
         f = 0.0
         for _ in range(cfg.gnn_blocks + 1):       # blocks + readout layer
-            f += 2.0 * G * T * T * F * adj_hidden           # adjacency MLP
-            f += 2.0 * G * T * T * adj_hidden * adj_hidden
-            f += 2.0 * G * T * T * adj_hidden
+            f += 2 * 2.0 * G * P * T * F                    # pair select
+            f += 2.0 * G * P * F * adj_hidden               # adjacency MLP
+            f += 2.0 * G * P * adj_hidden * adj_hidden
+            f += 2.0 * G * P * adj_hidden
+            f += 2.0 * G * T * T * (P + 1)                  # reconstruction
             f += 2.0 * G * T * T * F                        # A @ x
             f += 2.0 * G * T * (2 * F) * cfg.gnn_dim        # gc dense
             F += cfg.gnn_dim

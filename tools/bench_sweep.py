@@ -31,7 +31,7 @@ ROUNDS = 5  # interleaved chunks per config per group
 MAX_SECONDS = 45.0  # per config within a group
 
 
-def prepare_config(name: str, cfg, adv: bool = False):
+def prepare_config(name: str, cfg, adv: bool = False, mode: str = "train"):
     import jax
 
     from induction_network_on_fewrel_tpu.data import (
@@ -76,6 +76,60 @@ def prepare_config(name: str, cfg, adv: bool = False):
         cfg, glove_init=vocab.vectors if vocab is not None else None
     )
     sup, qry, _ = batch_to_model_inputs(sampler.sample_batch())
+    if mode == "eval":
+        # EVAL-path throughput (round-5 VERDICT item 6): the fused eval —
+        # params fixed, lax.map over S stacked batches — on the cached and
+        # live transports. metrics["loss"] is stacked [S], so the shared
+        # hard-sync works unchanged.
+        from induction_network_on_fewrel_tpu.train.steps import (
+            init_state as _init_state,
+        )
+
+        S = max(cfg.steps_per_call, 1)
+        if cfg.token_cache:
+            from induction_network_on_fewrel_tpu.native.sampler import (
+                make_index_sampler,
+            )
+            from induction_network_on_fewrel_tpu.train.token_cache import (
+                make_token_cached_multi_eval_step,
+                tokenize_dataset,
+            )
+
+            if hasattr(sampler, "close"):
+                sampler.close()
+            table_np, sizes = tokenize_dataset(ds, tok)
+            table = jax.device_put(table_np)
+            isampler = make_index_sampler(
+                sizes, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size,
+                na_rate=cfg.na_rate, seed=0,
+            )
+            params = _init_state(model, cfg, sup, qry).params
+            ev = make_token_cached_multi_eval_step(model, cfg)
+
+            def step_once(params):
+                si, qi, ls = isampler.sample_fused(S)
+                return params, ev(params, table, si, qi, ls)
+
+            return _prepared(name, cfg, step_once, params, eff=S,
+                             closers=[isampler], mode="eval")
+        import numpy as np
+
+        from induction_network_on_fewrel_tpu.train.steps import (
+            make_multi_eval_step,
+        )
+
+        params = _init_state(model, cfg, sup, qry).params
+        ev = make_multi_eval_step(model, cfg)
+
+        def step_once(params):
+            bs = [batch_to_model_inputs(sampler.sample_batch())
+                  for _ in range(S)]
+            ss, qs, ls = jax.tree.map(lambda *xs: np.stack(xs), *bs)
+            return params, ev(params, ss, qs, ls)
+
+        closers = [sampler] if hasattr(sampler, "close") else []
+        return _prepared(name, cfg, step_once, params, eff=S,
+                         closers=closers, mode="eval")
     if cfg.token_cache:
         # Device-resident token table + index episodes, fused scan — the
         # production --token_cache path (train/token_cache.py).
@@ -234,14 +288,15 @@ def prepare_config(name: str, cfg, adv: bool = False):
     return _prepared(name, cfg, step_once, pack, eff=eff, closers=closers)
 
 
-def _prepared(name, cfg, step_once, pack, eff=1, closers=()):
+def _prepared(name, cfg, step_once, pack, eff=1, closers=(), mode="train"):
     return {
         "name": name, "cfg": cfg, "step_once": step_once, "pack": pack,
         "eff": eff, "closers": list(closers), "rates": [], "warmup_s": None,
+        "mode": mode,
     }
 
 
-def _row_mfu(cfg, rates):
+def _row_mfu(cfg, rates, mode="train"):
     """Median-rate MFU from the generalized analytic FLOPs model
     (utils/flops.train_step_flops — matmul terms only, 3x-forward
     convention, frozen backbones at 1x/0x). None off-TPU or for configs
@@ -264,7 +319,13 @@ def _row_mfu(cfg, rates):
         )
         if not peak:
             return None
-        per_ep = train_step_flops(cfg)["per_episode"]
+        fl = train_step_flops(cfg)
+        per_ep = fl["per_episode"]
+        if mode == "eval":
+            # Exact forward count, not per_episode/3: frozen-backbone
+            # configs already carry enc_mult=1 in the train number, so a
+            # /3 would undercount them (review finding, round 5).
+            per_ep = fl["forward"] / cfg.batch_size
         return round(statistics.median(rates) * per_ep / peak, 4)
     except Exception:  # noqa: BLE001 — accounting must never sink a row
         return None
@@ -314,10 +375,11 @@ def run_group(members, rounds: int = ROUNDS):
         p["closers"] = []
 
     prepared = []
-    for name, cfg, adv in members:
+    for member in members:
+        name, cfg, adv, mode = (*member, "train")[:4]
         p = None
         try:
-            p = prepare_config(name, cfg, adv)
+            p = prepare_config(name, cfg, adv, mode)
             t0 = time.monotonic()
             for _ in range(WARMUP):
                 p["pack"], metrics = p["step_once"](p["pack"])
@@ -353,7 +415,7 @@ def run_group(members, rounds: int = ROUNDS):
             "chunks": len(rates),
             "warmup_s": p["warmup_s"],
             "backend": jax.default_backend(),
-            "mfu": _row_mfu(p["cfg"], rates),
+            "mfu": _row_mfu(p["cfg"], rates, p.get("mode", "train")),
         }
         if "error" in p:
             row["error"] = p["error"]
@@ -422,6 +484,24 @@ def main() -> int:
           tc(encoder="cnn", n=5, k=5, q=5, model=m, steps_per_call=64), False)
          for m in ("induction", "proto", "proto_hatt", "siamese",
                    "gnn", "snail", "metanet")],
+        # EVAL-path rows (round-5 VERDICT item 6): the fused eval at the
+        # flagship shape on both transports, interleaved with each other.
+        # embed_optimizer is train-side machinery; eval scores params as
+        # they are, so "shared" keeps the table untouched.
+        [("8t: flagship EVAL token_cache (fused lax.map)",
+          tc(encoder="bilstm", n=5, k=5, q=5, batch_size=64,
+             vocab_size=400002, steps_per_call=256), False, "eval"),
+         ("8L: flagship EVAL live tokens (fused)",
+          ExperimentConfig(
+              encoder="bilstm", n=5, k=5, q=5, vocab_size=400002,
+              max_length=40, compute_dtype="bfloat16", batch_size=64,
+              steps_per_call=64), False, "eval")],
+        # BERT fine-tune MFU row (round-5 VERDICT item 5a): the UNFROZEN
+        # backbone — enc_mult=3 in utils/flops.py — so the fine-tune
+        # regime finally carries an MFU number next to the frozen path's.
+        [("9f: 5w5s bert-base FINE-TUNE (unfrozen)", ExperimentConfig(
+            encoder="bert", n=5, k=5, q=5, bert_frozen=False,
+            **{**base, "batch_size": 2, "steps_per_call": 8}), False)],
         [("6s: 400k-vocab B64 embed=shared (dense Adam)",
           tc(encoder="bilstm", n=5, k=5, q=5, batch_size=64, vocab_size=400002,
              steps_per_call=256, embed_optimizer="shared"), False),

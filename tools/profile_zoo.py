@@ -33,7 +33,7 @@ def _collapse(name: str) -> str:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("model", choices=["gnn", "snail", "pair"])
+    ap.add_argument("model", choices=["gnn", "snail", "pair", "cnn1shot"])
     ap.add_argument("--top", type=int, default=20)
     args = ap.parse_args()
 
@@ -52,6 +52,15 @@ def main() -> int:
         cfg = ExperimentConfig(
             encoder="bert", model="pair", n=5, k=5, q=5,
             **{**base, "batch_size": 1, "steps_per_call": 2},
+        )
+    elif args.model == "cnn1shot":
+        # The CNN cached headline (sweep row 1t, round-5 VERDICT item 5b):
+        # 5w1s induction on the token-cache fused path — the highest
+        # eps/s row in the sweep at the lowest MFU; this trace answers
+        # whether the bound is gathers/dispatch or something fixable.
+        cfg = ExperimentConfig(
+            encoder="cnn", n=5, k=1, q=5, token_cache=True,
+            steps_per_call=512, **base,
         )
     else:
         cfg = ExperimentConfig(

@@ -1,0 +1,285 @@
+#!/usr/bin/env python3
+"""Flagship roofline ledger (round-5 VERDICT item 3): predicted step-time
+floor from per-component HBM bytes + MXU FLOPs vs the measured step time.
+
+Three parts, all measured/derived on THIS chip in one run:
+
+1. **Calibration** — effective HBM bandwidth (IN-JIT streaming loop: 50
+   iterations of a 3-array f32 saxpy inside one compiled program) and
+   effective MXU throughput (serialized bf16 4096^2 matmul chain). The
+   sandbox v5e behind the axon tunnel delivers a fraction of nominal
+   (measured round 5: ~284-297 GB/s of 819, ~80-91 TFLOP/s of 197) —
+   the ledger uses the MEASURED numbers, so the prediction targets this
+   chip, then projects to production silicon. (A per-dispatch probe
+   reads only ~65 GB/s — that is tunnel launch gap, NOT HBM; see
+   calibrate() — and must never be used as a denominator.)
+2. **Analytic ledger** — per-component bytes and FLOPs for one training
+   step of the flagship config (B=64 5w5s, bilstm L=40, token-cache lazy).
+   Every formula is written out below; component time floor =
+   max(bytes / BW, flops / MXU)  (bandwidth- and compute-bound phases
+   cannot overlap below this).
+3. **Measurement** — one hard-synced fused call of the real production
+   step (bench.py machinery) -> measured ms/step to compare.
+
+Usage:  python tools/roofline_ledger.py [--spc 256] [--skip-measure]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NOMINAL_BW = 819e9      # v5e HBM GB/s (public spec)
+NOMINAL_MXU = 197e12    # v5e bf16 TFLOP/s (public spec)
+
+
+def calibrate(jax):
+    import numpy as np
+
+    jnp = jax.numpy
+    n = 64 * 1024 * 1024
+    x = jnp.ones((n,), jnp.float32)
+    # IN-JIT loop (one dispatch, 50 iterations of z = z*c + x, 3 arrays of
+    # HBM traffic each): measures the bandwidth a compiled program's
+    # interior actually gets. A per-dispatch probe on this tunneled
+    # backend reads ~65 GB/s — that is queue/launch gap, not HBM (measured
+    # round 5: in-jit 295 GB/s vs dispatch-level 65); step-internal
+    # accounting must use the in-jit number.
+    f = jax.jit(lambda z: jax.lax.scan(
+        lambda z, _: (z * 0.999 + x, None), z, None, length=50)[0])
+    z = f(x)
+    _ = float(z[0])
+    t0 = time.monotonic()
+    z = f(z)
+    _ = float(z[0])
+    bw = 3 * n * 4 * 50 / (time.monotonic() - t0)
+
+    k, iters = 4096, 100
+    a = (jax.random.normal(jax.random.key(0), (k, k), jnp.float32)
+         / np.sqrt(k)).astype(jnp.bfloat16)
+    mm = jax.jit(lambda c: jax.lax.scan(
+        lambda c, _: ((a @ c).astype(jnp.bfloat16), None), c, None,
+        length=iters)[0])
+    c = mm(jnp.eye(k, dtype=jnp.bfloat16))
+    _ = float(c[0, 0])
+    t0 = time.monotonic()
+    c = mm(c)
+    _ = float(c[0, 0])
+    mxu = 2 * k**3 * iters / (time.monotonic() - t0)
+    return bw, mxu
+
+
+def ledger(cfg) -> list[tuple[str, float, float]]:
+    """[(component, bytes/step, flops/step)] for the flagship train step.
+
+    Shapes: rows M = B*(N*K + N*Q) support+query concat-encoded; L tokens;
+    D = word+2*pos embedding width; u LSTM hidden/direction; A att_dim;
+    C induction_dim; H ntn_slices; bf16 activations (2 B), f32 head +
+    optimizer (4 B). Backward traffic follows the round-4 fused-kernel
+    design: recompute-gates backward re-reads emb and h/c state; dW/db
+    accumulate in VMEM (no HBM traffic).
+    """
+    B, N, K, Q, L = cfg.batch_size, cfg.n, cfg.k, cfg.q, cfg.max_length
+    TQ = N * Q
+    M = B * (N * K + TQ)
+    D = cfg.word_dim + 2 * cfg.pos_dim
+    u = cfg.lstm_hidden
+    A = cfg.att_dim
+    C = cfg.induction_dim
+    H = cfg.ntn_slices
+    bf, f32 = 2, 4
+
+    emb_b = L * M * D * bf          # [L, M, D] bf16, the gathered embedding
+    hs_b = L * M * 2 * u * bf       # [L, M, 2u] hidden states
+    rows = []
+
+    # L3 embedding: id gathers read the table rows and write emb_t; the
+    # windowed pos-offset matmul touches [L+1, L*P] windows (negligible).
+    rows.append(("embed gather fwd (write emb + read table)", 2 * emb_b, 0))
+
+    # Fused BiLSTM kernel FWD: reads emb_t once (gates computed in-kernel
+    # from the 60-wide embedding), writes hs AND cs (saved for backward).
+    proj_f = 2 * L * M * D * (8 * u)          # input projection, both dirs
+    rec_f = 2 * L * M * u * (4 * u) * 2       # recurrence h@whh, both dirs
+    rows.append(("bilstm kernel fwd", emb_b + 2 * hs_b, proj_f + rec_f))
+
+    # Self-attention FWD: proj reads hs, writes [L,M,A]; weighted-sum
+    # einsum reads hs again, writes [M, 2u].
+    att_f = 2 * L * M * 2 * u * A + 2 * L * M * 2 * u
+    rows.append((
+        "self-attn fwd", 2 * hs_b + L * M * A * bf + M * 2 * u * bf, att_f
+    ))
+
+    # Episode head FWD (f32): induction transform + routing + NTN.
+    e_b = B * (N * K + TQ) // 1  # episode rows
+    ind_f = 2 * B * N * K * 2 * u * C + 3 * (2 * B * N * K * C * 2)
+    qp_f = 2 * B * TQ * 2 * u * C
+    ntn_f = 2 * B * N * C * C * H + 2 * B * TQ * N * C * H
+    head_b = (B * (N * K + TQ) * 2 * u * f32      # enc rows f32
+              + B * N * H * C * f32               # cM
+              + B * TQ * N * H * f32)             # v
+    rows.append(("episode head fwd (f32)", head_b, ind_f + qp_f + ntn_f))
+
+    # BACKWARD: head + attention + kernel. Convention: ~2x forward FLOPs
+    # (dX and dW products), bytes re-read forward residuals + write grads.
+    rows.append(("episode head bwd", 2 * head_b, 2 * (ind_f + qp_f + ntn_f)))
+    rows.append(("self-attn bwd", 3 * hs_b + L * M * A * bf, 2 * att_f))
+    # Kernel bwd (recompute gates): reads hs, cs, emb, d(hs); writes demb.
+    # dW/db accumulate in VMEM -> no HBM term.
+    rows.append((
+        "bilstm kernel bwd (recompute gates)",
+        3 * hs_b + 2 * emb_b, 2 * (proj_f + rec_f) + proj_f,
+    ))
+    rows.append(("embed scatter bwd (demb -> rows)", 2 * emb_b, 0))
+
+    # Optimizer (f32): non-embedding params p, m, v read + write, grads
+    # read. Lazy embed: only the batch's unique rows (<= M*L token ids,
+    # bounded by the corpus) touch their table/moment rows.
+    n_main = (
+        2 * D * 4 * u + 2 * u * 4 * u + 2 * 4 * u      # lstm
+        + 2 * u * A + A                                 # attention
+        + 2 * u * C + C + 2 * u * C + C                 # induction + qproj
+        + H * C * C + H + 1                             # ntn
+        + 2 * (2 * L) * cfg.pos_dim                     # pos tables
+    )
+    rows.append(("optimizer main (Adam, f32)", 7 * n_main * f32, 0))
+    u_rows = min(M * L, 2002)   # unique ids, corpus-bounded (synthetic)
+    rows.append((
+        "lazy embed rows (gather+Adam+scatter)",
+        u_rows * cfg.word_dim * f32 * 8, 0,
+    ))
+    return rows
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--spc", type=int, default=256)
+    ap.add_argument("--skip-measure", action="store_true")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+
+    cfg = ExperimentConfig(
+        encoder="bilstm", n=5, k=5, q=5, batch_size=64, max_length=40,
+        vocab_size=400002, compute_dtype="bfloat16",
+        steps_per_call=args.spc, token_cache=True, embed_optimizer="lazy",
+    )
+
+    bw, mxu = calibrate(jax)
+    print(f"calibrated: HBM {bw / 1e9:.1f} GB/s "
+          f"({bw / NOMINAL_BW:.1%} of nominal), "
+          f"MXU {mxu / 1e12:.1f} TFLOP/s ({mxu / NOMINAL_MXU:.1%})")
+
+    rows = ledger(cfg)
+    total_b = sum(r[1] for r in rows)
+    total_f = sum(r[2] for r in rows)
+    print(f"\n{'component':45s} {'MB/step':>8s} {'GFLOP':>7s} "
+          f"{'t_bw ms':>8s} {'t_mxu ms':>8s} {'floor ms':>8s}")
+    floor = 0.0
+    for name, b, f in rows:
+        tb, tf = b / bw * 1e3, f / mxu * 1e3
+        floor += max(tb, tf)
+        print(f"{name:45s} {b / 1e6:8.1f} {f / 1e9:7.1f} "
+              f"{tb:8.3f} {tf:8.3f} {max(tb, tf):8.3f}")
+    print(f"{'TOTAL':45s} {total_b / 1e6:8.1f} {total_f / 1e9:7.1f} "
+          f"{'':8s} {'':8s} {floor:8.3f}")
+
+    # Production-silicon projection at nominal BW/MXU.
+    floor_prod = sum(
+        max(b / NOMINAL_BW, f / NOMINAL_MXU) * 1e3 for _, b, f in rows
+    )
+    eps_prod = cfg.batch_size / (floor_prod / 1e3)
+    print(f"\nprojected floor on nominal v5e (819 GB/s, 197 TF/s): "
+          f"{floor_prod:.3f} ms/step -> {eps_prod:,.0f} eps/s/chip ceiling")
+
+    measured = None
+    if not args.skip_measure:
+        print("\nmeasuring one fused call of the production step...")
+        from induction_network_on_fewrel_tpu.data import (
+            GloveTokenizer,
+            make_synthetic_fewrel,
+            make_synthetic_glove,
+        )
+        from induction_network_on_fewrel_tpu.models import build_model
+        from induction_network_on_fewrel_tpu.native.sampler import (
+            make_index_sampler,
+        )
+        from induction_network_on_fewrel_tpu.train.lazy_embed import (
+            augment_token_table,
+        )
+        from induction_network_on_fewrel_tpu.train.steps import init_state
+        from induction_network_on_fewrel_tpu.train.token_cache import (
+            make_token_cached_multi_train_step,
+            tokenize_dataset,
+        )
+
+        vocab = make_synthetic_glove(vocab_size=cfg.vocab_size - 2)
+        ds = make_synthetic_fewrel(
+            num_relations=20, instances_per_relation=cfg.k + cfg.q + 5,
+            vocab_size=min(cfg.vocab_size - 2, 2000),
+        )
+        tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+        table_np, sizes = tokenize_dataset(ds, tok)
+        table_np, uids = augment_token_table(table_np)
+        table_np = {**table_np, "uids": uids}
+        table = jax.device_put(table_np)
+        sampler = make_index_sampler(
+            sizes, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size, seed=0
+        )
+        model = build_model(cfg, glove_init=vocab.vectors)
+        b0s, b0q, _ = sampler.sample_fused(1)
+        sup = {k: v[b0s[0]] for k, v in table_np.items() if k != "uids"}
+        qry = {k: v[b0q[0]] for k, v in table_np.items() if k != "uids"}
+        state = init_state(model, cfg, sup, qry)
+        multi = make_token_cached_multi_train_step(model, cfg)
+
+        def call(state):
+            si, qi, lab = sampler.sample_fused(args.spc)
+            return multi(state, table, si, qi, lab)
+
+        for _ in range(2):
+            state, m = call(state)
+        _ = float(jax.device_get(m["loss"])[-1])
+        best = None
+        for _ in range(3):
+            t0 = time.monotonic()
+            state, m = call(state)
+            _ = float(jax.device_get(m["loss"])[-1])
+            dt = time.monotonic() - t0
+            best = dt if best is None else min(best, dt)
+        sampler.close()
+        measured = best / args.spc * 1e3
+        print(f"measured: {best:.3f} s/call -> {measured:.3f} ms/step "
+              f"({cfg.batch_size / (best / args.spc):,.0f} eps/s/chip); "
+              f"predicted floor {floor:.3f} ms/step "
+              f"-> floor/measured = {floor / measured:.1%}")
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({
+                "calibrated_bw_GBs": round(bw / 1e9, 1),
+                "calibrated_mxu_TFs": round(mxu / 1e12, 1),
+                "components": [
+                    {"name": n, "bytes": b, "flops": fl}
+                    for n, b, fl in rows
+                ],
+                "floor_ms_this_chip": round(floor, 3),
+                "floor_ms_nominal_v5e": round(floor_prod, 3),
+                "measured_ms_per_step": (
+                    round(measured, 3) if measured else None
+                ),
+            }, f, indent=1)
+        print(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
