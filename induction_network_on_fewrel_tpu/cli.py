@@ -70,6 +70,14 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
         choices=["auto", "scan", "pallas", "interpret"],
         help="LSTM recurrence impl: pallas = fused TPU kernel (auto on TPU)",
     )
+    p.add_argument(
+        "--attn_backend", default="auto",
+        choices=["auto", "xla", "pallas", "interpret"],
+        help="self-attention impl: auto = the two-pass XLA form (measured "
+             "faster than the fused kernel on this chip, BASELINE.md "
+             "round 5); pallas = the fused one-pass online-softmax kernel, "
+             "kept selectable for A/Bs on other silicon",
+    )
     p.add_argument("--induction_dim", type=int, default=100)
     p.add_argument("--routing_iters", type=int, default=3)
     p.add_argument("--ntn_slices", type=int, default=100)
@@ -295,6 +303,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         snail_tc_filters=args.snail_tc_filters,
         encoder=args.encoder, hidden_size=args.hidden_size,
         lstm_hidden=args.lstm_hidden, lstm_backend=args.lstm_backend,
+        attn_backend=args.attn_backend,
         tfm_layers=args.tfm_layers, tfm_model=args.tfm_model,
         tfm_heads=args.tfm_heads, tfm_ff=args.tfm_ff,
         moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,

@@ -48,6 +48,12 @@ class ExperimentConfig:
     lstm_hidden: int = 128    # per direction
     att_dim: int = 64         # structured self-attention projection dim
     lstm_backend: str = "auto"  # auto | scan | pallas | interpret (ops/lstm.py)
+    # Self-attention impl (ops/attn.py): "auto" resolves to the two-pass
+    # XLA form on EVERY backend (the fused kernel measured 0.97-0.98x of
+    # it on this chip — BASELINE.md round-5 rejection; re-A/B on other
+    # silicon before flipping). Not an architecture field — params and
+    # math are backend-independent, like lstm_backend.
+    attn_backend: str = "auto"  # auto | xla | pallas | interpret
     # BERT (built from scratch in models/bert.py; random-init unless weights
     # are found on disk — this sandbox has no network):
     bert_layers: int = 12

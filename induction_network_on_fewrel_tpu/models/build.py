@@ -155,9 +155,21 @@ def build_model(
                 import jax
 
                 backend = "pallas" if jax.default_backend() == "tpu" else "scan"
+            attn = getattr(cfg, "attn_backend", "auto")
+            if attn == "auto":
+                # auto = the TWO-PASS XLA form on every backend: the fused
+                # online-softmax kernel (ops/attn.py) was measured
+                # INTERLEAVED at 0.97-0.98x of XLA on the flagship step
+                # (BASELINE.md round 5) — XLA's flat [L*M, 2u] matmuls beat
+                # the kernel's chunked pipeline at L=40, and attention is
+                # only ~28% of step bytes (Amdahl caps the perfect-fusion
+                # win at ~10%). The kernel stays selectable for A/Bs on
+                # real silicon, where the bandwidth:compute ratio flips.
+                attn = "xla"
             encoder = BiLSTMSelfAttnEncoder(
                 lstm_hidden=cfg.lstm_hidden, att_dim=cfg.att_dim,
-                lstm_backend=backend, compute_dtype=dtype,
+                lstm_backend=backend, attn_backend=attn,
+                compute_dtype=dtype,
             )
         else:
             raise ValueError(f"unknown encoder {cfg.encoder!r}")

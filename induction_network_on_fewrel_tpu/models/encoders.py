@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from induction_network_on_fewrel_tpu.ops import masked_max, masked_softmax
+from induction_network_on_fewrel_tpu.ops.attn import masked_selfattn_tm
 from induction_network_on_fewrel_tpu.ops.lstm import bilstm_encoder_tm
 
 
@@ -77,6 +78,12 @@ class BiLSTMSelfAttnEncoder(nn.Module):
     lstm_hidden: int = 128   # per direction; output dim is 2*lstm_hidden
     att_dim: int = 64
     lstm_backend: str = "scan"  # scan | pallas | interpret (ops/lstm.py)
+    # Attention impl (ops/attn.py): "xla" = two-pass (projection pass +
+    # weighted-sum pass; each reads H from HBM), "pallas"/"interpret" =
+    # fused one-pass online-softmax kernel (H read once per direction of
+    # the pass; the round-5 roofline puts the two-pass attention at ~40%
+    # of the flagship step's HBM bytes). Same params either way.
+    attn_backend: str = "xla"
     compute_dtype: jnp.dtype = jnp.float32
     # Callers that can supply embeddings already time-major ([L, M, D])
     # should: FewShotModel.encode then transposes the int IDS before the
@@ -131,16 +138,25 @@ class BiLSTMSelfAttnEncoder(nn.Module):
 
         # Structured self-attention (Lin et al. 2017 form used by the paper):
         # scores = w2 · tanh(W1 hᵀ), masked softmax over L (axis 0 here).
-        proj = nn.Dense(
-            self.att_dim, use_bias=False, dtype=self.compute_dtype, param_dtype=jnp.float32
-        )(H)
-        scores = nn.Dense(
-            1, use_bias=False, dtype=self.compute_dtype, param_dtype=jnp.float32
-        )(jnp.tanh(proj))[..., 0]                      # [L, M]
+        # Explicit params (not nn.Dense) so the fused kernel and the
+        # two-pass path share one tree — checkpoint format v4.
+        att_w1 = self.param(
+            "att_w1", nn.initializers.lecun_normal(), (2 * u, self.att_dim)
+        )
+        att_w2 = self.param(
+            "att_w2", nn.initializers.lecun_normal(), (self.att_dim, 1)
+        )
+        if self.attn_backend != "xla":
+            return masked_selfattn_tm(
+                H, mask, att_w1, att_w2, backend=self.attn_backend
+            )
+        cd = self.compute_dtype
+        proj = H @ att_w1.astype(cd)
+        scores = (jnp.tanh(proj) @ att_w2.astype(cd))[..., 0]  # [L, M]
         att = masked_softmax(
             scores.astype(jnp.float32), jnp.swapaxes(mask, 0, 1), axis=0
         )
-        return jnp.einsum("lm,lmh->mh", att.astype(self.compute_dtype), H)
+        return jnp.einsum("lm,lmh->mh", att.astype(cd), H)
 
     @property
     def output_dim(self) -> int:

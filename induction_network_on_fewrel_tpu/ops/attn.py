@@ -1,0 +1,317 @@
+"""Fused structured self-attention over the time axis (Pallas TPU kernel).
+
+The BiLSTM encoder's attention — ``scores = w2·tanh(W1·h_t)``, masked
+softmax over L, ``out = Σ_t a_t h_t`` (Lin et al. 2017 form, SURVEY.md
+§2.1 "BiLSTM + self-attention") — is HBM-bandwidth-bound, not FLOP-bound:
+the round-5 roofline ledger puts its fwd+bwd at ~362 MB/step of the
+flagship's 894 MB total, with XLA reading the [L, M, 2u] hidden states
+twice forward (projection pass + weighted-sum pass) and ~three times
+backward. This kernel computes the whole thing in ONE pass over L each
+way using a flash-attention-style ONLINE softmax over the time axis:
+
+  forward   m, d, acc  ← running max / normalizer / weighted sum; H read
+            once, out [M, 2u] written once. Row max/normalizer (tiny,
+            [M] each) are the only extra residuals.
+  backward  a_t is reconstructed per step from the saved (max, denom) —
+            no second online pass — and dH_t = a_t·dout + (ds_t path
+            through tanh/W1) is written in one pass; dW1/dw2 accumulate
+            in VMEM scratch (no HBM traffic), per-tile partials summed
+            outside (the ops/lstm.py dwhh pattern).
+
+Numerics match ops.core.masked_softmax exactly in exact arithmetic: the
+online normalizer ends at Σ exp(s_t − max) and the denominator adds the
+same 1e-13; fully-masked rows produce exact zeros (e is multiplied by
+the 0/1 mask AFTER the shift, so the all-masked normalizer is 0 and
+out = 0/1e-13 = 0). Internal math is float32 regardless of H's dtype.
+
+Layout follows ops/lstm.py: everything TIME-MAJOR, the iterated time axis
+a leading block dim of size 1, rows padded to the 128-row MXU tile with
+padded rows masked (their outputs and gradients are exact zeros).
+
+Backends: "xla" (two-pass reference, pure jnp — also the scan twin the
+tests compare against), "pallas" (compiled TPU kernel), "interpret"
+(same kernel code on the Pallas interpreter; CPU-runnable).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_TM = 128  # row tile (MXU systolic dimension); rows pad up to one tile
+_TL = 8    # time steps per grid invocation: 1000 -> 125 grid steps at the
+           # flagship shape, and each projection matmul sees TL*TM rows
+           # (the per-time-step form lost 0.81x to XLA - grid overhead
+           # swamped the byte savings; chip-measured round 5)
+_NEG = -1e30
+
+
+def masked_selfattn_tm(
+    H_t: jnp.ndarray,      # [L, M, D] hidden states, time-major
+    mask: jnp.ndarray,     # [M, L] (any numeric; >0 = valid token)
+    w1: jnp.ndarray,       # [D, A] projection (f32 param)
+    w2: jnp.ndarray,       # [A, 1] score vector (f32 param)
+    backend: str = "xla",
+) -> jnp.ndarray:          # [M, D] sentence vectors
+    if backend == "xla":
+        return _attn_reference(H_t, mask, w1, w2)
+    if backend in ("pallas", "interpret"):
+        return _attn_pallas(H_t, mask, w1, w2, backend == "interpret")
+    raise ValueError(f"unknown attention backend {backend!r}")
+
+
+def _attn_reference(H_t, mask, w1, w2):
+    """Two-pass jnp twin (f32 internal math, same as the kernel)."""
+    H32 = H_t.astype(jnp.float32)
+    s = (jnp.tanh(H32 @ w1) @ w2)[..., 0]               # [L, M]
+    mk = (jnp.swapaxes(mask, 0, 1) > 0)                 # [L, M]
+    s = jnp.where(mk, s, _NEG)
+    e = jnp.exp(s - jnp.max(s, axis=0, keepdims=True)) * mk
+    a = e / (jnp.sum(e, axis=0, keepdims=True) + 1e-13)
+    return jnp.einsum("lm,lmd->md", a, H32).astype(H_t.dtype)
+
+
+# --- kernels ---------------------------------------------------------------
+
+
+def _score(h32, mask_col, w1_ref, w2_ref):
+    """[TL, TM, D] f32 rows + [TL, TM, 1] 0/1 mask -> masked scores
+    [TL, TM, 1] and the tanh projection [TL, TM, A] (backward reuses it).
+    The projection runs as ONE [TL*TM, D] x [D, A] MXU matmul."""
+    TL, TM, D = h32.shape
+    A = w1_ref.shape[1]
+    t = jnp.tanh(jnp.dot(
+        h32.reshape(TL * TM, D), w1_ref[...],
+        preferred_element_type=jnp.float32,
+    )).reshape(TL, TM, A)
+    s = jnp.dot(
+        t.reshape(TL * TM, A), w2_ref[...],
+        preferred_element_type=jnp.float32,
+    ).reshape(TL, TM, 1)
+    return jnp.where(mask_col > 0, s, _NEG), t
+
+
+def _make_fwd_kernel(with_stats: bool):
+    """ONE online-softmax forward body; ``with_stats`` (a Python-level
+    closure flag) decides whether the softmax stats outputs exist and are
+    written at the last chunk — the no-grad primal and the vjp-forward
+    must share their numerics by construction, not by parallel edits."""
+
+    def kernel(H_ref, mask_ref, w1_ref, w2_ref, out_ref, *rest):
+        if with_stats:
+            mx_ref, dn_ref, acc_scr, m_scr, d_scr = rest
+        else:
+            acc_scr, m_scr, d_scr = rest
+        t = pl.program_id(1)
+        L = pl.num_programs(1)
+
+        @pl.when(t == 0)
+        def _():
+            acc_scr[...] = jnp.zeros_like(acc_scr)
+            m_scr[...] = jnp.full_like(m_scr, _NEG)
+            d_scr[...] = jnp.zeros_like(d_scr)
+
+        h32 = H_ref[...].astype(jnp.float32)            # [TL, TM, D]
+        mask_col = mask_ref[...]                        # [TL, TM, 1]
+        s, _ = _score(h32, mask_col, w1_ref, w2_ref)    # [TL, TM, 1]
+        m_new = jnp.maximum(m_scr[...], s.max(axis=0))
+        corr = jnp.exp(m_scr[...] - m_new)
+        e = jnp.exp(s - m_new[None]) * (mask_col > 0)   # [TL, TM, 1]
+        acc_scr[...] = acc_scr[...] * corr + jnp.sum(e * h32, axis=0)
+        d_scr[...] = d_scr[...] * corr + jnp.sum(e, axis=0)
+        m_scr[...] = m_new
+
+        @pl.when(t == L - 1)
+        def _():
+            out_ref[...] = (acc_scr[...] / (d_scr[...] + 1e-13)).astype(
+                out_ref.dtype
+            )
+            if with_stats:
+                mx_ref[0] = m_scr[...][:, 0]
+                dn_ref[0] = d_scr[...][:, 0]
+
+    return kernel
+
+
+_fwd_kernel = _make_fwd_kernel(with_stats=True)
+_fwd_kernel_infer = _make_fwd_kernel(with_stats=False)
+
+
+def _bwd_kernel(H_ref, mask_ref, w1_ref, w2_ref, out_ref, mx_ref, dn_ref,
+                dout_ref, dH_ref, dw1_ref, dw2_ref,
+                c_scr, dw1_scr, dw2_scr):
+    t = pl.program_id(1)
+
+    h32 = H_ref[...].astype(jnp.float32)                # [TL, TM, D]
+    mask_col = mask_ref[...]                            # [TL, TM, 1]
+    do = dout_ref[...].astype(jnp.float32)              # [TM, D]
+
+    @pl.when(t == 0)
+    def _():
+        c_scr[...] = jnp.sum(
+            do * out_ref[...].astype(jnp.float32), axis=1, keepdims=True
+        )
+        dw1_scr[...] = jnp.zeros_like(dw1_scr)
+        dw2_scr[...] = jnp.zeros_like(dw2_scr)
+
+    s, tl = _score(h32, mask_col, w1_ref, w2_ref)       # [TL, TM, *]
+    TL, TM, D = h32.shape
+    A = tl.shape[-1]
+    mx = mx_ref[0][:, None]                             # [TM, 1]
+    dn = dn_ref[0][:, None]
+    a = jnp.exp(s - mx[None]) * (mask_col > 0) / (dn[None] + 1e-13)
+    # Softmax-through-weighted-sum backward: ds_t = a_t (dout·h_t − dout·out)
+    ds = a * (jnp.sum(do[None] * h32, axis=-1, keepdims=True) - c_scr[...][None])
+    dproj = (ds * (1.0 - tl * tl)) * w2_ref[...][:, 0][None, None, :]
+    dh = a * do[None] + jax.lax.dot_general(
+        dproj.reshape(TL * TM, A), w1_ref[...],
+        (((1,), (1,)), ((), ())),                       # dproj @ w1^T
+        preferred_element_type=jnp.float32,
+    ).reshape(TL, TM, D)
+    dH_ref[...] = dh.astype(dH_ref.dtype)
+    dw1_scr[...] += jax.lax.dot_general(
+        h32.reshape(TL * TM, D), dproj.reshape(TL * TM, A),
+        (((0,), (0,)), ((), ())),                       # h^T @ dproj
+        preferred_element_type=jnp.float32,
+    )
+    dw2_scr[...] += jnp.sum(tl * ds, axis=(0, 1))[None]          # [1, A]
+    dw1_ref[0] = dw1_scr[...]
+    dw2_ref[0] = dw2_scr[...]
+
+
+# --- calls -----------------------------------------------------------------
+
+
+def _pad_rows(H_t, mask):
+    """Pad rows to the _TM tile AND time to the _TL chunk; padded entries
+    carry mask 0, so they contribute exact zeros everywhere."""
+    L, M, D = H_t.shape
+    pad_m = (-M) % _TM
+    pad_l = (-L) % _TL
+    if pad_m or pad_l:
+        H_t = jnp.pad(H_t, ((0, pad_l), (0, pad_m), (0, 0)))
+        mask = jnp.pad(mask, ((0, pad_l), (0, pad_m)))  # mask_t [L, M]
+    return H_t, mask[..., None], M + pad_m        # mask -> [Lp, Mp, 1]
+
+
+def _common_specs(D, A):
+    # mask rides as [Lp, Mp, 1] (not [Lp, Mp]): the TPU lowering constrains
+    # the LAST TWO block dims to 8/128-divisible-or-full, which a (TL, TM)
+    # block of a 2-D [Lp, Mp] array violates at L=40 (chip-caught round 5);
+    # the trailing singleton makes the constrained dims (TM, 1) = ok.
+    return [
+        pl.BlockSpec((_TL, _TM, D), lambda i, t: (t, i, 0)),   # H
+        pl.BlockSpec((_TL, _TM, 1), lambda i, t: (t, i, 0)),   # mask_t
+        pl.BlockSpec((D, A), lambda i, t: (0, 0)),             # w1 (full)
+        pl.BlockSpec((A, 1), lambda i, t: (0, 0)),             # w2 (full)
+    ]
+
+
+def _fwd_call(H_t, mask_t, w1, w2, interpret, with_stats):
+    Lp, Mp, D = H_t.shape
+    A = w1.shape[1]
+    tiles = Mp // _TM
+    grid = (tiles, Lp // _TL)
+    scratch = [
+        pltpu.VMEM((_TM, D), jnp.float32),
+        pltpu.VMEM((_TM, 1), jnp.float32),
+        pltpu.VMEM((_TM, 1), jnp.float32),
+    ]
+    out_spec = pl.BlockSpec((_TM, D), lambda i, t: (i, 0))
+    if not with_stats:
+        return pl.pallas_call(
+            _fwd_kernel_infer,
+            grid=grid,
+            in_specs=_common_specs(D, A),
+            out_specs=out_spec,
+            out_shape=jax.ShapeDtypeStruct((Mp, D), H_t.dtype),
+            scratch_shapes=scratch,
+            interpret=interpret,
+        )(H_t, mask_t, w1, w2)
+    stat = pl.BlockSpec((1, _TM), lambda i, t: (0, i))
+    return pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=_common_specs(D, A),
+        out_specs=[out_spec, stat, stat],
+        out_shape=[
+            jax.ShapeDtypeStruct((Mp, D), H_t.dtype),
+            jax.ShapeDtypeStruct((1, Mp), jnp.float32),
+            jax.ShapeDtypeStruct((1, Mp), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(H_t, mask_t, w1, w2)
+
+
+def _bwd_call(H_t, mask_t, w1, w2, out, mx, dn, dout, interpret):
+    Lp, Mp, D = H_t.shape
+    A = w1.shape[1]
+    tiles = Mp // _TM
+    grid = (tiles, Lp // _TL)
+    row = pl.BlockSpec((_TM, D), lambda i, t: (i, 0))
+    stat = pl.BlockSpec((1, _TM), lambda i, t: (0, i))
+    dH, dw1_p, dw2_p = pl.pallas_call(
+        _bwd_kernel,
+        grid=grid,
+        in_specs=_common_specs(D, A) + [row, stat, stat, row],
+        out_specs=[
+            pl.BlockSpec((_TL, _TM, D), lambda i, t: (t, i, 0)),
+            pl.BlockSpec((1, D, A), lambda i, t: (i, 0, 0)),
+            pl.BlockSpec((1, 1, A), lambda i, t: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Lp, Mp, D), H_t.dtype),
+            jax.ShapeDtypeStruct((tiles, D, A), jnp.float32),
+            jax.ShapeDtypeStruct((tiles, 1, A), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_TM, 1), jnp.float32),
+            pltpu.VMEM((D, A), jnp.float32),
+            pltpu.VMEM((1, A), jnp.float32),
+        ],
+        interpret=interpret,
+    )(H_t, mask_t, w1, w2, out, mx, dn, dout)
+    return dH, dw1_p.sum(axis=0), dw2_p.sum(axis=0).reshape(A, 1)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _attn_core(H_t, mask_t, w1, w2, interpret=False):
+    """mask_t: [L, M] float32 (0/1). The wrapper below prepares it."""
+    L, M, D = H_t.shape
+    H_p, mask_p, Mp = _pad_rows(H_t, mask_t)
+    out = _fwd_call(H_p, mask_p, w1, w2, interpret, with_stats=False)
+    return out[:M]
+
+
+def _attn_core_fwd(H_t, mask_t, w1, w2, interpret):
+    L, M, D = H_t.shape
+    H_p, mask_p, Mp = _pad_rows(H_t, mask_t)
+    out, mx, dn = _fwd_call(H_p, mask_p, w1, w2, interpret, with_stats=True)
+    return out[:M], (H_p, mask_p, w1, w2, out, mx, dn, L, M, mask_t.shape)
+
+
+def _attn_core_bwd(interpret, res, dout):
+    H_p, mask_p, w1, w2, out, mx, dn, L, M, mshape = res
+    Lp, Mp, D = H_p.shape
+    if Mp != M:
+        dout = jnp.pad(dout, ((0, Mp - M), (0, 0)))
+    dH, dw1, dw2 = _bwd_call(
+        H_p, mask_p, w1, w2, out, mx, dn, dout.astype(H_p.dtype), interpret
+    )
+    # The mask is a 0/1 gate: zero cotangent (f32 zeros, DCE'd by XLA).
+    return dH[:L, :M], jnp.zeros(mshape, jnp.float32), dw1, dw2
+
+
+_attn_core.defvjp(_attn_core_fwd, _attn_core_bwd)
+
+
+def _attn_pallas(H_t, mask, w1, w2, interpret=False):
+    mask_t = jax.lax.stop_gradient(
+        jnp.swapaxes(mask.astype(jnp.float32), 0, 1)
+    )
+    return _attn_core(H_t, mask_t, w1, w2, interpret)

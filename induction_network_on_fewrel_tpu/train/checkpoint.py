@@ -59,7 +59,10 @@ def _device_snapshot(state: Any) -> Any:
 #   v3: BiLSTM directions un-tied — w_ih/w_hh/bias grew a leading [2, ...]
 #       direction axis (torch bidirectional parity: independent `*_reverse`
 #       weights per direction).
-FORMAT_VERSION = 3
+#   v4: self-attention params renamed Dense_0/Dense_1 -> explicit
+#       att_w1/att_w2 (shared by the two-pass and fused-kernel attention
+#       paths, ops/attn.py). Shapes/init unchanged; names only.
+FORMAT_VERSION = 4
 
 
 def _format_compatible(stored: int, arch: ExperimentConfig) -> bool:
@@ -71,10 +74,11 @@ def _format_compatible(stored: int, arch: ExperimentConfig) -> bool:
     """
     if stored == FORMAT_VERSION:
         return True
-    if stored in (1, 2):
+    if stored in (1, 2, 3):
         # v1 -> v2 changed only the BiLSTM encoder's param tree
         # (ops/lstm.py explicit w_ih/w_hh/bias); v2 -> v3 gave those params
-        # a leading direction axis. cnn/bert restore unchanged either way.
+        # a leading direction axis; v3 -> v4 renamed its attention params.
+        # cnn/bert restore unchanged across all of these.
         return arch.encoder != "bilstm"
     return False
 

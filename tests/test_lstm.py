@@ -171,8 +171,8 @@ def test_golden_torch_bilstm_encoder_end_to_end():
     out_j = np.asarray(enc.apply(params, jnp.asarray(emb), jnp.asarray(mask)))
 
     w_ih, w_hh, b = (np.asarray(p[k]) for k in ("w_ih", "w_hh", "bias"))
-    W1 = np.asarray(p["Dense_0"]["kernel"])  # [2U, A]
-    w2 = np.asarray(p["Dense_1"]["kernel"])  # [A, 1]
+    W1 = np.asarray(p["att_w1"])  # [2U, A]
+    w2 = np.asarray(p["att_w2"])  # [A, 1]
 
     lstm = torch.nn.LSTM(D, U, batch_first=True, bidirectional=True)
     with torch.no_grad():

@@ -165,7 +165,7 @@ def test_pallas_interpret_under_mesh():
         encoder="bilstm", n=3, k=2, q=2, batch_size=8, max_length=L,
         vocab_size=302, compute_dtype="float32", lstm_hidden=16, att_dim=8,
         induction_dim=16, ntn_slices=8, lr=1e-3, weight_decay=0.0,
-        lstm_backend="interpret", dp=8,
+        lstm_backend="interpret", attn_backend="interpret", dp=8,
     )
     vocab = make_synthetic_glove(vocab_size=300)
     ds = make_synthetic_fewrel(
@@ -183,7 +183,7 @@ def test_pallas_interpret_under_mesh():
     step = make_sharded_train_step(model, cfg, mesh, state0)
     s_pl, m_pl = _run_steps(step, _copy_state(state0), batches)
 
-    cfg_s = cfg.replace(lstm_backend="scan")
+    cfg_s = cfg.replace(lstm_backend="scan", attn_backend="xla")
     model_s = build_model(cfg_s, glove_init=vocab.vectors)
     step_s = make_sharded_train_step(model_s, cfg_s, mesh, state0)
     s_sc, m_sc = _run_steps(step_s, _copy_state(state0), batches)

@@ -113,8 +113,8 @@ class TorchFlagshipTwin:
         self.w_ih = g("encoder", "w_ih")        # [2, D, 4u]
         self.w_hh = g("encoder", "w_hh")        # [2, u, 4u]
         self.bias = g("encoder", "bias")        # [2, 4u]  (single bias!)
-        self.att_W1 = g("encoder", "Dense_0", "kernel")   # [2u, A]
-        self.att_w2 = g("encoder", "Dense_1", "kernel")   # [A, 1]
+        self.att_W1 = g("encoder", "att_w1")              # [2u, A]
+        self.att_w2 = g("encoder", "att_w2")              # [A, 1]
         self.ind_W = g("induction", "Dense_0", "kernel")  # [2u, C]
         self.ind_b = g("induction", "Dense_0", "bias")
         self.qp_W = g("query_proj", "kernel")             # [2u, C]
@@ -283,8 +283,8 @@ def test_training_trajectory_matches_torch(loss):
         "w_ih": (("encoder", "w_ih"), twin.w_ih),
         "w_hh": (("encoder", "w_hh"), twin.w_hh),
         "bias": (("encoder", "bias"), twin.bias),
-        "att_W1": (("encoder", "Dense_0", "kernel"), twin.att_W1),
-        "att_w2": (("encoder", "Dense_1", "kernel"), twin.att_w2),
+        "att_W1": (("encoder", "att_w1"), twin.att_W1),
+        "att_w2": (("encoder", "att_w2"), twin.att_w2),
         "ind_W": (("induction", "Dense_0", "kernel"), twin.ind_W),
         "ind_b": (("induction", "Dense_0", "bias"), twin.ind_b),
         "qp_W": (("query_proj", "kernel"), twin.qp_W),

@@ -95,10 +95,18 @@ def main() -> int:
         make_sharded_train_step,
     )
 
-    cfg_m = cfg.replace(dp=1)
+    # attn pallas here on purpose: the sharded leg doubles as the
+    # on-silicon kernel x GSPMD composition check for BOTH kernels (the
+    # production default resolves to xla attention — BASELINE.md round-5
+    # A/B — but the kernel must keep compiling under the mesh).
+    cfg_m = cfg.replace(dp=1, attn_backend="pallas")
     mesh = make_mesh(dp=1, devices=jax.devices()[:1])
-    state_m = init_state(model, cfg_m, sup, qry)
-    sstep = make_sharded_train_step(model, cfg_m, mesh, state_m)
+    # REBUILD the model from cfg_m: attn_backend is consumed at
+    # build_model time, so reusing `model` would silently run the xla
+    # attention and this leg would guard nothing (review finding, r5).
+    model_m = build_model(cfg_m, glove_init=vocab.vectors)
+    state_m = init_state(model_m, cfg_m, sup, qry)
+    sstep = make_sharded_train_step(model_m, cfg_m, mesh, state_m)
     t0 = time.monotonic()
     state_m, m_m = sstep(state_m, sup, qry, label)
     loss_m = float(jax.device_get(m_m["loss"]))

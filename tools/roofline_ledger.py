@@ -116,7 +116,6 @@ def ledger(cfg) -> list[tuple[str, float, float]]:
     ))
 
     # Episode head FWD (f32): induction transform + routing + NTN.
-    e_b = B * (N * K + TQ) // 1  # episode rows
     ind_f = 2 * B * N * K * 2 * u * C + 3 * (2 * B * N * K * C * 2)
     qp_f = 2 * B * TQ * 2 * u * C
     ntn_f = 2 * B * N * C * C * H + 2 * B * TQ * N * C * H
