@@ -1,3 +1,6 @@
+from induction_network_on_fewrel_tpu.ops.attn import (  # noqa: F401
+    masked_selfattn_tm,
+)
 from induction_network_on_fewrel_tpu.ops.core import (  # noqa: F401
     gradient_reversal,
     masked_max,

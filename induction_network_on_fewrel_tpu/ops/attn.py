@@ -179,8 +179,14 @@ def _bwd_kernel(H_ref, mask_ref, w1_ref, w2_ref, out_ref, mx_ref, dn_ref,
         preferred_element_type=jnp.float32,
     )
     dw2_scr[...] += jnp.sum(tl * ds, axis=(0, 1))[None]          # [1, A]
-    dw1_ref[0] = dw1_scr[...]
-    dw2_ref[0] = dw2_scr[...]
+
+    # Only the LAST chunk's copy is observable (the output block index is
+    # t-invariant) — gate it like the forward's final writes instead of
+    # copying the partials out every chunk (review finding, round 5).
+    @pl.when(t == pl.num_programs(1) - 1)
+    def _():
+        dw1_ref[0] = dw1_scr[...]
+        dw2_ref[0] = dw2_scr[...]
 
 
 # --- calls -----------------------------------------------------------------

@@ -74,13 +74,51 @@ def _format_compatible(stored: int, arch: ExperimentConfig) -> bool:
     """
     if stored == FORMAT_VERSION:
         return True
-    if stored in (1, 2, 3):
+    if stored == 3:
+        # v3 -> v4 only RENAMED the bilstm attention params
+        # (Dense_0/Dense_1 -> att_w1/att_w2) — a pure rename, so restores
+        # migrate in place (_restore's fallback path) instead of walling
+        # off round-4 bilstm checkpoints (review finding, round 5).
+        return True
+    if stored in (1, 2):
         # v1 -> v2 changed only the BiLSTM encoder's param tree
         # (ops/lstm.py explicit w_ih/w_hh/bias); v2 -> v3 gave those params
-        # a leading direction axis; v3 -> v4 renamed its attention params.
-        # cnn/bert restore unchanged across all of these.
+        # a leading direction axis — real layout changes, no migration.
+        # cnn/bert restore unchanged across these.
         return arch.encoder != "bilstm"
     return False
+
+
+# --- v3 -> v4 attention-param rename migration -----------------------------
+#
+# The rename is detected STRUCTURALLY, not from the version file: the
+# bilstm encoder's dict is the unique place where the attention params
+# live next to w_ih, so "att_w1/att_w2 beside w_ih" <-> "Dense_0/Dense_1
+# beside w_ih" converts in either direction without touching the other
+# modules' Dense_0 entries (induction/relation). Adam moment trees mirror
+# the param tree, so the same walk migrates them too.
+
+
+def _rename_attn(tree, to_v3: bool):
+    """Recursively rename the attention pair in a plain state-dict tree.
+
+    Returns (new_tree, changed)."""
+    if not isinstance(tree, dict):
+        return tree, False
+    out = {}
+    changed = False
+    for k, v in tree.items():
+        out[k], ch = _rename_attn(v, to_v3)
+        changed |= ch
+    if to_v3 and {"att_w1", "att_w2", "w_ih"} <= out.keys():
+        out["Dense_0"] = {"kernel": out.pop("att_w1")}
+        out["Dense_1"] = {"kernel": out.pop("att_w2")}
+        changed = True
+    elif not to_v3 and {"Dense_0", "Dense_1", "w_ih"} <= out.keys():
+        out["att_w1"] = out.pop("Dense_0")["kernel"]
+        out["att_w2"] = out.pop("Dense_1")["kernel"]
+        changed = True
+    return out, changed
 
 
 def _stage_root_for(real_dir: Path, mode: str) -> Path | None:
@@ -542,12 +580,32 @@ class CheckpointManager:
                 f"the existing run, or point --save_ckpt at a fresh directory"
             )
 
+    def _restore(self, mngr, step: int, target: Any) -> Any:
+        """Restore ``step`` into ``target``; on a structure mismatch, retry
+        through the v3 attention-param rename (a v4 build reading a
+        round-4 bilstm checkpoint — pure rename, bit-identical weights).
+        Probing the actual stored structure per step (instead of trusting
+        the dir-level version file) keeps mixed dirs working: a resumed
+        v3 dir accumulates v4-named saves at later steps."""
+        try:
+            return mngr.restore(step, args=ocp.args.StandardRestore(target))
+        except Exception:
+            from flax import serialization as fser
+
+            sd = fser.to_state_dict(target)
+            sd_v3, changed = _rename_attn(sd, to_v3=True)
+            if not changed:  # no attention pair in this tree: not ours
+                raise
+            out = mngr.restore(step, args=ocp.args.StandardRestore(sd_v3))
+            out_v4, _ = _rename_attn(out, to_v3=False)
+            return fser.from_state_dict(target, out_v4)
+
     def restore_best(self, target: Any) -> tuple[Any, int]:
         self.wait()  # a step mid-write is not restorable yet
         step = self.mngr.best_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.dir}")
-        return self.mngr.restore(step, args=ocp.args.StandardRestore(target)), step
+        return self._restore(self.mngr, step, target), step
 
     def restore_latest(self, target: Any) -> tuple[Any, int]:
         """Newest state across the best-tracked steps AND the recovery ring.
@@ -564,15 +622,10 @@ class CheckpointManager:
             raise FileNotFoundError(f"no checkpoint in {self.dir}")
         if ring_side is not None and (best_side is None or ring_side >= best_side):
             return (
-                self.latest_mngr.restore(
-                    ring_side, args=ocp.args.StandardRestore(target)
-                ),
+                self._restore(self.latest_mngr, ring_side, target),
                 ring_side,
             )
-        return (
-            self.mngr.restore(best_side, args=ocp.args.StandardRestore(target)),
-            best_side,
-        )
+        return self._restore(self.mngr, best_side, target), best_side
 
     @staticmethod
     def load_config(ckpt_dir: str | Path) -> ExperimentConfig:

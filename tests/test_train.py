@@ -133,6 +133,64 @@ def test_checkpoint_format_version_guard(tmp_path):
     CheckpointManager(ok, cnn)  # no raise
 
 
+def test_v3_attention_rename_migration(tmp_path):
+    """A v3 (round-4) bilstm checkpoint — attention params still named
+    Dense_0/Dense_1 — restores into the v4 build bit-for-bit via the
+    structural rename fallback (a pure rename must not wall off trained
+    weights; review finding, round 5)."""
+    import orbax.checkpoint as ocp
+    from flax import serialization as fser
+
+    from induction_network_on_fewrel_tpu.train.checkpoint import (
+        CheckpointManager,
+        _rename_attn,
+    )
+
+    cfg = ExperimentConfig(
+        encoder="bilstm", n=2, k=2, q=2, batch_size=2, max_length=L,
+        vocab_size=302, compute_dtype="float32", lstm_hidden=8, att_dim=4,
+        induction_dim=8, ntn_slices=4,
+    )
+    model, sampler = _setup(cfg)
+    sup, qry, _ = batch_to_model_inputs(sampler.sample_batch())
+    state = init_state(model, cfg, sup, qry)
+
+    # Write a checkpoint the way a v3 build would have: same values, old
+    # attention names (in params AND the mirrored Adam moment trees).
+    sd = fser.to_state_dict(jax.device_get(state))
+    sd_v3, changed = _rename_attn(sd, to_v3=True)
+    assert changed  # params + mu + nu all carry the pair
+    d = tmp_path / "ck"
+    d.mkdir()
+    raw = ocp.CheckpointManager(
+        d,
+        options=ocp.CheckpointManagerOptions(
+            best_fn=lambda m: m["val_accuracy"], best_mode="max"
+        ),
+    )
+    raw.save(
+        7, args=ocp.args.StandardSave(sd_v3),
+        metrics={"val_accuracy": 0.5},
+    )
+    raw.wait_until_finished()
+    raw.close()
+    (d / "format_version").write_text("3")
+    (d / "config.json").write_text(cfg.to_json())
+
+    mgr = CheckpointManager(d, cfg)  # v3 + migration: must not raise
+    try:
+        restored, step = mgr.restore_best(jax.device_get(state))
+    finally:
+        mgr.close()
+    assert step == 7
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(state)), jax.tree.leaves(restored)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # The v4 names are present on the restored tree.
+    assert "att_w1" in restored.params["params"]["encoder"]
+
+
 def test_fused_multi_step_matches_sequential():
     """steps_per_call fusion must compute the IDENTICAL update sequence:
     S scanned steps == S sequential single steps on the same batches."""
