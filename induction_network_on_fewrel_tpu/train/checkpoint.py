@@ -100,25 +100,48 @@ def _format_compatible(stored: int, arch: ExperimentConfig) -> bool:
 
 
 def _rename_attn(tree, to_v3: bool):
-    """Recursively rename the attention pair in a plain state-dict tree.
+    """Recursively rename the attention pair IN PLACE in the state tree,
+    preserving every container type (TrainState dataclass, optax
+    NamedTuple states, tuples/lists). Container preservation is the whole
+    point: a flax to_state_dict round-trip turns the opt_state tuple into
+    a dict, and orbax then refuses the restore with a dict-vs-list
+    structure mismatch against a checkpoint saved from the real pytree
+    (review-reproduced on a production-format v3 save, round 5).
 
     Returns (new_tree, changed)."""
-    if not isinstance(tree, dict):
-        return tree, False
-    out = {}
-    changed = False
-    for k, v in tree.items():
-        out[k], ch = _rename_attn(v, to_v3)
-        changed |= ch
-    if to_v3 and {"att_w1", "att_w2", "w_ih"} <= out.keys():
-        out["Dense_0"] = {"kernel": out.pop("att_w1")}
-        out["Dense_1"] = {"kernel": out.pop("att_w2")}
-        changed = True
-    elif not to_v3 and {"Dense_0", "Dense_1", "w_ih"} <= out.keys():
-        out["att_w1"] = out.pop("Dense_0")["kernel"]
-        out["att_w2"] = out.pop("Dense_1")["kernel"]
-        changed = True
-    return out, changed
+    import dataclasses
+
+    if isinstance(tree, dict):
+        out = {}
+        changed = False
+        for k, v in tree.items():
+            out[k], ch = _rename_attn(v, to_v3)
+            changed |= ch
+        if to_v3 and {"att_w1", "att_w2", "w_ih"} <= out.keys():
+            out["Dense_0"] = {"kernel": out.pop("att_w1")}
+            out["Dense_1"] = {"kernel": out.pop("att_w2")}
+            changed = True
+        elif not to_v3 and {"Dense_0", "Dense_1", "w_ih"} <= out.keys():
+            out["att_w1"] = out.pop("Dense_0")["kernel"]
+            out["att_w2"] = out.pop("Dense_1")["kernel"]
+            changed = True
+        return out, changed
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):  # NamedTuple
+        parts = [_rename_attn(v, to_v3) for v in tree]
+        return type(tree)(*(p[0] for p in parts)), any(p[1] for p in parts)
+    if isinstance(tree, (tuple, list)):
+        parts = [_rename_attn(v, to_v3) for v in tree]
+        return type(tree)(p[0] for p in parts), any(p[1] for p in parts)
+    if dataclasses.is_dataclass(tree) and not isinstance(tree, type):
+        parts = {
+            f.name: _rename_attn(getattr(tree, f.name), to_v3)
+            for f in dataclasses.fields(tree)
+        }
+        return (
+            dataclasses.replace(tree, **{k: v[0] for k, v in parts.items()}),
+            any(v[1] for v in parts.values()),
+        )
+    return tree, False
 
 
 def _stage_root_for(real_dir: Path, mode: str) -> Path | None:
@@ -589,16 +612,22 @@ class CheckpointManager:
         v3 dir accumulates v4-named saves at later steps."""
         try:
             return mngr.restore(step, args=ocp.args.StandardRestore(target))
-        except Exception:
-            from flax import serialization as fser
-
-            sd = fser.to_state_dict(target)
-            sd_v3, changed = _rename_attn(sd, to_v3=True)
+        except Exception as primary:
+            target_v3, changed = _rename_attn(target, to_v3=True)
             if not changed:  # no attention pair in this tree: not ours
                 raise
-            out = mngr.restore(step, args=ocp.args.StandardRestore(sd_v3))
+            try:
+                out = mngr.restore(
+                    step, args=ocp.args.StandardRestore(target_v3)
+                )
+            except Exception as secondary:
+                # Chain BOTH: if the fallback also fails (e.g. genuine
+                # corruption, not a rename mismatch), the original error
+                # must stay visible, not be replaced by a phantom
+                # migration problem.
+                raise secondary from primary
             out_v4, _ = _rename_attn(out, to_v3=False)
-            return fser.from_state_dict(target, out_v4)
+            return out_v4
 
     def restore_best(self, target: Any) -> tuple[Any, int]:
         self.wait()  # a step mid-write is not restorable yet

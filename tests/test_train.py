@@ -139,7 +139,6 @@ def test_v3_attention_rename_migration(tmp_path):
     structural rename fallback (a pure rename must not wall off trained
     weights; review finding, round 5)."""
     import orbax.checkpoint as ocp
-    from flax import serialization as fser
 
     from induction_network_on_fewrel_tpu.train.checkpoint import (
         CheckpointManager,
@@ -155,10 +154,13 @@ def test_v3_attention_rename_migration(tmp_path):
     sup, qry, _ = batch_to_model_inputs(sampler.sample_batch())
     state = init_state(model, cfg, sup, qry)
 
-    # Write a checkpoint the way a v3 build would have: same values, old
-    # attention names (in params AND the mirrored Adam moment trees).
-    sd = fser.to_state_dict(jax.device_get(state))
-    sd_v3, changed = _rename_attn(sd, to_v3=True)
+    # Write a checkpoint the way the REAL v3 build saved it: StandardSave
+    # of the host TrainState PYTREE (containers intact — the opt_state
+    # tuple must survive as a tuple; a state-dict-shaped fixture would
+    # hide the container mismatch the migration has to handle — review
+    # finding, round 5), with the attention pair under its old names in
+    # params AND the mirrored Adam moment trees.
+    host_v3, changed = _rename_attn(jax.device_get(state), to_v3=True)
     assert changed  # params + mu + nu all carry the pair
     d = tmp_path / "ck"
     d.mkdir()
@@ -169,7 +171,7 @@ def test_v3_attention_rename_migration(tmp_path):
         ),
     )
     raw.save(
-        7, args=ocp.args.StandardSave(sd_v3),
+        7, args=ocp.args.StandardSave(host_v3),
         metrics={"val_accuracy": 0.5},
     )
     raw.wait_until_finished()
