@@ -76,7 +76,12 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
         help="self-attention impl: auto = the two-pass XLA form (measured "
              "faster than the fused kernel on this chip, BASELINE.md "
              "round 5); pallas = the fused one-pass online-softmax kernel, "
-             "kept selectable for A/Bs on other silicon",
+             "kept selectable for A/Bs on other silicon. Under --bf16 the "
+             "backends are close but NOT bit-identical: the kernel runs "
+             "its projection/softmax in f32 while the xla path computes "
+             "proj/tanh in bf16, so flipping backends shifts metrics "
+             "within bf16 tolerance (pinned in "
+             "tests/test_attn.py::test_encoder_attn_backend_equivalence)",
     )
     p.add_argument("--induction_dim", type=int, default=100)
     p.add_argument("--routing_iters", type=int, default=3)
@@ -366,8 +371,11 @@ def select_device(cfg: ExperimentConfig, compile_cache: str = "auto") -> None:
             os.makedirs(path, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", path)
             # The flagship fused program compiles in ~13 s — always worth
-            # caching; the default min-compile-time gate would skip the
-            # small eval programs, which cost little either way.
+            # caching. The 0.5 s threshold is deliberate: it admits every
+            # program whose compile is actually felt (the fused step, the
+            # boundary evals) while still excluding trivial sub-0.5 s
+            # utility programs, which would churn cache entries for no
+            # measurable wall-clock win.
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         except Exception as e:  # noqa: BLE001 — cache is an optimization
             print(f"compile cache disabled ({e})", file=sys.stderr)

@@ -29,12 +29,27 @@ import jax.numpy as jnp
 
 from induction_network_on_fewrel_tpu.models.base import FewShotModel
 
+# Default bound for _AdjacencyMLP.one_hot_max_t, shared with the FLOPs
+# model (utils/flops.py) so accounting follows the same form the module
+# actually executes at a given T.
+ONE_HOT_MAX_T = 64
+
 
 class _AdjacencyMLP(nn.Module):
     """Pairwise |x_i - x_j| -> scalar edge logit; softmax over neighbors."""
 
     hidden: int
     compute_dtype: jnp.dtype
+    # SIZE GUARD on the one-hot form (ADVICE round 5): its selection
+    # constants are [P, T] ≈ O(T³)/2 and the reconstruction constant is
+    # [T², P+1] ≈ O(T⁴)/2 floats, with a 2·G·T²·(P+1) reconstruction
+    # matmul on top. At zoo shapes (T = N·K+1 ≤ ~26) that is <1 MB of
+    # constants and the form wins 1.68x over broadcast; by T=64 the recon
+    # constant alone is ~33 MB, and around T≈100 (~200 MB) the
+    # reconstruction matmul dominates the MLP it was meant to shrink.
+    # Above this bound the module falls back to the broadcast pair form
+    # (same params, same math; O(T²·F) memory, no one-hot constants).
+    one_hot_max_t: int = ONE_HOT_MAX_T
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -42,6 +57,26 @@ class _AdjacencyMLP(nn.Module):
         import numpy as np
 
         G, T, F = x.shape
+        cd = self.compute_dtype
+
+        def mlp(diff):
+            h = nn.Dense(self.hidden, dtype=cd, param_dtype=jnp.float32)(diff)
+            h = nn.leaky_relu(h)
+            h = nn.Dense(self.hidden, dtype=cd, param_dtype=jnp.float32)(h)
+            h = nn.leaky_relu(h)
+            return nn.Dense(1, dtype=cd, param_dtype=jnp.float32)(h)[..., 0]
+
+        if T > self.one_hot_max_t:
+            # Broadcast form: full [G, T, T, F] pair tensor, edge MLP over
+            # every ordered pair, diagonal masked directly. More FLOPs on
+            # the MLP (T² vs T(T-1)/2 pairs) but no O(T⁴) constants.
+            diff = jnp.abs(x[:, :, None, :] - x[:, None, :, :])
+            logit = mlp(diff).astype(jnp.float32)       # [G, T, T]
+            logit = logit + jnp.asarray(
+                np.where(np.eye(T, dtype=bool), -1e9, 0.0), jnp.float32
+            )
+            return jax.nn.softmax(logit, axis=-1).astype(cd)
+
         # Pair selection and [T, T] reconstruction both ride ONE-HOT
         # MATMULS, not fancy indexing: a gather's backward is a scatter-add
         # and scatters serialize badly on TPU (measured round 5: the
@@ -57,7 +92,6 @@ class _AdjacencyMLP(nn.Module):
         sel1[np.arange(P), iu] = 1.0
         sel2 = np.zeros((P, T), np.float32)
         sel2[np.arange(P), ju] = 1.0
-        cd = self.compute_dtype
         a = jnp.einsum("pt,gtf->gpf", jnp.asarray(sel1, cd), x)
         b = jnp.einsum("pt,gtf->gpf", jnp.asarray(sel2, cd), x)
         # |x_i - x_j| is SYMMETRIC in (i, j): the edge MLP runs over the
@@ -65,12 +99,7 @@ class _AdjacencyMLP(nn.Module):
         # the full T^2 pair tensor (the gnn's dominant HBM term, round-4
         # zoo trace) — and each value lands at (i,j) AND (j,i) below.
         diff = jnp.abs(a - b)                          # [G, P, F]
-        h = nn.Dense(self.hidden, dtype=cd, param_dtype=jnp.float32)(diff)
-        h = nn.leaky_relu(h)
-        h = nn.Dense(self.hidden, dtype=cd, param_dtype=jnp.float32)(h)
-        h = nn.leaky_relu(h)
-        logit_p = nn.Dense(1, dtype=cd, param_dtype=jnp.float32)(h)[..., 0]
-        logit_p = logit_p.astype(jnp.float32)          # [G, P]
+        logit_p = mlp(diff).astype(jnp.float32)        # [G, P]
         # Reconstruction map: (i, j) -> pair slot, diagonal -> the -1e9
         # pad slot so self-edges stay masked (a node aggregates neighbors,
         # not itself; its own features persist via the residual concat).

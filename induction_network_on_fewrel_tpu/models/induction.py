@@ -120,3 +120,48 @@ class InductionNetwork(FewShotModel):
             logits = self.relation(class_vec, qry_c)        # [B, TQ, N]
         logits = self.append_nota(logits)                   # [B, TQ, N(+1)]
         return logits.astype(jnp.float32)
+
+    # --- serving sub-applies (serving/registry.py + serving/buckets.py) ---
+    #
+    # The episode forward splits cleanly at the class-vector boundary: the
+    # support half (encoder + routing) depends only on the support set, the
+    # query half only on the class vectors — so a serving engine runs
+    # ``class_vectors`` ONCE per registered support set and then answers
+    # every query with ``score_queries`` alone. Both halves reuse the exact
+    # modules __call__ uses (same params, same dtypes); the encoders are
+    # row-independent, so encoding support and query in separate calls is
+    # the same math as __call__'s fused concat-encode-split pass
+    # (numerical-tolerance parity pinned in tests/test_serving.py).
+
+    def class_vectors(self, support: dict[str, Any]) -> jnp.ndarray:
+        """[B, N, K] support token dict (or pre-encoded [B, N, K, H] array)
+        -> [B, N, C] class vectors via encoder + dynamic routing."""
+        if isinstance(support, dict):
+            with jax.named_scope("encoder"):
+                sup_enc = self.encode(
+                    support["word"], support["pos1"],
+                    support["pos2"], support["mask"],
+                )
+        else:
+            sup_enc = jnp.asarray(support)
+        with jax.named_scope("induction"):
+            return self.induction(sup_enc)
+
+    def score_queries(
+        self, class_vec: jnp.ndarray, query: dict[str, Any]
+    ) -> jnp.ndarray:
+        """([B, N, C] class vectors, [B, TQ] query token dict) -> relation
+        logits [B, TQ, N(+1)] — the steady-state serving path: one encoder
+        pass over the queries plus the NTN score, no support work at all."""
+        if isinstance(query, dict):
+            with jax.named_scope("encoder"):
+                qry_enc = self.encode(
+                    query["word"], query["pos1"], query["pos2"], query["mask"]
+                )
+        else:
+            qry_enc = jnp.asarray(query)
+        with jax.named_scope("relation"):
+            qry_c = self.query_proj(qry_enc)
+            logits = self.relation(class_vec.astype(self.head_dtype), qry_c)
+        logits = self.append_nota(logits)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,42 @@
+"""jax API compatibility shims for the parallel layer.
+
+``jax.shard_map`` is the stable name of what older jax (<= 0.4.x) exposes
+only as ``jax.experimental.shard_map.shard_map`` — with ``check_vma``
+spelled ``check_rep``. Every shard_map call site in this package goes
+through :func:`shard_map` below, so the multichip paths run on both API
+generations instead of dying with AttributeError on the older one.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def axis_size(axis_name) -> int:
+    """Static mesh-axis size inside a shard_map body: ``jax.lax.axis_size``
+    where it exists, else the size recorded in the trace's axis frame
+    (``jax.core.axis_frame`` — on 0.4.x it returns the size itself). Both
+    are STATIC ints, so scan trip counts and ppermute rings built from the
+    result stay compile-time constants."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    return int(getattr(frame, "size", frame))
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` when available, else the experimental spelling
+    (mapping ``check_vma`` -> its old name ``check_rep``). Same contract;
+    usable with ``functools.partial`` as a decorator exactly like the
+    stable API."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )

@@ -37,7 +37,16 @@ def maybe_initialize_distributed(
     a clean RuntimeError naming the likely causes, instead of an opaque
     gRPC traceback from deep inside the client.
     """
-    if jax.distributed.is_initialized():
+    # jax.distributed.is_initialized landed after 0.4.37; on older jax the
+    # global client handle is the only signal. Without this fallback every
+    # CLI entrypoint dies at import-adjacent time on such versions.
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        if is_init():
+            return True
+    elif getattr(jax.distributed, "global_state", None) is not None and (
+        jax.distributed.global_state.client is not None
+    ):
         return True
     if force or any(v in os.environ for v in _POD_ENV_VARS):
         kwargs = {}

@@ -38,6 +38,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from induction_network_on_fewrel_tpu.parallel.compat import (
+    axis_size as compat_axis_size,
+    shard_map as compat_shard_map,
+)
+
 
 def gpipe_local(block_fn: Callable, stacked_local, x: jnp.ndarray,
                 mask: jnp.ndarray, axis: str, microbatches: int):
@@ -46,7 +51,7 @@ def gpipe_local(block_fn: Callable, stacked_local, x: jnp.ndarray,
     stacked_local: this stage's slice of the layer-stacked params (leading
     axis NL/S). x: [M, L, d] (replicated); mask: [M, L]. Returns [M, L, d].
     """
-    S = jax.lax.axis_size(axis)
+    S = compat_axis_size(axis)
     stage = jax.lax.axis_index(axis)
     m = microbatches
     M, L, d = x.shape
@@ -113,7 +118,7 @@ def make_gpipe(mesh: Mesh, axis: str = "pp", microbatches: int = 4,
         )
 
         @partial(
-            jax.shard_map,
+            compat_shard_map,
             mesh=mesh,
             in_specs=(spec_stack, P(b, None, None), P(b, None)),
             out_specs=P(b, None, None),

@@ -31,6 +31,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from induction_network_on_fewrel_tpu.parallel.compat import (
+    axis_size as compat_axis_size,
+    shard_map as compat_shard_map,
+)
+
 _NEG = -1e30
 
 
@@ -51,7 +56,7 @@ def ring_attention_local(q, k, v, kv_mask, axis_name: str):
     ``axis_name``); kv_mask: [B, Lc] key-padding mask chunk that travels
     with k/v. Returns the local output chunk [B, H, Lc, D].
     """
-    n = jax.lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     scale = 1.0 / math.sqrt(q.shape[-1])
     B, H, Lc, D = q.shape
     q32 = q.astype(jnp.float32)
@@ -95,7 +100,7 @@ def make_ring_attention(mesh: Mesh, axis: str = "sp", batch_axis: str | None = N
     b = batch_axis
 
     @partial(
-        jax.shard_map,
+        compat_shard_map,
         mesh=mesh,
         in_specs=(
             P(b, None, axis, None),

@@ -23,6 +23,10 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from induction_network_on_fewrel_tpu.parallel.compat import (
+    shard_map as compat_shard_map,
+)
+
 from induction_network_on_fewrel_tpu.config import ExperimentConfig
 from induction_network_on_fewrel_tpu.models.losses import (
     accuracy,
@@ -280,7 +284,7 @@ def make_shard_map_train_step(model, cfg: ExperimentConfig, mesh: Mesh):
         )
 
     @partial(
-        jax.shard_map,
+        compat_shard_map,
         mesh=mesh,
         in_specs=(
             P(),

@@ -1,0 +1,41 @@
+"""TPU-native few-shot inference engine (serving/).
+
+Turns a trained induction-network checkpoint into a low-latency
+query-answering engine. The induction network's structure makes serving
+cheap (ISSUE 1 / Geng et al. 2019): a support set is distilled ONCE by the
+dynamic-routing loop into per-class vectors, after which each query costs
+one encoder pass plus the neural-tensor score. The pieces:
+
+* ``registry``  — ClassVectorRegistry: support sets -> device-resident
+  [N, C] class vectors (encoded once, never re-encoded at query time).
+* ``buckets``   — fixed shape buckets + AOT-compiled query programs, so
+  steady-state serving runs with ZERO recompiles.
+* ``batcher``   — dynamic micro-batcher: request queue with deadlines,
+  bounded-depth backpressure, and partial-bucket flush under pressure.
+* ``stats``     — p50/p99 latency, queue depth, batch occupancy, recompile
+  counters, emitted through utils.metrics.MetricsLogger.
+* ``engine``    — InferenceEngine: wires the above behind submit()/classify(),
+  including the FewRel 2.0 NOTA "no_relation" verdict (Gao et al. 2019).
+* ``cli``       — the ``serve.py`` entrypoint next to train.py/test.py.
+"""
+
+from induction_network_on_fewrel_tpu.serving.batcher import (  # noqa: F401
+    DeadlineExceeded,
+    DynamicBatcher,
+    Saturated,
+)
+from induction_network_on_fewrel_tpu.serving.buckets import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    QueryProgramCache,
+    pad_rows,
+    select_bucket,
+)
+from induction_network_on_fewrel_tpu.serving.engine import (  # noqa: F401
+    InferenceEngine,
+)
+from induction_network_on_fewrel_tpu.serving.registry import (  # noqa: F401
+    ClassVectorRegistry,
+)
+from induction_network_on_fewrel_tpu.serving.stats import (  # noqa: F401
+    ServingStats,
+)

@@ -1,0 +1,214 @@
+"""Dynamic micro-batcher: coalesce requests into shape buckets under
+deadline pressure, with bounded-depth backpressure.
+
+One worker thread owns the device: it pulls requests off a bounded queue,
+coalesces up to ``max(buckets)`` of them (waiting at most ``batch_window_s``
+for stragglers), and hands the batch to the engine's execute callback. Three
+robustness behaviors, each tested in tests/test_serving.py:
+
+* **Deadlines** — every request carries an absolute deadline. Requests that
+  expire before execution fail fast with ``DeadlineExceeded`` (never run a
+  query whose client has given up); a partial bucket is flushed EARLY when
+  the oldest request's slack (deadline - now - estimated execution time)
+  runs out, trading batch occupancy for meeting the deadline.
+* **Backpressure** — the queue has a hard depth bound. When it is full,
+  ``submit`` raises ``Saturated`` carrying a retry-after estimate instead of
+  queueing unbounded work (the client sheds load; the engine stays at a
+  bounded latency).
+* **Fault isolation** — an execution error fails that batch's futures, not
+  the worker thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable
+
+from induction_network_on_fewrel_tpu.serving.buckets import DEFAULT_BUCKETS
+
+
+class Saturated(RuntimeError):
+    """Queue at capacity — retry after ``retry_after_s``."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(
+            f"serving queue saturated; retry after {retry_after_s:.3f}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline expired before it reached the device."""
+
+
+@dataclasses.dataclass
+class Request:
+    query: dict                 # [L]-leaf tokenized query dict
+    deadline: float             # absolute time.monotonic() deadline
+    future: Future
+    enqueued_at: float
+
+
+class DynamicBatcher:
+    def __init__(
+        self,
+        execute: Callable[[list[Request]], None],
+        buckets: tuple[int, ...] = DEFAULT_BUCKETS,
+        max_queue_depth: int = 64,
+        batch_window_s: float = 0.002,
+        stats=None,
+        start: bool = True,
+    ):
+        """``execute(batch)`` fulfills (or fails) every future in ``batch``.
+        ``start=False`` skips the worker thread — unit tests then drive
+        ``drain_once()`` directly for deterministic scheduling."""
+        self._execute = execute
+        self.buckets = tuple(sorted(buckets))
+        self.batch_window_s = batch_window_s
+        self._stats = stats
+        self._q: queue.Queue = queue.Queue(maxsize=max_queue_depth)
+        self._closed = False
+        self._worker = None
+        if start:
+            self._worker = threading.Thread(target=self._run, daemon=True)
+            self._worker.start()
+
+    # --- client side -----------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    def _retry_after_s(self) -> float:
+        """How long a rejected client should back off: the time to drain the
+        queue at the observed per-batch execution rate."""
+        est = self._stats.exec_estimate_s() if self._stats else 0.005
+        batches_ahead = self._q.maxsize / max(self.buckets) + 1
+        return batches_ahead * max(est, 1e-4)
+
+    def submit(self, query: dict, deadline_s: float) -> Future:
+        """Enqueue one tokenized query; returns its Future. Raises
+        ``Saturated`` (with a retry-after hint) when the queue is full."""
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        now = time.monotonic()
+        req = Request(
+            query=query, deadline=now + deadline_s, future=Future(),
+            enqueued_at=now,
+        )
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            if self._stats:
+                self._stats.record_rejected()
+            raise Saturated(self._retry_after_s()) from None
+        return req.future
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            # Sentinel unblocks an idle worker. put_nowait, not put: a FULL
+            # queue (closing under saturation) must not block close —
+            # the worker re-checks _closed within its 0.1 s poll anyway.
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
+        if self._worker is not None:
+            self._worker.join(timeout=10.0)
+
+    # --- worker side -----------------------------------------------------
+
+    def _repost_sentinel(self) -> None:
+        # NEVER a blocking put: a racing submitter can refill the slot the
+        # sentinel just freed, and this thread is the queue's only consumer
+        # — a blocking re-post would deadlock it. _closed is already set,
+        # so a dropped sentinel only costs one 0.1 s poll.
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
+
+    def _collect(self, first: Request) -> list[Request]:
+        """Coalesce up to ``max(buckets)`` requests starting from ``first``.
+
+        Waits at most ``batch_window_s`` for stragglers, and LESS when the
+        oldest collected request's deadline slack is smaller — that early
+        return is the partial-bucket flush under deadline pressure.
+        """
+        batch = [first]
+        cap = self.buckets[-1]
+        window_end = time.monotonic() + self.batch_window_s
+        exec_est = self._stats.exec_estimate_s() if self._stats else 0.005
+        while len(batch) < cap:
+            now = time.monotonic()
+            slack = min(r.deadline for r in batch) - now - exec_est
+            wait = min(window_end - now, slack)
+            if wait <= 0:
+                break
+            try:
+                nxt = self._q.get(timeout=wait)
+            except queue.Empty:
+                break
+            if nxt is None:          # close() sentinel mid-collection:
+                self._repost_sentinel()  # for the outer loop; flush now
+                break
+            batch.append(nxt)
+        return batch
+
+    def split_expired(
+        self, batch: list[Request], now: float | None = None
+    ) -> tuple[list[Request], list[Request]]:
+        """(live, expired) partition; expired futures fail immediately."""
+        now = time.monotonic() if now is None else now
+        live = [r for r in batch if r.deadline > now]
+        dead = [r for r in batch if r.deadline <= now]
+        for r in dead:
+            if self._stats:
+                self._stats.record_deadline_miss()
+            r.future.set_exception(
+                DeadlineExceeded(
+                    f"deadline exceeded after {now - r.enqueued_at:.3f}s in queue"
+                )
+            )
+        return live, dead
+
+    def drain_once(self, block_s: float = 0.1) -> int:
+        """One worker iteration: collect, expire, execute. Returns the number
+        of requests executed (0 when idle). Public so tests and synchronous
+        callers can drive the batcher without the thread."""
+        try:
+            first = self._q.get(timeout=block_s)
+        except queue.Empty:
+            return 0
+        if first is None:
+            self._repost_sentinel()
+            return 0
+        batch = self._collect(first)
+        live, _ = self.split_expired(batch)
+        if not live:
+            return 0
+        try:
+            self._execute(live)
+        except BaseException as e:  # noqa: BLE001 — fail the batch, not the worker
+            for r in live:
+                if not r.future.done():
+                    r.future.set_exception(e)
+        return len(live)
+
+    def _run(self) -> None:
+        while not self._closed:
+            self.drain_once()
+        # Closed: fail anything still queued so no client blocks forever.
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if req is not None and not req.future.done():
+                req.future.set_exception(RuntimeError("batcher closed"))

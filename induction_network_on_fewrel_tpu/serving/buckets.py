@@ -1,0 +1,155 @@
+"""Shape buckets + AOT-compiled query programs (zero steady-state recompiles).
+
+XLA compiles one program per input shape, and a serving engine that jits on
+whatever batch arrives pays a multi-second compile whenever a new batch size
+shows up — unacceptable at request latency. So the query path runs against a
+SMALL FIXED SET of batch-size buckets: every micro-batch is padded up to the
+nearest bucket, each bucket's program is lowered + compiled ahead of time
+(``warmup``), and steady-state serving touches only those executables.
+
+The TPU static-shape discipline is the same one the training stack lives by
+(fixed ``max_length``, fixed episode geometry per compile); buckets extend it
+to the request axis. Compiles are COUNTED — the acceptance gate for the
+engine is "zero recompiles after warmup", and ``tools/loadgen.py`` asserts
+it — so this module owns the executables explicitly (jax AOT: lower ->
+compile keyed by (n_classes, bucket)) instead of hiding them in jit's cache.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+# Powers of two up to 16: at CPU/TPU serving shapes the encoder matmuls for
+# a 16-row bucket are still tiny, and 5 programs keep warmup around a second
+# on CPU. Override per engine for heavier traffic.
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16)
+
+# Wire dtypes for query leaves — the same narrowing the training path uses
+# (models/build.batch_to_model_inputs): pos offsets fit int16, mask int8.
+# The AOT executables are shape- AND dtype-exact, so there is exactly one
+# owner of this contract.
+QUERY_DTYPES = {
+    "word": np.int32, "pos1": np.int16, "pos2": np.int16, "mask": np.int8,
+}
+
+
+def zero_batch(max_length: int, lead: tuple[int, ...]) -> dict[str, np.ndarray]:
+    """All-zeros token batch with leading shape ``lead`` in the wire dtypes
+    — the shared init/restore-target shape builder (model init only reads
+    shapes, and token id 0 is always valid)."""
+    return {
+        k: np.zeros(lead + (max_length,), dt) for k, dt in QUERY_DTYPES.items()
+    }
+
+
+def select_bucket(n: int, buckets: tuple[int, ...] = DEFAULT_BUCKETS) -> int:
+    """Smallest bucket that fits ``n`` rows (callers cap collection at
+    ``max(buckets)``, so a fit always exists)."""
+    if n <= 0:
+        raise ValueError(f"bucket request for {n} rows")
+    for b in sorted(buckets):
+        if n <= b:
+            return b
+    raise ValueError(f"{n} rows exceed the largest bucket {max(buckets)}")
+
+
+def pad_rows(arr: np.ndarray, bucket: int) -> np.ndarray:
+    """Pad axis 0 with repeats of row 0 up to ``bucket`` rows. Repeating a
+    REAL row (not zeros) keeps the pad rows on the same numerical path as
+    live traffic — no special-case token patterns reaching the encoder —
+    and their outputs are sliced off before verdicts."""
+    n = arr.shape[0]
+    if n == bucket:
+        return arr
+    pad = np.broadcast_to(arr[:1], (bucket - n,) + arr.shape[1:])
+    return np.concatenate([arr, pad], axis=0)
+
+
+def stack_queries(
+    queries: list[dict[str, np.ndarray]], bucket: int
+) -> dict[str, np.ndarray]:
+    """[L]-leaf query dicts -> one padded [bucket, L] dict in wire dtypes."""
+    out = {}
+    for k, dt in QUERY_DTYPES.items():
+        out[k] = pad_rows(
+            np.stack([np.asarray(q[k]) for q in queries]).astype(dt), bucket
+        )
+    return out
+
+
+class QueryProgramCache:
+    """AOT-compiled ``score_queries`` executables keyed by (n_classes, bucket).
+
+    The program signature is ``(params, class_mat [N, C], query leaves
+    [bucket, L]) -> logits [bucket, N(+1)]``: params and the class matrix are
+    ARGUMENTS, not closure constants (constants bake into the program — the
+    same tunneled-backend lesson train/token_cache.py records), so
+    re-registering a class never invalidates a compiled program.
+    """
+
+    def __init__(self, model, stats=None):
+        import jax
+
+        self._jax = jax
+        self._stats = stats
+        self._exe: dict[tuple[int, int], Any] = {}
+        self.compiles = 0
+        self.in_warmup = False
+
+        def score(params, class_mat, query):
+            logits = model.apply(
+                params, class_mat[None],
+                {k: v[None] for k, v in query.items()},
+                method="score_queries",
+            )
+            return logits[0]  # [bucket, N(+1)]
+
+        self._score = score
+
+    def _compile(self, params, n_classes: int, class_dim: int,
+                 bucket: int, max_length: int):
+        jax = self._jax
+        aval = lambda s, d: jax.ShapeDtypeStruct(s, d)  # noqa: E731
+        p_avals = jax.tree.map(lambda x: aval(x.shape, x.dtype), params)
+        mat = aval((n_classes, class_dim), np.float32)
+        query = {
+            k: aval((bucket, max_length), dt) for k, dt in QUERY_DTYPES.items()
+        }
+        exe = jax.jit(self._score).lower(p_avals, mat, query).compile()
+        self.compiles += 1
+        if self._stats is not None:
+            self._stats.record_compile(during_warmup=self.in_warmup)
+        return exe
+
+    def get(self, params, n_classes: int, class_dim: int, bucket: int,
+            max_length: int):
+        key = (n_classes, bucket)
+        exe = self._exe.get(key)
+        if exe is None:
+            exe = self._exe[key] = self._compile(
+                params, n_classes, class_dim, bucket, max_length
+            )
+        return exe
+
+    def warmup(self, params, n_classes: int, class_dim: int,
+               buckets: tuple[int, ...], max_length: int) -> int:
+        """Compile every bucket's program for the current class count;
+        returns the number of programs compiled by this call."""
+        before = self.compiles
+        self.in_warmup = True
+        try:
+            for b in buckets:
+                self.get(params, n_classes, class_dim, b, max_length)
+        finally:
+            self.in_warmup = False
+        return self.compiles - before
+
+    def run(self, params, class_mat, query: dict[str, np.ndarray]) -> np.ndarray:
+        """Execute the (n_classes, bucket) program; compiles on miss (counted
+        as a steady-state recompile unless inside warmup)."""
+        bucket, max_length = query["word"].shape
+        n, c = class_mat.shape
+        exe = self.get(params, n, c, bucket, max_length)
+        return np.asarray(exe(params, class_mat, query))

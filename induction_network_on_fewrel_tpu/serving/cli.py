@@ -1,0 +1,197 @@
+"""``serve.py`` entrypoint — the serving CLI next to train.py/test.py.
+
+Flow: restore a checkpoint (or fresh-init synthetic weights for demos),
+register support sets (a FewRel-schema JSON via --support_file, or the
+synthetic fixtures), AOT-warm the bucket programs, then answer queries —
+JSON-lines from --input (or stdin with ``--input -``), or a built-in demo
+batch sampled from the registered corpus. One verdict JSON per line on
+stdout; serving metrics go to stderr and metrics.jsonl (kind="serve").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_serve_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="TPU-native few-shot inference engine (induction network)"
+    )
+    p.add_argument("--load_ckpt", default=None,
+                   help="checkpoint directory to serve; omitted = fresh-init "
+                        "synthetic weights (demo/loadgen only — verdicts are "
+                        "untrained)")
+    p.add_argument("--support_file", default=None,
+                   help="FewRel-schema JSON of support sets; each relation "
+                        "registers with its first K instances (synthetic "
+                        "fixtures when omitted)")
+    p.add_argument("--K", type=int, default=5, help="shots per registered class")
+    p.add_argument("--max_classes", type=int, default=None,
+                   help="register at most this many relations")
+    p.add_argument("--input", default=None, metavar="FILE|-",
+                   help="JSON-lines queries (FewRel instance schema or "
+                        "{'tokens': [...]}); '-' = stdin; omitted = demo "
+                        "queries sampled from the support corpus")
+    p.add_argument("--glove", default=None, help="GloVe json (word2id or combined)")
+    p.add_argument("--glove_mat", default=None, help=".npy matrix for word2id json")
+    p.add_argument("--device", default="cpu", choices=["tpu", "cpu"],
+                   help="serving defaults to cpu; pass tpu for real traffic")
+    p.add_argument("--compile_cache", default="auto", metavar="DIR|off",
+                   help="persistent XLA compile cache (see train.py --help)")
+    p.add_argument("--buckets", default="1,2,4,8,16",
+                   help="comma-separated micro-batch shape buckets (each is "
+                        "one AOT-compiled program)")
+    p.add_argument("--queue_depth", type=int, default=64,
+                   help="bounded request-queue depth (backpressure bound)")
+    p.add_argument("--batch_window_ms", type=float, default=2.0,
+                   help="max time to wait coalescing a bucket")
+    p.add_argument("--deadline_ms", type=float, default=1000.0,
+                   help="default per-request deadline")
+    p.add_argument("--demo_queries", type=int, default=32,
+                   help="queries for the built-in demo (no --input)")
+    p.add_argument("--run_dir", default=None,
+                   help="metrics.jsonl dir for kind='serve' records")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def _fresh_engine(args, buckets):
+    """Demo path: synthetic vocab + fresh-init induction weights (no
+    checkpoint on disk). The serving machinery is identical; only the
+    verdict quality is untrained."""
+    import jax
+
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.data import make_synthetic_glove
+    from induction_network_on_fewrel_tpu.data.tokenizer import GloveTokenizer
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
+    from induction_network_on_fewrel_tpu.train.steps import init_state
+    from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+    cfg = ExperimentConfig(
+        device=args.device, k=args.K, vocab_size=2002, seed=args.seed
+    )
+    vocab = make_synthetic_glove(vocab_size=cfg.vocab_size - 2,
+                                 word_dim=cfg.word_dim)
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    from induction_network_on_fewrel_tpu.serving.buckets import zero_batch
+
+    model = build_model(cfg, glove_init=vocab.vectors)
+    state = init_state(
+        model, cfg, zero_batch(cfg.max_length, (1, cfg.n, cfg.k)),
+        zero_batch(cfg.max_length, (1, cfg.total_q)),
+        rng=jax.random.key(cfg.seed),
+    )
+    print("no --load_ckpt: serving FRESH-INIT synthetic weights (demo only)",
+          file=sys.stderr)
+    return InferenceEngine(
+        model, state.params, cfg, tok, k=args.K, buckets=buckets,
+        max_queue_depth=args.queue_depth,
+        batch_window_s=args.batch_window_ms / 1e3,
+        default_deadline_s=args.deadline_ms / 1e3,
+        logger=MetricsLogger(args.run_dir) if args.run_dir else None,
+    )
+
+
+def _support_dataset(args, cfg_k: int, seed: int = 0):
+    from induction_network_on_fewrel_tpu.data import (
+        load_fewrel_json,
+        make_synthetic_fewrel,
+    )
+
+    if args.support_file:
+        return load_fewrel_json(args.support_file)
+    return make_synthetic_fewrel(
+        num_relations=10, instances_per_relation=max(cfg_k + 10, 20),
+        vocab_size=2000, seed=seed,
+    )
+
+
+def serve_main(argv=None) -> int:
+    args = build_serve_arg_parser().parse_args(argv)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+
+    # Device selection must happen before any jax backend init — reuse the
+    # train CLI's helper (it owns the axon-sitecustomize workaround).
+    from induction_network_on_fewrel_tpu.cli import select_device
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+
+    select_device(ExperimentConfig(device=args.device), args.compile_cache)
+
+    from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
+    from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+    if args.load_ckpt:
+        engine = InferenceEngine.from_checkpoint(
+            args.load_ckpt, device=args.device,
+            glove=args.glove, glove_mat=args.glove_mat,
+            k=args.K, buckets=buckets,
+            max_queue_depth=args.queue_depth,
+            batch_window_s=args.batch_window_ms / 1e3,
+            default_deadline_s=args.deadline_ms / 1e3,
+            logger=MetricsLogger(args.run_dir) if args.run_dir else None,
+        )
+    else:
+        engine = _fresh_engine(args, buckets)
+
+    try:
+        ds = _support_dataset(args, engine.registry.k, seed=args.seed)
+        names = engine.register_dataset(ds, max_classes=args.max_classes)
+        print(f"registered {len(names)} classes x {engine.registry.k} shots",
+              file=sys.stderr)
+        compiled = engine.warmup()
+        print(f"warmup: {compiled} bucket programs compiled "
+              f"(buckets={list(engine.batcher.buckets)})", file=sys.stderr)
+
+        if args.input:
+            stream = sys.stdin if args.input == "-" else open(args.input)
+            try:
+                for line in stream:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    verdict = engine.classify(json.loads(line))
+                    print(json.dumps(verdict), flush=True)
+            finally:
+                if stream is not sys.stdin:
+                    stream.close()
+        else:
+            _demo(engine, ds, args.demo_queries, seed=args.seed)
+
+        snap = engine.stats.snapshot(queue_depth=engine.batcher.queue_depth)
+        print("serve stats: " + json.dumps(snap), file=sys.stderr)
+        return 0
+    finally:
+        engine.close()
+
+
+def _demo(engine, ds, num_queries: int, seed: int = 0) -> None:
+    """Self-contained demo: classify held-out instances of the registered
+    corpus (instances AFTER the K supports, so the engine has not seen
+    them) and print one verdict line each."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    k = engine.registry.k
+    registered = set(engine.class_names)
+    pool = [
+        (rel, inst)
+        for rel in ds.rel_names if rel in registered
+        for inst in ds.instances[rel][k:]
+    ]
+    if not pool:
+        pool = [(rel, ds.instances[rel][0]) for rel in registered]
+    futures = []
+    for i in rng.choice(len(pool), size=min(num_queries, len(pool)),
+                        replace=False):
+        rel, inst = pool[int(i)]
+        futures.append((rel, engine.submit(inst)))
+    hits = 0
+    for true_rel, fut in futures:
+        verdict = fut.result(timeout=30.0)
+        hits += verdict["label"] == true_rel
+        print(json.dumps({"true": true_rel, **verdict}), flush=True)
+    print(f"demo accuracy: {hits}/{len(futures)}", file=sys.stderr)

@@ -1,0 +1,274 @@
+"""InferenceEngine: checkpoint -> low-latency few-shot query answering.
+
+Wires the serving pieces end to end: a ``ClassVectorRegistry`` (supports
+distilled once, resident on device), a ``QueryProgramCache`` (AOT-compiled
+per-bucket query programs), a ``DynamicBatcher`` (deadlines, backpressure,
+partial flush), and ``ServingStats``. Steady state per query: host
+tokenization + one pre-compiled program run (encoder pass + NTN score
+against the resident class matrix) — no support work, no compiles.
+
+NOTA (FewRel 2.0, Gao et al. 2019): checkpoints trained with ``na_rate > 0``
+carry a learned none-of-the-above head; its logit is appended as class N,
+and a query that lands there gets the explicit ``"no_relation"`` verdict —
+the open-world answer a serving engine needs for traffic that matches no
+registered relation.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from induction_network_on_fewrel_tpu.serving.batcher import DynamicBatcher, Request
+from induction_network_on_fewrel_tpu.serving.buckets import (
+    DEFAULT_BUCKETS,
+    QueryProgramCache,
+    select_bucket,
+    stack_queries,
+)
+from induction_network_on_fewrel_tpu.serving.registry import ClassVectorRegistry
+from induction_network_on_fewrel_tpu.serving.stats import ServingStats
+
+NO_RELATION = "no_relation"
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        model,
+        params,
+        cfg,
+        tokenizer,
+        k: int | None = None,
+        buckets: tuple[int, ...] = DEFAULT_BUCKETS,
+        max_queue_depth: int = 64,
+        batch_window_s: float = 0.002,
+        default_deadline_s: float = 1.0,
+        logger=None,
+        start: bool = True,
+    ):
+        if cfg.model != "induction":
+            raise ValueError(
+                f"class-vector serving requires --model induction (supports "
+                f"distill to per-class vectors); got {cfg.model!r}. Other "
+                f"episode heads re-read the support set per query."
+            )
+        if cfg.feature_cache:
+            raise ValueError(
+                "feature-cache checkpoints hold head-only params (no "
+                "encoder) — the serving engine cannot tokenize queries "
+                "through them; serve a full checkpoint instead"
+            )
+        self.cfg = cfg
+        self.model = model
+        self.params = params
+        self.tokenizer = tokenizer
+        self.nota = cfg.na_rate > 0
+        self.max_length = cfg.max_length
+        self.default_deadline_s = default_deadline_s
+        self._logger = logger
+        self._emit_step = 0
+
+        self.stats = ServingStats()
+        self.registry = ClassVectorRegistry(
+            model, params, tokenizer, k=k if k is not None else cfg.k
+        )
+        self.programs = QueryProgramCache(model, stats=self.stats)
+        self.batcher = DynamicBatcher(
+            self._execute_batch,
+            buckets=buckets,
+            max_queue_depth=max_queue_depth,
+            batch_window_s=batch_window_s,
+            stats=self.stats,
+            start=start,
+        )
+
+    # --- construction from a trained artifact ----------------------------
+
+    @classmethod
+    def from_checkpoint(
+        cls, ckpt_dir: str, device: str | None = None,
+        glove: str | None = None, glove_mat: str | None = None, **kw
+    ) -> "InferenceEngine":
+        """Build an engine from a checkpoint directory: the stored
+        config.json decides the architecture (exactly as test.py does), the
+        best checkpoint (falling back to the recovery ring) supplies the
+        weights. ``device`` overrides the stored --device for serving."""
+        import jax
+
+        from induction_network_on_fewrel_tpu.data import make_synthetic_glove
+        from induction_network_on_fewrel_tpu.data.glove import load_glove
+        from induction_network_on_fewrel_tpu.data.tokenizer import GloveTokenizer
+        from induction_network_on_fewrel_tpu.models import build_model
+        from induction_network_on_fewrel_tpu.train.checkpoint import (
+            CheckpointManager,
+        )
+        from induction_network_on_fewrel_tpu.train.steps import init_state
+
+        cfg = CheckpointManager.load_config(ckpt_dir)
+        if device is not None:
+            cfg = cfg.replace(device=device)
+        if cfg.encoder == "bert":
+            from induction_network_on_fewrel_tpu.data.bert_tokenizer import (
+                BertTokenizer,
+            )
+
+            vocab = None
+            tok = BertTokenizer(
+                cfg.max_length, vocab_path=cfg.bert_vocab_path,
+                vocab_size=cfg.bert_vocab_size,
+            )
+        else:
+            vocab = (
+                load_glove(glove, glove_mat) if glove
+                else make_synthetic_glove(
+                    vocab_size=cfg.vocab_size - 2, word_dim=cfg.word_dim
+                )
+            )
+            if (cfg.vocab_size, cfg.word_dim) != (vocab.vocab_size, vocab.word_dim):
+                raise ValueError(
+                    f"vocab {vocab.vocab_size}x{vocab.word_dim} does not "
+                    f"match the checkpoint's embedding table "
+                    f"{cfg.vocab_size}x{cfg.word_dim} — pass the GloVe file "
+                    f"the model was trained with"
+                )
+            tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+        model = build_model(
+            cfg, glove_init=vocab.vectors if vocab is not None else None
+        )
+        # Restore target: the same state tree training would build (shapes
+        # only — the zero token ids never influence the restored weights).
+        from induction_network_on_fewrel_tpu.serving.buckets import zero_batch
+
+        state = init_state(
+            model, cfg,
+            zero_batch(cfg.max_length, (1, cfg.n, cfg.k)),
+            zero_batch(cfg.max_length, (1, cfg.total_q)),
+        )
+        mngr = CheckpointManager(ckpt_dir, cfg)
+        try:
+            try:
+                state, step = mngr.restore_best(state)
+                which = "best"
+            except FileNotFoundError:
+                state, step = mngr.restore_latest(state)
+                which = "latest"
+        finally:
+            mngr.close()
+        print(
+            f"serving {which} checkpoint step={step} from {ckpt_dir} "
+            f"on {jax.default_backend()}",
+            file=sys.stderr,
+        )
+        return cls(model, state.params, cfg, tok, **kw)
+
+    # --- registration ----------------------------------------------------
+
+    def register_class(self, name: str, instances) -> None:
+        self.registry.register(name, instances)
+
+    def register_dataset(self, dataset, max_classes: int | None = None) -> list[str]:
+        return self.registry.register_dataset(dataset, max_classes=max_classes)
+
+    @property
+    def class_names(self) -> tuple[str, ...]:
+        return self.registry.names
+
+    def warmup(self) -> int:
+        """AOT-compile every bucket's query program for the current class
+        count; returns how many programs this call compiled. After warmup,
+        steady-state traffic is zero-recompile (stats.steady_recompiles
+        counts violations)."""
+        mat = np.asarray(self.registry.class_matrix())
+        n, c = mat.shape
+        return self.programs.warmup(
+            self.params, n, c, self.batcher.buckets, self.max_length
+        )
+
+    # --- query path ------------------------------------------------------
+
+    def submit(self, instance, deadline_s: float | None = None):
+        """Tokenize one query and enqueue it; returns a Future resolving to
+        the verdict dict. Raises ``Saturated`` under backpressure."""
+        if len(self.registry) == 0:
+            raise ValueError("no classes registered — register supports first")
+        t = self.tokenizer(self._as_instance(instance))
+        query = {"word": t.word, "pos1": t.pos1, "pos2": t.pos2, "mask": t.mask}
+        return self.batcher.submit(
+            query,
+            deadline_s if deadline_s is not None else self.default_deadline_s,
+        )
+
+    def classify(self, instance, deadline_s: float | None = None) -> dict:
+        """Synchronous submit + wait."""
+        fut = self.submit(instance, deadline_s)
+        timeout = (deadline_s or self.default_deadline_s) + 5.0
+        return fut.result(timeout=timeout)
+
+    def _execute_batch(self, batch: list[Request]) -> None:
+        # Atomic (names, matrix) snapshot: concurrent registration must not
+        # skew the verdict index -> name mapping (registry.snapshot doc).
+        names, class_mat = self.registry.snapshot()
+        bucket = select_bucket(len(batch), self.batcher.buckets)
+        query = stack_queries([r.query for r in batch], bucket)
+        t0 = time.monotonic()
+        logits = self.programs.run(self.params, class_mat, query)
+        exec_s = time.monotonic() - t0
+        self.stats.record_batch(len(batch), bucket, exec_s)
+        now = time.monotonic()
+        for row, req in zip(logits, batch):   # zip drops the pad rows
+            idx = int(np.argmax(row))
+            is_nota = self.nota and idx == len(names)
+            verdict = {
+                "label": NO_RELATION if is_nota else names[idx],
+                "class_index": -1 if is_nota else idx,
+                "nota": is_nota,
+                "logits": {n: float(row[i]) for i, n in enumerate(names)},
+                "latency_ms": round((now - req.enqueued_at) * 1e3, 3),
+            }
+            if self.nota:
+                verdict["logits"][NO_RELATION] = float(row[len(names)])
+            self.stats.record_done(now - req.enqueued_at)
+            req.future.set_result(verdict)
+        self._maybe_emit()
+
+    # --- observability / lifecycle ---------------------------------------
+
+    def _maybe_emit(self, every: int = 50) -> None:
+        if self._logger is None:
+            return
+        if self.stats.batches - self._emit_step >= every:
+            self._emit_step = self.stats.batches
+            self.stats.emit(
+                self._logger, self._emit_step,
+                queue_depth=self.batcher.queue_depth,
+            )
+
+    def emit_stats(self) -> None:
+        if self._logger is not None:
+            self.stats.emit(
+                self._logger, self.stats.batches,
+                queue_depth=self.batcher.queue_depth,
+            )
+
+    def close(self) -> None:
+        self.batcher.close()
+        self.emit_stats()
+
+    @staticmethod
+    def _as_instance(x):
+        from induction_network_on_fewrel_tpu.data.fewrel import Instance
+
+        if isinstance(x, Instance):
+            return x
+        if isinstance(x, dict):
+            if "h" in x:                       # raw FewRel JSON schema
+                return Instance.from_raw(x)
+            return Instance(
+                tokens=tuple(x["tokens"]),
+                head_pos=tuple(x.get("head_pos", (0,))),
+                tail_pos=tuple(x.get("tail_pos", (0,))),
+            )
+        raise TypeError(f"cannot interpret query of type {type(x).__name__}")

@@ -1,0 +1,122 @@
+"""Serving observability: latency percentiles, queue depth, batch occupancy,
+and recompile counters.
+
+All counters are updated from two threads (submitters + the batcher worker),
+so every mutation holds one lock; reads produce a consistent ``snapshot()``
+dict that is also the record emitted through the existing
+``utils.metrics.MetricsLogger`` (kind="serve" lines in metrics.jsonl — the
+same machine-readable channel train/val metrics use).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class ServingStats:
+    """Thread-safe serving counters + a bounded latency reservoir."""
+
+    # Bounded reservoir: long soaks must not grow host memory without limit.
+    # Replacement is deterministic round-robin past the cap — percentiles
+    # then reflect a sliding window over recent traffic, which is the
+    # operationally useful view anyway.
+    MAX_SAMPLES = 65536
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._lat_ms: list[float] = []
+        self._lat_next = 0          # round-robin slot past MAX_SAMPLES
+        self.served = 0             # futures resolved with a verdict
+        self.rejected = 0           # backpressure rejections at submit
+        self.deadline_missed = 0    # expired before execution
+        self.batches = 0            # bucket executions
+        self.batch_rows = 0         # real (unpadded) rows executed
+        self.batch_slots = 0        # bucket slots executed (incl. padding)
+        self.exec_s_total = 0.0     # device time across batches
+        self._exec_ewma_s: float | None = None
+        self.warmup_compiles = 0    # programs compiled by warmup()
+        self.steady_compiles = 0    # programs compiled AFTER warmup — the
+        #                             zero-recompile acceptance counter
+
+    # --- recording -------------------------------------------------------
+
+    def record_done(self, latency_s: float) -> None:
+        with self._lock:
+            self.served += 1
+            ms = latency_s * 1e3
+            if len(self._lat_ms) < self.MAX_SAMPLES:
+                self._lat_ms.append(ms)
+            else:
+                self._lat_ms[self._lat_next] = ms
+                self._lat_next = (self._lat_next + 1) % self.MAX_SAMPLES
+
+    def record_rejected(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_deadline_miss(self) -> None:
+        with self._lock:
+            self.deadline_missed += 1
+
+    def record_batch(self, rows: int, bucket: int, exec_s: float) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batch_rows += rows
+            self.batch_slots += bucket
+            self.exec_s_total += exec_s
+            # EWMA of batch execution time: the batcher's deadline-pressure
+            # slack estimate (how long collecting more rows can wait before
+            # the oldest request would miss its deadline).
+            a = 0.2
+            self._exec_ewma_s = (
+                exec_s if self._exec_ewma_s is None
+                else a * exec_s + (1 - a) * self._exec_ewma_s
+            )
+
+    def record_compile(self, during_warmup: bool) -> None:
+        with self._lock:
+            if during_warmup:
+                self.warmup_compiles += 1
+            else:
+                self.steady_compiles += 1
+
+    # --- reading ---------------------------------------------------------
+
+    def exec_estimate_s(self, default: float = 0.005) -> float:
+        with self._lock:
+            return self._exec_ewma_s if self._exec_ewma_s is not None else default
+
+    def percentile_ms(self, q: float) -> float | None:
+        """Nearest-rank percentile over the latency reservoir (no numpy
+        import on the submit path; the reservoir is small)."""
+        with self._lock:
+            lat = sorted(self._lat_ms)
+        if not lat:
+            return None
+        i = min(len(lat) - 1, max(0, int(round(q / 100.0 * len(lat))) - 1))
+        return lat[i]
+
+    def snapshot(self, queue_depth: int | None = None) -> dict:
+        p50, p99 = self.percentile_ms(50), self.percentile_ms(99)
+        with self._lock:
+            occ = (
+                self.batch_rows / self.batch_slots if self.batch_slots else 0.0
+            )
+            snap = {
+                "served": self.served,
+                "rejected": self.rejected,
+                "deadline_missed": self.deadline_missed,
+                "batches": self.batches,
+                "batch_occupancy": round(occ, 4),
+                "p50_ms": round(p50, 3) if p50 is not None else 0.0,
+                "p99_ms": round(p99, 3) if p99 is not None else 0.0,
+                "warmup_compiles": self.warmup_compiles,
+                "steady_recompiles": self.steady_compiles,
+            }
+        if queue_depth is not None:
+            snap["queue_depth"] = queue_depth
+        return snap
+
+    def emit(self, logger, step: int, queue_depth: int | None = None) -> None:
+        """One kind="serve" record through utils.metrics.MetricsLogger."""
+        logger.log(step, kind="serve", **self.snapshot(queue_depth))

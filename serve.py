@@ -1,0 +1,16 @@
+#!/usr/bin/env python3
+"""Serving entrypoint — the query-answering engine next to train.py/test.py.
+
+Example:
+    python serve.py --load_ckpt ./ckpt/bilstm_5w5s \
+        --support_file data/val_wiki.json --K 5 --input queries.jsonl
+
+No checkpoint / no data? `python serve.py` runs a fully synthetic demo
+(fresh-init weights, synthetic support corpus, built-in demo queries).
+"""
+import sys
+
+from induction_network_on_fewrel_tpu.serving.cli import serve_main
+
+if __name__ == "__main__":
+    sys.exit(serve_main())

@@ -124,6 +124,30 @@ def test_n_mismatch_rejected(name):
         build_model(BASE.replace(model=name, train_n=6, n=4))
 
 
+def test_gnn_adjacency_forms_equivalent():
+    """The one-hot adjacency form and its large-T broadcast fallback
+    (gnn._AdjacencyMLP.one_hot_max_t size guard) compute the same
+    row-stochastic adjacency from the same params."""
+    import numpy as np
+
+    from induction_network_on_fewrel_tpu.models.gnn import _AdjacencyMLP
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(3, 7, 10)).astype(np.float32))
+    onehot = _AdjacencyMLP(hidden=8, compute_dtype=jnp.float32)
+    bcast = _AdjacencyMLP(hidden=8, compute_dtype=jnp.float32,
+                          one_hot_max_t=4)  # T=7 > 4 forces the fallback
+    params = onehot.init(jax.random.key(0), x)
+    a1 = onehot.apply(params, x)
+    a2 = bcast.apply(params, x)  # identical param tree: forms interchange
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a2),
+                               rtol=1e-5, atol=1e-6)
+    # Both keep self-edges masked and rows stochastic.
+    for a in (a1, a2):
+        np.testing.assert_allclose(np.asarray(a).sum(-1), 1.0, rtol=1e-5)
+        assert float(np.abs(np.asarray(a)[:, np.arange(7), np.arange(7)]).max()) < 1e-6
+
+
 def test_checkpoint_merge_carries_model_geometry():
     """Geometry fields that shape params (k for proto_hatt, n for gnn) ride
     along in merge_architecture_from so restores don't hit shape errors."""

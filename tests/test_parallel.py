@@ -261,7 +261,8 @@ def test_distributed_init_failure_is_clean(monkeypatch):
     for v in ("COORDINATOR_ADDRESS", "TPU_WORKER_ID",
               "MEGASCALE_COORDINATOR_ADDRESS"):
         monkeypatch.delenv(v, raising=False)
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False,
+                        raising=False)  # attr absent on older jax
     assert maybe_initialize_distributed() is False
 
     monkeypatch.setenv("COORDINATOR_ADDRESS", "127.0.0.1:1")  # nothing there
@@ -269,7 +270,8 @@ def test_distributed_init_failure_is_clean(monkeypatch):
         jax.distributed, "initialize",
         lambda **kw: (_ for _ in ()).throw(TimeoutError("deadline exceeded")),
     )
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False,
+                        raising=False)  # attr absent on older jax
     with pytest.raises(RuntimeError, match="multi-host initialization"):
         maybe_initialize_distributed()
 

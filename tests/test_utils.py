@@ -68,6 +68,18 @@ def test_train_step_flops_covers_the_zoo():
         f = train_step_flops(cfg)
         assert f["train"] > 0
         assert f["per_episode"] * cfg.batch_size == f["train"]
+        # Implementation-overhead matmuls (one-hot select/reconstruct) are
+        # tracked OUTSIDE the algorithmic fields; only gnn has any.
+        assert f["overhead_flops"] >= 0
+        assert (f["overhead_flops"] > 0) == (model == "gnn")
+    # Above the gnn module's one_hot_max_t the broadcast fallback runs: no
+    # one-hot matmuls exist (overhead 0) and the edge MLP prices T^2 pairs.
+    big = train_step_flops(
+        ExperimentConfig(encoder="cnn", model="gnn",
+                         **{**base, "n": 13, "k": 5, "train_n": 13})
+    )  # T = 66 > 64
+    assert big["overhead_flops"] == 0.0
+    assert big["train"] > 0
     for enc in ("cnn", "bilstm", "transformer", "bert"):
         assert train_step_flops(
             ExperimentConfig(encoder=enc, **base)
