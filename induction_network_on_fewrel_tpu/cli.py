@@ -256,6 +256,21 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
                             "reaches N on a FRESH run (resumed runs ignore "
                             "it, so crash -> --resume completes; exercises "
                             "the recovery ring; debug)")
+        p.add_argument("--watchdog", action="store_true",
+                       help="run-health watchdog (obs/health.py): NaN/Inf "
+                            "scalars, throughput regression, routing "
+                            "collapse -> kind='health' events; critical "
+                            "events dump flight_recorder.json to --run_dir")
+        p.add_argument("--grad_probe_every", type=int, default=0,
+                       help="every K steps, log grad global-norm + "
+                            "grad-cosine vs an all-f32 reference backward "
+                            "on the same batch (bf16-backward soak "
+                            "visibility; 0 = off)")
+        p.add_argument("--nan_inject_step", type=int, default=0,
+                       help="telemetry-failure injection: corrupt the "
+                            "LOGGED loss with NaN once past step N "
+                            "(training unaffected; exercises the watchdog "
+                            "trip + flight-recorder dump; debug)")
     return p
 
 
@@ -336,6 +351,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         token_cache=getattr(args, "token_cache", False),
         divergence_guard=getattr(args, "divergence_guard", "none"),
         fault_step=getattr(args, "fault_step", 0),
+        watchdog=getattr(args, "watchdog", False),
+        grad_probe_every=getattr(args, "grad_probe_every", 0),
+        nan_inject_step=getattr(args, "nan_inject_step", 0),
         zero_opt=getattr(args, "zero_opt", False),
         device=args.device, compute_dtype=compute, seed=args.seed,
         dp=args.dp, tp=args.tp, sp=args.sp, pp=args.pp, ep=args.ep,
@@ -1019,6 +1037,19 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         )
 
     run_dir = args.run_dir or args.save_ckpt
+    watchdog = recorder = None
+    if cfg.watchdog:
+        # Telemetry spine (obs/): the recorder retains the last-N window
+        # and dumps on crash/SIGTERM/watchdog trip; the watchdog consumes
+        # every metrics record via a logger hook (wired by the trainer).
+        from induction_network_on_fewrel_tpu.obs import (
+            FlightRecorder,
+            HealthWatchdog,
+        )
+
+        recorder = FlightRecorder(out_dir=run_dir)
+        recorder.install_sigterm_handler()
+        watchdog = HealthWatchdog(recorder=recorder)
     trainer = FewShotTrainer(
         model, cfg, train_sampler, val_sampler,
         ckpt_dir=None if only_test else args.save_ckpt,
@@ -1031,6 +1062,7 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         mesh=mesh, adv=adv_pieces,
         profile_dir=getattr(args, "profile", None),
         profile_steps=getattr(args, "profile_steps", 10),
+        watchdog=watchdog, recorder=recorder,
     )
     if getattr(args, "debug_nans", False):
         from induction_network_on_fewrel_tpu.utils.debug import checkify_step
@@ -1063,10 +1095,12 @@ def make_test_sampler(args, cfg: ExperimentConfig, tok):
     )
 
 
-def _test_accuracy(args, cfg: ExperimentConfig, trainer, state) -> float:
+def _test_accuracy(args, cfg: ExperimentConfig, trainer, state) -> dict:
     """Evaluate on the test split, via the feature-cache path when active
     (the cached eval step reads int32 indices into a test-split table; the
-    token sampler's dicts would not even trace)."""
+    token sampler's dicts would not even trace). Returns the full metric
+    dict — accuracy plus acc_ci95 (±1.96·σ/√n, VERDICT weak #8) and the
+    NOTA confusion metrics when na_rate > 0."""
     if trainer.cached_test_eval is not None:
         test_ds = load_data(args, cfg, "test")
         sampler, eval_step, fused_eval = trainer.cached_test_eval(test_ds)
@@ -1077,16 +1111,48 @@ def _test_accuracy(args, cfg: ExperimentConfig, trainer, state) -> float:
         # rows. Both steps installed here are bound to the TEST table.
         trainer._fused_eval = fused_eval
         try:
-            return trainer.evaluate(state.params, cfg.test_iter, sampler=sampler)
+            m = trainer.evaluate(
+                state.params, cfg.test_iter, sampler=sampler,
+                return_metrics=True,
+            )
+            trainer.logger.log(0, "test", **m)
+            return m
         finally:
             if hasattr(sampler, "close"):
                 sampler.close()
     sampler = make_test_sampler(args, cfg, trainer.tokenizer)
     try:
-        return trainer.evaluate(state.params, cfg.test_iter, sampler=sampler)
+        m = trainer.evaluate(
+            state.params, cfg.test_iter, sampler=sampler, return_metrics=True
+        )
+        # kind="test" record: test accuracy + CI land in metrics.jsonl
+        # alongside the run's train/val stream (machine-readable eval).
+        trainer.logger.log(0, "test", **m)
+        return m
     finally:
         if hasattr(sampler, "close"):
             sampler.close()
+
+
+def _print_test_result(metrics: dict, kind: str = "test") -> None:
+    """Human line (stderr, with the ±CI error bar) + machine JSON line
+    (stdout; existing consumers key on test_accuracy, new ones get
+    acc_ci95 alongside). json.dumps + json_sanitize, not f-strings: a
+    pathological NaN accuracy must not produce an unparseable line."""
+    import json
+
+    from induction_network_on_fewrel_tpu.utils.metrics import json_sanitize
+
+    acc, ci = metrics["accuracy"], metrics.get("acc_ci95", 0.0)
+    print(f"{kind} accuracy: {acc:.4f} ± {ci:.4f} (95% CI)", file=sys.stderr)
+    out = {"test_accuracy": acc, "acc_ci95": ci}
+    out.update(
+        {k: v for k, v in metrics.items() if k not in ("accuracy", "acc_ci95")}
+    )
+    print(json.dumps(
+        {k: json_sanitize(round(v, 4) if isinstance(v, float) else v)
+         for k, v in out.items()}
+    ))
 
 
 def _merge_ckpt_architecture(cfg: ExperimentConfig, src: str) -> ExperimentConfig:
@@ -1158,8 +1224,7 @@ def _run_train(args, trainer) -> int:
                 mngr.close()  # restore-only manager: stop its saver thread
 
     if args.only_test:
-        acc = _test_accuracy(args, cfg, trainer, state)
-        print(f'{{"test_accuracy": {acc:.4f}}}')
+        _print_test_result(_test_accuracy(args, cfg, trainer, state))
         return 0
 
     # Global step numbering continues from the restored step on --resume so
@@ -1185,8 +1250,19 @@ def _run_train(args, trainer) -> int:
                       file=sys.stderr)
             except FileNotFoundError:
                 pass  # no best saved (e.g. val never ran): use last state
-        acc = trainer.evaluate(state.params, cfg.val_iter)
-        print(f'{{"final_val_accuracy": {acc:.4f}}}')
+        import json
+
+        from induction_network_on_fewrel_tpu.utils.metrics import json_sanitize
+
+        m = trainer.evaluate(state.params, cfg.val_iter, return_metrics=True)
+        acc, ci = m["accuracy"], m.get("acc_ci95", 0.0)
+        print(f"final val accuracy: {acc:.4f} ± {ci:.4f} (95% CI)",
+              file=sys.stderr)
+        # Same NaN-safe serialization contract as _print_test_result.
+        print(json.dumps({
+            "final_val_accuracy": json_sanitize(round(acc, 4)),
+            "acc_ci95": json_sanitize(round(ci, 4)),
+        }))
     return 0
 
 
@@ -1224,8 +1300,7 @@ def test_main(argv=None) -> int:
         state = trainer.reshard_state(state)
         print(f"loaded {which} checkpoint step={step} from {src}", file=sys.stderr)
 
-        acc = _test_accuracy(args, cfg, trainer, state)
-        print(f'{{"test_accuracy": {acc:.4f}}}')
+        _print_test_result(_test_accuracy(args, cfg, trainer, state))
         return 0
     finally:
         trainer.close()

@@ -153,6 +153,21 @@ class ExperimentConfig:
     # step counter reaches this value — exercises the crash/recovery path
     # (recovery ring + --resume) end-to-end. 0 = off. Debug-only knob.
     fault_step: int = 0
+    # Run-health watchdog (obs/health.py): watch the metrics stream for
+    # NaN/Inf scalars, throughput regression vs a rolling baseline, and
+    # routing-entropy collapse; critical events dump the flight recorder.
+    watchdog: bool = False
+    # Grad-health probe (train/steps.py make_grad_probe, VERDICT weak #7):
+    # every K steps, log grad global-norm and grad-cosine against an
+    # all-f32 reference backward on the same batch (kind="health",
+    # event="grad_probe" in metrics.jsonl). 0 = off. Live-token
+    # single-device path only (cached/adv paths skip it with a warning).
+    grad_probe_every: int = 0
+    # Telemetry-failure injection: corrupt the LOGGED loss with NaN once
+    # the step counter crosses this value (training state is untouched) —
+    # exercises watchdog trip + flight-recorder dump end-to-end the way
+    # fault_step exercises crash/recovery. 0 = off. Debug-only knob.
+    nan_inject_step: int = 0
 
     # --- FewRel 2.0 adversarial domain adaptation (training-time only) ---
     adv: bool = False         # train encoder against a domain discriminator

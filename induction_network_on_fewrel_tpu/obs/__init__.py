@@ -1,0 +1,45 @@
+"""obs/ — the unified telemetry spine (ISSUE 2).
+
+One shared model for what used to be three fragmented mechanisms:
+
+* ``spans``    — host-side timed regions (ring-buffered, named-scope
+                 bridged to XPlane traces).
+* ``health``   — run-health watchdog over the metrics stream (NaN/Inf,
+                 throughput regression, routing collapse, queue stall).
+* ``recorder`` — flight recorder; dumps the last-N window on crash,
+                 SIGTERM, or a watchdog trip.
+* ``export``   — counter/gauge registry + Prometheus text exposition.
+
+``tools/obs_report.py`` renders the emitted stream (metrics.jsonl +
+flight_recorder.json) into a single run report and schema-checks it.
+"""
+
+from induction_network_on_fewrel_tpu.obs.export import (
+    CounterRegistry,
+    get_registry,
+    set_registry,
+)
+from induction_network_on_fewrel_tpu.obs.health import (
+    HealthEvent,
+    HealthWatchdog,
+)
+from induction_network_on_fewrel_tpu.obs.recorder import FlightRecorder
+from induction_network_on_fewrel_tpu.obs.spans import (
+    SpanTracker,
+    get_tracker,
+    set_tracker,
+    span,
+)
+
+__all__ = [
+    "CounterRegistry",
+    "FlightRecorder",
+    "HealthEvent",
+    "HealthWatchdog",
+    "SpanTracker",
+    "get_registry",
+    "get_tracker",
+    "set_registry",
+    "set_tracker",
+    "span",
+]

@@ -1,0 +1,186 @@
+"""Shared counter/gauge registry + Prometheus text exposition.
+
+Before this layer, every execution path kept its own counters
+(``serving/stats.py`` fields, trainer locals); the registry gives them one
+namespace so a scrape — or the run report — sees train and serving through
+the same model:
+
+* ``counter(name)`` — monotonically increasing totals.
+* ``gauge(name)`` — last-written values.
+* ``gauge_fn(name, fn)`` — computed at render time (e.g. queue depth read
+  from the live batcher instead of mirrored on every mutation).
+
+``to_prometheus()`` renders the standard text exposition format
+(``# TYPE``/``# HELP`` + one sample per line) so the output can be served
+from any HTTP handler or dropped into a textfile collector; nothing here
+imports an HTTP server or a client library.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Callable
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _check_name(name: str) -> str:
+    if not _NAME_RE.match(name):
+        raise ValueError(f"invalid metric name {name!r}")
+    return name
+
+
+class Counter:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up; use a gauge")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class CounterRegistry:
+    """Named counters/gauges with idempotent registration: asking for the
+    same name twice returns the same instrument, so independent modules
+    (stats emitters, the trainer, tools) can share one registry without
+    coordinating construction order."""
+
+    def __init__(self, prefix: str = "induction"):
+        self.prefix = _check_name(prefix)
+        self._lock = threading.Lock()
+        self._instruments: dict[str, Counter | Gauge] = {}
+        self._fns: dict[str, Callable[[], float]] = {}
+        self._help: dict[str, str] = {}
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, help, Counter)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, help, Gauge)
+
+    def _get(self, name: str, help: str, cls):
+        _check_name(name)
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                if name in self._fns:
+                    raise ValueError(f"{name!r} already registered as gauge_fn")
+                inst = self._instruments[name] = cls()
+                self._help[name] = help
+            elif not isinstance(inst, cls):
+                raise ValueError(
+                    f"{name!r} already registered as {type(inst).__name__}"
+                )
+            return inst
+
+    def gauge_fn(self, name: str, fn: Callable[[], float], help: str = "") -> None:
+        """Register a pull-style gauge evaluated at render time.
+        Re-registration replaces the callback (latest wins) — a fresh
+        ServingStats binding over a closed one must not raise."""
+        _check_name(name)
+        with self._lock:
+            if name in self._instruments:
+                raise ValueError(f"{name!r} already registered as instrument")
+            self._fns[name] = fn
+            self._help[name] = help
+
+    def unregister(self, name: str, fn: Callable[[], float] | None = None) -> None:
+        """Drop an instrument or gauge_fn. Idempotent. Lets a closing
+        component (e.g. ServingStats.unbind_registry) release the
+        callbacks that would otherwise pin it in the global registry and
+        keep rendering stale values after its engine is gone. With ``fn``,
+        the gauge_fn is removed only if it is STILL the registered one —
+        a closing engine must not delete the live gauges a successor
+        engine re-registered under the same names (latest-wins)."""
+        with self._lock:
+            if fn is not None:
+                if self._fns.get(name) is fn:
+                    self._fns.pop(name)
+                    self._help.pop(name, None)
+                return
+            self._instruments.pop(name, None)
+            self._fns.pop(name, None)
+            self._help.pop(name, None)
+
+    # --- reading ---------------------------------------------------------
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            insts = dict(self._instruments)
+            fns = dict(self._fns)
+        out = {name: inst.value for name, inst in insts.items()}
+        for name, fn in fns.items():
+            try:
+                out[name] = float(fn())
+            except Exception:
+                out[name] = float("nan")  # a dead callback must not kill a scrape
+        return out
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (one metric family per instrument)."""
+        with self._lock:
+            insts = dict(self._instruments)
+            fns = dict(self._fns)
+            helps = dict(self._help)
+        lines = []
+        values = self.snapshot()
+        for name in sorted(values):
+            full = f"{self.prefix}_{name}"
+            mtype = (
+                "counter"
+                if isinstance(insts.get(name), Counter) else "gauge"
+            )
+            if name in fns:
+                mtype = "gauge"
+            if helps.get(name):
+                lines.append(f"# HELP {full} {helps[name]}")
+            lines.append(f"# TYPE {full} {mtype}")
+            lines.append(f"{full} {values[name]:g}")
+        return "\n".join(lines) + "\n"
+
+
+# Process-global registry: integration points (ServingStats, the trainer)
+# default to it, mirroring the global span tracker in obs/spans.py.
+_GLOBAL = CounterRegistry()
+
+
+def get_registry() -> CounterRegistry:
+    return _GLOBAL
+
+
+def set_registry(reg: CounterRegistry) -> CounterRegistry:
+    global _GLOBAL
+    prev, _GLOBAL = _GLOBAL, reg
+    return prev

@@ -1,0 +1,241 @@
+"""Run-health watchdog: turns the metrics stream into structured events.
+
+The failure modes this catches are the ones VERDICT.md flags as silent
+today:
+
+* **Non-finite loss/grads** — NaN/Inf in any numeric scalar of a train
+  record (the bf16-backward risk, the MSE-sigmoid dead zone).
+* **Throughput regression** — episodes/sec falling below a fraction of the
+  rolling-median baseline (feed stall, thermal/preemption slowdowns).
+* **Routing-entropy collapse** — the induction routing (or any model that
+  logs a ``routing_entropy`` / ``*_entropy`` scalar) pinning near zero:
+  every query routed identically, i.e. the class vectors collapsed.
+* **Serving queue stall** — queue depth > 0 while the served counter stops
+  advancing for longer than ``queue_stall_s`` (a wedged batcher worker).
+
+Wiring: the watchdog is installed as a ``MetricsLogger`` hook, so every
+record every execution path emits (train/val/serve) flows through
+``observe_record`` with no extra calls at the emit sites. Events are
+appended to the flight recorder, logged as ``kind="health"`` records in
+metrics.jsonl, and — for critical events — trip the watchdog, which dumps
+the flight recorder (obs/recorder.py) so the last-N window of context
+survives the incident.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+
+CRITICAL = "critical"
+WARNING = "warning"
+
+
+@dataclasses.dataclass
+class HealthEvent:
+    event: str                 # "non_finite" | "throughput_regression" | ...
+    severity: str              # "critical" | "warning"
+    step: int
+    message: str
+    data: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "event": self.event,
+            "severity": self.severity,
+            "step": self.step,
+            "message": self.message,
+            **{k: v for k, v in self.data.items()},
+        }
+
+
+class HealthWatchdog:
+    def __init__(
+        self,
+        logger=None,
+        recorder=None,
+        throughput_drop: float = 0.5,
+        throughput_window: int = 8,
+        throughput_warmup: int = 3,
+        entropy_floor: float = 0.05,
+        queue_stall_s: float = 5.0,
+        on_event: Callable[[HealthEvent], None] | None = None,
+    ):
+        """``throughput_drop``: trip when eps/s < drop * rolling median.
+        ``throughput_warmup``: train records to observe before the baseline
+        arms (the first windows include compile time and are not a
+        baseline). ``logger``/``recorder`` are attached lazily so the
+        watchdog can be constructed before either exists."""
+        self.logger = logger
+        self.recorder = recorder
+        self.throughput_drop = throughput_drop
+        self.throughput_warmup = throughput_warmup
+        self.entropy_floor = entropy_floor
+        self.queue_stall_s = queue_stall_s
+        self.on_event = on_event
+        # Bounded (the module contract says everything here is): a
+        # condition that persists for a whole soak must not grow host
+        # memory one event per window.
+        self.events: deque[HealthEvent] = deque(maxlen=512)
+        self.tripped = False
+        self._lock = threading.RLock()
+        self._eps = deque(maxlen=throughput_window)
+        self._in_emit = False
+        # Once-semantics latches: a PERSISTENT condition (loss stuck at
+        # NaN, entropy pinned at zero) emits one event when it begins and
+        # re-arms only after a clean observation — not one critical event
+        # (and one flight-recorder dump) per record for the rest of the
+        # run. Keys: "non_finite:<kind>", "routing_collapse:<metric>",
+        # "throughput".
+        self._latched: set[str] = set()
+        # Serving-stall state: (served counter, first time it was seen
+        # unchanged with a non-empty queue).
+        self._last_served: int | None = None
+        self._stall_since: float | None = None
+        self._stall_reported = False
+
+    # --- event plumbing --------------------------------------------------
+
+    def _emit(self, ev: HealthEvent) -> None:
+        self.events.append(ev)
+        if ev.severity == CRITICAL:
+            self.tripped = True
+        if self.recorder is not None:
+            self.recorder.record_event(ev.to_dict())
+        if self.logger is not None:
+            # Guard against self-observation: this log() call re-enters
+            # observe_record through the logger hook.
+            self._in_emit = True
+            try:
+                self.logger.log(
+                    ev.step, kind="health", event=ev.event,
+                    severity=ev.severity, message=ev.message, **ev.data,
+                )
+            finally:
+                self._in_emit = False
+        if ev.severity == CRITICAL and self.recorder is not None:
+            self.recorder.dump(reason=f"watchdog: {ev.event} ({ev.message})")
+        if self.on_event is not None:
+            self.on_event(ev)
+
+    # --- observations ----------------------------------------------------
+
+    def observe_record(self, rec: dict) -> None:
+        """MetricsLogger hook: one call per emitted record, any kind."""
+        with self._lock:
+            if self._in_emit:
+                return
+            kind = rec.get("kind")
+            if kind == "health":
+                # Grad-probe records are measurements, not watchdog output:
+                # a NaN grad norm must still trip the non-finite check.
+                if rec.get("event") == "grad_probe":
+                    self._check_finite(int(rec.get("step", 0)), rec)
+                return
+            step = int(rec.get("step", 0))
+            if kind in ("train", "val", "eval", "test", "serve"):
+                self._check_finite(step, rec)
+            if kind in ("train", "val", "eval"):
+                self._check_entropy(step, rec)
+            if kind == "train" and "episodes_per_s" in rec:
+                self._check_throughput(step, float(rec["episodes_per_s"]))
+            if kind == "serve":
+                self.observe_queue(
+                    int(rec.get("queue_depth", 0)),
+                    int(rec.get("served", 0)),
+                )
+
+    def _check_finite(self, step: int, rec: dict) -> None:
+        latch = f"non_finite:{rec.get('kind')}"
+        bad = {
+            k: str(v) for k, v in rec.items()
+            if isinstance(v, float) and not math.isfinite(v)
+        }
+        if not bad:
+            self._latched.discard(latch)  # clean record re-arms
+            return
+        if latch in self._latched:
+            return
+        self._latched.add(latch)
+        self._emit(HealthEvent(
+            event="non_finite", severity=CRITICAL, step=step,
+            message=f"non-finite scalars: {sorted(bad)}",
+            data={f"bad_{k}": v for k, v in bad.items()},
+        ))
+
+    def _check_entropy(self, step: int, rec: dict) -> None:
+        for k, v in rec.items():
+            if not k.endswith("entropy") or not isinstance(v, (int, float)):
+                continue
+            latch = f"routing_collapse:{k}"
+            if math.isfinite(v) and v < self.entropy_floor:
+                if latch in self._latched:
+                    continue
+                self._latched.add(latch)
+                self._emit(HealthEvent(
+                    event="routing_collapse", severity=CRITICAL, step=step,
+                    message=f"{k}={v:.4g} below floor {self.entropy_floor}",
+                    data={k: float(v)},
+                ))
+            else:
+                self._latched.discard(latch)
+
+    def _check_throughput(self, step: int, eps: float) -> None:
+        if not math.isfinite(eps):
+            return
+        if len(self._eps) >= self.throughput_warmup:
+            baseline = sorted(self._eps)[len(self._eps) // 2]  # rolling median
+            if baseline > 0 and eps < self.throughput_drop * baseline:
+                if "throughput" not in self._latched:
+                    self._latched.add("throughput")
+                    self._emit(HealthEvent(
+                        event="throughput_regression", severity=WARNING,
+                        step=step,
+                        message=(
+                            f"episodes_per_s {eps:.1f} < "
+                            f"{self.throughput_drop:.0%} of baseline "
+                            f"{baseline:.1f}"
+                        ),
+                        data={"episodes_per_s": eps, "baseline": baseline},
+                    ))
+                # A regressed window must not drag the baseline down with
+                # it (a real slowdown stays an incident, not the new
+                # normal) — and it must not re-arm the latch either.
+                return
+        self._latched.discard("throughput")  # healthy window re-arms
+        self._eps.append(eps)
+
+    def observe_queue(
+        self, queue_depth: int, served: int, now: float | None = None
+    ) -> None:
+        """Serving stall detection. Callable directly (the engine's emit
+        path does) with an injectable clock for tests."""
+        with self._lock:
+            now = time.monotonic() if now is None else now
+            if queue_depth <= 0 or (
+                self._last_served is not None and served > self._last_served
+            ):
+                self._stall_since = None
+                self._stall_reported = False
+            elif self._stall_since is None:
+                self._stall_since = now
+            elif (
+                not self._stall_reported
+                and now - self._stall_since >= self.queue_stall_s
+            ):
+                self._stall_reported = True
+                self._emit(HealthEvent(
+                    event="queue_stall", severity=CRITICAL, step=served,
+                    message=(
+                        f"queue depth {queue_depth} with served counter "
+                        f"stuck at {served} for "
+                        f"{now - self._stall_since:.1f}s"
+                    ),
+                    data={"queue_depth": queue_depth, "served": served},
+                ))
+            self._last_served = served

@@ -1,0 +1,134 @@
+"""Flight recorder: last-N telemetry kept in memory, dumped on incident.
+
+Answers "why did this run get slow/diverge" *after the fact* without
+re-running under a profiler: the recorder rides along holding bounded
+rings of (a) recent metric records, (b) health events, and (c) the span
+window from the tracker, and writes one ``flight_recorder.json`` when
+something goes wrong — a crash (``armed()`` context), SIGTERM, or a
+watchdog trip (obs/health.py calls ``dump`` on critical events).
+
+Everything is bounded; a recorder attached to a week-long soak costs the
+same memory as one attached to a smoke test.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import signal
+import threading
+import time
+from collections import deque
+from pathlib import Path
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        out_dir: str | Path | None = None,
+        tracker=None,
+        max_metrics: int = 512,
+        max_events: int = 256,
+    ):
+        """``out_dir`` is where ``flight_recorder.json`` lands (defaults to
+        the cwd at dump time). ``tracker`` is a SpanTracker whose current
+        window is included in dumps (default: the process-global one)."""
+        self.out_dir = Path(out_dir) if out_dir is not None else None
+        self._tracker = tracker
+        self._metrics: deque = deque(maxlen=max_metrics)
+        self._events: deque = deque(maxlen=max_events)
+        # RLock, not Lock: the SIGTERM handler runs dump() on the main
+        # thread between bytecodes — if the signal lands while that same
+        # thread is inside record_metric, a plain lock would deadlock the
+        # exit path instead of dumping.
+        self._lock = threading.RLock()
+        self._t0 = time.monotonic()
+        self.dump_count = 0
+        self.last_dump_path: Path | None = None
+        self._prev_sigterm = None
+
+    # --- feeding ---------------------------------------------------------
+
+    def record_metric(self, rec: dict) -> None:
+        """MetricsLogger hook: retain the most recent metric records."""
+        with self._lock:
+            self._metrics.append(rec)
+
+    def record_event(self, event: dict) -> None:
+        with self._lock:
+            self._events.append(event)
+
+    # --- dumping ---------------------------------------------------------
+
+    def _tracker_snapshot(self) -> list[dict]:
+        tracker = self._tracker
+        if tracker is None:
+            from induction_network_on_fewrel_tpu.obs.spans import get_tracker
+
+            tracker = get_tracker()
+        return tracker.snapshot()
+
+    def dump(self, reason: str, path: str | Path | None = None) -> Path:
+        """Write flight_recorder.json (atomically via tmp+rename) and
+        return its path. Multiple dumps overwrite — the newest incident is
+        the one being debugged; ``dump_count`` records that earlier dumps
+        happened."""
+        from induction_network_on_fewrel_tpu.utils.metrics import json_sanitize
+
+        with self._lock:
+            payload = {
+                "reason": reason,
+                "uptime_s": round(time.monotonic() - self._t0, 3),
+                "dumped_unix_s": time.time(),
+                "dump_count": self.dump_count + 1,
+                "events": list(self._events),
+                # Retained records carry raw floats (the watchdog needs
+                # them); the dump must stay strict JSON — no NaN tokens.
+                "metrics": [
+                    {k: json_sanitize(v) for k, v in m.items()}
+                    for m in self._metrics
+                ],
+                "spans": self._tracker_snapshot(),
+            }
+            self.dump_count += 1
+        out = Path(path) if path is not None else (
+            (self.out_dir or Path(".")) / "flight_recorder.json"
+        )
+        out.parent.mkdir(parents=True, exist_ok=True)
+        tmp = out.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(payload, default=str, indent=1))
+        tmp.replace(out)
+        self.last_dump_path = out
+        return out
+
+    # --- triggers --------------------------------------------------------
+
+    @contextlib.contextmanager
+    def armed(self, reason_prefix: str = "crash"):
+        """Dump on any exception escaping the block (then re-raise).
+        KeyboardInterrupt dumps too — an interrupted soak is exactly when
+        the window matters."""
+        try:
+            yield self
+        except BaseException as e:
+            self.dump(reason=f"{reason_prefix}: {type(e).__name__}: {e}")
+            raise
+
+    def install_sigterm_handler(self) -> bool:
+        """Dump on SIGTERM before chaining to the previous handler (or
+        default exit). Main-thread only — Python restricts signal() to it;
+        returns False (no-op) elsewhere so library use stays safe."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+
+        def _handler(signum, frame):
+            self.dump(reason="SIGTERM")
+            prev = self._prev_sigterm
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                signal.raise_signal(signal.SIGTERM)
+
+        self._prev_sigterm = signal.signal(signal.SIGTERM, _handler)
+        return True

@@ -32,6 +32,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+from induction_network_on_fewrel_tpu.obs.spans import span
 from induction_network_on_fewrel_tpu.parallel.sharding import (
     episode_batch_shardings,
 )
@@ -220,23 +221,32 @@ class PerHostSampler:
         return getattr(self.local, "return_indices", True)
 
     def sample_batch(self):
-        sup, qry, lab = batch_to_model_inputs(self.local.sample_batch())
-        return _AssembledBatch(*self.assembler(sup, qry, lab))
+        # Feed-latency spans (obs/spans.py): per-host sampling vs global
+        # assembly are the two halves of pod feed cost — separating them
+        # tells a slow-feed investigation whether the sampler or the
+        # make_array_from_process_local_data path is the term that grew.
+        with span("hostfeed/sample"):
+            sup, qry, lab = batch_to_model_inputs(self.local.sample_batch())
+        with span("hostfeed/assemble"):
+            return _AssembledBatch(*self.assembler(sup, qry, lab))
 
     def sample_fused(self, s: int):
         """S stacked local batches assembled into global [S, B_global, ...]
         arrays — keeps steps_per_call fusion available on pods."""
         local = self.local
-        if hasattr(local, "sample_fused"):
-            sup, qry, lab = local.sample_fused(s)
-        else:
-            batches = [
-                batch_to_model_inputs(local.sample_batch()) for _ in range(s)
-            ]
-            sup, qry, lab = jax.tree.map(
-                lambda *xs: np.stack(xs), *batches
-            )
-        return self.assembler.assemble_stacked(sup, qry, lab)
+        with span("hostfeed/sample", steps=s):
+            if hasattr(local, "sample_fused"):
+                sup, qry, lab = local.sample_fused(s)
+            else:
+                batches = [
+                    batch_to_model_inputs(local.sample_batch())
+                    for _ in range(s)
+                ]
+                sup, qry, lab = jax.tree.map(
+                    lambda *xs: np.stack(xs), *batches
+                )
+        with span("hostfeed/assemble", steps=s):
+            return self.assembler.assemble_stacked(sup, qry, lab)
 
     def __iter__(self):
         while True:

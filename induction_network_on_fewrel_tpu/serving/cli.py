@@ -53,11 +53,16 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
                    help="queries for the built-in demo (no --input)")
     p.add_argument("--run_dir", default=None,
                    help="metrics.jsonl dir for kind='serve' records")
+    p.add_argument("--watchdog", action="store_true",
+                   help="run-health watchdog (obs/health.py): queue-stall "
+                        "detection + NaN checks over the serve stream; "
+                        "critical events dump flight_recorder.json to "
+                        "--run_dir")
     p.add_argument("--seed", type=int, default=0)
     return p
 
 
-def _fresh_engine(args, buckets):
+def _fresh_engine(args, buckets, logger=None, watchdog=None):
     """Demo path: synthetic vocab + fresh-init induction weights (no
     checkpoint on disk). The serving machinery is identical; only the
     verdict quality is untrained."""
@@ -69,7 +74,6 @@ def _fresh_engine(args, buckets):
     from induction_network_on_fewrel_tpu.models import build_model
     from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
     from induction_network_on_fewrel_tpu.train.steps import init_state
-    from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
 
     cfg = ExperimentConfig(
         device=args.device, k=args.K, vocab_size=2002, seed=args.seed
@@ -92,7 +96,7 @@ def _fresh_engine(args, buckets):
         max_queue_depth=args.queue_depth,
         batch_window_s=args.batch_window_ms / 1e3,
         default_deadline_s=args.deadline_ms / 1e3,
-        logger=MetricsLogger(args.run_dir) if args.run_dir else None,
+        logger=logger, watchdog=watchdog,
     )
 
 
@@ -124,6 +128,21 @@ def serve_main(argv=None) -> int:
     from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
     from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
 
+    # One logger owned HERE (not per-engine): serve_main closes its
+    # persistent metrics.jsonl handle on exit.
+    logger = MetricsLogger(args.run_dir) if args.run_dir else None
+    watchdog = None
+    if args.watchdog:
+        from induction_network_on_fewrel_tpu.obs import (
+            FlightRecorder,
+            HealthWatchdog,
+        )
+
+        recorder = FlightRecorder(out_dir=args.run_dir)
+        recorder.install_sigterm_handler()
+        watchdog = HealthWatchdog(logger=logger, recorder=recorder)
+        if logger is not None:
+            logger.add_hook(recorder.record_metric)
     if args.load_ckpt:
         engine = InferenceEngine.from_checkpoint(
             args.load_ckpt, device=args.device,
@@ -132,10 +151,11 @@ def serve_main(argv=None) -> int:
             max_queue_depth=args.queue_depth,
             batch_window_s=args.batch_window_ms / 1e3,
             default_deadline_s=args.deadline_ms / 1e3,
-            logger=MetricsLogger(args.run_dir) if args.run_dir else None,
+            logger=logger, watchdog=watchdog,
         )
     else:
-        engine = _fresh_engine(args, buckets)
+        engine = _fresh_engine(args, buckets, logger=logger,
+                               watchdog=watchdog)
 
     try:
         ds = _support_dataset(args, engine.registry.k, seed=args.seed)
@@ -165,7 +185,21 @@ def serve_main(argv=None) -> int:
         print("serve stats: " + json.dumps(snap), file=sys.stderr)
         return 0
     finally:
+        if args.run_dir:
+            # Prometheus text exposition of the shared counter registry
+            # (obs/export.py) — the scrape-format twin of the final
+            # kind="serve" record; an HTTP server would serve this string.
+            # Rendered BEFORE close: engine.close() unbinds the stats
+            # callbacks from the registry.
+            from induction_network_on_fewrel_tpu.obs import get_registry
+            from pathlib import Path
+
+            Path(args.run_dir, "metrics.prom").write_text(
+                get_registry().to_prometheus()
+            )
         engine.close()
+        if logger is not None:
+            logger.close()
 
 
 def _demo(engine, ds, num_queries: int, seed: int = 0) -> None:

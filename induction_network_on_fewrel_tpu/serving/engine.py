@@ -21,6 +21,7 @@ import time
 
 import numpy as np
 
+from induction_network_on_fewrel_tpu.obs.spans import span
 from induction_network_on_fewrel_tpu.serving.batcher import DynamicBatcher, Request
 from induction_network_on_fewrel_tpu.serving.buckets import (
     DEFAULT_BUCKETS,
@@ -47,6 +48,7 @@ class InferenceEngine:
         batch_window_s: float = 0.002,
         default_deadline_s: float = 1.0,
         logger=None,
+        watchdog=None,
         start: bool = True,
     ):
         if cfg.model != "induction":
@@ -70,8 +72,16 @@ class InferenceEngine:
         self.default_deadline_s = default_deadline_s
         self._logger = logger
         self._emit_step = 0
+        # Telemetry spine (obs/): serving counters join the shared
+        # counter registry (Prometheus exposition + run reports see train
+        # and serving through one namespace); the optional watchdog gets
+        # queue-stall observations on every stats emit.
+        self.watchdog = watchdog
+        if watchdog is not None and logger is not None:
+            logger.add_hook(watchdog.observe_record)
 
         self.stats = ServingStats()
+        self.stats.bind_registry()
         self.registry = ClassVectorRegistry(
             model, params, tokenizer, k=k if k is not None else cfg.k
         )
@@ -196,10 +206,20 @@ class InferenceEngine:
             raise ValueError("no classes registered — register supports first")
         t = self.tokenizer(self._as_instance(instance))
         query = {"word": t.word, "pos1": t.pos1, "pos2": t.pos2, "mask": t.mask}
-        return self.batcher.submit(
+        fut = self.batcher.submit(
             query,
             deadline_s if deadline_s is not None else self.default_deadline_s,
         )
+        if self.watchdog is not None:
+            # Stall observation from the CLIENT thread: the execute-path
+            # observations below come from the worker itself, which is
+            # exactly the thread that has wedged when a stall is real —
+            # submitters are the independent observer that can still see
+            # a deep queue with a frozen served counter.
+            self.watchdog.observe_queue(
+                self.batcher.queue_depth, self.stats.served
+            )
+        return fut
 
     def classify(self, instance, deadline_s: float | None = None) -> dict:
         """Synchronous submit + wait."""
@@ -212,9 +232,11 @@ class InferenceEngine:
         # skew the verdict index -> name mapping (registry.snapshot doc).
         names, class_mat = self.registry.snapshot()
         bucket = select_bucket(len(batch), self.batcher.buckets)
-        query = stack_queries([r.query for r in batch], bucket)
+        with span("serve/stack", rows=len(batch), bucket=bucket):
+            query = stack_queries([r.query for r in batch], bucket)
         t0 = time.monotonic()
-        logits = self.programs.run(self.params, class_mat, query)
+        with span("serve/execute", rows=len(batch), bucket=bucket):
+            logits = self.programs.run(self.params, class_mat, query)
         exec_s = time.monotonic() - t0
         self.stats.record_batch(len(batch), bucket, exec_s)
         now = time.monotonic()
@@ -237,6 +259,10 @@ class InferenceEngine:
     # --- observability / lifecycle ---------------------------------------
 
     def _maybe_emit(self, every: int = 50) -> None:
+        if self.watchdog is not None:
+            self.watchdog.observe_queue(
+                self.batcher.queue_depth, self.stats.served
+            )
         if self._logger is None:
             return
         if self.stats.batches - self._emit_step >= every:
@@ -247,6 +273,10 @@ class InferenceEngine:
             )
 
     def emit_stats(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.observe_queue(
+                self.batcher.queue_depth, self.stats.served
+            )
         if self._logger is not None:
             self.stats.emit(
                 self._logger, self.stats.batches,
@@ -256,6 +286,11 @@ class InferenceEngine:
     def close(self) -> None:
         self.batcher.close()
         self.emit_stats()
+        # Unbinding drops this engine's gauges from the registry — any
+        # final scrape (serve_main writes metrics.prom) must happen BEFORE
+        # close. A closed engine must not stay pinned in (or serve stale
+        # values from) the global registry for the rest of the process.
+        self.stats.unbind_registry()
 
     @staticmethod
     def _as_instance(x):

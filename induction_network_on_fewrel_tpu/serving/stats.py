@@ -96,6 +96,58 @@ class ServingStats:
         i = min(len(lat) - 1, max(0, int(round(q / 100.0 * len(lat))) - 1))
         return lat[i]
 
+    def bind_registry(self, registry=None, prefix: str = "serve") -> None:
+        """Expose these counters through the shared obs/ CounterRegistry
+        (default: the process-global one) as pull-style gauges — the
+        Prometheus exposition then reads live values at render time and
+        the hot recording path above stays untouched. The trainer's
+        metrics and these serving counters land in ONE namespace."""
+        from induction_network_on_fewrel_tpu.obs.export import get_registry
+
+        reg = registry or get_registry()
+        self._bound_registry = reg
+        self._bound_fns: list[tuple[str, object]] = []
+
+        def _register(full: str, f, help: str) -> None:
+            self._bound_fns.append((full, f))
+            reg.gauge_fn(full, f, help)
+
+        def attr(name: str, help: str = "") -> None:
+            _register(f"{prefix}_{name}", lambda n=name: getattr(self, n), help)
+
+        attr("served", "futures resolved with a verdict")
+        attr("rejected", "backpressure rejections at submit")
+        attr("deadline_missed", "requests expired before execution")
+        attr("batches", "bucket executions")
+        attr("warmup_compiles", "programs compiled by warmup()")
+        attr("steady_compiles", "programs compiled after warmup")
+        # Derived metrics read through snapshot(): occupancy/percentile
+        # formulas live in ONE place, so metrics.jsonl kind="serve"
+        # records and the Prometheus exposition cannot drift apart.
+        def derived(name: str, help: str = "") -> None:
+            _register(
+                f"{prefix}_{name}", lambda k=name: self.snapshot()[k], help
+            )
+
+        derived("batch_occupancy", "real rows / bucket slots executed")
+        derived("p50_ms", "median request latency")
+        derived("p99_ms", "tail request latency")
+
+    def unbind_registry(self) -> None:
+        """Release this stats object's callbacks from the registry (engine
+        close): the gauge_fn closures would otherwise pin the instance —
+        latency reservoir included — and render stale values forever.
+        Identity-checked per callback, so closing an old engine never
+        deletes the gauges a successor re-registered under the same
+        names."""
+        reg = getattr(self, "_bound_registry", None)
+        if reg is None:
+            return
+        for name, f in self._bound_fns:
+            reg.unregister(name, fn=f)
+        self._bound_registry = None
+        self._bound_fns = []
+
     def snapshot(self, queue_depth: int | None = None) -> dict:
         p50, p99 = self.percentile_ms(50), self.percentile_ms(99)
         with self._lock:

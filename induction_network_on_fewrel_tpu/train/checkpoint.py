@@ -232,6 +232,17 @@ def _claim_stage_root(path: Path) -> Path | None:
     return path
 
 
+# Live telemetry files the run APPENDS to while checkpointing runs. They
+# must never enter the staging mirror: seeding (real -> staging) would
+# snapshot them, and the next drain (staging -> real) would copy the stale
+# snapshot back over the live file — observed on --resume as metrics.jsonl
+# reverting to its pre-resume content (records written through the
+# logger's persistent handle went to a replaced inode and were lost).
+_NON_CHECKPOINT_FILES = frozenset({
+    "metrics.jsonl", "flight_recorder.json", "metrics.prom",
+})
+
+
 def _sync_tree(src: Path, dst: Path, mirror_deletes: bool = True) -> None:
     """Copy files newer-or-missing from src -> dst. With
     ``mirror_deletes`` (the drain direction), NUMERIC step directories in
@@ -252,6 +263,8 @@ def _sync_tree(src: Path, dst: Path, mirror_deletes: bool = True) -> None:
     for p in src.iterdir():
         if ".orbax-checkpoint-tmp" in p.name:
             continue  # in-progress orbax write: never drain partial steps
+        if p.name in _NON_CHECKPOINT_FILES:
+            continue  # live telemetry: not checkpoint state, never mirrored
         q = dst / p.name
         try:
             if p.is_dir():

@@ -19,10 +19,12 @@ import numpy as np
 
 from induction_network_on_fewrel_tpu.config import ExperimentConfig
 from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+from induction_network_on_fewrel_tpu.obs.spans import span
 from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
 from induction_network_on_fewrel_tpu.train.steps import (
     init_state,
     make_eval_step,
+    make_grad_probe,
     make_multi_eval_step,
     make_multi_train_step,
     make_train_step,
@@ -64,12 +66,45 @@ class FewShotTrainer:
         adv=None,
         profile_dir: str | None = None,
         profile_steps: int = 10,
+        watchdog=None,
+        recorder=None,
     ):
         self.model = model
         self.cfg = cfg
         self.train_sampler = train_sampler
         self.val_sampler = val_sampler
         self.logger = logger or MetricsLogger(quiet=True)
+        # Telemetry spine (obs/): the watchdog and flight recorder observe
+        # every record through MetricsLogger hooks — one emission point,
+        # no per-site instrumentation. Both optional and host-side only.
+        self.watchdog = watchdog
+        self.recorder = recorder
+        # Hook ORDER is load-bearing: the recorder must see each record
+        # BEFORE the watchdog, whose critical events dump the recorder —
+        # else the dump's metrics window excludes the record that tripped.
+        if recorder is not None:
+            self.logger.add_hook(recorder.record_metric)
+        if watchdog is not None:
+            watchdog.logger = watchdog.logger or self.logger
+            if watchdog.recorder is None:
+                watchdog.recorder = recorder
+            self.logger.add_hook(watchdog.observe_record)
+        # Grad-health probe (cfg.grad_probe_every, VERDICT weak #7): only
+        # on the stock live-token path — injected (mesh/cached) steps feed
+        # index batches the probe's model.apply cannot consume, and the
+        # DANN path has its own objective.
+        self._grad_probe = None
+        if cfg.grad_probe_every > 0:
+            if train_step is None and adv is None:
+                self._grad_probe = make_grad_probe(model, cfg)
+            else:
+                import warnings
+
+                warnings.warn(
+                    "--grad_probe_every is ignored with injected "
+                    "(mesh-sharded/cached) steps or adversarial training",
+                    stacklevel=2,
+                )
         # Injectable steps so parallel/ can substitute mesh-sharded versions.
         self.train_step = train_step or make_train_step(model, cfg)
         self.eval_step = eval_step or make_eval_step(model, cfg)
@@ -194,6 +229,14 @@ class FewShotTrainer:
         ``start_step`` (pass the restored step on --resume so checkpoint
         step numbers keep increasing across restarts — orbax retention and
         the recovery ring compare by step)."""
+        if self.recorder is not None:
+            # Any exception escaping the loop (incl. --fault_step's
+            # injected crash) dumps the flight recorder before re-raising.
+            with self.recorder.armed("train crash"):
+                return self._train_impl(state, num_iters, start_step)
+        return self._train_impl(state, num_iters, start_step)
+
+    def _train_impl(self, state, num_iters, start_step):
         cfg = self.cfg
         if self.ckpt is not None:
             # A dir whose checkpoints are ahead of this run's numbering
@@ -228,19 +271,25 @@ class FewShotTrainer:
             spc = cfg.steps_per_call
             adv_fused = adv is not None and adv.multi_step is not None
             if self._fused_step is not None and end_step - step >= spc:
-                if self._can_sample_fused():
-                    # Index samplers fill the whole [S,B,*] stack in one
-                    # native call — the per-batch Python loop below was
-                    # measurable host overhead at large steps_per_call.
-                    sup_s, qry_s, lab_s = self.train_sampler.sample_fused(spc)
-                else:
-                    batches = [
-                        batch_to_model_inputs(next(it)) for _ in range(spc)
-                    ]
-                    sup_s, qry_s, lab_s = jax.tree.map(
-                        lambda *xs: np.stack(xs), *batches
+                with span("train/sample", steps=spc):
+                    if self._can_sample_fused():
+                        # Index samplers fill the whole [S,B,*] stack in one
+                        # native call — the per-batch Python loop below was
+                        # measurable host overhead at large steps_per_call.
+                        sup_s, qry_s, lab_s = self.train_sampler.sample_fused(spc)
+                    else:
+                        batches = [
+                            batch_to_model_inputs(next(it)) for _ in range(spc)
+                        ]
+                        sup_s, qry_s, lab_s = jax.tree.map(
+                            lambda *xs: np.stack(xs), *batches
+                        )
+                with span("train/dispatch", steps=spc):
+                    state, metrics = self._fused_step(state, sup_s, qry_s, lab_s)
+                if self._grad_probe is not None:
+                    probe_batch = jax.tree.map(
+                        lambda x: x[0], (sup_s, qry_s, lab_s)
                     )
-                state, metrics = self._fused_step(state, sup_s, qry_s, lab_s)
                 prev, step = step, step + spc
             elif adv_fused and end_step - step >= spc:
                 batches = [
@@ -260,31 +309,57 @@ class FewShotTrainer:
                 )
                 prev, step = step, step + spc
             else:
-                support, query, label = batch_to_model_inputs(next(it))
-                if adv is not None:
-                    src = adv.src_sampler.sample_batch()._asdict()
-                    tgt = adv.tgt_sampler.sample_batch()._asdict()
-                    state, adv.disc_state, metrics = adv.step(
-                        state, adv.disc_state, support, query, label, src, tgt
-                    )
-                else:
-                    state, metrics = self.train_step(
-                        state, support, query, label
-                    )
+                with span("train/sample", steps=1):
+                    support, query, label = batch_to_model_inputs(next(it))
+                with span("train/dispatch", steps=1):
+                    if adv is not None:
+                        src = adv.src_sampler.sample_batch()._asdict()
+                        tgt = adv.tgt_sampler.sample_batch()._asdict()
+                        state, adv.disc_state, metrics = adv.step(
+                            state, adv.disc_state, support, query, label,
+                            src, tgt
+                        )
+                    else:
+                        state, metrics = self.train_step(
+                            state, support, query, label
+                        )
+                if self._grad_probe is not None:
+                    probe_batch = (support, query, label)
                 prev, step = step, step + 1
             if step - last_logged >= window or step >= end_step:
-                m = jax.device_get(metrics)  # sync point, once per window
+                with span("train/metrics_fetch"):
+                    m = jax.device_get(metrics)  # sync point, once per window
                 dt = time.monotonic() - t0
                 eps_per_s = (step - last_logged) * cfg.batch_size / max(dt, 1e-9)
                 # Fused metrics are stacked [S]; report the window mean.
+                scalars = {k: float(np.mean(v)) for k, v in m.items()}
+                if cfg.nan_inject_step and last_logged < cfg.nan_inject_step <= step:
+                    # Telemetry-failure injection (debug knob): corrupt the
+                    # LOGGED loss only — the training state is untouched.
+                    # Exercises watchdog trip + flight-recorder dump.
+                    scalars["loss"] = float("nan")
                 self.logger.log(
-                    step,
-                    "train",
-                    episodes_per_s=eps_per_s,
-                    **{k: float(np.mean(v)) for k, v in m.items()},
+                    step, "train", episodes_per_s=eps_per_s, **scalars,
                 )
                 t0 = time.monotonic()
                 last_logged = step
+            if (
+                self._grad_probe is not None
+                and step // cfg.grad_probe_every > prev // cfg.grad_probe_every
+            ):
+                t_probe = time.monotonic()
+                with span("train/grad_probe"):
+                    out = jax.device_get(
+                        self._grad_probe(state.params, *probe_batch)
+                    )
+                self.logger.log(
+                    step, "health", event="grad_probe", severity="info",
+                    **{k: float(v) for k, v in out.items()},
+                )
+                # Exclude probe wall time (first call includes its jit
+                # compile — seconds) from the next episodes/sec window, or
+                # the watchdog would read a phantom throughput drop.
+                t0 += time.monotonic() - t_probe
             if cfg.fault_step and start_step == 0 and step >= cfg.fault_step:
                 # Failure injection (SURVEY.md §5.3): simulate a crash
                 # mid-run. Raised BEFORE the val boundary below, so the
@@ -308,12 +383,14 @@ class FewShotTrainer:
                     # and the boundary checkpoints see the exact
                     # dense-equivalent table (lazy-embed mode).
                     state = self._materialize(state)
-                val_metrics = self.evaluate(
-                    state.params, cfg.val_iter, return_metrics=True
-                )
+                with span("train/eval", episodes=cfg.val_iter):
+                    val_metrics = self.evaluate(
+                        state.params, cfg.val_iter, return_metrics=True
+                    )
                 val_acc = val_metrics["accuracy"]
                 # metrics.jsonl carries nota_precision/nota_recall when
-                # na_rate > 0 (BASELINE config #5's evaluation depth).
+                # na_rate > 0 (BASELINE config #5's evaluation depth),
+                # and acc_ci95 always (VERDICT weak #8).
                 self.logger.log(step, "val", **val_metrics)
                 improved = val_acc > self.best_val
                 if improved:
@@ -321,11 +398,13 @@ class FewShotTrainer:
                     # below compares against it either way.
                     self.best_val = val_acc
                 if self.ckpt is not None:
-                    if improved:
-                        self.ckpt.save(step, state, val_acc)
-                    # Recovery ring: saved at EVERY val boundary so a crash
-                    # on a plateau resumes from here, not the stale best.
-                    self.ckpt.save_latest(step, state)
+                    with span("train/checkpoint"):
+                        if improved:
+                            self.ckpt.save(step, state, val_acc)
+                        # Recovery ring: saved at EVERY val boundary so a
+                        # crash on a plateau resumes from here, not the
+                        # stale best.
+                        self.ckpt.save_latest(step, state)
                 # Divergence guard (SURVEY.md §5.3): the MSE-sigmoid loss
                 # can fall into its saturation dead zone on long overfit
                 # runs (all scores ~0, gradients vanished, unrecoverable —
@@ -401,6 +480,7 @@ class FewShotTrainer:
         for s in (self.train_sampler, self.val_sampler):
             if hasattr(s, "close"):
                 s.close()
+        self.logger.close()  # persistent metrics.jsonl handle
 
     def evaluate(self, params, num_episodes: int, sampler=None,
                  return_metrics: bool = False):
@@ -448,15 +528,26 @@ class FewShotTrainer:
                 support, query, label = batch_to_model_inputs(next(it))
                 collect(self.eval_step(params, support, query, label))
                 remaining -= 1
-        means = {
-            k: float(np.mean(np.concatenate(
+        arrays = {
+            k: np.concatenate(
                 [np.atleast_1d(np.asarray(a)) for a in jax.device_get(v)]
-            )))
+            )
             for k, v in collected.items()
         }
+        means = {k: float(np.mean(v)) for k, v in arrays.items()}
         if not return_metrics:
             return means["accuracy"]
         metrics = {"accuracy": means["accuracy"]}
+        # ±1.96·σ/√n over per-batch accuracy means (VERDICT weak #8): a
+        # 95% normal-approximation CI on the reported mean. n is the
+        # batch count — the samples ARE batch means, so σ is already the
+        # between-batch spread and dividing by √n_batches is the correct
+        # standard error of their grand mean.
+        accs = arrays["accuracy"]
+        metrics["acc_ci95"] = (
+            float(1.96 * np.std(accs, ddof=1) / np.sqrt(len(accs)))
+            if len(accs) > 1 else 0.0
+        )
         if "nota_tp" in means:
             metrics["nota_precision"] = means["nota_tp"] / max(
                 means["nota_pred"], 1e-12

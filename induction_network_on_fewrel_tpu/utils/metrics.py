@@ -3,14 +3,46 @@
 The reference prints step/loss/acc to stdout; here every record is also
 appended as one JSON line so runs are machine-readable (episodes/sec/chip is
 the [BJ] throughput metric of record).
+
+This logger is the telemetry spine's single emission point (obs/): every
+record also flows through registered hooks — the health watchdog and the
+flight recorder attach themselves here, so train/val/serve paths get
+watched without instrumenting each emit site. Schema (validated by
+``tools/obs_report.py --check``): one JSON object per line with ``step``
+(int), ``kind`` (train/val/eval/profile/serve/health/divergence/...),
+``wall_s`` (float), and scalar fields; ``kind="health"`` records may carry
+string fields (event/severity/message). Non-finite floats are written as
+the strings "nan"/"inf"/"-inf" — bare NaN tokens are not valid strict
+JSON, and the stream's contract is that ANY JSON-lines consumer (jq,
+dashboards) can parse every line; hooks still receive the raw float so
+the watchdog's non-finite check sees the real value.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import sys
+import threading
 import time
 from pathlib import Path
+from typing import Callable
+
+# The kinds the telemetry stream is allowed to carry — the contract
+# tools/obs_report.py --check enforces. Extend here, not ad hoc.
+KNOWN_KINDS = frozenset({
+    "train", "val", "eval", "test", "profile", "serve", "health",
+    "divergence", "divergence_stop",
+})
+
+
+def json_sanitize(v):
+    """Strict-JSON-safe scalar: non-finite floats become their repr
+    strings ('nan'/'inf'/'-inf'). Shared with the flight recorder so every
+    emitted artifact stays parseable by non-Python consumers."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return repr(v)
+    return v
 
 
 class MetricsLogger:
@@ -18,10 +50,20 @@ class MetricsLogger:
                  tensorboard_dir: str | Path | None = None):
         self.quiet = quiet
         self.path: Path | None = None
+        # Persistent append handle: reopening metrics.jsonl per record cost
+        # one open/close syscall pair per log() — measurable at fused-call
+        # logging rates. Opened lazily on first log so a logger constructed
+        # for a dir that is never written leaves no empty file. Lock: the
+        # serving batcher worker and the main thread both log through one
+        # logger; the per-call open of the old code was implicitly atomic,
+        # the shared handle is not.
+        self._fh = None
+        self._io_lock = threading.Lock()
         if out_dir is not None:
             out = Path(out_dir)
             out.mkdir(parents=True, exist_ok=True)
             self.path = out / "metrics.jsonl"
+        self.hooks: list[Callable[[dict], None]] = []
         # Optional TensorBoard scalars (SURVEY.md §5.5). tensorflow is a
         # heavyweight import (~6 s), so it loads only when a dir is given;
         # metrics.jsonl stays the always-on machine-readable record.
@@ -33,23 +75,68 @@ class MetricsLogger:
             self._tf = tf
         self._t0 = time.monotonic()
 
-    def log(self, step: int, kind: str = "train", **scalars: float) -> None:
+    def add_hook(self, hook: Callable[[dict], None]) -> None:
+        """Register a per-record observer (watchdog, flight recorder)."""
+        if hook not in self.hooks:
+            self.hooks.append(hook)
+
+    def log(self, step: int, kind: str = "train", **scalars) -> None:
         rec = {
             "step": int(step),
             "kind": kind,
             "wall_s": round(time.monotonic() - self._t0, 3),
-            **{k: float(v) for k, v in scalars.items()},
+            **{k: _coerce(v) for k, v in scalars.items()},
         }
         if self.path is not None:
-            with open(self.path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+            line = json.dumps(
+                {k: json_sanitize(v) for k, v in rec.items()}
+            ) + "\n"
+            with self._io_lock:
+                if self._fh is None or self._fh.closed:
+                    self._fh = open(self.path, "a")
+                self._fh.write(line)
+                self._fh.flush()  # flush per record: crash-visible telemetry
         if self._tb is not None:
-            with self._tb.as_default():
-                for k, v in scalars.items():
-                    self._tf.summary.scalar(
-                        f"{kind}/{k}", float(v), step=int(step)
-                    )
-            self._tb.flush()
+            with self._io_lock:
+                with self._tb.as_default():
+                    for k, v in scalars.items():
+                        if isinstance(v, str):
+                            continue
+                        self._tf.summary.scalar(
+                            f"{kind}/{k}", float(v), step=int(step)
+                        )
+                self._tb.flush()
         if not self.quiet:
-            fields = " ".join(f"{k}={v:.4g}" for k, v in scalars.items())
+            fields = " ".join(
+                f"{k}={v}" if isinstance(v, str) else f"{k}={v:.4g}"
+                for k, v in rec.items()
+                if k not in ("step", "kind", "wall_s")
+            )
             print(f"[{kind}] step={step} {fields}", file=sys.stderr, flush=True)
+        for hook in self.hooks:
+            hook(rec)  # raw floats on purpose: NaN must reach the watchdog
+
+    def close(self) -> None:
+        """Release the file handle (and TB writer). Safe to call repeatedly.
+        A log() after close transparently reopens the jsonl handle in
+        append mode; the TensorBoard writer is NOT reopened — TB is a
+        mirror, and the always-on record is metrics.jsonl."""
+        with self._io_lock:
+            if self._fh is not None and not self._fh.closed:
+                self._fh.close()
+            if self._tb is not None:
+                self._tb.close()
+                self._tb = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _coerce(v):
+    """float for numerics, passthrough for strings (health-event fields)."""
+    if isinstance(v, str):
+        return v
+    return float(v)

@@ -1,0 +1,431 @@
+"""obs/ telemetry spine: spans, watchdog, flight recorder, run report.
+
+Covers the ISSUE 2 acceptance surface: span nesting + ring-buffer
+eviction, watchdog triggers on injected NaN / throughput drop / queue
+stall / entropy collapse, flight-recorder dump on a simulated crash, the
+metrics.jsonl schema gate (tools/obs_report.py --check), and — the tier-1
+end-to-end — a 5-step synthetic training run with the watchdog enabled
+producing metrics + health events + a flight-recorder dump on injected
+NaN, all validating with zero schema errors.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    GloveTokenizer,
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.obs import (
+    CounterRegistry,
+    FlightRecorder,
+    HealthWatchdog,
+    SpanTracker,
+)
+from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+from induction_network_on_fewrel_tpu.train import FewShotTrainer
+from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+_TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+)
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import obs_report  # noqa: E402
+
+L = 16
+
+
+def _tiny_cfg(**kw):
+    base = dict(
+        encoder="cnn", n=2, k=2, q=2, batch_size=2, max_length=L,
+        vocab_size=302, compute_dtype="float32", val_step=0, lr=1e-2,
+    )
+    base.update(kw)
+    return ExperimentConfig(**base)
+
+
+def _setup(cfg, seed=0):
+    vocab = make_synthetic_glove(vocab_size=300)
+    ds = make_synthetic_fewrel(
+        num_relations=4, instances_per_relation=20, vocab_size=300, seed=seed
+    )
+    tok = GloveTokenizer(vocab, max_length=L)
+    sampler = EpisodeSampler(
+        ds, tok, n=cfg.n, k=cfg.k, q=cfg.q, batch_size=cfg.batch_size,
+        na_rate=cfg.na_rate, seed=seed,
+    )
+    model = build_model(cfg, glove_init=vocab.vectors)
+    return model, sampler
+
+
+# --- spans ----------------------------------------------------------------
+
+
+def test_span_nesting_and_attrs():
+    t = SpanTracker(capacity=16, xplane_bridge=False)
+    with t.span("outer"):
+        with t.span("inner", rows=3) as attrs:
+            attrs["extra"] = 1
+    spans = t.snapshot()
+    # Inner closes first, so it lands first in the ring.
+    inner, outer = spans[0], spans[1]
+    assert inner["name"] == "inner" and outer["name"] == "outer"
+    assert inner["parent"] == "outer" and outer["parent"] is None
+    assert inner["depth"] == 1 and outer["depth"] == 0
+    assert inner["attrs"] == {"rows": 3, "extra": 1}
+    assert inner["dur_s"] >= 0 and outer["dur_s"] >= inner["dur_s"]
+
+
+def test_span_ring_eviction_keeps_newest():
+    t = SpanTracker(capacity=4, xplane_bridge=False)
+    for i in range(7):
+        with t.span(f"s{i}"):
+            pass
+    assert len(t) == 4
+    assert t.evicted == 3
+    names = [s["name"] for s in t.snapshot()]
+    assert names == ["s3", "s4", "s5", "s6"]  # oldest first, oldest 3 gone
+
+
+def test_span_decorator_and_durations():
+    t = SpanTracker(capacity=8, xplane_bridge=False)
+
+    @t.wrap("probe")
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2 and f(2) == 3
+    assert len(t.durations("probe")) == 2
+
+
+# --- watchdog -------------------------------------------------------------
+
+
+def test_watchdog_nan_trips_and_dumps(tmp_path):
+    logger = MetricsLogger(tmp_path, quiet=True)
+    recorder = FlightRecorder(out_dir=tmp_path)
+    wd = HealthWatchdog(logger=logger, recorder=recorder)
+    logger.add_hook(wd.observe_record)
+    logger.add_hook(recorder.record_metric)
+
+    logger.log(1, "train", loss=0.5, episodes_per_s=100.0)
+    assert not wd.tripped
+    logger.log(2, "train", loss=float("nan"), episodes_per_s=100.0)
+    assert wd.tripped
+    assert [e.event for e in wd.events] == ["non_finite"]
+    # The critical event dumped the flight recorder...
+    dump = tmp_path / "flight_recorder.json"
+    assert dump.exists()
+    payload = json.loads(dump.read_text())
+    assert "non_finite" in payload["reason"]
+    assert payload["events"][0]["event"] == "non_finite"
+    # ...and a kind="health" record landed in metrics.jsonl.
+    kinds = [
+        json.loads(l)["kind"]
+        for l in (tmp_path / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert "health" in kinds
+    logger.close()
+
+
+def test_watchdog_throughput_regression():
+    def rec(step, eps):
+        return {"step": step, "kind": "train",
+                "loss": 0.1, "episodes_per_s": eps}
+
+    wd = HealthWatchdog(throughput_drop=0.5, throughput_warmup=3)
+    for step, eps in enumerate([100.0, 101.0, 99.0, 100.0]):
+        wd.observe_record(rec(step, eps))
+    assert len(wd.events) == 0
+    wd.observe_record(rec(9, 10.0))
+    assert [e.event for e in wd.events] == ["throughput_regression"]
+    assert not wd.tripped  # warning severity, not critical
+    # A PERSISTENT slowdown is one incident, not one event per window...
+    wd.observe_record(rec(10, 12.0))
+    assert len(wd.events) == 1
+    # ...and the regressed windows never became the new baseline: after a
+    # healthy window re-arms the latch, another drop trips again.
+    wd.observe_record(rec(11, 100.0))
+    wd.observe_record(rec(12, 10.0))
+    assert len(wd.events) == 2
+
+
+def test_watchdog_entropy_collapse():
+    def rec(step, h):
+        return {"step": step, "kind": "train",
+                "loss": 0.1, "routing_entropy": h}
+
+    wd = HealthWatchdog(entropy_floor=0.05)
+    wd.observe_record(rec(1, 1.2))
+    assert len(wd.events) == 0
+    wd.observe_record(rec(2, 0.01))
+    assert [e.event for e in wd.events] == ["routing_collapse"]
+    assert wd.tripped
+    # Pinned-at-zero entropy is ONE incident (latched), re-armed by a
+    # recovery above the floor.
+    wd.observe_record(rec(3, 0.01))
+    assert len(wd.events) == 1
+    wd.observe_record(rec(4, 1.0))
+    wd.observe_record(rec(5, 0.01))
+    assert len(wd.events) == 2
+
+
+def test_watchdog_queue_stall_injected_clock():
+    wd = HealthWatchdog(queue_stall_s=5.0)
+    wd.observe_queue(queue_depth=4, served=10, now=100.0)
+    wd.observe_queue(queue_depth=4, served=10, now=103.0)
+    assert len(wd.events) == 0      # not stalled long enough yet
+    wd.observe_queue(queue_depth=4, served=10, now=106.0)
+    assert [e.event for e in wd.events] == ["queue_stall"]
+    assert wd.tripped
+    # Progress resets the stall clock; the same stall never re-reports,
+    # but a NEW stall after progress re-arms.
+    wd.observe_queue(queue_depth=4, served=11, now=120.0)  # progress: reset
+    wd.observe_queue(queue_depth=4, served=11, now=130.0)  # stall begins
+    assert len(wd.events) == 1
+    wd.observe_queue(queue_depth=4, served=11, now=140.0)  # 10s stuck
+    assert len(wd.events) == 2
+
+
+def test_watchdog_ignores_health_records():
+    wd = HealthWatchdog()
+    wd.observe_record({"step": 1, "kind": "health", "event": "x",
+                       "some_metric": float("nan")})
+    assert len(wd.events) == 0      # watchdog output is not watchdog input
+    # ...except grad_probe measurements, which ARE checked for NaN.
+    wd.observe_record({"step": 2, "kind": "health", "event": "grad_probe",
+                       "grad_norm": float("inf")})
+    assert [e.event for e in wd.events] == ["non_finite"]
+
+
+# --- flight recorder ------------------------------------------------------
+
+
+def test_flight_recorder_dump_on_simulated_crash(tmp_path):
+    tracker = SpanTracker(capacity=8, xplane_bridge=False)
+    rec = FlightRecorder(out_dir=tmp_path, tracker=tracker, max_metrics=3)
+    for i in range(5):
+        rec.record_metric({"step": i, "kind": "train", "loss": float(i)})
+    with tracker.span("train/step"):
+        pass
+    with pytest.raises(RuntimeError, match="boom"):
+        with rec.armed("train crash"):
+            raise RuntimeError("boom")
+    dump = tmp_path / "flight_recorder.json"
+    assert dump.exists()
+    payload = json.loads(dump.read_text())
+    assert payload["reason"] == "train crash: RuntimeError: boom"
+    # Bounded ring: only the newest 3 metric records survive.
+    assert [m["step"] for m in payload["metrics"]] == [2, 3, 4]
+    assert payload["spans"][0]["name"] == "train/step"
+    assert rec.dump_count == 1
+
+
+# --- counter registry / prometheus ----------------------------------------
+
+
+def test_counter_registry_prometheus_text():
+    reg = CounterRegistry(prefix="test")
+    c = reg.counter("requests_total", help="total requests")
+    c.inc(); c.inc(2)
+    reg.gauge("queue_depth").set(7)
+    reg.gauge_fn("live_value", lambda: 1.5)
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        reg.gauge("requests_total")  # type collision
+    snap = reg.snapshot()
+    assert snap == {"requests_total": 3.0, "queue_depth": 7.0, "live_value": 1.5}
+    text = reg.to_prometheus()
+    assert "# TYPE test_requests_total counter" in text
+    assert "test_requests_total 3" in text
+    assert "# HELP test_requests_total total requests" in text
+    assert "# TYPE test_queue_depth gauge" in text
+    assert "test_live_value 1.5" in text
+
+
+def test_serving_stats_bind_registry():
+    from induction_network_on_fewrel_tpu.serving.stats import ServingStats
+
+    reg = CounterRegistry()
+    stats = ServingStats()
+    stats.bind_registry(reg)
+    stats.record_done(0.010)
+    stats.record_batch(rows=3, bucket=4, exec_s=0.004)
+    snap = reg.snapshot()
+    assert snap["serve_served"] == 1.0
+    assert snap["serve_batches"] == 1.0
+    assert snap["serve_batch_occupancy"] == pytest.approx(0.75)
+    assert snap["serve_p50_ms"] == pytest.approx(10.0)
+    # Re-binding (engine restart in one process) must not raise.
+    ServingStats().bind_registry(reg)
+    # Unbinding releases the callbacks (engine.close): no stale gauges.
+    stats.unbind_registry()
+    fresh = ServingStats()
+    fresh.bind_registry(reg)
+    fresh.unbind_registry()
+    assert not any(k.startswith("serve_") for k in reg.snapshot())
+
+
+# --- obs_report schema gate ----------------------------------------------
+
+
+def test_obs_report_check_passes_valid_stream(tmp_path, capsys):
+    with MetricsLogger(tmp_path, quiet=True) as logger:
+        logger.log(1, "train", loss=0.5, episodes_per_s=10.0)
+        logger.log(2, "val", accuracy=0.9, acc_ci95=0.01)
+        logger.log(3, "serve", served=5, p50_ms=1.0)
+        logger.log(3, "health", event="grad_probe", severity="info",
+                   grad_cosine=0.999)
+    assert obs_report.main([str(tmp_path), "--check"]) == 0
+    assert "0 schema errors" in capsys.readouterr().out
+
+
+def test_obs_report_check_flags_bad_stream(tmp_path, capsys):
+    (tmp_path / "metrics.jsonl").write_text(
+        '{"step": 1, "kind": "train", "wall_s": 0.1, "loss": 0.5}\n'
+        '{"step": "two", "kind": "train", "wall_s": 0.2}\n'   # step not int
+        '{"step": 3, "kind": "mystery", "wall_s": 0.3}\n'     # unknown kind
+        "not json at all\n"
+        '{"step": 4, "kind": "train", "wall_s": 0.4, "v": [1]}\n'  # non-scalar
+    )
+    assert obs_report.main([str(tmp_path), "--check"]) == 1
+    err = capsys.readouterr().err
+    assert "step must be an int" in err
+    assert "unknown kind" in err
+    assert "not JSON" in err
+    assert "must be scalar" in err
+
+
+def test_obs_report_missing_dir(tmp_path):
+    assert obs_report.main([str(tmp_path / "nope")]) == 2
+
+
+# --- end-to-end: the tier-1 telemetry gate --------------------------------
+
+
+def test_e2e_five_step_run_with_watchdog(tmp_path, capsys):
+    """ISSUE 2 acceptance: a 5-step synthetic run with the watchdog enabled
+    produces metrics.jsonl + health events + a flight-recorder dump on
+    injected NaN, and the report renders with zero schema errors."""
+    # CE loss: the MSE-sigmoid dead zone can zero the gradient within a
+    # few steps on this tiny fixture, which would make the probe's norms
+    # degenerate instead of exercising the healthy path.
+    cfg = _tiny_cfg(nan_inject_step=3, grad_probe_every=2, loss="ce")
+    model, sampler = _setup(cfg)
+    logger = MetricsLogger(tmp_path, quiet=True)
+    recorder = FlightRecorder(out_dir=tmp_path)
+    wd = HealthWatchdog(recorder=recorder)
+    trainer = FewShotTrainer(
+        model, cfg, sampler, logger=logger, watchdog=wd, recorder=recorder
+    )
+    try:
+        trainer.train(num_iters=5)
+    finally:
+        trainer.close()
+
+    # Telemetry artifacts: metrics, health events, flight dump.
+    recs = [
+        json.loads(l)
+        for l in (tmp_path / "metrics.jsonl").read_text().splitlines()
+    ]
+    kinds = {r["kind"] for r in recs}
+    assert "train" in kinds and "health" in kinds
+    # The injected NaN reached the log — serialized as the STRING "nan"
+    # (bare NaN tokens are not strict JSON; the stream's contract is that
+    # any JSON-lines consumer parses every line)...
+    assert any(
+        r["kind"] == "train" and r.get("loss") == "nan" for r in recs
+    )
+    # ...and every line is strict JSON (no NaN/Infinity constants).
+    def _reject(c):
+        raise AssertionError(f"non-strict JSON constant {c!r} in stream")
+
+    for line in (tmp_path / "metrics.jsonl").read_text().splitlines():
+        json.loads(line, parse_constant=_reject)
+    # ...tripped the watchdog...
+    assert wd.tripped
+    assert any(e.event == "non_finite" for e in wd.events)
+    # ...which dumped the flight recorder.
+    assert (tmp_path / "flight_recorder.json").exists()
+    # Grad probe fired (every 2 steps over 5 steps => >= 2 probes), with a
+    # near-1 cosine: the run config IS f32 here, so the f32 reference
+    # backward must agree with itself.
+    probes = [
+        r for r in recs
+        if r["kind"] == "health" and r.get("event") == "grad_probe"
+    ]
+    assert len(probes) >= 2
+    assert all(p["grad_cosine"] > 0.99 for p in probes)
+    assert all(np.isfinite(p["grad_norm"]) for p in probes)
+
+    # The report gate: zero schema errors, report renders.
+    assert obs_report.main([str(tmp_path), "--check"]) == 0
+    assert obs_report.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "0 schema errors" in out
+    assert "-- health --" in out
+    assert "-- flight_recorder --" in out
+
+
+def test_staging_sync_never_mirrors_telemetry(tmp_path):
+    """Regression: the tmpfs checkpoint staging mirror must skip live
+    telemetry files in BOTH directions. Seeding snapshotted metrics.jsonl
+    into staging and the next drain copied the stale snapshot back over
+    the live file — on --resume every record appended through the
+    logger's persistent handle was lost to a replaced inode."""
+    from induction_network_on_fewrel_tpu.train.checkpoint import _sync_tree
+
+    staging, real = tmp_path / "staging", tmp_path / "real"
+    (staging / "40").mkdir(parents=True)
+    (staging / "40" / "weights.bin").write_text("x")
+    (staging / "metrics.jsonl").write_text('{"step": 1}\n')  # stale snapshot
+    real.mkdir()
+    live = '{"step": 1}\n{"step": 2}\n{"step": 3}\n'
+    (real / "metrics.jsonl").write_text(live)
+    _sync_tree(staging, real, mirror_deletes=True)   # the drain direction
+    assert (real / "40" / "weights.bin").exists()    # checkpoints drain
+    assert (real / "metrics.jsonl").read_text() == live  # telemetry doesn't
+    _sync_tree(real, staging, mirror_deletes=False)  # the seed direction
+    assert (staging / "metrics.jsonl").read_text() == '{"step": 1}\n'
+
+
+def test_metrics_logger_persistent_handle_and_close(tmp_path):
+    logger = MetricsLogger(tmp_path, quiet=True)
+    logger.log(1, "train", loss=1.0)
+    fh = logger._fh
+    logger.log(2, "train", loss=0.5)
+    assert logger._fh is fh            # ONE handle across records
+    logger.close()
+    assert fh.closed
+    logger.log(3, "train", loss=0.25)  # reopens transparently
+    logger.close()
+    lines = (tmp_path / "metrics.jsonl").read_text().splitlines()
+    assert len(lines) == 3
+
+
+def test_evaluate_reports_ci95(tmp_path):
+    """±1.96·σ/√n next to mean accuracy (VERDICT weak #8)."""
+    cfg = _tiny_cfg()
+    model, sampler = _setup(cfg)
+    trainer = FewShotTrainer(model, cfg, sampler)
+    state = trainer.init_state()
+    m = trainer.evaluate(
+        state.params, num_episodes=16, sampler=sampler, return_metrics=True
+    )
+    assert 0.0 <= m["accuracy"] <= 1.0
+    assert m["acc_ci95"] >= 0.0
+    # n_batches = 16/2 = 8 samples; CI must match the definition exactly.
+    # (Recomputed here from a second evaluate pass over the same seeded
+    # sampler would drift; instead just sanity-bound it: σ of accuracies
+    # in [0,1] over 8 batches gives CI <= 1.96*0.5/sqrt(8) ~ 0.35.)
+    assert m["acc_ci95"] <= 0.4
