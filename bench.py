@@ -251,6 +251,19 @@ def _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips) -> i
             file=sys.stderr,
         )
 
+    # Boundary-soak leg (ISSUE 3 satellite): a short windowed-vs-all-in
+    # measurement with a ring checkpoint save after every chunk — the
+    # warm-soak all-in/windowed ratio in miniature, tracked per round so
+    # the delta-ring byte diet shows up in BENCH_* artifacts, not only in
+    # soak prose. Ring saves go through train/checkpoint.py save_latest:
+    # base + touched-row deltas for the lazy config, full otherwise.
+    # Runs BEFORE the device-busy trace: each fused call donates the state
+    # buffers, so the state must thread through, and the trace leg is the
+    # one consumer that doesn't return it.
+    allin_over_windowed, ring_bytes, state = _boundary_soak(
+        jax, cfg, fused_call, state, best_rate, n_chips
+    )
+
     # Device-busy fraction (VERDICT round-2 weak item 1): one traced chunk,
     # parsed from the XPlane via jax.profiler.ProfileData — puts "how much
     # of the wall is device work vs tunnel RPC" in the artifact itself
@@ -281,6 +294,11 @@ def _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips) -> i
     vs_prev = (
         round(best_rate / prev, 3) if (comparable and prev) else None
     )
+    # Analytic HBM bytes/step at THIS config (shared formulas with the
+    # roofline ledger, utils/roofline.py) — the byte-diet number the
+    # round-6 tentpole targets, stamped into every bench artifact.
+    from induction_network_on_fewrel_tpu.utils.roofline import step_bytes
+
     print(json.dumps({
         "metric": (
             f"train_episodes_per_sec_per_chip"
@@ -294,8 +312,81 @@ def _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips) -> i
         "mfu": mfu,
         "device_busy": device_busy,
         "flops_per_episode": flops["per_episode"],
+        "step_bytes": step_bytes(cfg),
+        "step_bytes_no_remat": step_bytes(cfg, remat_attn=False),
+        "allin_over_windowed": allin_over_windowed,
+        "ring_save_bytes": ring_bytes,
     }))
     return 0
+
+
+def _boundary_soak(jax, cfg, fused_call, state, windowed_rate, n_chips,
+                   chunks: int = 3):
+    """(all-in/windowed ratio, last ring-save payload bytes, state).
+
+    ``chunks`` fused calls each followed by a ring save into a throwaway
+    checkpoint dir (tmpfs-staging off: the measurement wants the real
+    write), then a durability wait — all-in = episodes / total wall
+    including the saves, against the main loop's windowed rate. An
+    UNTIMED priming save first absorbs the one-time delta base (warm-soak
+    semantics, like compile); the timed saves are the steady-state
+    boundary cost — deltas in lazy mode, full elsewhere. The reported
+    bytes are the LAST save's payload.
+
+    Failure isolation lives HERE, not in the caller: each fused call
+    donates the previous state's buffers, so the caller's binding is
+    stale the moment the first call runs — this function must hand back
+    the newest live state on EVERY path or the following device-busy
+    trace leg would run on deleted buffers.
+    """
+    import shutil
+    import tempfile
+
+    from induction_network_on_fewrel_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+
+    tmpdir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    mgr = None
+    try:
+        try:
+            mgr = CheckpointManager(tmpdir, cfg, stage="off")
+            info = None
+            # Priming save: writes the delta BASE (a full save) outside
+            # the timed window, as a warm soak's first boundary would.
+            mgr.save_latest(1, state, force=True)
+            mgr.wait()
+            t0 = time.monotonic()
+            for i in range(chunks):
+                state, metrics = fused_call(state)
+                _ = float(jax.device_get(metrics["loss"])[-1])  # hard sync
+                # force=True: the measurement is the save cost itself, so
+                # the adaptive in-flight skip must not elide it.
+                got = mgr.save_latest(
+                    int((i + 1) * STEPS_PER_CALL) + 1, state, force=True
+                )
+                info = got or info
+            mgr.wait()
+            wall = time.monotonic() - t0
+            allin = chunks * STEPS_PER_CALL * BATCH / wall / max(n_chips, 1)
+            ratio = round(allin / windowed_rate, 4) if windowed_rate else None
+            print(
+                f"bench: boundary soak: all-in {allin:.0f} vs windowed "
+                f"{windowed_rate:.0f} eps/s/chip -> ratio {ratio} "
+                f"(last ring save: {info})",
+                file=sys.stderr,
+            )
+            return ratio, (info or {}).get("bytes"), state
+        except Exception as e:  # the soak leg must never sink the bench
+            print(f"bench: boundary soak failed: {e!r}", file=sys.stderr)
+            return None, None, state
+    finally:
+        if mgr is not None:
+            try:
+                mgr.close()
+            except Exception as e:
+                print(f"bench: ckpt close failed: {e!r}", file=sys.stderr)
+        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def _device_busy_fraction(jax, fused_call, state) -> float | None:

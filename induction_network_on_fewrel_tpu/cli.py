@@ -83,6 +83,18 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
              "within bf16 tolerance (pinned in "
              "tests/test_attn.py::test_encoder_attn_backend_equivalence)",
     )
+    p.add_argument(
+        "--remat_attn", default="on", choices=["on", "off"],
+        help="recompute-in-backward attention (default on; TPU + xla "
+             "attention path only): the forward saves just the [M] softmax "
+             "stats instead of the [L,M,A] tanh projection, and the "
+             "one-pass Pallas backward kernel rebuilds the projection and "
+             "attention weights from the already-saved H in VMEM — attn "
+             "bwd 213 -> 134 MB/step at the flagship shape (ROOFLINE_r06). "
+             "Pure runtime knob: params and checkpoints are identical "
+             "either way (parity in tests/test_attn.py; bf16 shifts within "
+             "the documented kernel band, same as --attn_backend pallas)",
+    )
     p.add_argument("--induction_dim", type=int, default=100)
     p.add_argument("--routing_iters", type=int, default=3)
     p.add_argument("--ntn_slices", type=int, default=100)
@@ -176,6 +188,15 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
         help="checkpoint tmpfs staging: orbax writes to /dev/shm and the "
              "async saver thread drains to --save_ckpt (auto falls back to direct "
              "writes without /dev/shm or on multi-host runs)",
+    )
+    p.add_argument(
+        "--ckpt_delta", default="auto", choices=["auto", "off"],
+        help="delta ring checkpoints: recovery-ring saves write base + "
+             "touched-row deltas for the lazy embedding table/moments "
+             "(auto = on for --embed_optimizer lazy states; the ~240 MB "
+             "table+moment d2h per boundary shrinks to the rows that "
+             "actually changed). Best-checkpoint saves stay full; "
+             "resume-from-delta is trajectory-equal (tests/test_ckpt_delta.py)",
     )
     p.add_argument("--test_iter", type=int, default=3000)
     # data
@@ -324,6 +345,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         encoder=args.encoder, hidden_size=args.hidden_size,
         lstm_hidden=args.lstm_hidden, lstm_backend=args.lstm_backend,
         attn_backend=args.attn_backend,
+        remat_attn=getattr(args, "remat_attn", "on") == "on",
         tfm_layers=args.tfm_layers, tfm_model=args.tfm_model,
         tfm_heads=args.tfm_heads, tfm_ff=args.tfm_ff,
         moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,
@@ -347,6 +369,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         eval_steps_per_call=getattr(args, "eval_steps_per_call", 0),
         metric_window_calls=getattr(args, "metric_window_calls", 4),
         ckpt_stage=getattr(args, "ckpt_stage", "auto"),
+        ckpt_delta=getattr(args, "ckpt_delta", "auto"),
         feature_cache=getattr(args, "feature_cache", False),
         token_cache=getattr(args, "token_cache", False),
         divergence_guard=getattr(args, "divergence_guard", "none"),

@@ -54,6 +54,18 @@ class ExperimentConfig:
     # silicon before flipping). Not an architecture field — params and
     # math are backend-independent, like lstm_backend.
     attn_backend: str = "auto"  # auto | xla | pallas | interpret
+    # Recompute-in-backward attention (ops/attn.py "xla_remat"): with the
+    # resolved attention path "xla" on a TPU backend, run the two-pass XLA
+    # forward through a custom VJP that saves only the [M] softmax stats
+    # (not the [L, M, A] tanh projection / [L, M] attention weights) and
+    # recomputes both inside the one-pass Pallas backward kernel from the
+    # already-saved H. Byte arithmetic (utils/roofline.py, ROOFLINE_r06):
+    # attn fwd 149 -> 133 MB/step, attn bwd 213 -> 134 MB/step at the
+    # flagship shape. Parity is pinned in tests/test_attn.py (f32 ~1e-6;
+    # bf16 within the documented kernel band). Default ON; not an
+    # architecture field — params and checkpoints are backend-independent,
+    # like attn_backend/lstm_backend.
+    remat_attn: bool = True
     # BERT (built from scratch in models/bert.py; random-init unless weights
     # are found on disk — this sandbox has no network):
     bert_layers: int = 12
@@ -132,6 +144,15 @@ class ExperimentConfig:
     # destinations cost ~38% of sustained soak throughput vs tmpfs,
     # BASELINE.md round-3 decomposition); "off" = write directly.
     ckpt_stage: str = "auto"
+    # Delta ring checkpoints (train/checkpoint.py): recovery-ring saves
+    # write base + touched-row deltas for the lazy embedding table and its
+    # Adam moments (the ~240 MB of the ~250 MB lazy-state d2h that made
+    # boundary saves the dominant all-in tax — BASELINE.md round 5,
+    # all-in/windowed 54%). Best-checkpoint saves stay full. "auto" = on
+    # when the state carries lazy-embed leaves; "off" = every ring save is
+    # a full state. Resume-from-delta is trajectory-equal
+    # (tests/test_ckpt_delta.py).
+    ckpt_delta: str = "auto"
     # Frozen-encoder feature cache (train/feature_cache.py): encode the
     # dataset once, train the episode head on gathered features. Requires
     # --encoder bert with the frozen backbone; excludes pair/adv.

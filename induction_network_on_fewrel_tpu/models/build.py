@@ -166,6 +166,19 @@ def build_model(
                 # win at ~10%). The kernel stays selectable for A/Bs on
                 # real silicon, where the bandwidth:compute ratio flips.
                 attn = "xla"
+            if attn == "xla" and getattr(cfg, "remat_attn", False):
+                # --remat_attn: keep the XLA forward (the part that won the
+                # round-5 A/B) but run the backward through the one-pass
+                # kernel, saving only [M] softmax stats instead of the
+                # [L, M, A] tanh projection (ops/attn.py "xla_remat";
+                # ROOFLINE_r06: attn bwd 213 -> 134 MB/step). The compiled
+                # kernel needs a TPU; elsewhere the two-pass backward
+                # stays (the interpreter is for tests, not throughput) —
+                # same resolution shape as lstm_backend="auto".
+                import jax
+
+                if jax.default_backend() == "tpu":
+                    attn = "xla_remat"
             encoder = BiLSTMSelfAttnEncoder(
                 lstm_hidden=cfg.lstm_hidden, att_dim=cfg.att_dim,
                 lstm_backend=backend, attn_backend=attn,

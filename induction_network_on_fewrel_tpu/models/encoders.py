@@ -82,7 +82,12 @@ class BiLSTMSelfAttnEncoder(nn.Module):
     # weighted-sum pass; each reads H from HBM), "pallas"/"interpret" =
     # fused one-pass online-softmax kernel (H read once per direction of
     # the pass; the round-5 roofline puts the two-pass attention at ~40%
-    # of the flagship step's HBM bytes). Same params either way.
+    # of the flagship step's HBM bytes), "xla_remat"/"xla_remat_interpret"
+    # = recompute-in-backward hybrid (--remat_attn): the two-pass XLA
+    # forward saving only [M] softmax stats, the one-pass kernel backward
+    # rebuilding the tanh projection + attention weights from H in VMEM
+    # (attn-bwd 213 -> 134 MB/step at the flagship shape, ROOFLINE_r06).
+    # Same params every way — checkpoints interchange across backends.
     attn_backend: str = "xla"
     compute_dtype: jnp.dtype = jnp.float32
     # Callers that can supply embeddings already time-major ([L, M, D])

@@ -30,7 +30,27 @@ padded rows masked (their outputs and gradients are exact zeros).
 
 Backends: "xla" (two-pass reference, pure jnp — also the scan twin the
 tests compare against), "pallas" (compiled TPU kernel), "interpret"
-(same kernel code on the Pallas interpreter; CPU-runnable).
+(same kernel code on the Pallas interpreter; CPU-runnable), and the
+round-6 RECOMPUTE-IN-BACKWARD hybrids "xla_remat" / "xla_remat_interpret"
+(``--remat_attn``): forward runs the two-pass XLA form — the flat
+[L·M, 2u] MXU matmuls that beat the chunked kernel forward on chip
+(BASELINE.md round 5) — but through a custom VJP that saves ONLY the
+[M] softmax stats (running max + normalizer) instead of the [L, M, A]
+tanh projection and the [L, M] attention weights XLA's autodiff would
+keep; the backward is the one-pass Pallas kernel above, which
+rebuilds both from the already-saved H inside VMEM. Byte arithmetic at
+the flagship shape (utils/roofline.py): fwd 149 -> 133 MB (no
+projection/att residual writes), bwd 213 -> 134 MB (H read once +
+dH write once vs XLA's three H passes + saved-projection read).
+
+A plain ``jax.checkpoint``-style remat of the two-pass form was
+evaluated and REJECTED by the same arithmetic: the saved projection is
+A/2u = 1/4 the width of the H rows its recomputation must re-read, so
+XLA-level remat trades a 16 MB residual for an extra 66 MB H pass plus
+re-materializing the projection in the backward anyway (~ +82 MB/step).
+Recompute only pays when the recompute pass SHARES its H read with the
+gradient uses — i.e. inside the fused kernel. That is what xla_remat
+does.
 """
 
 from __future__ import annotations
@@ -61,6 +81,10 @@ def masked_selfattn_tm(
         return _attn_reference(H_t, mask, w1, w2)
     if backend in ("pallas", "interpret"):
         return _attn_pallas(H_t, mask, w1, w2, backend == "interpret")
+    if backend in ("xla_remat", "xla_remat_interpret"):
+        return _attn_xla_remat(
+            H_t, mask, w1, w2, backend == "xla_remat_interpret"
+        )
     raise ValueError(f"unknown attention backend {backend!r}")
 
 
@@ -321,3 +345,58 @@ def _attn_pallas(H_t, mask, w1, w2, interpret=False):
         jnp.swapaxes(mask.astype(jnp.float32), 0, 1)
     )
     return _attn_core(H_t, mask_t, w1, w2, interpret)
+
+
+# --- recompute-in-backward hybrid (--remat_attn) ---------------------------
+#
+# Forward: the two-pass XLA form, numerically the KERNEL's math (f32
+# projection/softmax regardless of H's dtype — jnp.dot with
+# preferred_element_type reads bf16 operands and accumulates f32, no
+# upcast copy of H materializes). It additionally emits the (max,
+# normalizer) stats the kernel backward reconstructs a_t from, so the
+# residual tuple is EXACTLY what _attn_core_fwd saves — the backward
+# rule IS _attn_core_bwd, one source of truth for the kernel bwd path.
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _attn_remat_core(H_t, mask_t, w1, w2, interpret=False):
+    # Primal (no-grad) path: plain two-pass form, nothing extra computed.
+    return _attn_reference(H_t, jnp.swapaxes(mask_t, 0, 1), w1, w2)
+
+
+def _attn_remat_fwd(H_t, mask_t, w1, w2, interpret):
+    L, M, D = H_t.shape
+    H_p, mask_p, Mp = _pad_rows(H_t, mask_t)
+    Lp = H_p.shape[0]
+    # Same pass structure as _attn_reference, on the padded arrays, with
+    # the softmax stats kept. Padded/fully-masked rows: s = _NEG
+    # everywhere -> normalizer 0 -> out exactly 0 (kernel convention).
+    t = jnp.tanh(jnp.dot(
+        H_p.reshape(Lp * Mp, D), w1, preferred_element_type=jnp.float32
+    ))
+    s = jnp.dot(t, w2, preferred_element_type=jnp.float32).reshape(Lp, Mp, 1)
+    s = jnp.where(mask_p > 0, s, _NEG)
+    mx = jnp.max(s, axis=0)                          # [Mp, 1]
+    e = jnp.exp(s - mx[None]) * (mask_p > 0)
+    dn = jnp.sum(e, axis=0)                          # [Mp, 1]
+    a = (e / (dn[None] + 1e-13))[..., 0]             # [Lp, Mp] f32
+    out = jnp.einsum(
+        "lm,lmd->md", a, H_p, preferred_element_type=jnp.float32
+    ).astype(H_t.dtype)                              # [Mp, D] (padded)
+    res = (
+        H_p, mask_p, w1, w2, out,
+        mx[:, 0][None], dn[:, 0][None], L, M, mask_t.shape,
+    )
+    return out[:M], res
+
+
+# Backward: the one-pass Pallas kernel, verbatim — H read once, the tanh
+# projection and a_t rebuilt in VMEM from the saved stats, dH written once.
+_attn_remat_core.defvjp(_attn_remat_fwd, _attn_core_bwd)
+
+
+def _attn_xla_remat(H_t, mask, w1, w2, interpret=False):
+    mask_t = jax.lax.stop_gradient(
+        jnp.swapaxes(mask.astype(jnp.float32), 0, 1)
+    )
+    return _attn_remat_core(H_t, mask_t, w1, w2, interpret)

@@ -53,6 +53,26 @@ tradeoff. Measured mean relative grad error is ~10-15% on random inputs
 (tests/test_lstm.py::test_pallas_bf16_io_close_to_f32); training-quality
 parity should be monitored via final val accuracy in bf16 runs, not only
 throughput. The f32 path is exact to 1e-5 against `lax.scan`.
+
+**Not saving ``cs`` — evaluated and REJECTED (round 6).** Dropping the
+cell-state residual would cut the fused forward's HBM writes in half
+(hs-only: 146 -> 81 MB/step at the flagship shape), but the backward
+needs tanh(c_t) (for da_o/dc_t) and the RAW c_{t-1} (for da_f), and the
+only local reconstruction from saved hs is the inversion
+``c_t = atanh(h_t / o_t)`` — ill-conditioned exactly where LSTMs live:
+d(atanh x)/dx = cosh²(c), so a 1-ulp rounding of h inflates to a cell
+error of eps·cosh²(c) (~20 ABSOLUTE at c = 10, f32), and for |c| ≳ 8.3
+tanh(c) rounds to ±1.0 in f32 and the inversion returns inf — while the
+factor da_f = dc_t·c_prev·f·(1-f) it feeds is NOT zero there. Measured on
+a saturating sequence (tests/test_lstm.py::
+test_cs_recompute_from_hs_rejected): reconstruction error exceeds 1.0
+absolute within 40 steps of a forget-dominant cell. The sound
+alternative — window-checkpointed cs (save every K-th step, recompute
+the window ascending inside the backward kernel) — is byte-positive
+(fwd -57 MB at K=8) but needs a K-step VMEM state buffer per tile
+(~0.5-1.5 MB at tm=128) and a dual-sweep kernel rewrite; it must be
+prototyped against real-chip VMEM limits, not the interpreter, so it is
+recorded as chip-session work (BASELINE.md round 6), not landed blind.
 """
 
 from __future__ import annotations

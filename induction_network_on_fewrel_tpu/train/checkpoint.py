@@ -4,6 +4,31 @@ Reference behavior (SURVEY.md §5.4): ``torch.save(state_dict)`` on best-val,
 ``--load_ckpt`` for test/finetune. Here: orbax with best-metric retention AND
 full resume (optimizer state and step survive, which torch ckpts in the
 reference family lose).
+
+**Delta ring saves (round 6, ``cfg.ckpt_delta``).** The recovery ring is
+pure redundancy written at every val boundary, and for the lazy-embed
+flagship its payload was ~97% embedding state: table + two Adam moment
+arrays + the per-row counts (~242 MB of the ~250 MB d2h that drove the
+warm-soak all-in/windowed ratio to 54%, BASELINE.md round 5). Ring saves
+therefore now write **base + touched-row deltas** when the state carries
+the lazy-embed leaves:
+
+* the FIRST ring save is a full **base** (flat-leaf format, its embedding
+  leaves kept resident on device as the diff reference);
+* every later ring save diffs the four embedding leaves against the base
+  on device (one elementwise compare + ``nonzero``), and enqueues only
+  the changed rows + the (small) non-embedding leaves. Never-touched rows
+  are bitwise-equal to the base by the lazy invariant (m = v = 0 rows
+  have exactly-zero updates), so the row set is exact — not a heuristic —
+  and resume-from-delta reconstructs the identical state
+  (tests/test_ckpt_delta.py pins trajectory equality).
+* a delta that grows past half the table triggers a fresh base
+  (re-snapshot), so pathological corpora degrade to the old full save,
+  never to a larger one.
+
+Best-checkpoint saves stay full: they are the durable artifact other
+tools (test.py, serving, convert_lazy_ckpt) consume. Non-lazy states
+(no emb leaves) keep full ring saves; ``ckpt_delta="off"`` forces them.
 """
 
 from __future__ import annotations
@@ -286,6 +311,51 @@ def _sync_tree(src: Path, dst: Path, mirror_deletes: bool = True) -> None:
             continue
 
 
+# --- delta-ring helpers ----------------------------------------------------
+#
+# Flat-leaf format: base/delta ring slots store ``{"leaves": {"00007":
+# arr}}`` keyed by tree_flatten position instead of the state pytree.
+# Restoring needs no target structure (orbax raw restore returns the dict
+# as saved), and the caller's template supplies the treedef — so the
+# format is independent of flax/optax container types, which a raw
+# restore of a StandardSave(state) tree would lose.
+
+
+def _leafkey(i: int) -> str:
+    return f"{i:05d}"
+
+
+def _ring_slots(tree) -> dict[str, int] | None:
+    """Flat indices of the four lazy-embed leaves (word table + Adam row
+    moments + per-row counts), or None when the tree carries no complete
+    set (plain TrainState, BERT/feature-cache states)."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    slots: dict[str, int] = {}
+    for i, (path, _) in enumerate(flat):
+        ks = jax.tree_util.keystr(path)
+        if ks.startswith(".params") and "'word_embedding'" in ks:
+            slots["table"] = i
+        elif ks == ".emb_m":
+            slots["m"] = i
+        elif ks == ".emb_v":
+            slots["v"] = i
+        elif ks == ".emb_last":
+            slots["last"] = i
+    return slots if set(slots) == {"table", "m", "v", "last"} else None
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+    import numpy as np
+
+    return sum(
+        int(np.asarray(x).nbytes) if not hasattr(x, "nbytes") else int(x.nbytes)
+        for x in jax.tree_util.tree_leaves(tree)
+    )
+
+
 class CheckpointManager:
     def __init__(self, ckpt_dir: str | Path, cfg: ExperimentConfig,
                  max_to_keep: int = 3, stage: str | None = None):
@@ -375,9 +445,20 @@ class CheckpointManager:
                 if self._stage_root is not None:
                     s_nonce_f.write_text(nonce)
         if self._stage_root is not None:
-            if any(p.name.isdigit() for p in self.dir.iterdir() if p.is_dir()) or (
-                self.dir / "latest"
-            ).exists():
+            if (
+                any(
+                    p.name.isdigit()
+                    for p in self.dir.iterdir() if p.is_dir()
+                )
+                # Any secondary manager root counts as "populated": a
+                # delta-mode dir may hold ONLY ring_base/ring_delta saves
+                # (no best yet), and skipping the seed would make them
+                # invisible to the staging-rooted managers on resume.
+                or any(
+                    (self.dir / d).exists()
+                    for d in ("latest", "ring_base", "ring_delta")
+                )
+            ):
                 _sync_tree(self.dir, self._stage_root, mirror_deletes=False)
             root = self._stage_root
         self.mngr = ocp.CheckpointManager(
@@ -398,6 +479,21 @@ class CheckpointManager:
             root / "latest",
             options=ocp.CheckpointManagerOptions(max_to_keep=1),
         )
+        # Delta ring (module docstring): base = full flat-leaf save whose
+        # embedding leaves stay device-resident as the diff reference;
+        # deltas = changed rows + non-embedding leaves. Both managers are
+        # always constructed (cheap on empty dirs) so a delta-written dir
+        # restores even under ckpt_delta="off".
+        self._delta_on = getattr(cfg, "ckpt_delta", "auto") != "off"
+        self.ring_base_mngr = ocp.CheckpointManager(
+            root / "ring_base",
+            options=ocp.CheckpointManagerOptions(max_to_keep=1),
+        )
+        self.ring_delta_mngr = ocp.CheckpointManager(
+            root / "ring_delta",
+            options=ocp.CheckpointManagerOptions(max_to_keep=1),
+        )
+        self._delta_base: dict | None = None
 
         # Async saver thread. Orbax's own async checkpointer still copies
         # device->host SYNCHRONOUSLY before returning, and on a tunneled
@@ -418,7 +514,16 @@ class CheckpointManager:
         self._save_error: Exception | None = None
         self._enqueued = {
             "best": self.mngr.latest_step(),
-            "ring": self.latest_mngr.latest_step(),
+            "ring": max(
+                (
+                    s for s in (
+                        self.latest_mngr.latest_step(),
+                        self.ring_base_mngr.latest_step(),
+                        self.ring_delta_mngr.latest_step(),
+                    ) if s is not None
+                ),
+                default=None,
+            ),
         }
         self._worker = threading.Thread(target=self._drain, daemon=True)
         self._worker.start()
@@ -470,6 +575,8 @@ class CheckpointManager:
             self._worker.join(timeout=30.0)
             self.mngr.close()
             self.latest_mngr.close()
+            self.ring_base_mngr.close()
+            self.ring_delta_mngr.close()
             try:
                 atexit.unregister(self._flush_at_exit)
             except Exception:  # noqa: BLE001 — unregister is best-effort
@@ -498,16 +605,20 @@ class CheckpointManager:
                     ),
                     snap,
                 )
+                mngr = {
+                    "best": self.mngr,
+                    "ring": self.latest_mngr,
+                    "ring_base": self.ring_base_mngr,
+                    "ring_delta": self.ring_delta_mngr,
+                }[kind]
                 if kind == "best":
-                    self.mngr.save(
+                    mngr.save(
                         step,
                         args=ocp.args.StandardSave(host),
                         metrics={"val_accuracy": metric},
                     )
                 else:
-                    self.latest_mngr.save(
-                        step, args=ocp.args.StandardSave(host)
-                    )
+                    mngr.save(step, args=ocp.args.StandardSave(host))
                 if self._stage_root is not None:
                     # Drain staging -> real INLINE on this thread: the
                     # sync must see a quiescent staging tree, and a
@@ -516,8 +627,7 @@ class CheckpointManager:
                     # 4). Serializing stretches per-save latency by the
                     # disk copy, which the adaptive ring-save skip
                     # already absorbs; saves still never block training.
-                    (self.mngr if kind == "best"
-                     else self.latest_mngr).wait_until_finished()
+                    mngr.wait_until_finished()
                     _sync_tree(self._stage_root, self.dir)
             except Exception as e:  # noqa: BLE001 — surfaced by wait()
                 self._save_error = e
@@ -554,15 +664,114 @@ class CheckpointManager:
         one save duration; on real hosts (PCIe d2h) the queue is always
         empty and every boundary saves. Best saves are never skipped, and
         callers that REQUIRE this exact step durable (the trainer's
-        end-of-run save) pass ``force=True``."""
+        end-of-run save) pass ``force=True``.
+
+        DELTA mode (module docstring): lazy-embed states enqueue base +
+        touched-row deltas instead of the full tree. Returns an info dict
+        ``{"mode": full|base|delta, "bytes": payload bytes, "rows":
+        changed rows (delta only)}`` for telemetry, or None when the save
+        was skipped/deduped."""
         self._check_save_error()
         self._check_staging_safety()
         if step in self._enqueued.values():
-            return
+            return None
         if not force and self._q.unfinished_tasks > 0:
-            return
+            return None
+        kind, payload, info = self._ring_item(step, state)
         self._enqueued["ring"] = step
-        self._q.put(("ring", step, _device_snapshot(state), None))
+        self._q.put((kind, step, payload, None))
+        return info
+
+    def _ring_item(self, step: int, state: Any) -> tuple[str, Any, dict]:
+        """Build the ring-save queue item: ("ring", full snapshot) for
+        non-lazy states or delta-off; ("ring_base"/"ring_delta", flat
+        payload) in delta mode. The delta diff runs ON DEVICE (one
+        elementwise compare over the four embedding leaves + nonzero);
+        the nonzero forces a device sync, which the val boundary this is
+        called from has already paid for eval."""
+        import jax
+        import numpy as np
+
+        slots = _ring_slots(state) if self._delta_on else None
+        if slots is None:
+            snap = _device_snapshot(state)
+            return "ring", snap, {"mode": "full", "bytes": _tree_bytes(snap)}
+        import jax.numpy as jnp
+
+        leaves = jax.tree_util.tree_leaves(state)
+        table, m, v, last = (
+            leaves[slots[k]] for k in ("table", "m", "v", "last")
+        )
+        base = self._delta_base
+        if base is not None and np.shape(base["table"]) != np.shape(table):
+            base = None  # different vocab restored into this manager
+        idx = None
+        if base is not None:
+            changed = (
+                jnp.any(jnp.asarray(table) != base["table"], axis=-1)
+                | jnp.any(jnp.asarray(m) != base["m"], axis=-1)
+                | jnp.any(jnp.asarray(v) != base["v"], axis=-1)
+                | (jnp.asarray(last) != base["last"])
+            )
+            idx = jnp.nonzero(changed)[0].astype(jnp.int32)
+            if 2 * int(idx.shape[0]) > int(np.shape(table)[0]):
+                base = idx = None  # delta past half the table: rebase
+            elif int(idx.shape[0]) == 0:
+                # Zero changed rows (e.g. a boundary with no embedding
+                # movement): orbax cannot save 0-length arrays ("params
+                # missing in checkpoint"), and a poisoned saver error
+                # would kill every later save. Pad to one row — row 0
+                # re-scatters its own base value on restore, a no-op.
+                idx = jnp.zeros((1,), jnp.int32)
+        if base is None:
+            # Fresh base: ONE on-device snapshot serves both the full save
+            # and the resident diff reference (the saver thread's d2h
+            # reads the same copies the next delta compares against).
+            snap_leaves = _device_snapshot(list(leaves))
+            nonce = np.int64(__import__("uuid").uuid4().int & ((1 << 63) - 1))
+            payload = {
+                "__ring_format__": np.int32(1),
+                "step": np.int64(step),
+                "nonce": nonce,
+                "leaves": {
+                    _leafkey(i): l for i, l in enumerate(snap_leaves)
+                },
+            }
+            self._delta_base = {
+                "step": int(step),
+                "nonce": int(nonce),
+                "table": snap_leaves[slots["table"]],
+                "m": snap_leaves[slots["m"]],
+                "v": snap_leaves[slots["v"]],
+                "last": snap_leaves[slots["last"]],
+            }
+            return "ring_base", payload, {
+                "mode": "base", "bytes": _tree_bytes(payload),
+            }
+        slot_set = set(slots.values())
+        rest = _device_snapshot({
+            _leafkey(i): l for i, l in enumerate(leaves) if i not in slot_set
+        })
+        payload = {
+            "__ring_format__": np.int32(2),
+            "step": np.int64(step),
+            "base_step": np.int64(base["step"]),
+            "base_nonce": np.int64(base["nonce"]),
+            "idx": idx,
+            "rows": {
+                # Gathers produce fresh buffers — already donation-safe.
+                "table": jnp.asarray(table)[idx],
+                "m": jnp.asarray(m)[idx],
+                "v": jnp.asarray(v)[idx],
+                "last": jnp.asarray(last)[idx],
+            },
+            "leaves": rest,
+        }
+        return "ring_delta", payload, {
+            "mode": "delta",
+            "bytes": _tree_bytes(payload),
+            "rows": int(idx.shape[0]),
+        }
 
     def wait(self) -> None:
         """Block until every enqueued async save is durable on disk — in
@@ -571,6 +780,8 @@ class CheckpointManager:
         self._q.join()
         self.mngr.wait_until_finished()
         self.latest_mngr.wait_until_finished()
+        self.ring_base_mngr.wait_until_finished()
+        self.ring_delta_mngr.wait_until_finished()
         self._check_save_error()
 
     def _check_save_error(self) -> None:
@@ -605,7 +816,12 @@ class CheckpointManager:
         run start instead (advisor finding, round 1)."""
         self.wait()  # in-flight async saves count as existing
         existing = max(
-            (s for m in (self.mngr, self.latest_mngr) for s in m.all_steps()),
+            (
+                s
+                for m in (self.mngr, self.latest_mngr,
+                          self.ring_base_mngr, self.ring_delta_mngr)
+                for s in m.all_steps()
+            ),
             default=None,
         )
         if existing is not None and start_step < existing:
@@ -650,7 +866,8 @@ class CheckpointManager:
         return self._restore(self.mngr, step, target), step
 
     def restore_latest(self, target: Any) -> tuple[Any, int]:
-        """Newest state across the best-tracked steps AND the recovery ring.
+        """Newest state across the best-tracked steps AND the recovery ring
+        (full slots, delta bases, and delta slots alike).
 
         Step number IS save order here: check_start_step (enforced at every
         training start) refuses runs whose numbering would collide with a
@@ -659,15 +876,128 @@ class CheckpointManager:
         every val boundary; the best manager only on improvement)."""
         self.wait()  # a step mid-write is not restorable yet
         best_side = self.mngr.latest_step()
-        ring_side = self.latest_mngr.latest_step()
+        ring_full = self.latest_mngr.latest_step()
+        ring_flat = max(
+            (
+                s for s in (
+                    self.ring_base_mngr.latest_step(),
+                    self.ring_delta_mngr.latest_step(),
+                ) if s is not None
+            ),
+            default=None,
+        )
+        ring_side = max(
+            (s for s in (ring_full, ring_flat) if s is not None),
+            default=None,
+        )
         if best_side is None and ring_side is None:
             raise FileNotFoundError(f"no checkpoint in {self.dir}")
         if ring_side is not None and (best_side is None or ring_side >= best_side):
-            return (
-                self._restore(self.latest_mngr, ring_side, target),
-                ring_side,
-            )
+            if ring_full is not None and ring_full >= ring_side:
+                return (
+                    self._restore(self.latest_mngr, ring_full, target),
+                    ring_full,
+                )
+            return self._restore_ring_flat(ring_side, target), ring_side
         return self._restore(self.mngr, best_side, target), best_side
+
+    def _restore_ring_flat(self, step: int, target: Any) -> Any:
+        """Reassemble a delta-ring state: base leaves + (when ``step`` is a
+        delta slot) the delta's non-embedding leaves and changed embedding
+        rows scattered over the base's. Also re-arms the device-resident
+        diff base so this manager's NEXT ring save deltas against the same
+        base the directory already holds."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        base_step = self.ring_base_mngr.latest_step()
+        if base_step is None:
+            raise FileNotFoundError(
+                f"delta ring in {self.dir} has no base save"
+            )
+        leaves_t, treedef = jax.tree_util.tree_flatten(target)
+        n = len(leaves_t)
+        # The base's leaves are exactly the target's (flat order), so the
+        # caller's template types every restored array; only the delta
+        # slots (dynamic row counts) restore untyped below. Numpy SCALAR
+        # leaves (np.int32 step from a device_get'd state) must become
+        # python scalars — orbax's template validator takes arrays and
+        # python int/float, not np.generic.
+        base_tpl = {
+            "__ring_format__": 0,
+            "step": 0,
+            "nonce": 0,
+            "leaves": {
+                _leafkey(i): (l.item() if isinstance(l, np.generic) else l)
+                for i, l in enumerate(leaves_t)
+            },
+        }
+        raw_base = self.ring_base_mngr.restore(
+            base_step, args=ocp.args.StandardRestore(base_tpl)
+        )
+        if len(raw_base["leaves"]) != n:
+            raise ValueError(
+                f"delta-ring base in {self.dir} holds "
+                f"{len(raw_base['leaves'])} leaves, target expects {n} — "
+                "architecture mismatch"
+            )
+        leaves = [raw_base["leaves"][_leafkey(i)] for i in range(n)]
+        slots = _ring_slots(target)
+        if step != base_step:
+            if slots is None:
+                raise ValueError(
+                    "delta ring slot exists but the restore target has no "
+                    "lazy-embed leaves (embed_optimizer mismatch?)"
+                )
+            raw_d = self.ring_delta_mngr.restore(
+                step, args=ocp.args.StandardRestore()
+            )
+            if (
+                int(raw_d["base_step"]) != int(base_step)
+                or int(raw_d["base_nonce"]) != int(raw_base["nonce"])
+            ):
+                raise ValueError(
+                    f"delta ring slot {step} references base "
+                    f"{int(raw_d['base_step'])}/"
+                    f"{int(raw_d['base_nonce'])}, but {self.dir} holds "
+                    f"{base_step}/{int(raw_base['nonce'])} — stale delta"
+                )
+            slot_set = set(slots.values())
+            for i in range(n):
+                if i not in slot_set:
+                    leaves[i] = raw_d["leaves"][_leafkey(i)]
+            idx = np.asarray(raw_d["idx"])
+            for name in ("table", "m", "v", "last"):
+                arr = np.array(leaves[slots[name]])  # writable copy
+                if idx.size:
+                    arr[idx] = np.asarray(raw_d["rows"][name])
+                leaves[slots[name]] = arr
+        if self._delta_on and slots is not None:
+            bl = raw_base["leaves"]
+            self._delta_base = {
+                "step": int(raw_base["step"]),
+                "nonce": int(raw_base["nonce"]),
+                "table": jnp.asarray(bl[_leafkey(slots["table"])]),
+                "m": jnp.asarray(bl[_leafkey(slots["m"])]),
+                "v": jnp.asarray(bl[_leafkey(slots["v"])]),
+                "last": jnp.asarray(bl[_leafkey(slots["last"])]),
+            }
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def purge_ring_newer_than(self, best_step: int) -> None:
+        """Delete every ring slot (full, base, delta) newer than
+        ``best_step`` — the divergence guard's restore path: orbax refuses
+        re-saves at <= its latest step, so slots holding the post-collapse
+        state would otherwise win every later --resume. Purging the base
+        also drops the device diff reference, so the next ring save
+        rebuilds a fresh base."""
+        for m in (self.latest_mngr, self.ring_delta_mngr, self.ring_base_mngr):
+            for s in m.all_steps():
+                if s > best_step:
+                    m.delete(s)
+        if self._delta_base is not None and self._delta_base["step"] > best_step:
+            self._delta_base = None
 
     @staticmethod
     def load_config(ckpt_dir: str | Path) -> ExperimentConfig:

@@ -403,8 +403,12 @@ class FewShotTrainer:
                             self.ckpt.save(step, state, val_acc)
                         # Recovery ring: saved at EVERY val boundary so a
                         # crash on a plateau resumes from here, not the
-                        # stale best.
-                        self.ckpt.save_latest(step, state)
+                        # stale best. In delta mode (ckpt_delta) the save
+                        # is base + touched-row deltas; the kind="ckpt"
+                        # record tracks the byte diet per boundary.
+                        self._log_ring_save(
+                            step, self.ckpt.save_latest(step, state)
+                        )
                 # Divergence guard (SURVEY.md §5.3): the MSE-sigmoid loss
                 # can fall into its saturation dead zone on long overfit
                 # runs (all scores ~0, gradients vanished, unrecoverable —
@@ -435,9 +439,7 @@ class FewShotTrainer:
                         # deleting it would leave the dir empty (advisor
                         # finding, round 2).
                         if best_step is not None:
-                            for s in self.ckpt.latest_mngr.all_steps():
-                                if s > best_step:
-                                    self.ckpt.latest_mngr.delete(s)
+                            self.ckpt.purge_ring_newer_than(best_step)
                         self.logger.log(
                             step, "divergence_stop",
                             restored_step=float(
@@ -464,11 +466,27 @@ class FewShotTrainer:
                 # stop — the returned state is the restored BEST (an
                 # earlier step), and stamping it with the diverged run's
                 # step number would corrupt resume ordering.
-                self.ckpt.save_latest(step, state, force=True)
+                self._log_ring_save(
+                    step, self.ckpt.save_latest(step, state, force=True)
+                )
             # Saves are async (off the val-boundary critical path); the
             # run's contract is that returning implies durable checkpoints.
             self.ckpt.wait()
         return state
+
+    def _log_ring_save(self, step: int, info: dict | None) -> None:
+        """kind="ckpt" telemetry for ring saves (train/checkpoint.py
+        save_latest's info dict): mode full/base/delta, payload bytes, and
+        changed-row count for deltas — the observable form of the delta
+        byte diet (tools/obs_report.py renders a ckpt section from it).
+        None = the save was deduped/skipped; nothing to record."""
+        if info is None:
+            return
+        extra = {"rows": float(info["rows"])} if "rows" in info else {}
+        self.logger.log(
+            step, "ckpt", event="ring_save", mode=info["mode"],
+            bytes=float(info["bytes"]), **extra,
+        )
 
     def close(self) -> None:
         """Release the checkpoint manager's saver thread + atexit handle and

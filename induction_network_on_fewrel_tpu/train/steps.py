@@ -292,6 +292,11 @@ def make_grad_probe(model, cfg: ExperimentConfig):
     ref_cfg = cfg.replace(
         compute_dtype="float32", head_dtype="float32",
         lstm_backend="scan", attn_backend="xla",
+        # The reference backward must be the PLAIN two-pass attention:
+        # with remat_attn left on, the probe would compare the run
+        # gradient against another kernel-backward gradient and a drift
+        # in the recompute path would be invisible.
+        remat_attn=False,
     )
     ref_model = build_model(ref_cfg)
     aux_w = cfg.moe_aux_weight if cfg.moe_experts > 0 else 0.0

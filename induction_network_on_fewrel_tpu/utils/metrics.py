@@ -33,6 +33,10 @@ from typing import Callable
 KNOWN_KINDS = frozenset({
     "train", "val", "eval", "test", "profile", "serve", "health",
     "divergence", "divergence_stop",
+    # Checkpoint telemetry (round 6): one record per ring save with
+    # event="ring_save", mode=full|base|delta, bytes=payload bytes, and
+    # rows=changed rows for deltas — the delta-ring byte diet, observable.
+    "ckpt",
 })
 
 

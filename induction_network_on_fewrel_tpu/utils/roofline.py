@@ -1,0 +1,129 @@
+"""Analytic per-component HBM bytes + MXU FLOPs for one flagship train step.
+
+Extracted from tools/roofline_ledger.py (round 6) so the formulas have ONE
+home: the ledger tool prints/calibrates against them, and bench.py stamps
+``step_bytes`` into its artifact from the same arithmetic — the byte-diet
+claims (ISSUE 3) are tracked by the bench gate, not asserted in prose.
+
+Shapes: rows M = B*(N*K + N*Q) support+query concat-encoded; L tokens;
+D = word+2*pos embedding width; u LSTM hidden/direction; A att_dim;
+C induction_dim; H ntn_slices; bf16 activations (2 B), f32 head +
+optimizer (4 B). Backward traffic follows the accepted kernel designs:
+the fused BiLSTM backward recomputes gates (re-reads emb and h/c state;
+dW/db accumulate in VMEM), and with ``remat_attn`` the attention backward
+is the one-pass kernel (H read once, dH written once, the tanh projection
+and attention weights rebuilt in VMEM from the [M] softmax stats the
+forward saved instead of the [L, M, A] projection).
+"""
+
+from __future__ import annotations
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+
+
+def step_components(
+    cfg: ExperimentConfig, remat_attn: bool | None = None
+) -> list[tuple[str, float, float]]:
+    """[(component, bytes/step, flops/step)] for the flagship train step.
+
+    ``remat_attn`` None follows ``cfg.remat_attn``. The non-remat rows are
+    the round-5 ledger unchanged (two-pass attention saving the [L, M, A]
+    tanh projection); the remat rows model the recompute-in-backward path
+    (ops/attn.py "xla_remat").
+    """
+    if remat_attn is None:
+        remat_attn = getattr(cfg, "remat_attn", False)
+    B, N, K, Q, L = cfg.batch_size, cfg.n, cfg.k, cfg.q, cfg.max_length
+    TQ = N * Q
+    M = B * (N * K + TQ)
+    D = cfg.word_dim + 2 * cfg.pos_dim
+    u = cfg.lstm_hidden
+    A = cfg.att_dim
+    C = cfg.induction_dim
+    H = cfg.ntn_slices
+    bf, f32 = 2, 4
+
+    emb_b = L * M * D * bf          # [L, M, D] bf16, the gathered embedding
+    hs_b = L * M * 2 * u * bf       # [L, M, 2u] hidden states
+    out_b = M * 2 * u * bf          # [M, 2u] sentence vectors
+    rows: list[tuple[str, float, float]] = []
+
+    # L3 embedding: id gathers read the table rows and write emb_t; the
+    # windowed pos-offset matmul touches [L+1, L*P] windows (negligible).
+    rows.append(("embed gather fwd (write emb + read table)", 2 * emb_b, 0))
+
+    # Fused BiLSTM kernel FWD: reads emb_t once (gates computed in-kernel
+    # from the 60-wide embedding), writes hs AND cs (saved for backward —
+    # the hs-only variant was evaluated and rejected, ops/lstm.py: the
+    # atanh reconstruction of c from h is ill-conditioned at saturation).
+    proj_f = 2 * L * M * D * (8 * u)          # input projection, both dirs
+    rec_f = 2 * L * M * u * (4 * u) * 2       # recurrence h@whh, both dirs
+    rows.append(("bilstm kernel fwd", emb_b + 2 * hs_b, proj_f + rec_f))
+
+    att_f = 2 * L * M * 2 * u * A + 2 * L * M * 2 * u
+    if remat_attn:
+        # FWD: the two flat-matmul passes read hs twice and write the
+        # sentence vectors + [M] softmax stats; the [L, M, A] projection
+        # and [L, M] attention weights are NOT saved.
+        rows.append((
+            "self-attn fwd (remat: stats-only residual)",
+            2 * hs_b + out_b + 2 * M * f32, att_f,
+        ))
+        # BWD: one-pass kernel — hs read once, dH written once, dout/out
+        # read for the softmax-backward dot; projection + attention
+        # weights rebuilt in VMEM (recompute adds ~1x the forward
+        # projection FLOPs on top of the usual 2x-forward backward).
+        rows.append((
+            "self-attn bwd (kernel recompute)",
+            2 * hs_b + 2 * out_b + 2 * M * f32, 3 * att_f,
+        ))
+    else:
+        # Two-pass XLA attention saving the tanh projection: proj pass
+        # reads hs, writes [L, M, A]; weighted-sum pass reads hs again.
+        rows.append((
+            "self-attn fwd", 2 * hs_b + L * M * A * bf + out_b, att_f
+        ))
+        # BWD re-reads hs three ways (softmax-backward dot, dW1, dH write)
+        # plus the saved projection.
+        rows.append(("self-attn bwd", 3 * hs_b + L * M * A * bf, 2 * att_f))
+
+    # Episode head FWD (f32): induction transform + routing + NTN.
+    ind_f = 2 * B * N * K * 2 * u * C + 3 * (2 * B * N * K * C * 2)
+    qp_f = 2 * B * TQ * 2 * u * C
+    ntn_f = 2 * B * N * C * C * H + 2 * B * TQ * N * C * H
+    head_b = (B * (N * K + TQ) * 2 * u * f32      # enc rows f32
+              + B * N * H * C * f32               # cM
+              + B * TQ * N * H * f32)             # v
+    rows.append(("episode head fwd (f32)", head_b, ind_f + qp_f + ntn_f))
+    rows.append(("episode head bwd", 2 * head_b, 2 * (ind_f + qp_f + ntn_f)))
+
+    # Kernel bwd (recompute gates): reads hs, cs, emb, d(hs); writes demb.
+    # dW/db accumulate in VMEM -> no HBM term.
+    rows.append((
+        "bilstm kernel bwd (recompute gates)",
+        3 * hs_b + 2 * emb_b, 2 * (proj_f + rec_f) + proj_f,
+    ))
+    rows.append(("embed scatter bwd (demb -> rows)", 2 * emb_b, 0))
+
+    # Optimizer (f32): non-embedding params p, m, v read + write, grads
+    # read. Lazy embed: only the batch's unique rows (<= M*L token ids,
+    # bounded by the corpus) touch their table/moment rows.
+    n_main = (
+        2 * D * 4 * u + 2 * u * 4 * u + 2 * 4 * u      # lstm
+        + 2 * u * A + A                                 # attention
+        + 2 * u * C + C + 2 * u * C + C                 # induction + qproj
+        + H * C * C + H + 1                             # ntn
+        + 2 * (2 * L) * cfg.pos_dim                     # pos tables
+    )
+    rows.append(("optimizer main (Adam, f32)", 7 * n_main * f32, 0))
+    u_rows = min(M * L, 2002)   # unique ids, corpus-bounded (synthetic)
+    rows.append((
+        "lazy embed rows (gather+Adam+scatter)",
+        u_rows * cfg.word_dim * f32 * 8, 0,
+    ))
+    return rows
+
+
+def step_bytes(cfg: ExperimentConfig, remat_attn: bool | None = None) -> int:
+    """Total analytic HBM bytes for one flagship train step."""
+    return int(sum(b for _, b, _ in step_components(cfg, remat_attn)))

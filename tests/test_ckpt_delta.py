@@ -1,0 +1,288 @@
+"""Delta ring checkpoints (train/checkpoint.py, round 6).
+
+The recovery ring writes base + touched-row deltas for lazy-embed states
+(the ~242 MB of table+moment d2h that dominated boundary cost, BASELINE.md
+round 5). The contract under test:
+
+* resume-from-delta is TRAJECTORY-EQUAL: restore_latest reassembles the
+  bitwise-identical state, and training continued from it matches the
+  uninterrupted run exactly;
+* non-lazy states keep full ring saves; tiny tables whose delta exceeds
+  half the rows re-base instead of writing a larger-than-full delta;
+* the divergence guard's purge covers base and delta slots;
+* ring saves emit kind="ckpt" telemetry that obs_report's schema gate
+  accepts.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    GloveTokenizer,
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
+from induction_network_on_fewrel_tpu.train.steps import init_state, make_train_step
+
+# Vocab >> corpus so the touched-row set stays far under the half-table
+# rebase threshold and ring saves actually take the delta path.
+VOCAB = 402
+CFG = ExperimentConfig(
+    encoder="cnn", n=3, k=2, q=2, batch_size=2, max_length=12,
+    vocab_size=VOCAB, hidden_size=16, lr=3e-3, weight_decay=0.0,
+    embed_optimizer="lazy", compute_dtype="float32", ckpt_stage="off",
+)
+STEPS = 8
+
+
+@pytest.fixture(scope="module")
+def fixture():
+    vocab = make_synthetic_glove(vocab_size=VOCAB - 2)
+    ds = make_synthetic_fewrel(
+        num_relations=6, instances_per_relation=6, vocab_size=35
+    )
+    tok = GloveTokenizer(vocab, max_length=CFG.max_length)
+    sampler = EpisodeSampler(ds, tok, CFG.n, CFG.k, CFG.q, CFG.batch_size, seed=3)
+    batches = [
+        batch_to_model_inputs(sampler.sample_batch()) for _ in range(STEPS + 2)
+    ]
+    model = build_model(CFG, glove_init=vocab.vectors)
+    return model, batches
+
+
+def _assert_trees_equal(a, b):
+    for (pa, va), (_, vb) in zip(
+        jax.tree_util.tree_flatten_with_path(a)[0],
+        jax.tree_util.tree_flatten_with_path(b)[0],
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(va), np.asarray(vb),
+            err_msg=f"leaf {jax.tree_util.keystr(pa)} diverged",
+        )
+
+
+def test_delta_resume_trajectory_equality(fixture, tmp_path):
+    """Train -> base save -> train -> DELTA save -> (new manager, as a
+    resumed process would build) restore -> continue == the uninterrupted
+    run, bitwise, every leaf — the ISSUE 3 acceptance bar."""
+    model, batches = fixture
+    step_fn = make_train_step(model, CFG)
+    state = init_state(model, CFG, batches[0][0], batches[0][1])
+    template = jax.device_get(state)
+
+    mgr = CheckpointManager(tmp_path, CFG)
+    for sup, qry, lab in batches[:4]:
+        state, _ = step_fn(state, sup, qry, lab)
+    info_base = mgr.save_latest(4, state)
+    mgr.wait()
+    for sup, qry, lab in batches[4:6]:
+        state, _ = step_fn(state, sup, qry, lab)
+    info_delta = mgr.save_latest(6, state)
+    mgr.close()
+    assert info_base["mode"] == "base"
+    assert info_delta["mode"] == "delta"
+    # The steady-state boundary payload is a small fraction of the full
+    # save — the byte diet this feature exists for. At this toy shape the
+    # non-embedding head dominates both, so compare the EMBEDDING portion:
+    # delta rows << table rows.
+    assert info_delta["rows"] < VOCAB // 4
+
+    # Fresh manager on the same dir = a resumed process.
+    mgr2 = CheckpointManager(tmp_path, CFG)
+    restored, step_no = mgr2.restore_latest(template)
+    assert step_no == 6
+    _assert_trees_equal(jax.device_get(state), restored)
+
+    # Continue BOTH from the restore and from the live state: identical.
+    cont_live, _ = step_fn(state, *batches[6])
+    cont_rest, _ = step_fn(restored, *batches[6])
+    _assert_trees_equal(jax.device_get(cont_live), jax.device_get(cont_rest))
+
+    # And the post-resume ring save is a delta against the SAME base the
+    # directory already held (no fresh base: the restore re-armed it).
+    info_resumed = mgr2.save_latest(7, cont_rest)
+    assert info_resumed["mode"] == "delta"
+    mgr2.wait()
+    restored2, step_no2 = mgr2.restore_latest(template)
+    assert step_no2 == 7
+    _assert_trees_equal(jax.device_get(cont_rest), restored2)
+    mgr2.close()
+
+
+def test_zero_row_delta_saves_and_restores(fixture, tmp_path):
+    """A boundary where NO embedding row moved (identical state saved at
+    a later step) must still produce a valid delta: orbax cannot store
+    0-length arrays, and a poisoned saver error would kill every later
+    save on the manager (round-6 review finding — the save pads to one
+    no-op row)."""
+    model, batches = fixture
+    step_fn = make_train_step(model, CFG)
+    state = init_state(model, CFG, batches[0][0], batches[0][1])
+    for sup, qry, lab in batches[:2]:
+        state, _ = step_fn(state, sup, qry, lab)
+    mgr = CheckpointManager(tmp_path, CFG)
+    assert mgr.save_latest(2, state, force=True)["mode"] == "base"
+    mgr.wait()
+    # Same state, later step: zero changed rows.
+    info = mgr.save_latest(3, state, force=True)
+    assert info["mode"] == "delta"
+    mgr.wait()  # must not surface a saver error
+    # The manager stays healthy for further saves…
+    state2, _ = step_fn(state, *batches[2])
+    assert mgr.save_latest(4, state2, force=True)["mode"] == "delta"
+    mgr.wait()
+    # …and the zero-row slot restores bitwise.
+    template = jax.device_get(init_state(model, CFG, batches[0][0], batches[0][1]))
+    restored, step_no = mgr.restore_latest(template)
+    assert step_no == 4
+    _assert_trees_equal(jax.device_get(state2), restored)
+    mgr.close()
+
+
+def test_non_lazy_states_keep_full_ring(fixture, tmp_path):
+    """A shared-optimizer state has no emb leaves: ring saves stay full
+    orbax saves in the legacy slot; no base/delta dirs are populated."""
+    cfg = CFG.replace(embed_optimizer="shared")
+    vocab = make_synthetic_glove(vocab_size=VOCAB - 2)
+    model = build_model(cfg, glove_init=vocab.vectors)
+    _, batches = fixture
+    state = jax.device_get(init_state(model, cfg, batches[0][0], batches[0][1]))
+
+    mgr = CheckpointManager(tmp_path, cfg)
+    info = mgr.save_latest(5, state, force=True)
+    mgr.wait()
+    assert info["mode"] == "full"
+    assert mgr.latest_mngr.latest_step() == 5
+    assert mgr.ring_base_mngr.latest_step() is None
+    restored, step_no = mgr.restore_latest(state)
+    assert step_no == 5
+    _assert_trees_equal(state, restored)
+    mgr.close()
+
+
+def test_ckpt_delta_off_forces_full(fixture, tmp_path):
+    """ckpt_delta="off": lazy states too write full ring saves."""
+    model, batches = fixture
+    cfg = CFG.replace(ckpt_delta="off")
+    state = jax.device_get(
+        init_state(model, cfg, batches[0][0], batches[0][1])
+    )
+    mgr = CheckpointManager(tmp_path, cfg)
+    info = mgr.save_latest(3, state, force=True)
+    mgr.wait()
+    assert info["mode"] == "full"
+    assert mgr.ring_base_mngr.latest_step() is None
+    mgr.close()
+
+
+def test_delta_rebase_past_half_table(tmp_path):
+    """When a delta would cover more than half the table (tiny vocab,
+    wide corpus), the save re-bases instead of writing a bigger-than-full
+    delta — the degradation path is the OLD behavior, never worse."""
+    vocab = make_synthetic_glove(vocab_size=50)
+    cfg = CFG.replace(vocab_size=52)
+    ds = make_synthetic_fewrel(
+        num_relations=6, instances_per_relation=6, vocab_size=35
+    )
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    sampler = EpisodeSampler(ds, tok, cfg.n, cfg.k, cfg.q, cfg.batch_size, seed=3)
+    batches = [batch_to_model_inputs(sampler.sample_batch()) for _ in range(6)]
+    model = build_model(cfg, glove_init=vocab.vectors)
+    step_fn = make_train_step(model, cfg)
+    state = init_state(model, cfg, batches[0][0], batches[0][1])
+
+    mgr = CheckpointManager(tmp_path, cfg)
+    for sup, qry, lab in batches[:3]:
+        state, _ = step_fn(state, sup, qry, lab)
+    assert mgr.save_latest(3, state)["mode"] == "base"
+    mgr.wait()
+    for sup, qry, lab in batches[3:]:
+        state, _ = step_fn(state, sup, qry, lab)
+    # The 35-word corpus touches ~2/3 of the 52-row table: rebase.
+    assert mgr.save_latest(6, state)["mode"] == "base"
+    mgr.wait()
+    template = jax.device_get(init_state(model, cfg, batches[0][0], batches[0][1]))
+    restored, step_no = mgr.restore_latest(template)
+    assert step_no == 6
+    _assert_trees_equal(jax.device_get(state), restored)
+    mgr.close()
+
+
+def test_purge_ring_covers_base_and_delta(fixture, tmp_path):
+    """The divergence guard's purge must delete base AND delta slots newer
+    than the restored best, and drop the device diff base so the next
+    ring save re-bases (orbax refuses re-saves at <= its latest step)."""
+    model, batches = fixture
+    step_fn = make_train_step(model, CFG)
+    state = init_state(model, CFG, batches[0][0], batches[0][1])
+    mgr = CheckpointManager(tmp_path, CFG)
+    for sup, qry, lab in batches[:2]:
+        state, _ = step_fn(state, sup, qry, lab)
+    mgr.save(2, state, val_accuracy=0.9)  # the "best" to fall back to
+    mgr.save_latest(3, state, force=True)
+    mgr.wait()
+    state2 = state
+    for sup, qry, lab in batches[2:4]:
+        state2, _ = step_fn(state2, sup, qry, lab)
+    assert mgr.save_latest(5, state2, force=True)["mode"] == "delta"
+    mgr.wait()
+
+    mgr.purge_ring_newer_than(2)
+    assert mgr.ring_base_mngr.latest_step() is None
+    assert mgr.ring_delta_mngr.latest_step() is None
+    template = jax.device_get(init_state(model, CFG, batches[0][0], batches[0][1]))
+    _, step_no = mgr.restore_latest(template)
+    assert step_no == 2  # only the best survives
+    mgr.close()
+
+
+def test_ring_save_telemetry_schema(fixture, tmp_path):
+    """Trainer-integrated: a lazy run with val boundaries emits
+    kind="ckpt" ring_save records that the obs_report schema gate accepts,
+    and the run's ring slots restore to the returned state."""
+    import sys
+    from pathlib import Path
+
+    from induction_network_on_fewrel_tpu.train.framework import FewShotTrainer
+    from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    import obs_report
+
+    vocab = make_synthetic_glove(vocab_size=VOCAB - 2)
+    ds = make_synthetic_fewrel(
+        num_relations=6, instances_per_relation=6, vocab_size=35
+    )
+    tok = GloveTokenizer(vocab, max_length=CFG.max_length)
+    cfg = CFG.replace(val_step=4, val_iter=4)
+    sampler = EpisodeSampler(ds, tok, cfg.n, cfg.k, cfg.q, cfg.batch_size, seed=5)
+    model = build_model(cfg, glove_init=vocab.vectors)
+    run_dir = tmp_path / "run"
+    trainer = FewShotTrainer(
+        model, cfg, sampler, val_sampler=sampler, ckpt_dir=tmp_path / "ckpt",
+        logger=MetricsLogger(out_dir=run_dir, quiet=True),
+    )
+    state = trainer.train(num_iters=9)
+    trainer.close()
+
+    n, errors = obs_report.check_schema(run_dir / "metrics.jsonl")
+    assert not errors, errors
+    recs = obs_report.load_records(run_dir / "metrics.jsonl")
+    saves = [r for r in recs if r.get("kind") == "ckpt"]
+    assert saves, "no ring-save telemetry emitted"
+    assert {s["mode"] for s in saves} <= {"base", "delta", "full"}
+    summary = obs_report.ckpt_summary(recs)
+    assert summary["records"] == len(saves)
+
+    mgr = CheckpointManager(tmp_path / "ckpt", cfg)
+    template = jax.device_get(state)
+    restored, step_no = mgr.restore_latest(template)
+    assert step_no == 9
+    _assert_trees_equal(template, restored)
+    mgr.close()

@@ -377,3 +377,46 @@ def test_fused_backward_parity_scan_vs_pallas(fused_inputs):
         np.testing.assert_allclose(
             gp, gs, rtol=1e-4, atol=1e-5, err_msg=name
         )
+
+
+def test_cs_recompute_from_hs_rejected():
+    """Measured evidence for the round-6 REJECTION of the hs-only forward
+    (ops/lstm.py module doc): dropping the cs residual requires
+    reconstructing the cell as c = atanh(h / o), whose conditioning is
+    cosh²(c) — fine while |c| is small, catastrophically wrong once the
+    cell saturates (tanh(c) rounds to ±1.0 in f32 for |c| ≳ 8.3 and the
+    inversion returns the clip bound, not c). A forget-dominant cell
+    reaches that regime within a normal sentence length, so the byte
+    saving is not purchasable at training-grade numerics."""
+    rng = np.random.default_rng(0)
+    u = 8
+    steps = 40
+    # Forget-dominant regime: i ~ sigmoid(4), f ~ sigmoid(6), g ~ tanh(2),
+    # o ~ sigmoid(0) — the integrator cell every LSTM learns for
+    # long-range features. Recurrence replicated exactly as the kernel
+    # computes it (f32, [i, f, g, o] gate order).
+    c = np.zeros(u, np.float32)
+    errs, cs = [], []
+    for _ in range(steps):
+        i = 1.0 / (1.0 + np.exp(-np.float32(4.0)))
+        f = 1.0 / (1.0 + np.exp(-np.float32(6.0)))
+        g = np.tanh(np.float32(2.0) + rng.normal(0, 0.1, u).astype(np.float32))
+        o = 1.0 / (1.0 + np.exp(-rng.normal(0, 0.5, u).astype(np.float32)))
+        c = (f * c + i * g).astype(np.float32)
+        h = (o * np.tanh(c)).astype(np.float32)
+        # The reconstruction the hs-only backward would have to run:
+        ratio = np.clip(h / o, -1.0 + 1e-7, 1.0 - 1e-7)
+        c_hat = np.arctanh(ratio.astype(np.float32))
+        errs.append(np.abs(c_hat - c).max())
+        cs.append(np.abs(c).max())
+    errs, cs = np.asarray(errs), np.asarray(cs)
+    # Early, unsaturated steps reconstruct fine — the idea is not absurd…
+    assert errs[0] < 1e-4, errs[0]
+    # …but the cell saturates within a sentence, and the reconstruction
+    # error exceeds O(1) ABSOLUTE — gradients built from it (da_f uses
+    # c_prev directly) would be garbage, not approximate.
+    assert cs[-1] > 8.3, f"fixture failed to saturate: |c| = {cs[-1]}"
+    assert errs[-1] > 1.0, (
+        f"reconstruction unexpectedly survived saturation: err {errs[-1]} "
+        f"at |c| {cs[-1]} — re-evaluate the ops/lstm.py rejection note"
+    )

@@ -181,6 +181,49 @@ def serve_summary(recs: list[dict]) -> dict | None:
     }
 
 
+def ckpt_summary(recs: list[dict]) -> dict | None:
+    """Ring-save telemetry (round 6, kind="ckpt"): how many boundary saves
+    ran in each mode and what the steady-state payload is — the delta-ring
+    byte diet, read straight off the stream."""
+    saves = [
+        r for r in recs
+        if r.get("kind") == "ckpt" and r.get("event") == "ring_save"
+    ]
+    if not saves:
+        return None
+    by_mode: dict[str, int] = {}
+    for s in saves:
+        by_mode[str(s.get("mode"))] = by_mode.get(str(s.get("mode")), 0) + 1
+    out = {"records": len(saves), "by_mode": by_mode}
+    last = saves[-1]
+    if isinstance(last.get("bytes"), (int, float)):
+        out["last_bytes"] = int(last["bytes"])
+        out["last_mode"] = last.get("mode")
+    deltas = [
+        s["bytes"] for s in saves
+        if s.get("mode") == "delta" and isinstance(s.get("bytes"), (int, float))
+    ]
+    fulls = [
+        s["bytes"] for s in saves
+        if s.get("mode") in ("full", "base")
+        and isinstance(s.get("bytes"), (int, float))
+    ]
+    if deltas:
+        out["delta_bytes_mean"] = int(sum(deltas) / len(deltas))
+    if deltas and fulls:
+        # The headline ratio: steady-state delta payload vs a full save.
+        out["delta_over_full"] = round(
+            (sum(deltas) / len(deltas)) / max(fulls), 4
+        )
+    rows = [
+        s["rows"] for s in saves
+        if isinstance(s.get("rows"), (int, float))
+    ]
+    if rows:
+        out["rows_last"] = int(rows[-1])
+    return out
+
+
 def health_summary(recs: list[dict]) -> dict:
     events = [r for r in recs if r.get("kind") == "health"]
     by_event: dict[str, int] = {}
@@ -309,7 +352,7 @@ def render(report: dict) -> str:
     lines.append(f"schema: {n} records, {len(errors)} errors")
     for e in errors[:10]:
         lines.append(f"  ! {e}")
-    for section in ("train", "mfu", "eval", "serve", "health",
+    for section in ("train", "mfu", "eval", "serve", "ckpt", "health",
                     "flight_recorder", "overhead"):
         body = report.get(section)
         if body is None:
@@ -357,6 +400,7 @@ def main(argv=None) -> int:
         "mfu": mfu_summary(run_dir, train),
         "eval": eval_summary(recs),
         "serve": serve_summary(recs),
+        "ckpt": ckpt_summary(recs),
         "health": health_summary(recs),
         "flight_recorder": recorder_summary(run_dir),
     }

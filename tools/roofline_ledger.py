@@ -15,9 +15,11 @@ Three parts, all measured/derived on THIS chip in one run:
    calibrate() — and must never be used as a denominator.)
 2. **Analytic ledger** — per-component bytes and FLOPs for one training
    step of the flagship config (B=64 5w5s, bilstm L=40, token-cache lazy).
-   Every formula is written out below; component time floor =
+   The formulas live in utils/roofline.py (shared with bench.py's
+   ``step_bytes`` field); component time floor =
    max(bytes / BW, flops / MXU)  (bandwidth- and compute-bound phases
-   cannot overlap below this).
+   cannot overlap below this). Round 6 prints BOTH attention-residual
+   policies (remat_attn on/off) so the byte diet is an explicit A/B.
 3. **Measurement** — one hard-synced fused call of the real production
    step (bench.py machinery) -> measured ms/step to compare.
 
@@ -74,85 +76,16 @@ def calibrate(jax):
     return bw, mxu
 
 
-def ledger(cfg) -> list[tuple[str, float, float]]:
+def ledger(cfg, remat_attn: bool | None = None) -> list[tuple[str, float, float]]:
     """[(component, bytes/step, flops/step)] for the flagship train step.
 
-    Shapes: rows M = B*(N*K + N*Q) support+query concat-encoded; L tokens;
-    D = word+2*pos embedding width; u LSTM hidden/direction; A att_dim;
-    C induction_dim; H ntn_slices; bf16 activations (2 B), f32 head +
-    optimizer (4 B). Backward traffic follows the round-4 fused-kernel
-    design: recompute-gates backward re-reads emb and h/c state; dW/db
-    accumulate in VMEM (no HBM traffic).
+    The formulas live in utils/roofline.py (round 6: bench.py stamps
+    ``step_bytes`` from the same arithmetic). ``remat_attn`` selects the
+    attention-residual policy; None follows the config.
     """
-    B, N, K, Q, L = cfg.batch_size, cfg.n, cfg.k, cfg.q, cfg.max_length
-    TQ = N * Q
-    M = B * (N * K + TQ)
-    D = cfg.word_dim + 2 * cfg.pos_dim
-    u = cfg.lstm_hidden
-    A = cfg.att_dim
-    C = cfg.induction_dim
-    H = cfg.ntn_slices
-    bf, f32 = 2, 4
+    from induction_network_on_fewrel_tpu.utils.roofline import step_components
 
-    emb_b = L * M * D * bf          # [L, M, D] bf16, the gathered embedding
-    hs_b = L * M * 2 * u * bf       # [L, M, 2u] hidden states
-    rows = []
-
-    # L3 embedding: id gathers read the table rows and write emb_t; the
-    # windowed pos-offset matmul touches [L+1, L*P] windows (negligible).
-    rows.append(("embed gather fwd (write emb + read table)", 2 * emb_b, 0))
-
-    # Fused BiLSTM kernel FWD: reads emb_t once (gates computed in-kernel
-    # from the 60-wide embedding), writes hs AND cs (saved for backward).
-    proj_f = 2 * L * M * D * (8 * u)          # input projection, both dirs
-    rec_f = 2 * L * M * u * (4 * u) * 2       # recurrence h@whh, both dirs
-    rows.append(("bilstm kernel fwd", emb_b + 2 * hs_b, proj_f + rec_f))
-
-    # Self-attention FWD: proj reads hs, writes [L,M,A]; weighted-sum
-    # einsum reads hs again, writes [M, 2u].
-    att_f = 2 * L * M * 2 * u * A + 2 * L * M * 2 * u
-    rows.append((
-        "self-attn fwd", 2 * hs_b + L * M * A * bf + M * 2 * u * bf, att_f
-    ))
-
-    # Episode head FWD (f32): induction transform + routing + NTN.
-    ind_f = 2 * B * N * K * 2 * u * C + 3 * (2 * B * N * K * C * 2)
-    qp_f = 2 * B * TQ * 2 * u * C
-    ntn_f = 2 * B * N * C * C * H + 2 * B * TQ * N * C * H
-    head_b = (B * (N * K + TQ) * 2 * u * f32      # enc rows f32
-              + B * N * H * C * f32               # cM
-              + B * TQ * N * H * f32)             # v
-    rows.append(("episode head fwd (f32)", head_b, ind_f + qp_f + ntn_f))
-
-    # BACKWARD: head + attention + kernel. Convention: ~2x forward FLOPs
-    # (dX and dW products), bytes re-read forward residuals + write grads.
-    rows.append(("episode head bwd", 2 * head_b, 2 * (ind_f + qp_f + ntn_f)))
-    rows.append(("self-attn bwd", 3 * hs_b + L * M * A * bf, 2 * att_f))
-    # Kernel bwd (recompute gates): reads hs, cs, emb, d(hs); writes demb.
-    # dW/db accumulate in VMEM -> no HBM term.
-    rows.append((
-        "bilstm kernel bwd (recompute gates)",
-        3 * hs_b + 2 * emb_b, 2 * (proj_f + rec_f) + proj_f,
-    ))
-    rows.append(("embed scatter bwd (demb -> rows)", 2 * emb_b, 0))
-
-    # Optimizer (f32): non-embedding params p, m, v read + write, grads
-    # read. Lazy embed: only the batch's unique rows (<= M*L token ids,
-    # bounded by the corpus) touch their table/moment rows.
-    n_main = (
-        2 * D * 4 * u + 2 * u * 4 * u + 2 * 4 * u      # lstm
-        + 2 * u * A + A                                 # attention
-        + 2 * u * C + C + 2 * u * C + C                 # induction + qproj
-        + H * C * C + H + 1                             # ntn
-        + 2 * (2 * L) * cfg.pos_dim                     # pos tables
-    )
-    rows.append(("optimizer main (Adam, f32)", 7 * n_main * f32, 0))
-    u_rows = min(M * L, 2002)   # unique ids, corpus-bounded (synthetic)
-    rows.append((
-        "lazy embed rows (gather+Adam+scatter)",
-        u_rows * cfg.word_dim * f32 * 8, 0,
-    ))
-    return rows
+    return step_components(cfg, remat_attn)
 
 
 def main() -> int:
@@ -160,16 +93,23 @@ def main() -> int:
     ap.add_argument("--spc", type=int, default=256)
     ap.add_argument("--skip-measure", action="store_true")
     ap.add_argument("--json", default=None)
+    ap.add_argument(
+        "--remat", default="on", choices=["on", "off"],
+        help="attention-residual policy for the PRODUCTION rows "
+             "(the tool always prints both for the A/B)",
+    )
     args = ap.parse_args()
 
     import jax
 
     from induction_network_on_fewrel_tpu.config import ExperimentConfig
 
+    remat = args.remat == "on"
     cfg = ExperimentConfig(
         encoder="bilstm", n=5, k=5, q=5, batch_size=64, max_length=40,
         vocab_size=400002, compute_dtype="bfloat16",
         steps_per_call=args.spc, token_cache=True, embed_optimizer="lazy",
+        remat_attn=remat,
     )
 
     bw, mxu = calibrate(jax)
@@ -177,26 +117,36 @@ def main() -> int:
           f"({bw / NOMINAL_BW:.1%} of nominal), "
           f"MXU {mxu / 1e12:.1f} TFLOP/s ({mxu / NOMINAL_MXU:.1%})")
 
-    rows = ledger(cfg)
-    total_b = sum(r[1] for r in rows)
-    total_f = sum(r[2] for r in rows)
-    print(f"\n{'component':45s} {'MB/step':>8s} {'GFLOP':>7s} "
-          f"{'t_bw ms':>8s} {'t_mxu ms':>8s} {'floor ms':>8s}")
-    floor = 0.0
-    for name, b, f in rows:
-        tb, tf = b / bw * 1e3, f / mxu * 1e3
-        floor += max(tb, tf)
-        print(f"{name:45s} {b / 1e6:8.1f} {f / 1e9:7.1f} "
-              f"{tb:8.3f} {tf:8.3f} {max(tb, tf):8.3f}")
-    print(f"{'TOTAL':45s} {total_b / 1e6:8.1f} {total_f / 1e9:7.1f} "
-          f"{'':8s} {'':8s} {floor:8.3f}")
+    floors, totals = {}, {}
+    for policy in (False, True):
+        rows = ledger(cfg, remat_attn=policy)
+        total_b = sum(r[1] for r in rows)
+        total_f = sum(r[2] for r in rows)
+        tag = "remat_attn ON" if policy else "remat_attn OFF (round-5 policy)"
+        print(f"\n=== {tag} ===")
+        print(f"{'component':45s} {'MB/step':>8s} {'GFLOP':>7s} "
+              f"{'t_bw ms':>8s} {'t_mxu ms':>8s} {'floor ms':>8s}")
+        floor = 0.0
+        for name, b, f in rows:
+            tb, tf = b / bw * 1e3, f / mxu * 1e3
+            floor += max(tb, tf)
+            print(f"{name:45s} {b / 1e6:8.1f} {f / 1e9:7.1f} "
+                  f"{tb:8.3f} {tf:8.3f} {max(tb, tf):8.3f}")
+        print(f"{'TOTAL':45s} {total_b / 1e6:8.1f} {total_f / 1e9:7.1f} "
+              f"{'':8s} {'':8s} {floor:8.3f}")
+        floors[policy], totals[policy] = floor, total_b
+
+    rows = ledger(cfg, remat_attn=remat)
+    floor = floors[remat]
+    print(f"\nbyte diet: {totals[False] / 1e6:.1f} -> {totals[True] / 1e6:.1f} "
+          f"MB/step ({totals[True] / totals[False]:.1%}) with remat_attn")
 
     # Production-silicon projection at nominal BW/MXU.
     floor_prod = sum(
         max(b / NOMINAL_BW, f / NOMINAL_MXU) * 1e3 for _, b, f in rows
     )
     eps_prod = cfg.batch_size / (floor_prod / 1e3)
-    print(f"\nprojected floor on nominal v5e (819 GB/s, 197 TF/s): "
+    print(f"projected floor on nominal v5e (819 GB/s, 197 TF/s): "
           f"{floor_prod:.3f} ms/step -> {eps_prod:,.0f} eps/s/chip ceiling")
 
     measured = None
@@ -264,12 +214,19 @@ def main() -> int:
     if args.json:
         with open(args.json, "w") as f:
             json.dump({
+                # Calibration backend matters: CPU-emitted ledgers carry
+                # honest-but-irrelevant bw/mxu floors; the component BYTE
+                # rows are analytic and backend-independent.
+                "calibration_backend": __import__("jax").default_backend(),
                 "calibrated_bw_GBs": round(bw / 1e9, 1),
                 "calibrated_mxu_TFs": round(mxu / 1e12, 1),
+                "remat_attn": remat,
                 "components": [
                     {"name": n, "bytes": b, "flops": fl}
                     for n, b, fl in rows
                 ],
+                "step_bytes": int(totals[remat]),
+                "step_bytes_no_remat": int(totals[False]),
                 "floor_ms_this_chip": round(floor, 3),
                 "floor_ms_nominal_v5e": round(floor_prod, 3),
                 "measured_ms_per_step": (
