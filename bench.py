@@ -264,6 +264,24 @@ def _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips) -> i
         jax, cfg, fused_call, state, best_rate, n_chips
     )
 
+    # Input-pipeline leg (ISSUE 4): the datapipe producer feed at prefetch
+    # depths {0, 2, 4} — feed_stall_frac (fraction of wall the trainer
+    # waited on the feed; at depth 0 that is the fully-serial baseline's
+    # inline sampling) and eps_per_sec per depth, so the overlap win sits
+    # in the BENCH trajectory, not only in soak prose.
+    datapipe_leg = None
+    try:
+        # CPU fallback keeps the leg responsive: one timed call per depth
+        # (the fused call itself is tens of seconds there, and the stall
+        # measurement is a within-call integral, not a between-call
+        # variance estimate); TPU gets the full 6-call window.
+        datapipe_leg, state = _datapipe_leg(
+            jax, cfg, multi_step, sampler, table, state, n_chips,
+            calls=6 if backend == "tpu" else 1,
+        )
+    except Exception as e:  # the leg must never sink the bench
+        print(f"bench: datapipe leg failed: {e!r}", file=sys.stderr)
+
     # Device-busy fraction (VERDICT round-2 weak item 1): one traced chunk,
     # parsed from the XPlane via jax.profiler.ProfileData — puts "how much
     # of the wall is device work vs tunnel RPC" in the artifact itself
@@ -316,8 +334,86 @@ def _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips) -> i
         "step_bytes_no_remat": step_bytes(cfg, remat_attn=False),
         "allin_over_windowed": allin_over_windowed,
         "ring_save_bytes": ring_bytes,
+        "datapipe": datapipe_leg,
     }))
     return 0
+
+
+def _datapipe_leg(jax, cfg, multi_step, sampler, table, state, n_chips,
+                  calls: int = 6):
+    """({depth: {feed_stall_frac, eps_per_sec}}, state).
+
+    Each depth gets a FRESH index sampler (same seed — identical episode
+    stream, so the work is like-for-like) wrapped in a PipelineFeed
+    producing whole fused units with device-put payloads; the timed loop
+    is the main bench's hard-synced fused call driven through the feed.
+    feed_stall_frac = consumer seconds waiting on the feed / wall seconds
+    (depth 0 counts the inline sampling — the fully-serial baseline).
+    Threads the donated state back to the caller on every path."""
+    from induction_network_on_fewrel_tpu.datapipe import PipelineFeed
+    from induction_network_on_fewrel_tpu.native.sampler import (
+        make_index_sampler,
+    )
+
+    sizes = [
+        int(sampler._offsets[i + 1] - sampler._offsets[i])
+        for i in range(len(sampler._offsets) - 1)
+    ] if hasattr(sampler, "_offsets") else None
+    if sizes is None:  # python index sampler fallback
+        sizes = list(sampler.sizes)
+    S = STEPS_PER_CALL
+    out = {}
+    for depth in (0, 2, 4):
+        feed = PipelineFeed(
+            make_index_sampler(
+                sizes, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size,
+                seed=1234,
+            ),
+            prefetch_depth=depth, unit=S, device_put=True,
+        )
+        # Per-depth failure isolation INSIDE the leg, so a feed failure
+        # between calls drops only that depth and the newest live state
+        # still returns to the caller. Not airtight: a multi_step raise
+        # AFTER input donation leaves `state` pointing at deleted buffers
+        # and the remaining depths (and device-busy leg) fail too — the
+        # leg trades that rare mid-call case for correct handling of the
+        # realistic between-call feed errors.
+        try:
+            # Warm the feed's first unit outside the timed window (the
+            # main loop's compile is already warm; depth>0 starts its
+            # producer here).
+            state, metrics = multi_step(state, table, *feed.sample_fused(S))
+            _ = float(jax.device_get(metrics["loss"])[-1])
+            base_stats = feed.stats()
+            t0 = time.monotonic()
+            for _ in range(calls):
+                state, metrics = multi_step(
+                    state, table, *feed.sample_fused(S)
+                )
+                _ = float(jax.device_get(metrics["loss"])[-1])  # hard sync
+            wall = time.monotonic() - t0
+            stats = feed.stats()
+            stall = stats["stall_s"] - base_stats["stall_s"]
+            eps = calls * S * BATCH / wall / max(n_chips, 1)
+            out[str(depth)] = {
+                "feed_stall_frac": round(stall / wall, 6),
+                "eps_per_sec": round(eps, 2),
+                "stall_s": round(stall, 4),
+                "wall_s": round(wall, 4),
+            }
+            print(
+                f"bench: datapipe depth={depth}: {eps:.0f} eps/s/chip, "
+                f"feed stall {100 * stall / wall:.2f}% of wall",
+                file=sys.stderr,
+            )
+        except Exception as e:
+            print(
+                f"bench: datapipe depth={depth} failed: {e!r}",
+                file=sys.stderr,
+            )
+        finally:
+            feed.close()
+    return out, state
 
 
 def _boundary_soak(jax, cfg, fused_call, state, windowed_rate, n_chips,

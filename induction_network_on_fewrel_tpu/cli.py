@@ -224,6 +224,34 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
     )
     p.add_argument("--prefetch", type=int, default=4, help="native sampler ring-buffer depth (0 = sync)")
     p.add_argument("--sampler_threads", type=int, default=2, help="native sampler worker threads")
+    p.add_argument(
+        "--prefetch_depth", type=int, default=2,
+        help="datapipe producer-pipeline depth (units of steps_per_call "
+             "batches on fused index paths): a background thread samples/"
+             "assembles ahead into a bounded queue so host feed overlaps "
+             "train/dispatch; the pipeline cursor rides in every "
+             "checkpoint and resume replays the exact episode stream. "
+             "0 = the synchronous path (bitwise-identical stream)",
+    )
+    p.add_argument(
+        "--mixture", default="",
+        help="episode-mixture schedule (datapipe/mixture.py): "
+             "'source:w[@idx][,w@idx...];...' where a source is 'train' or "
+             "a FewRel-schema JSON path, e.g. "
+             "'train:1.0;pubmed.json:0.0@0,1.0@4000' (DA ramp). Weights "
+             "interpolate linearly over the batch index; the per-batch "
+             "source pick is deterministic from (seed, batch index) and "
+             "resumes exactly from the checkpoint cursor. Live token path "
+             "only",
+    )
+    if train:
+        p.add_argument(
+            "--feed_fault", default="",
+            help="input-pipeline fault injection (debug drills): "
+                 "'slow:SECONDS', 'stall:INDEX', 'poison:INDEX' "
+                 "(comma-separable) — exercises the watchdog's feed_stall/"
+                 "feed_poisoned detectors (RUNBOOK §10)",
+        )
     # device / parallelism
     p.add_argument("--device", default="tpu", choices=["tpu", "cpu"])
     p.add_argument(
@@ -384,6 +412,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         tfm_stacked=args.tfm_stacked or args.pp > 1,
         sampler=args.sampler, prefetch=args.prefetch,
         sampler_threads=args.sampler_threads,
+        prefetch_depth=getattr(args, "prefetch_depth", 2),
+        mixture=getattr(args, "mixture", ""),
+        feed_fault=getattr(args, "feed_fault", ""),
         adv=getattr(args, "adv", None) is not None,
         adv_lambda=getattr(args, "adv_lambda", 1.0),
         adv_dis_hidden=getattr(args, "adv_dis_hidden", 256),
@@ -993,6 +1024,100 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
 
             fused_step = make_sharded_multi_train_step(model, cfg, mesh, state)
 
+    # --- datapipe/ (ISSUE 4): mixture schedule + producer pipeline -------
+    if cfg.mixture and not only_test:
+        # Mixtures interleave LIVE token-path samplers over same-geometry
+        # corpora; the cached paths bind index samplers to one device
+        # table each, and per-host pods would need per-source local
+        # sizing — refuse both with guidance instead of mis-sampling.
+        if caching:
+            raise ValueError(
+                "--mixture does not combine with --token_cache/"
+                "--feature_cache (cached index samplers are bound to one "
+                "device table per split); drop the cache flags"
+            )
+        if jax.process_count() > 1:
+            raise ValueError(
+                "--mixture is single-process for now (per-host mixture "
+                "feeding needs per-source local sizing); drop --mixture "
+                "on pods"
+            )
+        from induction_network_on_fewrel_tpu.data import (
+            load_fewrel_json,
+            make_synthetic_fewrel,
+        )
+        from induction_network_on_fewrel_tpu.datapipe import (
+            MixtureSampler,
+            MixtureSchedule,
+        )
+
+        schedule = MixtureSchedule.parse(cfg.mixture)
+        children = []
+        for i, name in enumerate(schedule.names):
+            if name == "train":
+                # Rebuilt prefetch-free like every other child (same seed
+                # keeps its stream identity): the already-built sampler
+                # carries the native C++ prefetch ring, and children must
+                # not stack prefetchers under the datapipe producer.
+                if hasattr(train_sampler, "close"):
+                    train_sampler.close()
+                children.append((name, make_sampler(
+                    train_ds, tok, cfg.train_n, cfg.k, cfg.q,
+                    cfg.batch_size, na_rate=cfg.na_rate, seed=cfg.seed,
+                    backend=live_backend, prefetch=0, num_threads=1,
+                )))
+                continue
+            if name.startswith("synthetic"):
+                _, _, sseed = name.partition(":")
+                src_ds = make_synthetic_fewrel(
+                    num_relations=max(cfg.train_n, cfg.n) * 2,
+                    instances_per_relation=max(cfg.k + cfg.q + 5, 20),
+                    vocab_size=cfg.vocab_size - 2,
+                    seed=int(sseed or 83),
+                )
+            else:
+                src_ds = load_fewrel_json(name)
+            # Child streams are seeded per SOURCE POSITION (stable across
+            # runs with the same spec — required for cursor resume). No
+            # native prefetch inside children: the datapipe producer is
+            # the pipeline; stacked prefetchers would hide the cursor.
+            children.append((name, make_sampler(
+                src_ds, tok, cfg.train_n, cfg.k, cfg.q, cfg.batch_size,
+                na_rate=cfg.na_rate, seed=cfg.seed + 1000 + i,
+                backend=live_backend, prefetch=0, num_threads=1,
+            )))
+        train_sampler = MixtureSampler(children, schedule, seed=cfg.seed)
+
+    if not only_test:
+        from induction_network_on_fewrel_tpu.datapipe import (
+            FeedFaults,
+            PipelineFeed,
+        )
+
+        # Production unit: whole fused [S,B,...] stacks when the trainer
+        # will consume them that way (index samplers under
+        # steps_per_call fusion), else single batches.
+        unit = (
+            cfg.steps_per_call
+            if (
+                cfg.steps_per_call > 1
+                and hasattr(train_sampler, "sample_fused")
+                and getattr(train_sampler, "return_indices", True)
+            ) else 1
+        )
+        train_sampler = PipelineFeed(
+            train_sampler,
+            prefetch_depth=cfg.prefetch_depth,
+            unit=unit,
+            # Double-buffered device puts: producer-side H2D on
+            # single-device fused paths (mesh paths assemble global
+            # arrays in the sampler already; per-batch token dicts are
+            # stacked host-side by the trainer and must stay numpy).
+            device_put=(mesh is None and unit > 1),
+            faults=FeedFaults.parse(cfg.feed_fault),
+            stream_tag=f"mixture={cfg.mixture};seed={cfg.seed}",
+        )
+
     adv_pieces = None
     if cfg.adv and not only_test:
         from induction_network_on_fewrel_tpu.data import (
@@ -1238,6 +1363,23 @@ def _run_train(args, trainer) -> int:
             )
             state = trainer.reshard_state(state)
             print(f"restored checkpoint step={start_step} from {src}", file=sys.stderr)
+            if args.resume:
+                # Input-pipeline cursor (datapipe/): reposition the feed so
+                # the resumed run replays the exact episode stream the
+                # uninterrupted one would have consumed. A --load_ckpt
+                # fine-tune deliberately restarts the stream at 0 (its
+                # step numbering restarts too).
+                if trainer.restore_feed_cursor(mngr, start_step):
+                    print(
+                        f"restored input-pipeline cursor at step "
+                        f"{start_step}", file=sys.stderr,
+                    )
+                else:
+                    print(
+                        "no input-pipeline cursor in the checkpoint "
+                        "(pre-datapipe dir?); the episode stream restarts "
+                        "from its seed", file=sys.stderr,
+                    )
         except FileNotFoundError:
             if args.load_ckpt:
                 raise

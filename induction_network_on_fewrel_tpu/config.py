@@ -227,6 +227,26 @@ class ExperimentConfig:
     sampler: str = "auto"     # auto | native (C++ prefetching) | python
     prefetch: int = 4         # native ring-buffer depth (0 = synchronous)
     sampler_threads: int = 2  # native worker threads
+    # datapipe/ producer pipeline (ISSUE 4): background thread drives the
+    # train sampler into a bounded queue of this many UNITS (a unit is
+    # steps_per_call batches on the fused index paths, 1 otherwise), with
+    # double-buffered device puts on single-device runs — host sampling
+    # overlaps train/dispatch instead of serializing with it. 0 = the
+    # synchronous pre-datapipe path, bitwise-identical episode stream
+    # (tests/test_datapipe.py pins both invariants). The pipeline cursor
+    # rides in every checkpoint; resume replays the exact stream.
+    prefetch_depth: int = 2
+    # Declarative episode-mixture schedule (datapipe/mixture.py spec
+    # grammar, e.g. "train:1.0;pubmed.json:0.0@0,1.0@4000" for a FewRel
+    # 2.0 domain-adaptation ramp). "" = single-source (the flat sampler).
+    # Sources must produce identically-shaped batches (static jit shapes):
+    # curricula act on source WEIGHTS over batch index, never on episode
+    # geometry.
+    mixture: str = ""
+    # Feed-path fault injection (datapipe/faults.py): "slow:SECONDS",
+    # "stall:INDEX", "poison:INDEX", comma-separable. Debug-only drills
+    # for the obs watchdog's feed_stall/feed_poisoned detectors. "" = off.
+    feed_fault: str = ""
 
     @property
     def total_q(self) -> int:

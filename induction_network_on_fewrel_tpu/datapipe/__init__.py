@@ -1,0 +1,44 @@
+"""datapipe/ — pipelined, checkpointable episode input pipeline (ISSUE 4).
+
+The training input side as a production subsystem instead of an inline
+``sample_batch()`` call on the critical path:
+
+* ``producer`` — ``PipelineFeed``: a background producer thread drives any
+  existing sampler into a bounded queue (optionally device-putting batches
+  ahead of dispatch), so host sampling/assembly overlaps device compute
+  instead of serializing with ``train/dispatch``. ``prefetch_depth=0``
+  degrades to the exact synchronous path (bitwise-equal stream).
+* ``cursor`` — ``PipelineCursor``: an explicit, serializable pipeline
+  position (stream state, consumed batch index, per-host layout
+  fingerprint) saved in every checkpoint and restored on resume; the
+  resumed episode stream is byte-identical to the uninterrupted one at any
+  prefetch depth.
+* ``mixture`` — declarative episode-mixture schedules (domain-adaptation
+  interleaves, weight curricula) resolved deterministically from the
+  stream seed and batch index.
+* ``faults`` — feed-path fault injection (slow producer, producer stall,
+  poisoned batch) wired into the obs watchdog so a sick feed trips a
+  health event instead of silently wedging the run.
+"""
+
+from induction_network_on_fewrel_tpu.datapipe.cursor import (
+    PipelineCursor,
+    capture_sampler_state,
+    restore_sampler_state,
+)
+from induction_network_on_fewrel_tpu.datapipe.faults import FeedFaults
+from induction_network_on_fewrel_tpu.datapipe.mixture import (
+    MixtureSampler,
+    MixtureSchedule,
+)
+from induction_network_on_fewrel_tpu.datapipe.producer import PipelineFeed
+
+__all__ = [
+    "FeedFaults",
+    "MixtureSampler",
+    "MixtureSchedule",
+    "PipelineCursor",
+    "PipelineFeed",
+    "capture_sampler_state",
+    "restore_sampler_state",
+]

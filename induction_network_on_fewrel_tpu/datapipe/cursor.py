@@ -1,0 +1,181 @@
+"""Serializable pipeline cursor: WHERE the episode stream is, exactly.
+
+A checkpoint that omits the input-pipeline position silently changes the
+training data on resume: the restored model continues from step S, but the
+sampler restarts from batch 0 (or wherever a fresh seed lands), so the
+resumed run replays a different episode stream than the uninterrupted one.
+The cursor closes that hole. It captures, per checkpoint:
+
+* the **sampler stream state** at a captured batch index — exact RNG
+  state for the Python samplers (``numpy.random.Generator`` bit-generator
+  state, a JSON-able dict), the next-batch sequence number for the native
+  C++ samplers (pure functions of ``(seed, batch_index)``), recursively
+  for mixtures and per-host wrappers;
+* the **consumed batch index** — how many batches the trainer actually
+  took (the producer may have prefetched further; prefetched-but-unconsumed
+  batches are re-produced on resume, never skipped);
+* a **layout fingerprint** — process count/index and global/local batch
+  size. Per-host streams are seeded per process, so restoring a cursor
+  under a different layout would silently splice two different global
+  streams; the fingerprint makes that a loud error instead.
+
+Restoring is ``restore_sampler_state`` (exact state) plus a bounded replay
+of ``consumed - captured_at`` discarded batches (mid-unit resume: the
+capture granularity is one producer unit, at most ``steps_per_call``
+batches, so the replay is cheap and exact).
+
+The capture/restore protocol is duck-typed: samplers may implement
+``feed_state() -> dict`` and ``restore_feed_state(state)`` (the repo's
+samplers all do — sampling/episodes.py, train/feature_cache.py,
+native/sampler.py, parallel/hostfeed.py, datapipe/mixture.py). Samplers
+without the protocol fall back to ``{"kind": "replay"}``: restore then
+means "fresh sampler + discard ``consumed`` batches", which is still exact
+for any deterministic sampler, just not O(1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+CURSOR_VERSION = 1
+
+
+def capture_sampler_state(sampler) -> dict:
+    """The sampler's stream state, restorable via restore_sampler_state.
+
+    ``{"kind": "replay"}`` when the sampler has no feed_state protocol —
+    restore must then replay from a FRESH sampler."""
+    fn = getattr(sampler, "feed_state", None)
+    if fn is None:
+        return {"kind": "replay"}
+    return fn()
+
+
+def restore_sampler_state(sampler, state: dict, skip: int = 0) -> None:
+    """Set ``sampler`` to ``state``'s position, then discard ``skip``
+    batches (mid-unit resume). For ``kind="replay"`` the sampler must be
+    freshly constructed with the original seed; ``skip`` then counts from
+    batch 0."""
+    if state.get("kind") != "replay":
+        fn = getattr(sampler, "restore_feed_state", None)
+        if fn is None:
+            raise ValueError(
+                f"cursor carries state kind {state.get('kind')!r} but "
+                f"{type(sampler).__name__} has no restore_feed_state"
+            )
+        fn(state)
+    for _ in range(skip):
+        sampler.sample_batch()
+
+
+def current_layout(global_batch: int, local_batch: int | None = None) -> dict:
+    """The layout fingerprint of THIS process (see module docstring)."""
+    try:
+        import jax
+
+        pc, pi = jax.process_count(), jax.process_index()
+    except Exception:  # noqa: BLE001 — cursor math must not need a backend
+        pc, pi = 1, 0
+    return {
+        "process_count": int(pc),
+        "process_index": int(pi),
+        "global_batch": int(global_batch),
+        "local_batch": int(local_batch if local_batch is not None
+                           else global_batch),
+    }
+
+
+@dataclasses.dataclass
+class PipelineCursor:
+    """One restorable input-pipeline position (all fields JSON-able)."""
+
+    consumed: int               # batches the trainer consumed so far
+    captured_at: int            # batch index ``sampler_state`` corresponds to
+    sampler_state: dict         # from capture_sampler_state
+    layout: dict                # from current_layout
+    stream_tag: str = ""        # mixture spec / seed tag, validated on restore
+    version: int = CURSOR_VERSION
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PipelineCursor":
+        v = int(d.get("version", 0))
+        if v != CURSOR_VERSION:
+            raise ValueError(
+                f"pipeline cursor version {v} unsupported "
+                f"(this build reads v{CURSOR_VERSION})"
+            )
+        return cls(
+            consumed=int(d["consumed"]),
+            captured_at=int(d["captured_at"]),
+            sampler_state=dict(d["sampler_state"]),
+            layout=dict(d["layout"]),
+            stream_tag=str(d.get("stream_tag", "")),
+            version=v,
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "PipelineCursor":
+        return cls.from_dict(json.loads(s))
+
+    def check_layout(self, layout: dict) -> None:
+        """Raise when this cursor was written under a different process
+        layout — resuming would splice two different global streams."""
+        mismatched = {
+            k: (self.layout.get(k), layout.get(k))
+            for k in ("process_count", "process_index",
+                      "global_batch", "local_batch")
+            if self.layout.get(k) != layout.get(k)
+        }
+        if mismatched:
+            raise ValueError(
+                f"pipeline cursor layout mismatch {mismatched}: the episode "
+                "stream is seeded per process layout, so resuming under a "
+                "different one would not reproduce the uninterrupted "
+                "stream. Resume with the original layout, or start a fresh "
+                "run directory."
+            )
+
+
+def _json_scalarize(obj: Any) -> Any:
+    """numpy scalars/arrays inside an RNG state dict -> plain Python so the
+    cursor serializes with the stdlib json encoder."""
+    import numpy as np
+
+    if isinstance(obj, dict):
+        return {k: _json_scalarize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_scalarize(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.generic):
+        return obj.item()
+    return obj
+
+
+def rng_feed_state(rng) -> dict:
+    """feed_state payload for a ``numpy.random.Generator``-backed sampler:
+    the bit-generator's full state (exact O(1) resume)."""
+    return {
+        "kind": "rng",
+        "bit_generator": type(rng.bit_generator).__name__,
+        "state": _json_scalarize(rng.bit_generator.state),
+    }
+
+
+def restore_rng_feed_state(rng, state: dict) -> None:
+    got = state.get("bit_generator")
+    want = type(rng.bit_generator).__name__
+    if got != want:
+        raise ValueError(
+            f"cursor RNG state is for bit generator {got!r}, sampler uses "
+            f"{want!r} — numpy version / sampler construction mismatch"
+        )
+    rng.bit_generator.state = state["state"]

@@ -1,0 +1,101 @@
+"""Feed-path fault injection: make input-pipeline failure modes drillable.
+
+The serving stack got this discipline in PR 2 (``--nan_inject_step``,
+``--fault_step``); the feed path gets the same here. A fault spec is a
+comma-separated list of directives applied inside the producer:
+
+* ``slow:SECONDS``   — sleep SECONDS before producing every unit (a slow
+  host sampler / starved CPU; the stall telemetry and bench leg quantify
+  how much of it prefetch hides).
+* ``stall:INDEX``    — the producer stops producing once the next batch
+  index reaches INDEX (a wedged worker). The consumer's stall ticks keep
+  flowing, so the watchdog trips ``feed_stall`` instead of the run hanging
+  silently.
+* ``poison:INDEX``   — corrupt the unit containing batch INDEX (float
+  leaves NaN-poisoned, int leaves negated) AFTER cursor capture, the way a
+  bad DMA or a buggy transform would. The feed's validator refuses to hand
+  the batch to the train step and emits a critical ``feed_poisoned``
+  health event.
+
+Parsing lives here so ``--feed_fault`` on the CLI, the tests, and any
+drill script agree on one grammar (``FeedFaults.parse``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class FeedFaults:
+    """Immutable fault plan; ``FeedFaults()`` (all off) is the default."""
+
+    slow_s: float = 0.0         # per-unit producer delay
+    stall_at: int | None = None  # stop producing at this batch index
+    poison_at: int | None = None  # corrupt the unit containing this index
+
+    @classmethod
+    def parse(cls, spec: str | None) -> "FeedFaults":
+        """``"slow:0.05,poison:30"`` -> FeedFaults(slow_s=0.05, poison_at=30).
+
+        Empty/None -> all off. Unknown directives raise (a typoed drill
+        that silently does nothing is worse than no drill)."""
+        if not spec:
+            return cls()
+        slow_s, stall_at, poison_at = 0.0, None, None
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, arg = part.partition(":")
+            if name == "slow":
+                slow_s = float(arg)
+                if slow_s < 0:
+                    raise ValueError(f"slow delay must be >= 0, got {slow_s}")
+            elif name == "stall":
+                stall_at = int(arg)
+            elif name == "poison":
+                poison_at = int(arg)
+            else:
+                raise ValueError(
+                    f"unknown feed fault {name!r} (known: slow:SECONDS, "
+                    f"stall:INDEX, poison:INDEX)"
+                )
+        return cls(slow_s=slow_s, stall_at=stall_at, poison_at=poison_at)
+
+    @property
+    def active(self) -> bool:
+        return (
+            self.slow_s > 0
+            or self.stall_at is not None
+            or self.poison_at is not None
+        )
+
+    def stalls_unit(self, unit_start: int) -> bool:
+        return self.stall_at is not None and unit_start >= self.stall_at
+
+    def poisons_unit(self, unit_start: int, unit: int) -> bool:
+        return (
+            self.poison_at is not None
+            and unit_start <= self.poison_at < unit_start + unit
+        )
+
+
+def poison_tree(tree):
+    """NaN-poison float leaves, negate int leaves (shape-preserving, so the
+    corruption models bad VALUES, not a feed bug the shape check would
+    catch for free). numpy-only — runs on host batches."""
+    import numpy as np
+
+    def bad(x):
+        a = np.array(x)  # writable copy
+        if np.issubdtype(a.dtype, np.floating):
+            a.fill(np.nan)
+        elif np.issubdtype(a.dtype, np.integer):
+            np.negative(a, out=a)
+            a -= 1  # 0 rows must corrupt too
+        return a
+
+    import jax
+
+    return jax.tree.map(bad, tree)

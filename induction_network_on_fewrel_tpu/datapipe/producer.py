@@ -1,0 +1,582 @@
+"""PipelineFeed: background-produced, bounded, checkpointable episode feed.
+
+Why: the trainer's ``train/sample`` span (host-side episode sampling +
+global-array assembly) runs serialized with ``train/dispatch`` — every
+step pays the host work on the critical path. This feed moves production
+onto a background thread driving the EXISTING samplers into a bounded
+queue, so batch ``t+1`` is sampled (and optionally already device-put)
+while the device runs batch ``t``. The consumer's wait on the queue is the
+*feed stall* — measured, logged (``kind="data"``), and benchmarked
+(``bench.py`` input-pipeline leg; target < 2% of p50 step time).
+
+Stream contract — the load-bearing invariant every feature here preserves:
+
+    The sequence of batches handed to the trainer is IDENTICAL to the
+    synchronous path's, at every prefetch depth.
+
+Production is strictly sequential from one base sampler (no work
+stealing, no reordering); depth only changes how far ahead that sequence
+is materialized. ``prefetch_depth=0`` short-circuits to direct synchronous
+delegation — bitwise the pre-datapipe behavior.
+
+Units: the feed produces in blocks of ``unit`` batches (``steps_per_call``
+for index samplers whose ``sample_fused`` fills a stacked [S,B,...] block
+in one native call; 1 otherwise). Units are a production/transport
+granularity only — consumption may interleave single draws and fused
+draws; the feed slices/stacks across unit boundaries as needed, and the
+cursor tracks position in BATCHES.
+
+Checkpointing: the producer captures the base sampler's stream state
+(datapipe/cursor.py) immediately before drawing each unit; ``cursor_state``
+pairs the captured state of the unit containing the consumed position with
+the consumed batch index. Prefetched-but-unconsumed batches are thereby
+re-produced on resume, never skipped — resume is byte-identical.
+
+Faults (datapipe/faults.py): ``slow`` delays production, ``stall`` wedges
+the producer (the consumer's stall ticks then trip the obs watchdog's
+``feed_stall`` detector), ``poison`` corrupts a unit after state capture —
+the validator refuses to hand it to the train step and the poisoned tick
+trips ``feed_poisoned``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Iterator
+
+import numpy as np
+
+from induction_network_on_fewrel_tpu.datapipe.cursor import (
+    PipelineCursor,
+    capture_sampler_state,
+    current_layout,
+    restore_sampler_state,
+)
+from induction_network_on_fewrel_tpu.datapipe.faults import (
+    FeedFaults,
+    poison_tree,
+)
+from induction_network_on_fewrel_tpu.obs.spans import span
+
+
+class FeedError(RuntimeError):
+    """The feed cannot serve batches (producer died or batch poisoned)."""
+
+
+class _Item:
+    __slots__ = ("start", "payload", "poisoned")
+
+    def __init__(self, start: int, payload: Any, poisoned: str | None):
+        self.start = start          # batch index of payload[0]
+        self.payload = payload      # fused (sup, qry, lab) or a single batch
+        self.poisoned = poisoned    # validator verdict (None = clean)
+
+
+class PipelineFeed:
+    """Wraps any sampler (``sample_batch``/optional ``sample_fused``) with
+    a producer thread + bounded queue + serializable cursor. Drop-in: the
+    trainer-facing surface (``sample_batch``, ``sample_fused`` when fused,
+    ``batch_size``, ``total_q``, ``return_indices``, iteration, ``close``)
+    is the base sampler's."""
+
+    def __init__(
+        self,
+        base,
+        prefetch_depth: int = 2,
+        unit: int = 1,
+        device_put: bool = False,
+        faults: FeedFaults | None = None,
+        logger=None,
+        local_batch: int | None = None,
+        stream_tag: str = "",
+        stall_tick_s: float = 2.0,
+        validate: bool | None = None,
+    ):
+        if prefetch_depth < 0:
+            raise ValueError(f"prefetch_depth must be >= 0, got {prefetch_depth}")
+        if unit < 1:
+            raise ValueError(f"unit must be >= 1, got {unit}")
+        if unit > 1 and not hasattr(base, "sample_fused"):
+            raise ValueError(
+                f"unit={unit} needs a sampler with sample_fused; "
+                f"{type(base).__name__} has none"
+            )
+        self.base = base
+        self.depth = prefetch_depth
+        self.unit = unit
+        self.batch_size = base.batch_size
+        self._device_put = device_put and prefetch_depth > 0
+        self.faults = faults or FeedFaults()
+        self.logger = logger          # attachable later (trainer wires it)
+        self.stream_tag = stream_tag
+        self._stall_tick_s = stall_tick_s
+        # Validation (shape/dtype template + finite/int-range checks) runs
+        # on the PRODUCER thread — off the critical path. Default: on
+        # whenever poisoning is drillable or a logger will carry events
+        # (the logger attaches after construction, so the default is
+        # resolved per check in _should_validate, not frozen here).
+        self._validate_opt = validate
+        if local_batch is None:
+            # Per-host wrappers (parallel/hostfeed.PerHostSampler) report
+            # the GLOBAL batch; the layout fingerprint wants both sides.
+            local_batch = getattr(
+                getattr(base, "local", None), "batch_size", None
+            )
+        self._layout = current_layout(base.batch_size, local_batch)
+
+        # --- stream position (all guarded by _lock) ---
+        self._lock = threading.Lock()
+        self._consumed = 0            # batches handed to the trainer
+        self._produced = 0            # batches drawn from the base sampler
+        self._next_produce = 0        # producer's next unit start
+        # {unit_start: sampler state captured BEFORE drawing that unit}.
+        # Seeded with the position-0 state so cursor_state never has to
+        # touch the base sampler concurrently with the producer.
+        self._states: dict[int, dict] = {0: capture_sampler_state(base)}
+        self._template = None         # (shape, dtype) tree of unit 0
+
+        # --- telemetry accumulators ---
+        self._stall_s = 0.0           # consumer time blocked on the queue
+        self._produce_s = 0.0         # producer time drawing units
+        self._poisoned = 0
+        self._win_t0 = time.monotonic()
+        self._win = {"stall_s": 0.0, "produce_s": 0.0, "consumed": 0,
+                     "produced": 0}
+
+        # --- producer machinery ---
+        self._q: queue.Queue = queue.Queue(maxsize=max(prefetch_depth, 1))
+        self._cur: _Item | None = None  # partially-consumed unit
+        self._cur_off = 0
+        self._stop = threading.Event()
+        self._gen = 0                 # bumped by restore_cursor/close
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+        if unit > 1:
+            # Exposed as an INSTANCE attribute so hasattr-based dispatch in
+            # the trainer (_can_sample_fused) sees it only in fused mode.
+            self.sample_fused = self._sample_fused
+
+    # --- properties the trainer reads off samplers ------------------------
+
+    @property
+    def total_q(self):
+        return self.base.total_q
+
+    @property
+    def return_indices(self):
+        return getattr(self.base, "return_indices", True)
+
+    # --- producer side ----------------------------------------------------
+
+    def _ensure_producer(self) -> None:
+        if self.depth == 0 or (self._thread is not None and self._thread.is_alive()):
+            return
+        if self._error is not None:
+            raise FeedError("feed producer died") from self._error
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._produce_loop, args=(self._gen,),
+            name="datapipe-producer", daemon=True,
+        )
+        self._thread.start()
+
+    def _should_validate(self) -> bool:
+        if self._validate_opt is not None:
+            return self._validate_opt
+        return self.faults.active or self.logger is not None
+
+    def _draw_unit(self):
+        if self.unit > 1:
+            return self.base.sample_fused(self.unit)
+        return self.base.sample_batch()
+
+    def _produce_loop(self, gen: int) -> None:
+        try:
+            while not self._stop.is_set() and gen == self._gen:
+                start = self._next_produce
+                if self.faults.stalls_unit(start):
+                    # Wedged-worker drill: produce nothing, stay alive. The
+                    # consumer's stall ticks surface it to the watchdog.
+                    self._stop.wait(0.05)
+                    continue
+                if self.faults.slow_s > 0:
+                    self._stop.wait(self.faults.slow_s)
+                    if self._stop.is_set() or gen != self._gen:
+                        return
+                state = capture_sampler_state(self.base)
+                t0 = time.monotonic()
+                with span("datapipe/produce", unit=self.unit):
+                    payload = self._draw_unit()
+                dt = time.monotonic() - t0
+                poisoned = None
+                if self.faults.poisons_unit(start, self.unit):
+                    payload = poison_tree(payload)
+                if self._should_validate():
+                    poisoned = self._check_payload(payload)
+                if self._device_put:
+                    import jax
+
+                    payload = jax.device_put(payload)
+                item = _Item(start, payload, poisoned)
+                with self._lock:
+                    self._states[start] = state
+                    self._produce_s += dt
+                    self._win["produce_s"] += dt
+                while not self._stop.is_set() and gen == self._gen:
+                    try:
+                        self._q.put(item, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                else:
+                    return
+                with self._lock:
+                    self._next_produce = start + self.unit
+                    self._produced = self._next_produce
+                    self._win["produced"] += self.unit
+        except BaseException as e:  # noqa: BLE001 — surfaced on next pop
+            self._error = e
+
+    def _check_payload(self, payload) -> str | None:
+        """Shape/dtype vs the first unit's template, floats finite, int
+        leaves non-negative (episode indices/labels/token ids are all
+        >= 0 in this repo). Returns a verdict string, None when clean."""
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(payload)
+        sig = [(np.shape(x), np.asarray(x).dtype) for x in leaves]
+        if self._template is None:
+            self._template = sig
+        elif sig != self._template:
+            return f"batch signature changed: {sig} != {self._template}"
+        for x in leaves:
+            a = np.asarray(x)
+            if np.issubdtype(a.dtype, np.floating):
+                if not np.all(np.isfinite(a)):
+                    return "non-finite values in a float leaf"
+            elif np.issubdtype(a.dtype, np.integer):
+                if a.size and int(a.min()) < 0:
+                    return "negative values in an integer leaf"
+        return None
+
+    # --- consumer side ----------------------------------------------------
+
+    def _producer_alive(self) -> bool:
+        """Depth 0 has no producer thread BY DESIGN — it must read as
+        alive or the watchdog mis-diagnoses feed_dead on every
+        synchronous-mode record."""
+        return self.depth == 0 or (
+            self._thread is not None and self._thread.is_alive()
+        )
+
+    def _account_inline(self, dt: float, n: int) -> None:
+        """Depth-0 bookkeeping for one synchronous draw of ``n`` batches
+        taking ``dt`` seconds: at depth 0 the consumer's wait on the feed
+        IS the inline production, so the time accounts as BOTH stall and
+        produce — feed_stall_frac then means "fraction of wall the
+        trainer waited on the feed" at every depth (the serial-vs-
+        pipelined comparison bench.py makes)."""
+        with self._lock:
+            self._consumed += n
+            self._produced = self._consumed
+            self._win["consumed"] += n
+            self._win["produced"] += n
+            self._stall_s += dt
+            self._produce_s += dt
+            self._win["stall_s"] += dt
+            self._win["produce_s"] += dt
+
+    def _tick(self, stalled_s: float) -> None:
+        """Stall telemetry while blocked: a kind="data" record the obs
+        watchdog can turn into a feed_stall event (obs/health.py). Uses the
+        consumed batch count as the step."""
+        if self.logger is None:
+            return
+        with self._lock:
+            self.logger.log(
+                self._consumed, "data",
+                produced=float(self._produced),
+                consumed=float(self._consumed),
+                queue_depth=float(self._q.qsize()),
+                stalled_s=round(stalled_s, 3),
+                producer_alive=float(self._producer_alive()),
+                poisoned=float(self._poisoned),
+            )
+
+    def _pop_item(self) -> _Item:
+        self._ensure_producer()
+        t0 = time.monotonic()
+        next_tick = t0 + self._stall_tick_s
+        while True:
+            if self._error is not None:
+                raise FeedError("feed producer died") from self._error
+            try:
+                item = self._q.get(timeout=0.2)
+                break
+            except queue.Empty:
+                if self._thread is None or not self._thread.is_alive():
+                    if self._error is not None:
+                        raise FeedError(
+                            "feed producer died"
+                        ) from self._error
+                    raise FeedError("feed producer exited without error")
+                now = time.monotonic()
+                if now >= next_tick:
+                    self._tick(now - t0)
+                    next_tick = now + self._stall_tick_s
+        waited = time.monotonic() - t0
+        with self._lock:
+            self._stall_s += waited
+            self._win["stall_s"] += waited
+            # Prune captured states behind this unit: position can never
+            # rewind past the unit currently being consumed.
+            for s in [s for s in self._states if s < item.start]:
+                del self._states[s]
+        if item.poisoned is not None:
+            with self._lock:
+                self._poisoned += 1
+            self._tick(0.0)  # poisoned counter reaches the watchdog
+            raise FeedError(
+                f"poisoned batch refused at index {item.start}: "
+                f"{item.poisoned}"
+            )
+        return item
+
+    def _slice_batch(self, payload, off: int):
+        """One batch out of a fused [S,B,...] unit payload."""
+        sup, qry, lab = payload
+        from induction_network_on_fewrel_tpu.train.feature_cache import (
+            IndexEpisodeBatch,  # deferred: jax-heavy module
+        )
+
+        return IndexEpisodeBatch(sup[off], qry[off], lab[off])
+
+    def _next_single(self):
+        if self.depth == 0:
+            # Synchronous mode still honors the drillable faults so
+            # --feed_fault works at any depth (poison respects indices).
+            start = self._consumed
+            if self.faults.stalls_unit(start):
+                # Wedged-feed drill without a producer thread: block here
+                # emitting stall ticks, exactly what a hung sampler does —
+                # the watchdog trips feed_stall instead of the drill
+                # silently sampling past the fault.
+                t0 = time.monotonic()
+                while True:
+                    time.sleep(self._stall_tick_s)
+                    self._tick(time.monotonic() - t0)
+            t0 = time.monotonic()
+            if self.faults.slow_s > 0:
+                time.sleep(self.faults.slow_s)
+            batch = self.base.sample_batch()
+            dt = time.monotonic() - t0
+            if self.faults.poisons_unit(start, 1):
+                batch = poison_tree(batch)
+            if self._should_validate():
+                verdict = self._check_payload(batch)
+                if verdict is not None:
+                    with self._lock:
+                        self._poisoned += 1
+                    self._tick(0.0)
+                    raise FeedError(
+                        f"poisoned batch refused at index {start}: {verdict}"
+                    )
+            self._account_inline(dt, 1)
+            return batch
+        if self._cur is None or self._cur_off >= self.unit:
+            self._cur = self._pop_item()
+            self._cur_off = 0
+        item, off = self._cur, self._cur_off
+        out = (
+            self._slice_batch(item.payload, off)
+            if self.unit > 1 else item.payload
+        )
+        self._cur_off += 1
+        if self._cur_off >= self.unit:
+            self._cur = None
+        with self._lock:
+            self._consumed += 1
+            self._win["consumed"] += 1
+        return out
+
+    def sample_batch(self):
+        return self._next_single()
+
+    def _sample_fused(self, s: int):
+        """Fused twin (installed only when unit > 1): serves whole produced
+        units on the fast path; assembles across unit boundaries when the
+        consumption pattern left a partial unit behind."""
+        if self.depth == 0:
+            if self.faults.active:
+                # Faults need per-batch indices; take the generic path.
+                batches = [self._next_single() for _ in range(s)]
+                return self._stack_batches(batches)
+            t0 = time.monotonic()
+            out = self.base.sample_fused(s)
+            self._account_inline(time.monotonic() - t0, s)
+            return out
+        if s == self.unit and self._cur is None:
+            item = self._pop_item()
+            with self._lock:
+                self._consumed += s
+                self._win["consumed"] += s
+            return item.payload
+        batches = [self._next_single() for _ in range(s)]
+        return self._stack_batches(batches)
+
+    @staticmethod
+    def _stack_batches(batches):
+        """Re-stack single batches into the fused [S,B,...] layout. Slices
+        of device-put payloads stack ON DEVICE (jnp) — np.stack would pull
+        every leaf back to host and re-upload, inverting the producer-side
+        device-put win for any consumption pattern that leaves a partial
+        unit behind (e.g. a library-built trainer whose init_state draws
+        one batch before the fused loop)."""
+        import jax
+
+        def stack(xs):
+            if isinstance(xs[0], jax.Array):
+                import jax.numpy as jnp
+
+                return jnp.stack(xs)
+            return np.stack([np.asarray(x) for x in xs])
+
+        return tuple(stack([b[f] for b in batches]) for f in range(3))
+
+    def __iter__(self) -> Iterator:
+        while True:
+            yield self.sample_batch()
+
+    # --- cursor -----------------------------------------------------------
+
+    def cursor_state(self) -> PipelineCursor:
+        """The restorable position at the CONSUMED boundary. Prefetched
+        batches sitting in the queue are intentionally not covered — they
+        re-produce on resume."""
+        with self._lock:
+            c = self._consumed
+            if self.depth == 0:
+                state, captured_at = capture_sampler_state(self.base), c
+            else:
+                eligible = [s for s in self._states if s <= c]
+                if not eligible:
+                    raise RuntimeError(
+                        f"no captured sampler state at or before batch {c} "
+                        f"(internal bookkeeping bug)"
+                    )
+                captured_at = max(eligible)
+                state = self._states[captured_at]
+            if state.get("kind") == "replay":
+                # Protocol-less sampler: restore means "fresh sampler +
+                # replay", so the capture point is the stream origin.
+                captured_at = 0
+            return PipelineCursor(
+                consumed=c,
+                captured_at=captured_at,
+                sampler_state=state,
+                layout=dict(self._layout),
+                stream_tag=self.stream_tag,
+            )
+
+    def restore_cursor(self, cursor: PipelineCursor) -> None:
+        """Reposition the stream to ``cursor`` — the resumed sequence of
+        batches is byte-identical to what the uninterrupted run would have
+        consumed next. Validates the layout fingerprint and stream tag
+        first (a mismatch would silently splice two different streams)."""
+        cursor.check_layout(self._layout)
+        if cursor.stream_tag != self.stream_tag:
+            raise ValueError(
+                f"pipeline cursor stream tag {cursor.stream_tag!r} does not "
+                f"match this feed's {self.stream_tag!r} (different --mixture "
+                f"/ sampler wiring); resume with the original configuration"
+            )
+        self._halt_producer()
+        restore_sampler_state(
+            self.base, cursor.sampler_state,
+            skip=cursor.consumed - cursor.captured_at,
+        )
+        with self._lock:
+            self._consumed = cursor.consumed
+            self._produced = cursor.consumed
+            self._next_produce = cursor.consumed
+            self._states = {
+                cursor.consumed: capture_sampler_state(self.base)
+            }
+            self._cur, self._cur_off = None, 0
+        # Producer restarts lazily on the next pop (same generation path
+        # as first use).
+
+    def _halt_producer(self) -> None:
+        self._gen += 1
+        self._stop.set()
+        # Unblock a producer waiting on a full queue.
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        # Drain anything the exiting producer managed to enqueue after the
+        # drain above (put/get race is benign but must not survive).
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        # Cleared AFTER the join (a dying thread writes _error on its way
+        # out): a halt starts a fresh producer generation, and a stale
+        # error from the dead one must not poison it — restore_cursor's
+        # contract is a FULL reposition, so reposition-and-retry after a
+        # transient producer failure is a legitimate caller move.
+        self._error = None
+
+    # --- telemetry --------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Cumulative counters (bench.py reads these)."""
+        with self._lock:
+            return {
+                "produced": self._produced,
+                "consumed": self._consumed,
+                "queue_depth": self._q.qsize(),
+                "stall_s": round(self._stall_s, 6),
+                "produce_s": round(self._produce_s, 6),
+                "poisoned": self._poisoned,
+            }
+
+    def drain_stats(self) -> dict:
+        """Per-window feed telemetry for one kind="data" record: counters
+        since the last drain plus instantaneous queue state. All floats
+        (MetricsLogger coerces anyway)."""
+        now = time.monotonic()
+        with self._lock:
+            win, self._win = self._win, {
+                "stall_s": 0.0, "produce_s": 0.0, "consumed": 0,
+                "produced": 0,
+            }
+            window_s = now - self._win_t0
+            self._win_t0 = now
+            qd = self._q.qsize()
+            return {
+                "produced": float(self._produced),
+                "consumed": float(self._consumed),
+                "queue_depth": float(qd),
+                "episodes_buffered": float(
+                    qd * self.unit * self.batch_size
+                ),
+                "stall_s": round(win["stall_s"], 6),
+                "produce_s": round(win["produce_s"], 6),
+                "window_s": round(window_s, 6),
+                "window_consumed": float(win["consumed"]),
+                "producer_alive": float(self._producer_alive()),
+                "poisoned": float(self._poisoned),
+            }
+
+    def close(self) -> None:
+        self._halt_producer()
+        if hasattr(self.base, "close"):
+            self.base.close()

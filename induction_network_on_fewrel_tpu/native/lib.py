@@ -50,9 +50,16 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.inf_sampler_sample_indices.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, i32p, i32p, i32p
     ]
+    lib.inf_sampler_get_next.restype = ctypes.c_int64
+    lib.inf_sampler_get_next.argtypes = [ctypes.c_void_p]
+    lib.inf_sampler_set_next.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.inf_pipeline_create.restype = ctypes.c_void_p
     lib.inf_pipeline_create.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32
+    ]
+    lib.inf_pipeline_create_at.restype = ctypes.c_void_p
+    lib.inf_pipeline_create_at.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64
     ]
     lib.inf_pipeline_next.argtypes = batch_args
     lib.inf_pipeline_destroy.argtypes = [ctypes.c_void_p]

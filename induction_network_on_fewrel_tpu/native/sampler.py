@@ -93,6 +93,11 @@ class NativeEpisodeSampler:
             ctypes.c_uint64(seed),
         )
         self._pipeline = None
+        self._prefetch, self._num_threads = prefetch, num_threads
+        # Stream position mirror (datapipe cursor): the C++ pipeline pulls
+        # by its own sequence counter, so the Python wrapper tracks the
+        # consumed position uniformly for both modes.
+        self._pos = 0
         if prefetch > 0:
             if num_threads < 1:
                 raise ValueError(
@@ -134,11 +139,30 @@ class NativeEpisodeSampler:
             self._lib.inf_pipeline_next(self._pipeline, *args)
         else:
             self._lib.inf_sampler_sample(self._handle, *args)
+        self._pos += 1
         return EpisodeBatch(*sup, *qry, label)
 
     def __iter__(self):
         while True:
             yield self.sample_batch()
+
+    # --- datapipe cursor protocol (batch i is pure in (seed, i)) ---------
+
+    def feed_state(self) -> dict:
+        return {"kind": "native", "next": int(self._pos)}
+
+    def restore_feed_state(self, state: dict) -> None:
+        pos = int(state["next"])
+        self._pos = pos
+        self._lib.inf_sampler_set_next(self._handle, pos)
+        if self._pipeline is not None:
+            # The C++ prefetch pipeline pulls by its own sequence counter;
+            # recreate it at the restored position (queued-ahead batches
+            # are simply re-produced — never skipped).
+            self._lib.inf_pipeline_destroy(self._pipeline)
+            self._pipeline = self._lib.inf_pipeline_create_at(
+                self._handle, self._prefetch, self._num_threads, pos
+            )
 
     def close(self) -> None:
         if getattr(self, "_pipeline", None) is not None:
@@ -201,6 +225,17 @@ class NativeIndexSampler:
             _ptr(lab, ctypes.c_int32),
         )
         return sup, qry, lab
+
+    # --- datapipe cursor protocol ----------------------------------------
+
+    def feed_state(self) -> dict:
+        return {
+            "kind": "native",
+            "next": int(self._lib.inf_sampler_get_next(self._handle)),
+        }
+
+    def restore_feed_state(self, state: dict) -> None:
+        self._lib.inf_sampler_set_next(self._handle, int(state["next"]))
 
     def sample_batch(self):
         from induction_network_on_fewrel_tpu.train.feature_cache import (

@@ -12,6 +12,11 @@ today:
   every query routed identically, i.e. the class vectors collapsed.
 * **Serving queue stall** — queue depth > 0 while the served counter stops
   advancing for longer than ``queue_stall_s`` (a wedged batcher worker).
+* **Feed stall / poison** — the training input pipeline (datapipe/) starving
+  its consumer: stall ticks (``kind="data"``) whose produced counter stops
+  advancing for longer than ``queue_stall_s`` while the trainer waits, a
+  dead producer thread, or a poisoned batch — the feed-side generalization
+  of the serving queue-stall detector.
 
 Wiring: the watchdog is installed as a ``MetricsLogger`` hook, so every
 record every execution path emits (train/val/serve) flows through
@@ -98,6 +103,12 @@ class HealthWatchdog:
         self._last_served: int | None = None
         self._stall_since: float | None = None
         self._stall_reported = False
+        # Feed-stall state (training input pipeline): produced counter and
+        # first time it was seen unchanged while the consumer waited.
+        self._last_fed: int | None = None
+        self._feed_stall_since: float | None = None
+        self._feed_stall_reported = False
+        self._poisoned_seen = 0
 
     # --- event plumbing --------------------------------------------------
 
@@ -148,6 +159,15 @@ class HealthWatchdog:
                 self.observe_queue(
                     int(rec.get("queue_depth", 0)),
                     int(rec.get("served", 0)),
+                )
+            if kind == "data":
+                self.observe_feed(
+                    produced=int(rec.get("produced", 0)),
+                    consumed=int(rec.get("consumed", 0)),
+                    producer_alive=bool(rec.get("producer_alive", 1.0)),
+                    poisoned=int(rec.get("poisoned", 0)),
+                    step=step,
+                    waiting="stalled_s" in rec,
                 )
 
     def _check_finite(self, step: int, rec: dict) -> None:
@@ -209,6 +229,70 @@ class HealthWatchdog:
                 return
         self._latched.discard("throughput")  # healthy window re-arms
         self._eps.append(eps)
+
+    def observe_feed(
+        self,
+        produced: int,
+        consumed: int,
+        producer_alive: bool = True,
+        poisoned: int = 0,
+        step: int = 0,
+        waiting: bool = False,
+        now: float | None = None,
+    ) -> None:
+        """Training-feed stall detection — the datapipe generalization of
+        observe_queue: same ``queue_stall_s`` budget, but the watched
+        counter is the PRODUCER's (a starving consumer with a stuck
+        producer is the wedge; an idle feed with a full queue is healthy).
+        Fed from ``kind="data"`` records; callable directly with an
+        injectable clock for tests."""
+        with self._lock:
+            now = time.monotonic() if now is None else now
+            if poisoned > self._poisoned_seen:
+                self._poisoned_seen = poisoned
+                self._emit(HealthEvent(
+                    event="feed_poisoned", severity=CRITICAL, step=step,
+                    message=(
+                        f"input pipeline refused a poisoned batch "
+                        f"(total {poisoned})"
+                    ),
+                    data={"poisoned": poisoned, "consumed": consumed},
+                ))
+            if not producer_alive:
+                if "feed_dead" not in self._latched:
+                    self._latched.add("feed_dead")
+                    self._emit(HealthEvent(
+                        event="feed_dead", severity=CRITICAL, step=step,
+                        message=(
+                            f"input-pipeline producer thread is dead at "
+                            f"consumed={consumed}"
+                        ),
+                        data={"produced": produced, "consumed": consumed},
+                    ))
+                return
+            self._latched.discard("feed_dead")
+            advancing = self._last_fed is None or produced > self._last_fed
+            if advancing or not waiting:
+                self._feed_stall_since = None
+                self._feed_stall_reported = False
+            elif self._feed_stall_since is None:
+                self._feed_stall_since = now
+            elif (
+                not self._feed_stall_reported
+                and now - self._feed_stall_since >= self.queue_stall_s
+            ):
+                self._feed_stall_reported = True
+                self._emit(HealthEvent(
+                    event="feed_stall", severity=CRITICAL, step=step,
+                    message=(
+                        f"input pipeline stalled: produced counter stuck "
+                        f"at {produced} for "
+                        f"{now - self._feed_stall_since:.1f}s with the "
+                        f"trainer waiting"
+                    ),
+                    data={"produced": produced, "consumed": consumed},
+                ))
+            self._last_fed = produced
 
     def observe_queue(
         self, queue_depth: int, served: int, now: float | None = None

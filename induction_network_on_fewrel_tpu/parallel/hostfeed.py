@@ -252,6 +252,27 @@ class PerHostSampler:
         while True:
             yield self.sample_batch()
 
+    # --- datapipe cursor protocol: position lives in the LOCAL sampler
+    # (assembly is stateless); the cursor's layout fingerprint — not this
+    # state — is what guards against cross-layout resumes.
+
+    def feed_state(self) -> dict:
+        from induction_network_on_fewrel_tpu.datapipe.cursor import (
+            capture_sampler_state,
+        )
+
+        return {
+            "kind": "perhost",
+            "local": capture_sampler_state(self.local),
+        }
+
+    def restore_feed_state(self, state: dict) -> None:
+        from induction_network_on_fewrel_tpu.datapipe.cursor import (
+            restore_sampler_state,
+        )
+
+        restore_sampler_state(self.local, state["local"])
+
     def close(self):
         if hasattr(self.local, "close"):
             self.local.close()

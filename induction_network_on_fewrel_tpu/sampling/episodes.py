@@ -159,6 +159,23 @@ class EpisodeSampler:
         while True:
             yield self.sample_batch()
 
+    # --- datapipe cursor protocol (datapipe/cursor.py): the generator's
+    # bit-generator state IS the stream position — exact O(1) resume.
+
+    def feed_state(self) -> dict:
+        from induction_network_on_fewrel_tpu.datapipe.cursor import (
+            rng_feed_state,
+        )
+
+        return rng_feed_state(self.rng)
+
+    def restore_feed_state(self, state: dict) -> None:
+        from induction_network_on_fewrel_tpu.datapipe.cursor import (
+            restore_rng_feed_state,
+        )
+
+        restore_rng_feed_state(self.rng, state)
+
 
 class InstanceBatch(NamedTuple):
     """A batch of M unlabeled instances (domain-adaptation side channel)."""

@@ -512,6 +512,12 @@ class CheckpointManager:
         # the python-side _enqueued record, never the managers.
         self._q: queue.Queue = queue.Queue(maxsize=2)
         self._save_error: Exception | None = None
+        # Cursor sidecars of best saves are never pruned (see
+        # _write_cursor); seed the ledger with the steps the best manager
+        # already holds so a RESUMED process keeps protecting them too.
+        # Safe here: __init__ runs before the saver thread touches the
+        # managers (same rule as the _enqueued seeding below).
+        self._protected_cursor_steps: set[int] = set(self.mngr.all_steps())
         self._enqueued = {
             "best": self.mngr.latest_step(),
             "ring": max(
@@ -634,19 +640,26 @@ class CheckpointManager:
             finally:
                 self._q.task_done()
 
-    def save(self, step: int, state: Any, val_accuracy: float) -> None:
+    def save(self, step: int, state: Any, val_accuracy: float,
+             cursor: dict | None = None) -> None:
         """ASYNC: snapshots the state on-device and returns; the d2h copy
         and the orbax write happen on the saver thread, off the training
         critical path. Durability points: restore_*() and wait() block
-        first; the trainer calls wait() at run end."""
+        first; the trainer calls wait() at run end.
+
+        ``cursor``: the input-pipeline position (datapipe/cursor.py
+        PipelineCursor.to_dict()) saved as a sidecar next to the step —
+        resume then replays the exact episode stream."""
         self._check_save_error()
         self._check_staging_safety()
         self._enqueued["best"] = step
+        self._write_cursor(step, cursor, protect=True)
         self._q.put(
             ("best", step, _device_snapshot(state), float(val_accuracy))
         )
 
-    def save_latest(self, step: int, state: Any, force: bool = False) -> None:
+    def save_latest(self, step: int, state: Any, force: bool = False,
+                    cursor: dict | None = None) -> None:
         """Recovery save (single rotating slot), async like save(). Skipped
         when either side already holds (or was just enqueued with) this
         step — restore_latest consults both, so a best-save at the same
@@ -679,8 +692,78 @@ class CheckpointManager:
             return None
         kind, payload, info = self._ring_item(step, state)
         self._enqueued["ring"] = step
+        self._write_cursor(step, cursor)
         self._q.put((kind, step, payload, None))
         return info
+
+    # --- input-pipeline cursor sidecars (datapipe/cursor.py) --------------
+    #
+    # One small JSON per saved step, living at the managers' root (the
+    # staging root when staging is on — the saver thread's drain then
+    # copies it to the real dir together with its step). Sidecars are
+    # written SYNCHRONOUSLY at enqueue time: they are a few hundred bytes,
+    # and writing before the orbax save means a crash can leave an orphan
+    # cursor (harmless) but never a restorable step without its cursor.
+
+    _CURSOR_KEEP = 16  # newest RING sidecars retained (restores read one)
+
+    def _cursor_name(self, step: int) -> str:
+        return f"cursor_{step:08d}.json"
+
+    def _write_cursor(self, step: int, cursor: dict | None,
+                      protect: bool = False) -> None:
+        """``protect`` marks the step's sidecar as belonging to a BEST
+        save: those must survive pruning — on a long plateau the ring
+        writes >_CURSOR_KEEP newer sidecars, and a divergence-guard purge
+        followed by --resume restores exactly that old best step; losing
+        its cursor would silently splice a seed-restarted stream (review
+        finding, this round). The protected set is a python-side ledger
+        (the orbax managers belong to the saver thread)."""
+        if cursor is None:
+            return
+        import json
+
+        if protect:
+            self._protected_cursor_steps.add(int(step))
+        root = self._stage_root or self.dir
+        path = root / self._cursor_name(step)
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(json.dumps(cursor, sort_keys=True))
+        tmp.replace(path)  # atomic: a torn sidecar must never parse
+        # Prune in BOTH roots: the drain mirrors new sidecars into the
+        # real dir but (by design) never deletes non-step files there, so
+        # a staging-only prune would leave the run dir accumulating one
+        # sidecar per boundary forever (review finding, this round).
+        for r in (self._stage_root, self.dir):
+            if r is None:
+                continue
+            prunable = [
+                p for p in sorted(r.glob("cursor_*.json"))
+                if self._cursor_step_of(p) not in self._protected_cursor_steps
+            ]
+            for old in prunable[: -self._CURSOR_KEEP]:
+                old.unlink(missing_ok=True)
+
+    @staticmethod
+    def _cursor_step_of(path: Path) -> int | None:
+        try:
+            return int(path.stem.split("_")[1])
+        except (IndexError, ValueError):
+            return None
+
+    def load_cursor(self, step: int) -> dict | None:
+        """The cursor sidecar for ``step``, or None (pre-datapipe dirs,
+        pruned sidecars). Staging is checked first — it is never behind."""
+        import json
+
+        self.wait()  # a sidecar mid-drain counts once durable
+        for root in (self._stage_root, self.dir):
+            if root is None:
+                continue
+            path = root / self._cursor_name(step)
+            if path.exists():
+                return json.loads(path.read_text())
+        return None
 
     def _ring_item(self, step: int, state: Any) -> tuple[str, Any, dict]:
         """Build the ring-save queue item: ("ring", full snapshot) for
@@ -783,6 +866,16 @@ class CheckpointManager:
         self.ring_base_mngr.wait_until_finished()
         self.ring_delta_mngr.wait_until_finished()
         self._check_save_error()
+        # Quiescent point (saver idle, managers readable from this
+        # thread — same rule as restore_*): re-derive cursor protection
+        # from the best steps orbax actually RETAINS, so sidecars of
+        # rotated-out best saves become prunable instead of accumulating
+        # one per improvement for run lifetime (review finding). The
+        # just-enqueued best stays protected via the ledger either way.
+        retained = set(self.mngr.all_steps())
+        if self._enqueued["best"] is not None:
+            retained.add(int(self._enqueued["best"]))
+        self._protected_cursor_steps = retained
 
     def _check_save_error(self) -> None:
         if self._save_error is not None:
@@ -996,6 +1089,16 @@ class CheckpointManager:
             for s in m.all_steps():
                 if s > best_step:
                     m.delete(s)
+        # Cursor sidecars newer than the restored best describe a stream
+        # position the purged slots held — a later --resume must not
+        # splice the post-collapse stream onto the restored state.
+        for root in (self._stage_root, self.dir):
+            if root is None:
+                continue
+            for p in root.glob("cursor_*.json"):
+                s = self._cursor_step_of(p)
+                if s is not None and s > best_step:
+                    p.unlink(missing_ok=True)
         if self._delta_base is not None and self._delta_base["step"] > best_step:
             self._delta_base = None
 

@@ -238,6 +238,22 @@ class FeatureEpisodeSampler:
         while True:
             yield self.sample_batch()
 
+    # --- datapipe cursor protocol (exact RNG-state resume) ---------------
+
+    def feed_state(self) -> dict:
+        from induction_network_on_fewrel_tpu.datapipe.cursor import (
+            rng_feed_state,
+        )
+
+        return rng_feed_state(self.rng)
+
+    def restore_feed_state(self, state: dict) -> None:
+        from induction_network_on_fewrel_tpu.datapipe.cursor import (
+            restore_rng_feed_state,
+        )
+
+        restore_rng_feed_state(self.rng, state)
+
 
 # --- cached steps: device-resident table, index-only transfer --------------
 #

@@ -74,6 +74,17 @@ class FewShotTrainer:
         self.train_sampler = train_sampler
         self.val_sampler = val_sampler
         self.logger = logger or MetricsLogger(quiet=True)
+        # datapipe/ producer pipeline (duck-typed on the cursor surface):
+        # when the train sampler is a PipelineFeed, the trainer (a) gives
+        # it the logger so stall ticks reach the watchdog, (b) logs
+        # kind="data" feed telemetry once per metric window, and (c) saves
+        # the pipeline cursor into every checkpoint so --resume replays
+        # the exact episode stream.
+        self._feed = (
+            train_sampler if hasattr(train_sampler, "cursor_state") else None
+        )
+        if self._feed is not None and getattr(self._feed, "logger", None) is None:
+            self._feed.logger = self.logger
         # Telemetry spine (obs/): the watchdog and flight recorder observe
         # every record through MetricsLogger hooks — one emission point,
         # no per-site instrumentation. Both optional and host-side only.
@@ -341,6 +352,11 @@ class FewShotTrainer:
                 self.logger.log(
                     step, "train", episodes_per_s=eps_per_s, **scalars,
                 )
+                if self._feed is not None:
+                    # Per-window feed telemetry (ISSUE 4 satellite): queue
+                    # depth, episodes buffered, stall/produce seconds —
+                    # obs_report's input-pipeline section reads this.
+                    self.logger.log(step, "data", **self._feed.drain_stats())
                 t0 = time.monotonic()
                 last_logged = step
             if (
@@ -399,15 +415,18 @@ class FewShotTrainer:
                     self.best_val = val_acc
                 if self.ckpt is not None:
                     with span("train/checkpoint"):
+                        cursor = self._feed_cursor()
                         if improved:
-                            self.ckpt.save(step, state, val_acc)
+                            self.ckpt.save(step, state, val_acc,
+                                           cursor=cursor)
                         # Recovery ring: saved at EVERY val boundary so a
                         # crash on a plateau resumes from here, not the
                         # stale best. In delta mode (ckpt_delta) the save
                         # is base + touched-row deltas; the kind="ckpt"
                         # record tracks the byte diet per boundary.
                         self._log_ring_save(
-                            step, self.ckpt.save_latest(step, state)
+                            step, self.ckpt.save_latest(step, state,
+                                                        cursor=cursor)
                         )
                 # Divergence guard (SURVEY.md §5.3): the MSE-sigmoid loss
                 # can fall into its saturation dead zone on long overfit
@@ -467,12 +486,38 @@ class FewShotTrainer:
                 # earlier step), and stamping it with the diverged run's
                 # step number would corrupt resume ordering.
                 self._log_ring_save(
-                    step, self.ckpt.save_latest(step, state, force=True)
+                    step, self.ckpt.save_latest(
+                        step, state, force=True, cursor=self._feed_cursor()
+                    )
                 )
             # Saves are async (off the val-boundary critical path); the
             # run's contract is that returning implies durable checkpoints.
             self.ckpt.wait()
         return state
+
+    def _feed_cursor(self) -> dict | None:
+        """The input-pipeline cursor to ride in a checkpoint (None when the
+        train sampler is not a PipelineFeed — pre-datapipe wiring)."""
+        if self._feed is None:
+            return None
+        return self._feed.cursor_state().to_dict()
+
+    def restore_feed_cursor(self, mngr, step: int) -> bool:
+        """Reposition the feed from the cursor saved with ``step`` in
+        ``mngr`` (a CheckpointManager). Returns whether a cursor was found;
+        layout/stream mismatches raise (datapipe/cursor.py). Called by the
+        CLI on --resume after the state restore."""
+        if self._feed is None:
+            return False
+        cur = mngr.load_cursor(step)
+        if cur is None:
+            return False
+        from induction_network_on_fewrel_tpu.datapipe.cursor import (
+            PipelineCursor,
+        )
+
+        self._feed.restore_cursor(PipelineCursor.from_dict(cur))
+        return True
 
     def _log_ring_save(self, step: int, info: dict | None) -> None:
         """kind="ckpt" telemetry for ring saves (train/checkpoint.py

@@ -37,6 +37,12 @@ KNOWN_KINDS = frozenset({
     # event="ring_save", mode=full|base|delta, bytes=payload bytes, and
     # rows=changed rows for deltas — the delta-ring byte diet, observable.
     "ckpt",
+    # Input-pipeline telemetry (ISSUE 4, datapipe/): per-window feed
+    # records from the producer pipeline — produced/consumed counters,
+    # queue depth, episodes buffered, stall/produce seconds — plus stall
+    # ticks emitted while the consumer is blocked (the obs watchdog's
+    # feed-stall detector reads these).
+    "data",
 })
 
 

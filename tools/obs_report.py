@@ -224,6 +224,48 @@ def ckpt_summary(recs: list[dict]) -> dict | None:
     return out
 
 
+def data_summary(recs: list[dict]) -> dict | None:
+    """Input-pipeline section (ISSUE 4, kind="data"): the headline is the
+    feed stall fraction — consumer seconds blocked on the queue over the
+    windows' wall seconds (acceptance: < 2% of step time with prefetch
+    enabled). Window records carry window_s; stall ticks (emitted while
+    blocked) carry stalled_s and no window_s — they contribute context
+    (producer liveness, poison counts) but not the fraction's denominator."""
+    data = [r for r in recs if r.get("kind") == "data"]
+    if not data:
+        return None
+    windows = [
+        r for r in data
+        if isinstance(r.get("window_s"), (int, float)) and r["window_s"] > 0
+    ]
+    out = {"records": len(data), "windows": len(windows)}
+    if windows:
+        stall = sum(float(r.get("stall_s", 0.0)) for r in windows)
+        wall = sum(float(r["window_s"]) for r in windows)
+        produce = sum(float(r.get("produce_s", 0.0)) for r in windows)
+        out["stall_s_total"] = round(stall, 4)
+        out["produce_s_total"] = round(produce, 4)
+        out["feed_stall_frac"] = round(stall / wall, 6) if wall > 0 else None
+        depths = [
+            float(r["queue_depth"]) for r in windows
+            if isinstance(r.get("queue_depth"), (int, float))
+        ]
+        if depths:
+            out["queue_depth_mean"] = round(sum(depths) / len(depths), 3)
+    last = data[-1]
+    for k in ("produced", "consumed", "queue_depth", "episodes_buffered",
+              "producer_alive", "poisoned"):
+        if isinstance(last.get(k), (int, float)):
+            out[k] = last[k]
+    stalls = [r for r in data if "stalled_s" in r]
+    if stalls:
+        out["stall_ticks"] = len(stalls)
+        out["longest_stall_s"] = round(
+            max(float(r.get("stalled_s", 0.0)) for r in stalls), 3
+        )
+    return out
+
+
 def health_summary(recs: list[dict]) -> dict:
     events = [r for r in recs if r.get("kind") == "health"]
     by_event: dict[str, int] = {}
@@ -352,8 +394,9 @@ def render(report: dict) -> str:
     lines.append(f"schema: {n} records, {len(errors)} errors")
     for e in errors[:10]:
         lines.append(f"  ! {e}")
-    for section in ("train", "mfu", "eval", "serve", "ckpt", "health",
-                    "flight_recorder", "overhead"):
+    for section in ("train", "mfu", "eval", "serve", "ckpt",
+                    "input_pipeline", "health", "flight_recorder",
+                    "overhead"):
         body = report.get(section)
         if body is None:
             continue
@@ -401,6 +444,7 @@ def main(argv=None) -> int:
         "eval": eval_summary(recs),
         "serve": serve_summary(recs),
         "ckpt": ckpt_summary(recs),
+        "input_pipeline": data_summary(recs),
         "health": health_summary(recs),
         "flight_recorder": recorder_summary(run_dir),
     }
