@@ -314,8 +314,24 @@ def _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips) -> i
     )
     # Analytic HBM bytes/step at THIS config (shared formulas with the
     # roofline ledger, utils/roofline.py) — the byte-diet number the
-    # round-6 tentpole targets, stamped into every bench artifact.
-    from induction_network_on_fewrel_tpu.utils.roofline import step_bytes
+    # round-6 tentpole targets, stamped into every bench artifact. Round 7
+    # adds the collective terms at the flagship dp=8 mesh (the comms
+    # ledger's shape — the bench itself may run single-chip, so the comms
+    # row is the projection for the sharded deployment, same arithmetic
+    # tools/comms_ledger.py asserts the compiled HLO against).
+    from induction_network_on_fewrel_tpu.utils.roofline import (
+        comms_payload_bytes,
+        comms_wire_bytes,
+        step_bytes,
+    )
+
+    comms_cfg = cfg.replace(dp=8)
+    # Real corpus bound for the demb [U, D] term when the lazy table is in
+    # hand (round-7 review finding: the synthetic default understates real
+    # corpora several-fold).
+    comms_u = (
+        int(table_np["uids"].shape[0]) if "uids" in table_np else None
+    )
 
     print(json.dumps({
         "metric": (
@@ -330,8 +346,22 @@ def _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips) -> i
         "mfu": mfu,
         "device_busy": device_busy,
         "flops_per_episode": flops["per_episode"],
-        "step_bytes": step_bytes(cfg),
-        "step_bytes_no_remat": step_bytes(cfg, remat_attn=False),
+        "step_bytes": step_bytes(cfg, corpus_rows=comms_u),
+        "step_bytes_no_remat": step_bytes(
+            cfg, remat_attn=False, corpus_rows=comms_u
+        ),
+        # Lazy legs only: the comms arithmetic models the compact demb of
+        # the lazy/token-cache path — a shared-embed leg's sharded compile
+        # schedules full-table-shaped demb collectives it doesn't carry
+        # (null = "unmodeled here, see the ledger", never a wrong number).
+        "comms_bytes_per_step": (
+            int(comms_payload_bytes(comms_cfg, corpus_rows=comms_u))
+            if cfg.embed_optimizer == "lazy" else None
+        ),
+        "comms_wire_bytes_per_step": (
+            int(comms_wire_bytes(comms_cfg, corpus_rows=comms_u))
+            if cfg.embed_optimizer == "lazy" else None
+        ),
         "allin_over_windowed": allin_over_windowed,
         "ring_save_bytes": ring_bytes,
         "datapipe": datapipe_leg,

@@ -262,6 +262,15 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
              "program; tracing/lowering still runs). 'auto' = "
              "~/.cache/induction_tpu_xla; 'off' disables.",
     )
+    p.add_argument(
+        "--compact_demb", default="auto", choices=["auto", "on", "off"],
+        help="dp-sharded embedding gradient: keep the demb segment-sum "
+             "local per shard and all-reduce only the compact [U, D] "
+             "touched-row gradient, instead of GSPMD replicating the "
+             "[L, M, word_dim] embedding cotangent (26 MB/step/device at "
+             "the flagship shape — COMMS_r07). 'off' restores the dense "
+             "behavior for A/Bs; identical params/checkpoints either way",
+    )
     p.add_argument("--dp", type=int, default=0, help="data-parallel mesh axis (0 = all devices)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis")
     p.add_argument("--sp", type=int, default=1,
@@ -406,6 +415,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         grad_probe_every=getattr(args, "grad_probe_every", 0),
         nan_inject_step=getattr(args, "nan_inject_step", 0),
         zero_opt=getattr(args, "zero_opt", False),
+        compact_demb=getattr(args, "compact_demb", "auto"),
         device=args.device, compute_dtype=compute, seed=args.seed,
         dp=args.dp, tp=args.tp, sp=args.sp, pp=args.pp, ep=args.ep,
         pp_microbatches=args.pp_microbatches,
@@ -841,11 +851,23 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
                 ),
                 GlobalBatchAssembler(mesh, cfg.batch_size),
             )
+    demb_impl = None
+    if use_mesh:
+        from induction_network_on_fewrel_tpu.parallel.sharding import (
+            demb_impl_for,
+        )
+
+        demb_impl = demb_impl_for(cfg, mesh)
     model = build_model(
         cfg, glove_init=vocab.vectors if vocab is not None else None,
         attn_impl=attn_impl, pipeline_impl=pipeline_impl,
+        demb_impl=demb_impl,
     )
     cache_test_eval = None  # set by either index-cache path below
+    # Real corpus distinct-row count (token-cache lazy build_table fills
+    # it from the train split's uids) — the kind="comms" demb term's
+    # honest bound; stays empty on paths that don't know it.
+    corpus_rows: dict = {}
     if cfg.feature_cache:
         # Frozen-encoder feature cache (train/feature_cache.py): encode both
         # splits once with the frozen backbone, then swap the token samplers
@@ -987,6 +1009,11 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
                 )
 
                 tab, uids = augment_token_table(tab)
+                # First call is the TRAIN split: its corpus row count is
+                # the real demb [U, D] bound the kind="comms" telemetry
+                # should use instead of the synthetic-fixture default
+                # (utils/roofline.touched_rows).
+                corpus_rows.setdefault("train", int(uids.shape[0]))
                 tab = {**tab, "uids": uids}
             return {k: _tput(v) for k, v in tab.items()}, sizes
 
@@ -1211,6 +1238,8 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         profile_dir=getattr(args, "profile", None),
         profile_steps=getattr(args, "profile_steps", 10),
         watchdog=watchdog, recorder=recorder,
+        comms_u_rows=corpus_rows.get("train"),
+        comms_compact=demb_impl is not None,
     )
     if getattr(args, "debug_nans", False):
         from induction_network_on_fewrel_tpu.utils.debug import checkify_step

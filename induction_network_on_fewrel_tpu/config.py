@@ -217,6 +217,17 @@ class ExperimentConfig:
     # fine-tune pressing v5e HBM at big batch). Exact same update
     # trajectory; GSPMD inserts the collectives.
     zero_opt: bool = False
+    # Compact demb collective (parallel/sharding.make_compact_demb_lookup,
+    # ISSUE 5): on dp-sharded runs, keep the embedding lookup AND its
+    # backward segment-sum local to each shard and all-reduce only the
+    # compact [U, D] touched-row gradient — without it GSPMD replicates
+    # the [L, M, word_dim] f32 embedding cotangent across dp (26.1
+    # MB/step/device at the flagship shape, 77% of the wire payload;
+    # COMMS_r06 -> COMMS_r07). "auto"/"on" = active whenever the mesh has
+    # dp > 1 (numerics-neutral restructure, any backend); "off" = the
+    # pre-round-7 dense behavior, kept for the chip A/B. Not an
+    # architecture field: params/checkpoints are identical either way.
+    compact_demb: str = "auto"
     dp: int = 1               # data-parallel mesh axis (episodes sharded)
     tp: int = 1               # tensor-parallel mesh axis (NTN slices / hidden)
     sp: int = 1               # sequence-parallel mesh axis (ring attention)

@@ -26,12 +26,17 @@ def build_model(
     glove_init: np.ndarray | None = None,
     attn_impl=None,
     pipeline_impl=None,
+    demb_impl=None,
 ) -> InductionNetwork:
     """``attn_impl``: override the transformer encoder's attention — e.g.
     ``parallel.ring.make_ring_attention(mesh)`` for sp-sharded long-context
     runs. ``pipeline_impl``: executor for the layer-stacked transformer —
     ``parallel.pipeline.make_gpipe(mesh)`` for pp-sharded runs (implies the
-    stacked parameter layout). Both ignored by the other encoders."""
+    stacked parameter layout). Both ignored by the other encoders.
+    ``demb_impl``: mesh-aware word-table lookup for dp-sharded runs
+    (``parallel.sharding.demb_impl_for``) — shard-local demb backward with
+    a compact [U, D] all-reduce instead of the replicated [L, M, word_dim]
+    embedding cotangent; ignored by the BERT paths (their own table)."""
     dtype = _DTYPES[cfg.compute_dtype]
     if cfg.moe_experts > 0 and cfg.encoder != "transformer":
         raise ValueError(
@@ -119,6 +124,7 @@ def build_model(
             glove_init=glove_init,
             compute_dtype=dtype,
             freeze_word_table=cfg.embed_optimizer == "frozen",
+            demb_impl=demb_impl,
         )
         if cfg.encoder == "cnn":
             encoder = CNNEncoder(hidden_size=cfg.hidden_size, compute_dtype=dtype)

@@ -18,6 +18,8 @@ count the one-hot matmul loses (see ops/segsum.py).
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -52,6 +54,16 @@ class Embedding(nn.Module):
     # clip reduces over symbolic zeros (XLA folds them away) — a frozen
     # table costs nothing per step, instead of a full-table grad pass.
     freeze_word_table: bool = False
+    # Mesh-aware word lookup (parallel/sharding.make_compact_demb_lookup,
+    # threaded by build_model on multi-device dp runs; None elsewhere):
+    # ``(table, ids, batch_dim) -> vecs``. Same forward values as the plain
+    # gather; its custom-VJP backward keeps the demb segment-sum LOCAL to
+    # each dp shard and all-reduces only the compact [U, D] touched-row
+    # gradient — instead of GSPMD replicating the [L, M, word_dim]
+    # cotangent (26 MB/step/device at the flagship shape, COMMS_r06).
+    # Like attn_impl on the transformer: an execution strategy, not an
+    # architecture field — params and checkpoints are unchanged.
+    demb_impl: Any = None
 
     @nn.compact
     def __call__(
@@ -84,7 +96,8 @@ class Embedding(nn.Module):
             init = lambda *_: jnp.asarray(self.glove_init, jnp.float32)
         else:
             init = nn.initializers.normal(0.1)
-        if self.has_variable("lazy_embed", "rows"):
+        lazy_rows = self.has_variable("lazy_embed", "rows")
+        if lazy_rows:
             # embed_optimizer=lazy (train/lazy_embed.py): the step body
             # passes the batch's CAUGHT-UP unique rows [U, word_dim] via
             # this collection, with word ids already remapped into them —
@@ -104,10 +117,29 @@ class Embedding(nn.Module):
         pos2_table = self.param(
             "pos2_embedding", nn.initializers.normal(0.1), (2 * self.max_length, self.pos_dim)
         )
-        # Matmul-gradient lookups where the table is small enough to win
-        # (see module docstring); frozen tables have no backward at all, so
+        # On dp-sharded runs the mesh-aware demb_impl takes the word
+        # lookup whenever the table's row gradient is COMPACT: always for
+        # the lazy rows leaf (any size — real corpora run 40-60k rows and
+        # its shard-local backward picks matmul-grad vs scatter by the
+        # segsum crossover internally; gating the whole path behind
+        # MATMUL_GRAD_MAX_ROWS would deactivate the comms fix exactly
+        # there — round-7 review finding), and for dense tables only
+        # below the crossover. A LARGE dense shared table must NOT take
+        # it: psumming the full [vocab, D] gradient (~80 MB at 400k rows)
+        # costs more wire than the replicated-cotangent gather it would
+        # replace — shared-mode 400k runs keep the native path (ledger-
+        # only territory; round-7 review finding, pass 3). Off-mesh:
+        # matmul-gradient lookups where the table is small enough to win
+        # (module docstring); frozen tables have no backward at all, so
         # the plain gather is strictly simpler there.
-        if word_table.shape[0] <= MATMUL_GRAD_MAX_ROWS and not self.freeze_word_table:
+        small = word_table.shape[0] <= MATMUL_GRAD_MAX_ROWS
+        if self.freeze_word_table:
+            word_vecs = word_table[word]
+        elif self.demb_impl is not None and (lazy_rows or small):
+            word_vecs = self.demb_impl(
+                word_table, word, 1 if time_major else 0
+            )
+        elif small:
             word_vecs = lookup_matmul_grad(word_table, word)
         else:
             word_vecs = word_table[word]

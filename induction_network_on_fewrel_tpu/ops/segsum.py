@@ -50,16 +50,32 @@ _MIN_CHUNK = 1024
 
 def _segment_sum_matmul(cot: jnp.ndarray, ids: jnp.ndarray, num_rows: int) -> jnp.ndarray:
     """sum_t one_hot(ids[t]) * cot[t] -> [num_rows, D], f32, via chunked matmul."""
-    cot2 = cot.reshape(-1, cot.shape[-1])
-    flat = ids.reshape(-1)
-    T, D = cot2.shape
-    chunk = max(_MIN_CHUNK, _ONEHOT_BYTES // (num_rows * cot2.dtype.itemsize))
+    T, D = ids.size, cot.shape[-1]
+    chunk = max(_MIN_CHUNK, _ONEHOT_BYTES // (num_rows * cot.dtype.itemsize))
     if chunk >= T:
-        onehot = jax.nn.one_hot(flat, num_rows, dtype=cot2.dtype)  # [T, U]
+        # Single-chunk: contract over the token dims AS THEY ARE — no
+        # [.., T_sharded, ..] -> [T] flatten. The flatten merged a
+        # batch-SHARDED token dim with its unsharded neighbors, a layout
+        # GSPMD cannot represent, so the partitioner replicated both ids
+        # and the cotangent first — at the flagship shape that was the
+        # 26 MB [L, M, word_dim] f32 all-gather per step per device
+        # (COMMS_r06). Contracting the original dims keeps both operands
+        # batch-sharded; the partial products meet in ONE compact
+        # [num_rows, D] all-reduce.
+        onehot = jax.nn.one_hot(ids, num_rows, dtype=cot.dtype)  # ids.shape+[U]
+        nd = ids.ndim
         return jax.lax.dot_general(
-            onehot, cot2, (((0,), (0,)), ((), ())),  # onehotᵀ @ cot
+            onehot, cot,
+            ((tuple(range(nd)), tuple(range(nd))), ((), ())),  # onehotᵀ @ cot
             preferred_element_type=jnp.float32,
         )
+    # Chunked path (one-hot would blow the budget): flattening is fine on a
+    # single device / inside shard_map (where everything is local); sharded
+    # GSPMD callers with big tables route through the compact-demb wrapper
+    # (parallel/sharding.make_compact_demb_lookup), which runs THIS code
+    # per-shard under shard_map and psums the [num_rows, D] result.
+    cot2 = cot.reshape(-1, cot.shape[-1])
+    flat = ids.reshape(-1)
     pad = (-T) % chunk
     if pad:
         cot2 = jnp.pad(cot2, ((0, pad), (0, 0)))

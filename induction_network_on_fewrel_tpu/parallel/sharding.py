@@ -186,6 +186,126 @@ def batch_shardings(mesh: Mesh, tree: Any):
     return jax.tree.map(assign, tree)
 
 
+# --- compact demb (ZeRO-style sparse embedding gradient) -------------------
+
+
+def make_compact_demb_lookup(mesh: Mesh):
+    """Mesh-aware word-table lookup whose BACKWARD keeps demb local.
+
+    The embedding gather's matmul-gradient backward (ops/segsum.py) is
+    local arithmetic per token, but its chunked spelling flattens the
+    token dims — merging the dp-sharded episode dim into its neighbors,
+    which GSPMD cannot shard, so the partitioner replicated the cotangent
+    and ids first: at the flagship shape a 26.1 MB/step/device
+    ``[L, M, word_dim]`` f32 all-gather, 77% of the wire payload
+    (COMMS_r06; the ZeRO sparse-gradient observation of Rajbhandari et
+    al., 2020 applied to the induction encoder's word table). This
+    wrapper is the explicit spelling of the fix:
+
+    * forward: the plain gather, with a ``with_sharding_constraint``
+      pinning the gathered ``[.., D]`` activation batch-sharded over dp —
+      the ``[L, M, word_dim]`` activation stays sharded END TO END and
+      XLA can never materialize the replicated form;
+    * backward (custom VJP): ``shard_map`` over the mesh — each dp shard
+      runs the chunked segment-sum on its LOCAL tokens only (the flatten
+      is harmless per shard), then ONE ``psum`` reduces the compact
+      ``[U, D]`` touched-row gradient across dp. The psum is wrapped in
+      ``jax.named_scope("demb/compact_allreduce")`` so the collective's
+      HLO metadata names this op — tools/comms_ledger.py attributes it.
+
+    Returns ``lookup(table, ids, batch_dim)`` (batch_dim = which ids dim
+    carries the dp-sharded episode rows: 1 for time-major [L, M], else
+    0), or None when the mesh has no dp axis > 1 (nothing to keep local).
+    Numerics: forward values are IDENTICAL to the plain gather; the
+    gradient sums the same per-token terms grouped per shard first —
+    float-associativity differences only (parity at 1e-5 in
+    tests/test_comms.py, same band as the dense path).
+    """
+    if "dp" not in mesh.axis_names or mesh.shape["dp"] <= 1:
+        return None
+    import jax.numpy as jnp
+    import numpy as np
+
+    from induction_network_on_fewrel_tpu.ops.segsum import (
+        MATMUL_GRAD_MAX_ROWS,
+        _segment_sum_matmul,
+    )
+
+    def _local_segment_sum(cot_l, ids_l, num_rows):
+        """Per-shard demb: the one-hot-matmul form below the scatter-vs-
+        matmul crossover (ops/segsum.py), the native scatter-add above it
+        (real corpora run 40-60k rows; at that size the O(T*U*D) one-hot
+        matmul loses — the crossover is about backward FLOPs and is
+        orthogonal to KEEPING the sum local, which is this wrapper's
+        job). Both are exact sums of the same per-token terms."""
+        if num_rows <= MATMUL_GRAD_MAX_ROWS:
+            return _segment_sum_matmul(cot_l, ids_l, num_rows)
+        cot2 = cot_l.reshape(-1, cot_l.shape[-1]).astype(jnp.float32)
+        return jnp.zeros(
+            (num_rows, cot_l.shape[-1]), jnp.float32
+        ).at[ids_l.reshape(-1)].add(cot2)
+
+    def lookup(table, ids, batch_dim: int):
+        num_rows, table_dtype = table.shape[0], table.dtype
+
+        def batch_spec(ndim: int) -> P:
+            axes: list = [None] * ndim
+            axes[batch_dim] = "dp"
+            return P(*axes)
+
+        @jax.custom_vjp
+        def gather(tbl, idx):
+            return tbl[idx]
+
+        def gather_fwd(tbl, idx):
+            out = jax.lax.with_sharding_constraint(
+                tbl[idx], NamedSharding(mesh, batch_spec(idx.ndim + 1))
+            )
+            return out, idx
+
+        def gather_bwd(idx, cot):
+            def local_segsum(cot_l, ids_l):
+                # Per-shard tokens only -> partial [U, D]; ONE compact
+                # all-reduce instead of replicating [.., T, D] cotangent.
+                part = _local_segment_sum(cot_l, ids_l, num_rows)
+                return jax.lax.psum(part, "dp")
+
+            with jax.named_scope("demb/compact_allreduce"):
+                dtable = compat_shard_map(
+                    local_segsum, mesh=mesh,
+                    in_specs=(batch_spec(cot.ndim), batch_spec(idx.ndim)),
+                    out_specs=P(), check_vma=False,
+                )(cot, idx)
+            return (
+                dtable.astype(table_dtype),
+                np.zeros(idx.shape, jax.dtypes.float0),
+            )
+
+        gather.defvjp(gather_fwd, gather_bwd)
+        return gather(table, ids)
+
+    return lookup
+
+
+def demb_impl_for(cfg: ExperimentConfig, mesh: Mesh | None):
+    """Resolve cfg.compact_demb against the mesh: the compact-demb lookup
+    when it applies (mesh with dp > 1, knob not "off"), else None (the
+    embedding keeps its mesh-free lookups). "auto" and "on" are the same
+    resolution — the path is numerics-neutral graph restructuring, valid
+    on any backend including the 8-virtual-device CPU mesh."""
+    if mesh is None or getattr(cfg, "compact_demb", "auto") == "off":
+        return None
+    if "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        # Sequence parallelism shards the TOKEN axis of ids/cotangent; the
+        # compact path's shard_map declares only the dp sharding and would
+        # force an sp->replicated reshard of the cotangent — while the
+        # reshape-free single-chunk segment-sum (ops/segsum.py) contracts
+        # BOTH sharded dims natively at the shapes the sp legs run. Keep
+        # the generic path there.
+        return None
+    return make_compact_demb_lookup(mesh)
+
+
 # --- GSPMD steps -----------------------------------------------------------
 
 

@@ -68,6 +68,8 @@ class FewShotTrainer:
         profile_steps: int = 10,
         watchdog=None,
         recorder=None,
+        comms_u_rows=None,
+        comms_compact=None,
     ):
         self.model = model
         self.cfg = cfg
@@ -133,6 +135,74 @@ class FewShotTrainer:
         # Mesh the injected steps were built for (None = single device);
         # restored checkpoints must be re-placed onto it (see reshard_state).
         self.mesh = mesh
+        # Per-window collective-traffic telemetry (ISSUE 5, kind="comms"):
+        # the ledger arithmetic's bytes/step/device, computed once — the
+        # SAME formulas tools/comms_ledger.py asserts the compiled HLO
+        # against (utils/roofline.comms_components), so the stream and the
+        # ledger can never disagree. dp read from the MESH (cfg.dp=0 means
+        # "all devices" at the CLI and must not gate the record off);
+        # BiLSTM runs only — the roofline formulas model the flagship
+        # BiLSTM step, and emitting them for another encoder would be a
+        # confident wrong number. --compact_demb off runs get the DENSE
+        # arithmetic (the replicated-cotangent all-gather), so the A/B
+        # leg's headline is honest. ``comms_u_rows``: the real corpus
+        # distinct-row count when the caller knows it (cli threads the
+        # token-cache lazy uids length); default = the synthetic bound.
+        self._comms_record = None
+        mesh_dp = mesh.shape.get("dp", 1) if mesh is not None else 1
+        # Pure-dp meshes only: ZeRO-1 swaps the grad all-reduce for
+        # reduce-scatter + param all-gather (~2x payload — the ledger
+        # measured zero1 at 2.7x dp8) and tp/pp/ep/sp add collectives the
+        # formulas don't carry; emitting the dp-only number there would
+        # be the confident-wrong-number failure this gate exists to
+        # prevent. Those legs stay ledger-only.
+        pure_dp = (
+            mesh is not None
+            and mesh_dp > 1
+            and not cfg.zero_opt
+            and all(
+                size == 1
+                for ax, size in mesh.shape.items() if ax != "dp"
+            )
+        )
+        # ...and TOKEN-CACHE lazy only: the demb terms model the compact
+        # [U_corpus, D] row gradient of the cached-corpus leaf. A
+        # shared-embed run's real demb collective is full-table-shaped,
+        # and a NON-cached lazy run's leaf is batch-bounded at
+        # U = min(T, V) (train/lazy_embed.py) — ~M*L rows at flagship-
+        # like shapes, several-fold more than the corpus bound the
+        # formulas would report. Both stay ledger-only (round-7 review
+        # finding, pass 5).
+        if (pure_dp and cfg.encoder == "bilstm"
+                and cfg.embed_optimizer == "lazy" and cfg.token_cache):
+            from induction_network_on_fewrel_tpu.utils.roofline import (
+                comms_payload_bytes,
+                comms_wire_bytes,
+            )
+
+            # ``comms_compact``: whether a compact demb_impl was ACTUALLY
+            # resolved for this run's steps (cli passes it) — re-deriving
+            # from the knob alone would report compact arithmetic on a
+            # run whose resolver declined (round-7 review finding).
+            compact = (
+                comms_compact if comms_compact is not None
+                else cfg.compact_demb != "off"
+            )
+            wire = comms_wire_bytes(
+                cfg, dp=mesh_dp, compact=compact, corpus_rows=comms_u_rows
+            )
+            self._comms_record = {
+                "payload_bytes_per_step": float(comms_payload_bytes(
+                    cfg, dp=mesh_dp, compact=compact,
+                    corpus_rows=comms_u_rows,
+                )),
+                "wire_bytes_per_step": float(wire),
+                "wire_mb_per_step": round(wire / 1e6, 3),
+                "dp": float(mesh_dp),
+                "compact_demb": float(compact),
+            }
+            if comms_u_rows:
+                self._comms_record["demb_u_rows"] = float(comms_u_rows)
         # FewRel 2.0 adversarial adaptation: AdvPieces bundle, or None. When
         # set, training runs the DANN step (few-shot loss + domain game)
         # instead of the plain step; eval/checkpointing are unchanged (the
@@ -357,6 +427,11 @@ class FewShotTrainer:
                     # depth, episodes buffered, stall/produce seconds —
                     # obs_report's input-pipeline section reads this.
                     self.logger.log(step, "data", **self._feed.drain_stats())
+                if self._comms_record is not None:
+                    # Per-window collective bytes (ISSUE 5 satellite) from
+                    # the shared ledger arithmetic — obs_report's comms
+                    # section headline is wire_mb_per_step.
+                    self.logger.log(step, "comms", **self._comms_record)
                 t0 = time.monotonic()
                 last_logged = step
             if (

@@ -43,6 +43,13 @@ KNOWN_KINDS = frozenset({
     # ticks emitted while the consumer is blocked (the obs watchdog's
     # feed-stall detector reads these).
     "data",
+    # Collective-traffic telemetry (ISSUE 5): one record per metric window
+    # on mesh-sharded runs with the ledger arithmetic's per-step bytes
+    # (utils/roofline.comms_components — the SAME formulas the compiled-
+    # HLO ledger is asserted against): payload_bytes_per_step,
+    # wire_bytes_per_step, wire_mb_per_step, dp. obs_report's comms
+    # section reads these (headline: wire_mb_per_step).
+    "comms",
 })
 
 

@@ -1,0 +1,361 @@
+"""Comms-diet tests (ISSUE 5): compact demb parity + the HLO regression gate.
+
+The round-6 flagship comms compile found GSPMD replicating the
+[L, M, word_dim] f32 embedding cotangent across dp — 26.1 MB/step/device,
+77% of the wire payload (COMMS_r06). Round 7 restructured the demb
+backward (ops/segsum.py reshape-free contraction;
+parallel/sharding.make_compact_demb_lookup shard-local segment-sum + one
+compact [U, D] all-reduce). Pinned here:
+
+* PARITY: the compact path computes the same training trajectory as the
+  dense path on the 8-virtual-device CPU mesh — losses tight, params at
+  1e-5 (float associativity only: per-shard partial sums reduce in a
+  different order) — for dp8, dp4×tp2, and dp8+ZeRO-1.
+* REGRESSION GATE (tier-1, fast leg): the compiled production step has NO
+  collective moving >= L·M·word_dim·4 bytes (the dense all-gather's
+  size), every collective is attributed, and the compact demb all-reduce
+  is present and named. A future sharding change cannot silently
+  reintroduce the dense all-gather.
+* RESUME: delta ring checkpoints (--ckpt_delta) are unaffected by the new
+  demb representation — base+delta save/restore mid-run continues the
+  sharded compact-demb trajectory bitwise.
+* The ledger's attribution parser itself (tools/comms_ledger.py
+  collective_rows/attributed_rows/check_attribution): labels, direction,
+  aggregation, and the unattributed-collective warning that exists so a
+  payload term can never sit anonymous for two rounds again.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import tools.comms_ledger as cl
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    GloveTokenizer,
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.native.sampler import make_index_sampler
+from induction_network_on_fewrel_tpu.parallel import make_mesh
+from induction_network_on_fewrel_tpu.parallel.sharding import demb_impl_for
+from induction_network_on_fewrel_tpu.train.lazy_embed import augment_token_table
+from induction_network_on_fewrel_tpu.train.steps import init_state
+from induction_network_on_fewrel_tpu.train.token_cache import (
+    make_token_cached_train_step,
+    tokenize_dataset,
+)
+
+L = 12
+CFG = ExperimentConfig(
+    encoder="bilstm", train_n=3, n=3, k=2, q=2, batch_size=8, max_length=L,
+    vocab_size=302, compute_dtype="float32", lstm_hidden=16, att_dim=8,
+    induction_dim=16, ntn_slices=8, token_cache=True, steps_per_call=1,
+    embed_optimizer="lazy", lr=1e-3, weight_decay=0.0, ckpt_stage="off",
+)
+STEPS = 3
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    vocab = make_synthetic_glove(vocab_size=CFG.vocab_size - 2)
+    # Token vocab << table vocab: the touched-row set stays far under the
+    # half-table rebase threshold, so ring saves in the resume test take
+    # the DELTA path (same bound test_ckpt_delta.py uses).
+    ds = make_synthetic_fewrel(
+        num_relations=6, instances_per_relation=CFG.k + CFG.q + 2,
+        vocab_size=35,
+    )
+    tok = GloveTokenizer(vocab, max_length=CFG.max_length)
+    table_np, sizes = tokenize_dataset(ds, tok)
+    table_np, uids = augment_token_table(table_np)
+    table_np = {**table_np, "uids": uids}
+    idx = make_index_sampler(
+        sizes, CFG.n, CFG.k, CFG.q, batch_size=CFG.batch_size, seed=0,
+        backend="python",
+    )
+    batches = []
+    for _ in range(STEPS + 2):
+        si, qi, lab = idx.sample_fused(1)
+        batches.append((si[0], qi[0], lab[0]))
+    return vocab, table_np, batches
+
+
+def _make_step(cfg, mesh, corpus, compact: bool):
+    """(step, table_on_mesh, state0) for the token-cache lazy cached path —
+    the production (flagship) configuration at test shapes. ``compact``
+    toggles the demb path the way cfg.compact_demb does."""
+    vocab, table_np, _ = corpus
+    use = cfg if compact else cfg.replace(compact_demb="off")
+    model = build_model(
+        use, glove_init=vocab.vectors, demb_impl=demb_impl_for(use, mesh)
+    )
+    table = {
+        k: jax.device_put(v, NamedSharding(mesh, P()))
+        for k, v in table_np.items()
+    }
+    si, qi, _ = corpus[2][0]
+    sup = {k: v[si] for k, v in table_np.items() if k != "uids"}
+    qry = {k: v[qi] for k, v in table_np.items() if k != "uids"}
+    state = init_state(model, use, sup, qry)
+    step = make_token_cached_train_step(model, use, mesh, state)
+    return step, table, state
+
+
+def _run(step, table, state, batches):
+    losses = []
+    for si, qi, lab in batches:
+        state, metrics = step(state, table, si, qi, lab)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    return state, losses
+
+
+def _assert_parity(mesh, corpus, cfg=CFG):
+    _, _, batches = corpus
+    step_c, table_c, state_c = _make_step(cfg, mesh, corpus, compact=True)
+    step_d, table_d, state_d = _make_step(cfg, mesh, corpus, compact=False)
+    sc, lc = _run(step_c, table_c, state_c, batches[:STEPS])
+    sd, ld = _run(step_d, table_d, state_d, batches[:STEPS])
+    # Forward values are identical (same gather); the loss differs only
+    # through the previous steps' grads, whose per-shard partial sums
+    # reduce in a different order — same band as the dense GSPMD paths.
+    np.testing.assert_allclose(lc, ld, rtol=0, atol=1e-5)
+    for (pa, va), (_, vb) in zip(
+        jax.tree_util.tree_flatten_with_path(jax.device_get(sc.params))[0],
+        jax.tree_util.tree_flatten_with_path(jax.device_get(sd.params))[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(va), np.asarray(vb), atol=1e-5, rtol=1e-5,
+            err_msg=f"param {jax.tree_util.keystr(pa)} diverged",
+        )
+
+
+def test_compact_demb_parity_dp8(corpus):
+    _assert_parity(make_mesh(dp=8), corpus)
+
+
+@pytest.mark.slow
+def test_compact_demb_parity_dp4_tp2(corpus):
+    _assert_parity(make_mesh(dp=4, tp=2), corpus)
+
+
+@pytest.mark.slow
+def test_compact_demb_parity_zero1(corpus):
+    _assert_parity(make_mesh(dp=8), corpus, cfg=CFG.replace(zero_opt=True))
+
+
+def test_hlo_gate_no_dense_embedding_collective(corpus):
+    """The tier-1 regression gate (ISSUE 5 satellite): compile the
+    production cached-lazy step on the dp8 mesh and assert the compiled
+    HLO (a) moves no single collective >= L·M·word_dim·4 bytes — the
+    dense [L, M, word_dim] all-gather's size at THIS shape, the exact
+    payload that hid at tiny shapes for two rounds — (b) attributes every
+    collective, and (c) carries the named compact-demb all-reduce."""
+    mesh = make_mesh(dp=8)
+    _, _, batches = corpus
+    step, table, state = _make_step(CFG, mesh, corpus, compact=True)
+    si, qi, lab = batches[0]
+    txt = step.lower(state, table, si, qi, lab).compile().as_text()
+
+    rows = cl.collective_rows(txt)
+    assert rows, "no collectives found — the dp8 compile should have some"
+    gate = cl.dense_allgather_bytes(CFG)
+    biggest = max(r["bytes"] for r in rows)
+    assert biggest < gate, (
+        f"a collective moves {biggest} B >= the dense embedding "
+        f"all-gather size {gate} B — the replicated [L, M, word_dim] "
+        "gather is back (see parallel/sharding.make_compact_demb_lookup)"
+    )
+    anon = [r for r in rows if r["source"] is None]
+    assert not anon, f"unattributed collectives on the production path: {anon}"
+    assert any(
+        "demb/compact_allreduce" in (r["source"] or "") for r in rows
+    ), "the compact demb all-reduce is missing from the compiled step"
+    # And the step actually runs on the mesh.
+    state2, metrics = step(state, table, si, qi, lab)
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
+def test_compact_demb_scatter_branch_parity_and_gate(corpus, monkeypatch):
+    """Above the matmul-grad crossover the compact backward switches to a
+    shard-local SCATTER-ADD (real corpora run 40-60k rows — gating the
+    whole compact path behind MATMUL_GRAD_MAX_ROWS would deactivate the
+    comms fix exactly where it matters; round-7 review finding). Force
+    the crossover down so the branch runs at test shapes: parity vs the
+    dense twin AND the no-dense-collective gate must hold."""
+    import induction_network_on_fewrel_tpu.ops.segsum as segsum
+
+    monkeypatch.setattr(segsum, "MATMUL_GRAD_MAX_ROWS", 8)
+    mesh = make_mesh(dp=8)
+    _, _, batches = corpus
+    step_c, table_c, state_c = _make_step(CFG, mesh, corpus, compact=True)
+    si, qi, lab = batches[0]
+    txt = step_c.lower(state_c, table_c, si, qi, lab).compile().as_text()
+    rows = cl.collective_rows(txt)
+    assert max(r["bytes"] for r in rows) < cl.dense_allgather_bytes(CFG)
+    assert any(
+        "demb/compact_allreduce" in (r["source"] or "") for r in rows
+    )
+
+    step_d, table_d, state_d = _make_step(CFG, mesh, corpus, compact=False)
+    sc, lc = _run(step_c, table_c, state_c, batches[:2])
+    sd, ld = _run(step_d, table_d, state_d, batches[:2])
+    np.testing.assert_allclose(lc, ld, rtol=0, atol=1e-5)
+
+
+def test_large_dense_shared_table_keeps_native_path(corpus, monkeypatch):
+    """A LARGE dense SHARED word table must NOT take the compact path:
+    psumming its full [vocab, D] gradient (~80 MB at 400k rows) would
+    out-cost the gather it replaces (round-7 review finding, pass 3).
+    The crossover is forced down so the 302-row shared table counts as
+    'large'; the spy proves demb_impl is never invoked during tracing —
+    while a lazy run at the same patched crossover DOES take it (the
+    lazy rows leaf is compact at any size)."""
+    import induction_network_on_fewrel_tpu.models.embedding as emb_mod
+
+    monkeypatch.setattr(emb_mod, "MATMUL_GRAD_MAX_ROWS", 8)
+    mesh = make_mesh(dp=8)
+    vocab, table_np, batches = corpus
+    cfg = CFG.replace(embed_optimizer="shared")
+    calls = []
+    real = demb_impl_for(cfg, mesh)
+
+    def spy(table, ids, batch_dim):
+        calls.append(tuple(table.shape))
+        return real(table, ids, batch_dim)
+
+    model = build_model(cfg, glove_init=vocab.vectors, demb_impl=spy)
+    tab_np = {k: v for k, v in table_np.items() if k not in ("uids", "winv")}
+    table = {
+        k: jax.device_put(v, NamedSharding(mesh, P()))
+        for k, v in tab_np.items()
+    }
+    si, qi, lab = batches[0]
+    sup = {k: v[si] for k, v in tab_np.items()}
+    qry = {k: v[qi] for k, v in tab_np.items()}
+    state = init_state(model, cfg, sup, qry)
+    step = make_token_cached_train_step(model, cfg, mesh, state)
+    step.lower(state, table, si, qi, lab)  # traces fwd+bwd
+    assert calls == [], (
+        f"compact demb engaged on a large dense shared table: {calls}"
+    )
+
+    # Control: the lazy twin at the same patched crossover takes the spy
+    # (rows leaf is compact regardless of the crossover).
+    calls_lazy = []
+
+    def spy_lazy(table, ids, batch_dim):
+        calls_lazy.append(tuple(table.shape))
+        return real(table, ids, batch_dim)
+
+    model_l = build_model(CFG, glove_init=vocab.vectors, demb_impl=spy_lazy)
+    table_l = {
+        k: jax.device_put(v, NamedSharding(mesh, P()))
+        for k, v in table_np.items()
+    }
+    sup_l = {k: v[si] for k, v in table_np.items() if k != "uids"}
+    qry_l = {k: v[qi] for k, v in table_np.items() if k != "uids"}
+    state_l = init_state(model_l, CFG, sup_l, qry_l)
+    step_l = make_token_cached_train_step(model_l, CFG, mesh, state_l)
+    step_l.lower(state_l, table_l, si, qi, lab)
+    assert calls_lazy, "lazy rows leaf should take the compact path"
+
+
+def test_delta_ring_resume_with_compact_demb(corpus, tmp_path):
+    """Delta ring checkpoints are unaffected by the compact demb
+    representation: base -> delta -> restore into a fresh manager ->
+    continue == the uninterrupted sharded run, bitwise (the demb change
+    touches only the gradient computation, never the state tree)."""
+    from induction_network_on_fewrel_tpu.parallel.sharding import shard_state
+    from induction_network_on_fewrel_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+
+    mesh = make_mesh(dp=8)
+    _, _, batches = corpus
+    step, table, state = _make_step(CFG, mesh, corpus, compact=True)
+    template = jax.device_get(state)
+
+    mgr = CheckpointManager(tmp_path, CFG)
+    state, _ = step(state, table, *batches[0])
+    assert mgr.save_latest(1, state, force=True)["mode"] == "base"
+    mgr.wait()
+    state, _ = step(state, table, *batches[1])
+    info = mgr.save_latest(2, state, force=True)
+    assert info["mode"] == "delta"
+    mgr.close()
+
+    mgr2 = CheckpointManager(tmp_path, CFG)
+    restored, step_no = mgr2.restore_latest(template)
+    mgr2.close()
+    assert step_no == 2
+    restored = shard_state(restored, mesh)
+
+    cont_live, m_live = step(state, table, *batches[2])
+    cont_rest, m_rest = step(restored, table, *batches[2])
+    assert float(jax.device_get(m_live["loss"])) == float(
+        jax.device_get(m_rest["loss"])
+    )
+    for (pa, va), (_, vb) in zip(
+        jax.tree_util.tree_flatten_with_path(jax.device_get(cont_live))[0],
+        jax.tree_util.tree_flatten_with_path(jax.device_get(cont_rest))[0],
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(va), np.asarray(vb),
+            err_msg=f"leaf {jax.tree_util.keystr(pa)} diverged after resume",
+        )
+
+
+# --- attribution parser units (no compiles) --------------------------------
+
+_HLO_SNIPPET = """\
+HloModule jit_step
+ENTRY %main {
+  %ag = f32[16,96,50]{2,0,1} all-gather(f32[16,12,50]{2,0,1} %x), channel_id=16, dimensions={1}, metadata={op_name="jit(step)/jit(main)/while/body/transpose(jvp(Net))/encoder/embedding/reshape" source_file="a.py"}
+  %ar = f32[237,50]{1,0} all-reduce(f32[237,50]{1,0} %y), channel_id=1, to_apply=%add, metadata={op_name="jit(step)/jit(main)/transpose(jvp(Net))/demb/compact_allreduce/psum" source_file="b.py"}
+  %anon = f32[64]{0} all-reduce(f32[64]{0} %z), channel_id=2, to_apply=%add
+  %ars = f32[8]{0} all-reduce-start(f32[8]{0} %w), channel_id=3, to_apply=%add, metadata={op_name="jit(step)/loss/reduce_sum"}
+  %ard = f32[8]{0} all-reduce-done(f32[8]{0} %ars)
+}
+"""
+
+
+def test_collective_rows_attribution():
+    rows = cl.collective_rows(_HLO_SNIPPET)
+    by_op = {(r["op"], r["bytes"]): r for r in rows}
+    # Direction + meaningful tail; scaffolding (while/body, jit, jvp,
+    # transpose) stripped.
+    ag = by_op[("all-gather", 16 * 96 * 50 * 4)]
+    assert ag["source"] == "bwd:encoder/embedding/reshape"
+    ar = by_op[("all-reduce", 237 * 50 * 4)]
+    assert ar["source"] == "bwd:demb/compact_allreduce/psum"
+    # Async pair: -start carries the shape and is counted once; -done
+    # is skipped.
+    assert ("all-reduce", 32) in by_op
+    assert by_op[("all-reduce", 32)]["source"] == "fwd:loss/reduce_sum"
+    # Anonymous op -> source None (NOT dropped: bytes still counted).
+    assert by_op[("all-reduce", 256)]["source"] is None
+    assert len(rows) == 4
+
+
+def test_attributed_rows_aggregation_and_strict_warning(capsys):
+    rows = cl.collective_rows(_HLO_SNIPPET)
+    agg = cl.attributed_rows(rows)
+    assert agg[0]["bytes"] >= agg[-1]["bytes"]  # largest first
+    anon_bytes = cl.check_attribution("unit", rows)
+    assert anon_bytes == 256
+    err = capsys.readouterr().err
+    assert "unattributed" in err and "306 KiB" in err
+    # A fully-attributed leg stays silent.
+    clean = [r for r in rows if r["source"] is not None]
+    assert cl.check_attribution("unit2", clean) == 0
+    assert capsys.readouterr().err == ""
+
+
+def test_collective_bytes_matches_rows():
+    per_op = cl.collective_bytes(_HLO_SNIPPET)
+    assert per_op["all-gather"]["bytes"] == 16 * 96 * 50 * 4
+    assert per_op["all-reduce"]["count"] == 3

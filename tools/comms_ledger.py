@@ -1,6 +1,6 @@
 #!/usr/bin/env python3
 """Per-step collective-communication ledger from compiled HLO (round-5
-VERDICT item 8).
+VERDICT item 8; round-7 attribution + compact-demb regression gate).
 
 For each parallelism leg the dryrun exercises (dp, dp+tp, sp/ring, ep/MoE,
 pp/GPipe, ZeRO-1, and the production token-cache fused path), jit-compile
@@ -11,13 +11,30 @@ reduce-scatter, collective-permute, all-to-all). The result is
 bytes/step/device of ICI traffic as the COMPILER actually scheduled it —
 arithmetic, not design claims ("scales over ICI").
 
+Round-7 lesson baked in: every collective row is ATTRIBUTED to the op
+that produced it, parsed from the HLO ``metadata={op_name=...}`` jax
+records for every traced op (``jax.named_scope``/module paths — the same
+vocabulary the obs spans bridge into XPlane profiles). The round-5 miss
+this answers: the 26.1 MB/step/device flagship ``[L, M, word_dim]``
+embedding all-gather sat in the tiny-shape leg for two rounds as an
+anonymous 306 KiB row nobody could name, so nobody scaled it. Collectives
+with NO attribution are now a loud warning and a nonzero exit under
+``--strict`` — a payload term can never go uncounted again.
+
+The flagship leg additionally enforces the compact-demb regression gate:
+no single collective may move >= L*M*word_dim*4 bytes (the dense
+embedding all-gather's size) — the sharding-safe demb path
+(parallel/sharding.make_compact_demb_lookup) all-reduces only the compact
+[U, D] touched-row gradient. tests/test_comms.py runs the same gate at
+tiny shapes in tier-1.
+
 Bytes are per-device per-step at the dryrun's tiny shapes; the ledger also
 re-derives the dominant term analytically (gradient allreduce ~= 2x param
 bytes for ring allreduce) so BASELINE.md can project to flagship shapes
 and v4-8 scale. Run:
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-        python tools/comms_ledger.py [--json out.json]
+        python tools/comms_ledger.py [--json out.json] [--strict]
 """
 
 from __future__ import annotations
@@ -58,11 +75,41 @@ def _shape_bytes(shape_str: str) -> int:
     return total
 
 
-def collective_bytes(hlo_text: str) -> dict[str, dict[str, int]]:
-    """HLO text -> {collective op kind: {count, bytes}} from op OUTPUT
-    shapes (ring all-reduce moves ~2x this on the wire; the ledger reports
-    payload bytes and lets the projection apply the algorithm factor)."""
-    out: dict[str, dict[str, int]] = {}
+_OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
+
+# op_name path components that are trace scaffolding, not provenance.
+_SCAFFOLD = frozenset({"while", "body", "cond", "checkpoint", "remat"})
+
+
+def _attr_label(op_name: str) -> str:
+    """jax HLO op_name -> compact source label: direction (fwd/bwd) +
+    the meaningful tail of the module/named_scope path.
+
+    ``jit(multi_step)/jit(main)/while/body/transpose(jvp(InductionNetwork))
+    /encoder/.../embedding/reshape`` -> ``bwd:.../embedding/reshape``.
+    Explicit ``jax.named_scope`` names (e.g. the compact-demb psum's
+    ``demb/compact_allreduce``) ride the same path and survive into the
+    label — the bridge between obs span vocabulary and HLO metadata."""
+    parts = [p for p in op_name.split("/") if p and not p.startswith("jit(")]
+    bwd = any(p.startswith("transpose(") for p in parts)
+    core = [
+        p for p in parts
+        if p not in _SCAFFOLD
+        and not p.startswith("transpose(")
+        and not p.startswith("jvp(")
+    ]
+    tail = "/".join(core[-3:]) if core else op_name
+    return f"{'bwd' if bwd else 'fwd'}:{tail}"
+
+
+def collective_rows(hlo_text: str) -> list[dict]:
+    """HLO text -> one row per collective op: ``{op, bytes, source}`` from
+    op OUTPUT shapes (ring all-reduce moves ~2x this on the wire; the
+    ledger reports payload bytes and lets the projection apply the
+    algorithm factor). ``source`` is the attribution label parsed from the
+    op's metadata, or None when the compiled op carries no op_name — an
+    unattributed payload term (see check_attribution)."""
+    rows: list[dict] = []
     for line in hlo_text.splitlines():
         line = line.strip()
         # Skip fusion/computation headers; match `<shape> <op>(`  e.g.
@@ -78,10 +125,64 @@ def collective_bytes(hlo_text: str) -> dict[str, dict[str, int]]:
         shape_str, op, suffix = m.groups()
         if op not in _COLLECTIVES or suffix == "-done":
             continue
-        entry = out.setdefault(op, {"count": 0, "bytes": 0})
+        nm = _OP_NAME_RE.search(line)
+        rows.append({
+            "op": op,
+            "bytes": _shape_bytes(shape_str),
+            "source": _attr_label(nm.group(1)) if nm and nm.group(1) else None,
+        })
+    return rows
+
+
+def per_op_from_rows(rows: list[dict]) -> dict[str, dict[str, int]]:
+    """collective_rows -> {collective op kind: {count, bytes}} — the ONE
+    aggregation both collective_bytes and main() use."""
+    out: dict[str, dict[str, int]] = {}
+    for row in rows:
+        entry = out.setdefault(row["op"], {"count": 0, "bytes": 0})
         entry["count"] += 1
-        entry["bytes"] += _shape_bytes(shape_str)
+        entry["bytes"] += row["bytes"]
     return out
+
+
+def collective_bytes(hlo_text: str) -> dict[str, dict[str, int]]:
+    """HLO text -> {collective op kind: {count, bytes}} (see
+    collective_rows for the per-op attributed form)."""
+    return per_op_from_rows(collective_rows(hlo_text))
+
+
+def attributed_rows(rows: list[dict]) -> list[dict]:
+    """Aggregate collective_rows by (op, source) -> [{op, source, count,
+    bytes}], largest payload first. Unattributed rows aggregate under
+    source=None so they stay visible, never silently merged."""
+    agg: dict[tuple, dict] = {}
+    for r in rows:
+        key = (r["op"], r["source"])
+        e = agg.setdefault(
+            key, {"op": r["op"], "source": r["source"], "count": 0, "bytes": 0}
+        )
+        e["count"] += 1
+        e["bytes"] += r["bytes"]
+    return sorted(agg.values(), key=lambda e: -e["bytes"])
+
+
+def check_attribution(name: str, rows: list[dict]) -> int:
+    """Count unattributed collective bytes; print a LOUD warning when any
+    exist (the round-5 failure mode: the 306 KiB anonymous all-gather that
+    became 26 MB at the flagship shape). Returns the unattributed byte
+    count — main() turns it into a nonzero exit under --strict."""
+    anon = [r for r in rows if r["source"] is None]
+    anon_bytes = sum(r["bytes"] for r in anon)
+    if anon:
+        print(
+            f"WARNING [{name}]: {len(anon)} unattributed collective(s), "
+            f"{anon_bytes} B/step/device with no op_name metadata — every "
+            "payload term must be nameable (round-5 lesson: the anonymous "
+            "306 KiB all-gather was the 26 MB flagship term). Inspect the "
+            "compiled HLO; add a jax.named_scope at the producing op.",
+            file=sys.stderr,
+        )
+    return anon_bytes
 
 
 def _tiny(**kw):
@@ -103,12 +204,15 @@ def _legs():
     import __graft_entry__ as ge
     from induction_network_on_fewrel_tpu.parallel import make_mesh
     from induction_network_on_fewrel_tpu.parallel.sharding import (
+        demb_impl_for,
         make_sharded_train_step,
     )
     from induction_network_on_fewrel_tpu.train.steps import init_state
 
     def plain(cfg, mesh):
-        model, params, sup, qry, label = ge._build(cfg)
+        model, params, sup, qry, label = ge._build(
+            cfg, demb_impl=demb_impl_for(cfg, mesh)
+        )
         state = init_state(model, cfg, sup, qry)
         step = make_sharded_train_step(model, cfg, mesh, state)
         return step, (state, sup, qry, label)
@@ -153,7 +257,9 @@ def _legs():
 
         gp = make_gpipe(mesh, microbatches=cfg.pp_microbatches,
                         batch_axis="dp" if mesh.shape["dp"] > 1 else None)
-        model, params, sup, qry, label = ge._build(cfg, pipeline_impl=gp)
+        model, params, sup, qry, label = ge._build(
+            cfg, pipeline_impl=gp, demb_impl=demb_impl_for(cfg, mesh)
+        )
         state = init_state(model, cfg, sup, qry)
         step = make_sharded_train_step(model, cfg, mesh, state)
         return step, (state, sup, qry, label)
@@ -218,7 +324,13 @@ def _cached_leg(cfg, mesh):
         sizes, cfg.n, cfg.k, cfg.q, batch_size=cfg.batch_size, seed=0,
         backend="python",
     )
-    model = build_model(cfg, glove_init=vocab.vectors)
+    from induction_network_on_fewrel_tpu.parallel.sharding import (
+        demb_impl_for,
+    )
+
+    model = build_model(
+        cfg, glove_init=vocab.vectors, demb_impl=demb_impl_for(cfg, mesh)
+    )
     si, qi, lab = idx.sample_fused(cfg.steps_per_call)
     sup = {k: v[si[0]] for k, v in table_np.items() if k != "uids"}
     qry = {k: v[qi[0]] for k, v in table_np.items() if k != "uids"}
@@ -231,25 +343,31 @@ def _cached_leg(cfg, mesh):
 # gradient all-reduce: non-embedding grads ~5.05 MB f32 + compact
 # lazy-row cotangent ~0.4 MB => 5.45 MB payload, 10.7 MB ring wire. The
 # round-6 flagship compile REFUTED it: the partitioned HLO additionally
-# all-gathers the full [L, M, word_dim] f32 embedding across dp
+# all-gathered the full [L, M, word_dim] f32 embedding across dp
 # (25.6 MB/step/device at the flagship shape — present in the round-5
-# tiny-shape leg all along as its unattributed 306 KiB all-gather, just
-# never scaled up) plus ~2 MB of resharding permutes. The projection
-# below is the CORRECTED model; check_flagship asserts the compiled
-# payload stays within 40% of it, which still catches the failure mode
-# the check exists for (an accidentally dense table all-reduce would be
-# ~80 MB, 2.4x the band). Chip follow-up recorded in BASELINE.md: the
-# all-gather looks avoidable (local demb scatter-add + [U, D] row
-# all-reduce), worth a sharding-hint A/B on silicon.
-FLAGSHIP_GRAD_PAYLOAD = 5.45e6
+# tiny-shape leg all along as its UNATTRIBUTED 306 KiB all-gather, just
+# never scaled up) plus ~2 MB of resharding permutes. Round 7 removed
+# the all-gather (parallel/sharding.make_compact_demb_lookup: the demb
+# segment-sum stays local per shard; only the compact [U, D] touched-row
+# gradient is all-reduced — already inside the 5.45 MB grad term), so
+# the projection is back to the round-5 shape PLUS the resharding term
+# the round-6 compile taught us to count. With every collective now
+# attributed (collective_rows) the band tightens from ±40% to ±15%: the
+# wide band existed only because a 26 MB term was anonymous. The same
+# formulas live in utils/roofline.comms_components so bench.py's
+# comms_bytes_per_step and this assertion can never drift apart.
 
 
 def flagship_payload_projection(cfg) -> float:
-    """Corrected payload model: grad all-reduce + the [L, M, word_dim]
-    f32 embedding all-gather + ~2 MB resharding slack."""
-    m_rows = cfg.batch_size * (cfg.n * cfg.k + cfg.n * cfg.q)
-    emb_ag = cfg.max_length * m_rows * cfg.word_dim * 4
-    return FLAGSHIP_GRAD_PAYLOAD + emb_ag + 2e6
+    """Round-7 payload model: grad all-reduce (non-embedding grads + the
+    compact [U, D] demb rows) + resharding slack. The [L, M, word_dim]
+    all-gather is structurally absent — enforced by check_flagship's
+    regression gate, not just this band."""
+    from induction_network_on_fewrel_tpu.utils.roofline import (
+        comms_payload_bytes,
+    )
+
+    return comms_payload_bytes(cfg)
 
 
 def flagship_leg():
@@ -266,39 +384,74 @@ def flagship_leg():
     return ("dp8_tokencache_lazy_flagship", cfg, make_mesh(dp=8), _cached_leg)
 
 
-def check_flagship(cfg, result: dict, tol: float = 0.4) -> None:
-    """Assert the compiled flagship payload is within ``tol`` (fractional)
-    of the corrected projection. A band, not an equality: the model
-    carries the two structural terms (gradient all-reduce + embedding
-    all-gather) and slack for metric/clip reductions and partitioner
-    resharding — the assertion catches a shape-dependent GSPMD blowup or
-    a silent regression of the comms story, not formula rounding."""
+def dense_allgather_bytes(cfg) -> int:
+    """The regression-gate threshold: the dense [L, M, word_dim] f32
+    embedding all-gather's payload at cfg's shape. No single collective
+    may reach it — if one does, a sharding change silently reintroduced
+    the replicated embedding (the 26 MB round-6 finding). One home for
+    the arithmetic: utils/roofline.dense_embedding_allgather_bytes."""
+    from induction_network_on_fewrel_tpu.utils.roofline import (
+        dense_embedding_allgather_bytes,
+    )
+
+    return dense_embedding_allgather_bytes(cfg)
+
+
+def check_flagship(cfg, result: dict, tol: float = 0.15) -> None:
+    """Assert (a) the compiled flagship payload is within ``tol`` of the
+    projection and (b) NO single collective moves >= the dense embedding
+    all-gather's bytes (the compact-demb regression gate). The band
+    tightened from the round-6 ±40% to ±15%: it was wide only because
+    the dominant term was unattributed — with per-collective attribution
+    the model's terms are nameable against compiled rows one by one."""
+    from induction_network_on_fewrel_tpu.utils.roofline import (
+        comms_components,
+    )
+
     total = result["total_bytes_per_step_per_device"]
     proj = flagship_payload_projection(cfg)
+    terms = "; ".join(
+        f"{name} {b / 1e6:.2f}" for name, b in comms_components(cfg)
+    )
     lo, hi = proj * (1 - tol), proj * (1 + tol)
     assert lo <= total <= hi, (
         f"flagship collective payload {total / 1e6:.2f} MB/step/device "
-        f"outside [{lo / 1e6:.2f}, {hi / 1e6:.2f}] — the corrected "
-        f"round-6 projection ({proj / 1e6:.2f} MB payload: grads "
-        f"{FLAGSHIP_GRAD_PAYLOAD / 1e6:.2f} + [L,M,word_dim] f32 "
-        "embedding all-gather + resharding) no longer describes what "
-        "GSPMD schedules at the real shape"
+        f"outside [{lo / 1e6:.2f}, {hi / 1e6:.2f}] — the round-7 "
+        f"projection ({proj / 1e6:.2f} MB payload: {terms}) no longer "
+        "describes what GSPMD schedules at the real shape"
     )
-    # Wire estimate at d=8: ring AR moves 2(d-1)/d of its payload, ring
-    # AG (d-1)/d of the gathered size; permutes ~1x.
+    gate = dense_allgather_bytes(cfg)
+    worst = max(
+        (r for r in result.get("attributed", [{"bytes": 0}])),
+        key=lambda r: r["bytes"] // max(r.get("count", 1), 1),
+        default={"bytes": 0},
+    )
+    biggest = max((r["bytes"] for r in result.get("rows", [])), default=0)
+    assert biggest < gate, (
+        f"REGRESSION: a single collective moves {biggest} B >= the dense "
+        f"[L,M,word_dim] embedding all-gather ({gate} B) — a sharding "
+        f"change reintroduced the replicated embedding (worst row: "
+        f"{worst}). See parallel/sharding.make_compact_demb_lookup."
+    )
+    # Wire estimate from the shared ring-factor model (ONE home:
+    # utils/roofline.wire_bytes), at the leg's actual dp.
+    from induction_network_on_fewrel_tpu.utils.roofline import wire_bytes
+
     ar = sum(
         v["bytes"] for k, v in result["collectives"].items()
         if k in ("all-reduce", "reduce-scatter")
     )
     ag = result["collectives"].get("all-gather", {}).get("bytes", 0)
-    rest = total - ar - ag
-    wire = 2 * 7 / 8 * ar + 7 / 8 * ag + rest
+    wire = wire_bytes(
+        {"all-reduce": ar, "all-gather": ag, "other": total - ar - ag},
+        result["mesh"].get("dp", 8),
+    )
     print(
         f"flagship: payload {total / 1e6:.2f} MB/step/device (projection "
         f"{proj / 1e6:.2f}, within {tol:.0%}); wire ~{wire / 1e6:.1f} MB "
         f"-> ~{wire / 45e9 * 1e3:.2f} ms at v5e ICI 45 GB/s vs the "
-        "~3.5 ms measured step — the round-5 '10.7 MB, ~7%' story "
-        "under-counted by the embedding all-gather"
+        "~3.5 ms measured step — was 33.7 MB payload / ~22% un-overlapped "
+        "before the compact-demb path (COMMS_r06)"
     )
 
 
@@ -314,6 +467,12 @@ def main() -> int:
     ap.add_argument(
         "--only-flagship", action="store_true",
         help="run ONLY the flagship leg + its projection assertion",
+    )
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="exit nonzero if ANY collective lacks op_name attribution — "
+             "an anonymous payload term is how the 26 MB flagship "
+             "all-gather hid for two rounds",
     )
     args = ap.parse_args()
 
@@ -334,11 +493,15 @@ def main() -> int:
         legs.append(flagship_leg())
 
     results = {}
+    anon_total = 0
     for name, cfg, mesh, build in legs:
         step, fn_args = build(cfg, mesh)
         lowered = step.lower(*fn_args)
         compiled = lowered.compile()
-        per_op = collective_bytes(compiled.as_text())
+        rows = collective_rows(compiled.as_text())
+        attributed = attributed_rows(rows)
+        anon_total += check_attribution(name, rows)
+        per_op = per_op_from_rows(rows)
         total = sum(v["bytes"] for v in per_op.values())
         n_params = None
         try:
@@ -348,24 +511,41 @@ def main() -> int:
         results[name] = {
             "mesh": dict(mesh.shape),
             "collectives": per_op,
+            "attributed": attributed,
+            "unattributed_bytes": sum(
+                r["bytes"] for r in rows if r["source"] is None
+            ),
             "total_bytes_per_step_per_device": total,
             "param_count": n_params,
             "param_bytes_f32": (4 * n_params) if n_params else None,
         }
         print(f"{name}: {total} B/step/device, "
               f"{ {k: v['count'] for k, v in per_op.items()} }")
+        for row in attributed[:6]:
+            print(f"  {row['bytes']:>10} B x{row['count']:<3} {row['op']:<19} "
+                  f"{row['source'] or 'UNATTRIBUTED'}")
         if name == "dp8_tokencache_lazy_flagship":
             # VERDICT round-5 item 5: the projection must describe what
-            # GSPMD actually schedules at the REAL shape, asserted here.
+            # GSPMD actually schedules at the REAL shape, asserted here —
+            # plus the round-7 regression gate (no dense-sized collective).
+            results[name]["rows"] = rows
             check_flagship(cfg, results[name])
+            del results[name]["rows"]
             results[name]["payload_projection_bytes"] = (
                 flagship_payload_projection(cfg)
+            )
+            results[name]["dense_allgather_gate_bytes"] = (
+                dense_allgather_bytes(cfg)
             )
 
     if args.json:
         with open(args.json, "w") as f:
             json.dump(results, f, indent=1)
         print(f"wrote {args.json}")
+    if args.strict and anon_total:
+        print(f"--strict: {anon_total} unattributed collective bytes",
+              file=sys.stderr)
+        return 1
     return 0
 
 

@@ -266,6 +266,26 @@ def data_summary(recs: list[dict]) -> dict | None:
     return out
 
 
+def comms_summary(recs: list[dict]) -> dict | None:
+    """Collective-traffic section (ISSUE 5, kind="comms"): the headline is
+    wire_mb_per_step — bytes every training step puts on the ICI fabric
+    per device, from the ledger arithmetic the compiled HLO is asserted
+    against (utils/roofline.comms_components / tools/comms_ledger.py).
+    The records are per-window restatements of a per-step constant, so
+    the LAST record is the truth; a mid-run change (it would take a
+    restart with different dp/compact_demb) would show in the count."""
+    comms = [r for r in recs if r.get("kind") == "comms"]
+    if not comms:
+        return None
+    last = comms[-1]
+    out = {"records": len(comms)}
+    for k in ("wire_mb_per_step", "payload_bytes_per_step",
+              "wire_bytes_per_step", "dp", "compact_demb", "demb_u_rows"):
+        if isinstance(last.get(k), (int, float)):
+            out[k] = last[k]
+    return out
+
+
 def health_summary(recs: list[dict]) -> dict:
     events = [r for r in recs if r.get("kind") == "health"]
     by_event: dict[str, int] = {}
@@ -395,8 +415,8 @@ def render(report: dict) -> str:
     for e in errors[:10]:
         lines.append(f"  ! {e}")
     for section in ("train", "mfu", "eval", "serve", "ckpt",
-                    "input_pipeline", "health", "flight_recorder",
-                    "overhead"):
+                    "input_pipeline", "comms", "health",
+                    "flight_recorder", "overhead"):
         body = report.get(section)
         if body is None:
             continue
@@ -445,6 +465,7 @@ def main(argv=None) -> int:
         "serve": serve_summary(recs),
         "ckpt": ckpt_summary(recs),
         "input_pipeline": data_summary(recs),
+        "comms": comms_summary(recs),
         "health": health_summary(recs),
         "flight_recorder": recorder_summary(run_dir),
     }
