@@ -65,35 +65,58 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
              "vocab's true size)",
     )
     p.add_argument("--lstm_hidden", type=int, default=128)
+    # The encoder's runtime backend knobs all resolve TPU-aware in ONE
+    # place: models/build.resolve_runtime_backends (its docstring carries
+    # the full resolution table — help texts here stay short and point at
+    # it instead of restating stale copies). None of these are
+    # architecture fields: params and checkpoints are identical across
+    # every setting.
     p.add_argument(
         "--lstm_backend", default="auto",
         choices=["auto", "scan", "pallas", "interpret"],
-        help="LSTM recurrence impl: pallas = fused TPU kernel (auto on TPU)",
+        help="LSTM recurrence impl; auto = the fused Pallas kernel on a "
+             "real TPU backend, lax.scan elsewhere (resolution table: "
+             "models/build.resolve_runtime_backends)",
     )
     p.add_argument(
         "--attn_backend", default="auto",
         choices=["auto", "xla", "pallas", "interpret"],
-        help="self-attention impl: auto = the two-pass XLA form (measured "
-             "faster than the fused kernel on this chip, BASELINE.md "
-             "round 5); pallas = the fused one-pass online-softmax kernel, "
-             "kept selectable for A/Bs on other silicon. Under --bf16 the "
-             "backends are close but NOT bit-identical: the kernel runs "
-             "its projection/softmax in f32 while the xla path computes "
-             "proj/tanh in bf16, so flipping backends shifts metrics "
-             "within bf16 tolerance (pinned in "
-             "tests/test_attn.py::test_encoder_attn_backend_equivalence)",
+        help="self-attention impl; auto = the two-pass XLA form on every "
+             "backend (the fused one-pass kernel measured 0.97-0.98x of "
+             "it on this chip, BASELINE.md round 5 — kept selectable for "
+             "A/Bs on other silicon). Under --bf16 the backends shift "
+             "metrics within bf16 tolerance, not bitwise (pinned in "
+             "tests/test_attn.py::test_encoder_attn_backend_equivalence). "
+             "Resolution table: models/build.resolve_runtime_backends",
     )
     p.add_argument(
         "--remat_attn", default="on", choices=["on", "off"],
-        help="recompute-in-backward attention (default on; TPU + xla "
-             "attention path only): the forward saves just the [M] softmax "
-             "stats instead of the [L,M,A] tanh projection, and the "
-             "one-pass Pallas backward kernel rebuilds the projection and "
-             "attention weights from the already-saved H in VMEM — attn "
-             "bwd 213 -> 134 MB/step at the flagship shape (ROOFLINE_r06). "
-             "Pure runtime knob: params and checkpoints are identical "
-             "either way (parity in tests/test_attn.py; bf16 shifts within "
-             "the documented kernel band, same as --attn_backend pallas)",
+        help="recompute-in-backward attention: save only the [M] softmax "
+             "stats, rebuild the [L,M,A] projection in the kernel backward "
+             "(attn bwd 213 -> 134 MB/step, ROOFLINE_r06). 'on' engages "
+             "TPU-only, the same auto shape as --lstm_backend and "
+             "--lstm_cs_window/--lstm_residuals (one table, one home: "
+             "models/build.resolve_runtime_backends); parity in "
+             "tests/test_attn.py",
+    )
+    p.add_argument(
+        "--lstm_cs_window", type=int, default=8,
+        help="windowed-cs remat in the fused BiLSTM backward (round 8): "
+             "save one (h, c) checkpoint pair per this many timesteps "
+             "instead of the full cell-state residual stream, recompute "
+             "in-window states in VMEM (kernel fwd 146 -> 97, bwd 227 -> "
+             "113 MB/step at W=8, ROOFLINE_r08). 0 = the round-6 "
+             "full-residual design (the A/B twin). Kernel lstm paths "
+             "only; parity at every W in tests/test_lstm.py (resolution "
+             "table: models/build.resolve_runtime_backends)",
+    )
+    p.add_argument(
+        "--lstm_residuals", default="auto", choices=["auto", "f32", "bf16"],
+        help="storage dtype of the BiLSTM residual streams/checkpoints; "
+             "auto = follow --compute dtype (bf16 on the flagship). VMEM "
+             "carries and the in-window recompute stay f32; drift is "
+             "policed by --grad_probe_every (resolution table: "
+             "models/build.resolve_runtime_backends)",
     )
     p.add_argument("--induction_dim", type=int, default=100)
     p.add_argument("--routing_iters", type=int, default=3)
@@ -383,6 +406,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         lstm_hidden=args.lstm_hidden, lstm_backend=args.lstm_backend,
         attn_backend=args.attn_backend,
         remat_attn=getattr(args, "remat_attn", "on") == "on",
+        lstm_cs_window=getattr(args, "lstm_cs_window", 8),
+        lstm_residuals=getattr(args, "lstm_residuals", "auto"),
         tfm_layers=args.tfm_layers, tfm_model=args.tfm_model,
         tfm_heads=args.tfm_heads, tfm_ff=args.tfm_ff,
         moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,

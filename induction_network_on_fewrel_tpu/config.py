@@ -66,6 +66,28 @@ class ExperimentConfig:
     # architecture field — params and checkpoints are backend-independent,
     # like attn_backend/lstm_backend.
     remat_attn: bool = True
+    # Windowed-cs remat in the fused Pallas BiLSTM backward (ops/lstm.py,
+    # round 8): the forward saves one (h, c) checkpoint pair per W
+    # natural-time steps instead of the full [L, M, u] cs/hs residual
+    # streams; the backward recomputes each window's states in VMEM from
+    # the seed. 0 = the round-6 full-residual design (the A/B twin).
+    # Byte arithmetic at the flagship shape (utils/roofline.py, W=8):
+    # kernel fwd 146 -> 97, kernel bwd 227 -> 113 MB/step. Engages on the
+    # kernel (pallas/interpret) lstm paths only — the scan backend keeps
+    # no residuals and ignores it (models/build.resolve_runtime_backends,
+    # the one home for the TPU-aware resolution of all encoder backend
+    # knobs). Pure runtime knob: params/outputs/checkpoints identical at
+    # every W (parity pinned in tests/test_lstm.py, windows {1, 8, T},
+    # T % W != 0 included).
+    lstm_cs_window: int = 8
+    # Storage dtype of the BiLSTM residual streams (full-cs mode) or
+    # checkpoint pairs (windowed mode): "auto" = follow compute_dtype
+    # (bf16 on the flagship — halves residual HBM traffic), "f32"/"bf16"
+    # force it. VMEM carries and the in-window recompute stay f32 either
+    # way, so bf16 residuals round only the window seeds. Drift is policed
+    # at run time by the --grad_probe_every grad-cosine machinery
+    # (train/steps.py) and bounded in tests/test_lstm.py.
+    lstm_residuals: str = "auto"
     # BERT (built from scratch in models/bert.py; random-init unless weights
     # are found on disk — this sandbox has no network):
     bert_layers: int = 12

@@ -21,6 +21,81 @@ from induction_network_on_fewrel_tpu.models.snail import SNAIL
 _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
 
 
+def resolve_runtime_backends(cfg: ExperimentConfig) -> dict:
+    """ONE home for the TPU-aware resolution of the encoder's runtime
+    backend knobs (cli.py help text points here instead of restating it).
+    None of these are architecture fields: params, outputs, and
+    checkpoints are identical across every setting.
+
+    ==================  =========  ========================================
+    knob                default    resolution
+    ==================  =========  ========================================
+    --lstm_backend      auto       pallas on a real TPU backend, scan
+                                   elsewhere (the CPU interpreter is for
+                                   tests, not throughput)
+    --remat_attn        on         with the resolved attention path "xla"
+                                   on a TPU backend, the backward runs
+                                   through the one-pass kernel
+                                   ("xla_remat"); elsewhere the two-pass
+                                   backward stays (the compiled kernel
+                                   needs a chip)
+    --lstm_cs_window    8          engages on the kernel (pallas/
+                                   interpret) lstm paths only — the scan
+                                   backend keeps no residuals; 0 = the
+                                   round-6 full-residual A/B twin
+    --lstm_residuals    auto       follow compute_dtype (bf16 on the
+                                   flagship) on the kernel paths; "f32"/
+                                   "bf16" force the storage dtype, carries
+                                   stay f32 either way
+    ==================  =========  ========================================
+
+    ``--attn_backend auto`` resolves to the two-pass XLA form on every
+    backend (the fused online-softmax kernel measured 0.97-0.98x of XLA
+    on this chip, BASELINE.md round 5; it stays selectable for A/Bs on
+    other silicon).
+
+    Returns {lstm_backend, attn_backend, lstm_cs_window,
+    lstm_residual_dtype} with every "auto" resolved.
+    """
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    backend = cfg.lstm_backend
+    if backend == "auto":
+        backend = "pallas" if on_tpu else "scan"
+    attn = getattr(cfg, "attn_backend", "auto")
+    if attn == "auto":
+        attn = "xla"
+    if attn == "xla" and getattr(cfg, "remat_attn", False) and on_tpu:
+        attn = "xla_remat"
+    kernel_lstm = backend in ("pallas", "interpret")
+    # Validate the RAW knob even where scan makes it inert — a negative
+    # window must fail here with a named error on every backend, not as
+    # an opaque shape error deep in pallas tracing on the TPU resolve.
+    raw_window = int(getattr(cfg, "lstm_cs_window", 0))
+    if raw_window < 0:
+        raise ValueError(
+            f"lstm_cs_window must be >= 0, got {raw_window} "
+            "(0 = full residual streams, W > 0 = windowed-cs remat)"
+        )
+    cs_window = raw_window if kernel_lstm else 0
+    residuals = getattr(cfg, "lstm_residuals", "auto")
+    if residuals not in ("auto", "f32", "bf16"):
+        raise ValueError(
+            f"unknown lstm_residuals {residuals!r} (auto | f32 | bf16)"
+        )
+    residual_dtype = (
+        {"f32": jnp.float32, "bf16": jnp.bfloat16}.get(residuals)
+        if kernel_lstm else None
+    )  # None = follow the compute dtype
+    return {
+        "lstm_backend": backend,
+        "attn_backend": attn,
+        "lstm_cs_window": cs_window,
+        "lstm_residual_dtype": residual_dtype,
+    }
+
+
 def build_model(
     cfg: ExperimentConfig,
     glove_init: np.ndarray | None = None,
@@ -154,40 +229,16 @@ def build_model(
                 moe_group_size=cfg.moe_group_size,
             )
         elif cfg.encoder == "bilstm":
-            backend = cfg.lstm_backend
-            if backend == "auto":
-                # Pallas kernel on a real TPU; lax.scan elsewhere (the CPU
-                # interpreter is for tests, not throughput).
-                import jax
-
-                backend = "pallas" if jax.default_backend() == "tpu" else "scan"
-            attn = getattr(cfg, "attn_backend", "auto")
-            if attn == "auto":
-                # auto = the TWO-PASS XLA form on every backend: the fused
-                # online-softmax kernel (ops/attn.py) was measured
-                # INTERLEAVED at 0.97-0.98x of XLA on the flagship step
-                # (BASELINE.md round 5) — XLA's flat [L*M, 2u] matmuls beat
-                # the kernel's chunked pipeline at L=40, and attention is
-                # only ~28% of step bytes (Amdahl caps the perfect-fusion
-                # win at ~10%). The kernel stays selectable for A/Bs on
-                # real silicon, where the bandwidth:compute ratio flips.
-                attn = "xla"
-            if attn == "xla" and getattr(cfg, "remat_attn", False):
-                # --remat_attn: keep the XLA forward (the part that won the
-                # round-5 A/B) but run the backward through the one-pass
-                # kernel, saving only [M] softmax stats instead of the
-                # [L, M, A] tanh projection (ops/attn.py "xla_remat";
-                # ROOFLINE_r06: attn bwd 213 -> 134 MB/step). The compiled
-                # kernel needs a TPU; elsewhere the two-pass backward
-                # stays (the interpreter is for tests, not throughput) —
-                # same resolution shape as lstm_backend="auto".
-                import jax
-
-                if jax.default_backend() == "tpu":
-                    attn = "xla_remat"
+            # Rationale for each resolution lives in ONE place:
+            # resolve_runtime_backends' table (and BASELINE.md round 5 for
+            # the attn kernel rejection). Every knob here is runtime-only.
+            r = resolve_runtime_backends(cfg)
             encoder = BiLSTMSelfAttnEncoder(
                 lstm_hidden=cfg.lstm_hidden, att_dim=cfg.att_dim,
-                lstm_backend=backend, attn_backend=attn,
+                lstm_backend=r["lstm_backend"],
+                attn_backend=r["attn_backend"],
+                lstm_cs_window=r["lstm_cs_window"],
+                lstm_residual_dtype=r["lstm_residual_dtype"],
                 compute_dtype=dtype,
             )
         else:

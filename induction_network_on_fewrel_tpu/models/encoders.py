@@ -89,6 +89,15 @@ class BiLSTMSelfAttnEncoder(nn.Module):
     # (attn-bwd 213 -> 134 MB/step at the flagship shape, ROOFLINE_r06).
     # Same params every way — checkpoints interchange across backends.
     attn_backend: str = "xla"
+    # Windowed-cs remat window for the fused kernel backward (ops/lstm.py
+    # round 8): W > 0 = save one (h, c) checkpoint pair per W natural-time
+    # steps and recompute in-window states in VMEM; 0 = the round-6 full
+    # hs/cs residual streams. Kernel (pallas/interpret) paths only — scan
+    # keeps no residuals. Pure runtime knob, like the backends above.
+    lstm_cs_window: int = 0
+    # Storage dtype of those residuals/checkpoints (None = follow the
+    # embedding dtype); VMEM carries and the recompute stay f32.
+    lstm_residual_dtype: jnp.dtype | None = None
     compute_dtype: jnp.dtype = jnp.float32
     # Callers that can supply embeddings already time-major ([L, M, D])
     # should: FewShotModel.encode then transposes the int IDS before the
@@ -137,7 +146,9 @@ class BiLSTMSelfAttnEncoder(nn.Module):
         # projected gates never materialize in HBM on the pallas path; the
         # scan path computes them explicitly with identical math.
         H = bilstm_encoder_tm(
-            emb_t, w_ih, b[:, None, :], w_hh, backend=self.lstm_backend
+            emb_t, w_ih, b[:, None, :], w_hh, backend=self.lstm_backend,
+            cs_window=self.lstm_cs_window,
+            residual_dtype=self.lstm_residual_dtype,
         )                                                     # [L, M, 2u]
         H = H.astype(self.compute_dtype)
 

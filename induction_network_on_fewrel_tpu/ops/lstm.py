@@ -54,25 +54,48 @@ tradeoff. Measured mean relative grad error is ~10-15% on random inputs
 parity should be monitored via final val accuracy in bf16 runs, not only
 throughput. The f32 path is exact to 1e-5 against `lax.scan`.
 
-**Not saving ``cs`` — evaluated and REJECTED (round 6).** Dropping the
-cell-state residual would cut the fused forward's HBM writes in half
-(hs-only: 146 -> 81 MB/step at the flagship shape), but the backward
-needs tanh(c_t) (for da_o/dc_t) and the RAW c_{t-1} (for da_f), and the
-only local reconstruction from saved hs is the inversion
-``c_t = atanh(h_t / o_t)`` — ill-conditioned exactly where LSTMs live:
-d(atanh x)/dx = cosh²(c), so a 1-ulp rounding of h inflates to a cell
-error of eps·cosh²(c) (~20 ABSOLUTE at c = 10, f32), and for |c| ≳ 8.3
-tanh(c) rounds to ±1.0 in f32 and the inversion returns inf — while the
-factor da_f = dc_t·c_prev·f·(1-f) it feeds is NOT zero there. Measured on
-a saturating sequence (tests/test_lstm.py::
+**Not saving ``cs`` via inversion — evaluated and REJECTED (round 6).**
+Dropping the cell-state residual and reconstructing it from saved hs
+requires the inversion ``c_t = atanh(h_t / o_t)`` — ill-conditioned
+exactly where LSTMs live: d(atanh x)/dx = cosh²(c), so a 1-ulp rounding
+of h inflates to a cell error of eps·cosh²(c) (~20 ABSOLUTE at c = 10,
+f32), and for |c| ≳ 8.3 tanh(c) rounds to ±1.0 in f32 and the inversion
+returns inf — while the factor da_f = dc_t·c_prev·f·(1-f) it feeds is
+NOT zero there. Measured on a saturating sequence (tests/test_lstm.py::
 test_cs_recompute_from_hs_rejected): reconstruction error exceeds 1.0
-absolute within 40 steps of a forget-dominant cell. The sound
-alternative — window-checkpointed cs (save every K-th step, recompute
-the window ascending inside the backward kernel) — is byte-positive
-(fwd -57 MB at K=8) but needs a K-step VMEM state buffer per tile
-(~0.5-1.5 MB at tm=128) and a dual-sweep kernel rewrite; it must be
-prototyped against real-chip VMEM limits, not the interpreter, so it is
-recorded as chip-session work (BASELINE.md round 6), not landed blind.
+absolute within 40 steps of a forget-dominant cell.
+
+**Windowed-cs remat (round 8 — the sound alternative, landed).** The
+fused encoder path (``bilstm_encoder_tm``) accepts ``cs_window = W > 0``:
+the forward writes hs (the user-facing output) plus one (h, c)
+CHECKPOINT PAIR per W-step window — the state at each window's
+kernel-last step — and no full residual streams at all. The backward is
+a dual-sweep kernel: on entering a window (walking kernel time
+backwards) it re-runs the forward recurrence ASCENDING from the
+checkpoint seed, holding the window's (h, c) in VMEM scratch, then the
+per-step gradient sweep reads cell state and h_prev from that scratch
+instead of HBM. Recompute ascends FORWARD from a saved seed — the exact
+opposite of the rejected atanh inversion, so the conditioning argument
+above does not apply (in f32 the recomputed cells are the forward's own
+arithmetic replayed; parity vs lax.scan stays at 1e-5 for any W —
+tests/test_lstm.py window sweep {1, 8, T}, T % W != 0 included). Flagship
+bytes (utils/roofline.py, W=8 bf16 residuals): kernel fwd 146 -> 97,
+kernel bwd 227 -> 113 MB/step — the backward streams only d(hs), the
+checkpoints, and the embedding block, which the recompute and gradient
+sweeps share from VMEM. Windows are defined as NATURAL-time blocks so
+both directions' residual reads stay block-aligned (a kernel-time
+window of the reverse direction is exactly a natural-time block read
+backwards); the last block is ragged when W does not divide L and the
+kernel masks it. ``cs_window = 0`` keeps the round-6 full-cs design
+(the A/B twin).
+
+**Residual dtype (``residual_dtype``)**: the checkpoint pairs (windowed
+mode) or the cs stream (full-cs mode) are stored in this dtype — bf16
+halves their HBM traffic independently of the compute dtype; all VMEM
+carries and the in-window recompute stay f32, so bf16 residuals round
+only the window SEEDS (vs every step in the round-6 bf16 path). Policed
+at run time by the --grad_probe_every grad-cosine machinery
+(train/steps.py) and bounded in tests/test_lstm.py.
 """
 
 from __future__ import annotations
@@ -439,7 +462,7 @@ def lstm_recurrence_grouped(
 # ---------------------------------------------------------------------------
 
 
-def _pick_tm(M: int, u: int, itemsize: int, D: int = 0) -> int:
+def _pick_tm(M: int, u: int, itemsize: int, D: int = 0, W: int = 0) -> int:
     """Row-tile for the time-major kernels: avoid padding when possible.
 
     The TPU grid runs sequentially (pipelined), so fewer, larger row tiles
@@ -458,13 +481,29 @@ def _pick_tm(M: int, u: int, itemsize: int, D: int = 0) -> int:
     cap's slack absorbed the difference, but a larger embedding dim could
     otherwise pick a tile that exceeds VMEM at compile time (advisor
     finding, round 3).
+
+    ``W > 0`` models the WINDOWED-CS fused backward (cs_window): the emb
+    block becomes a [W, tm, D] window, the per-step [tm, u] cs/hs-prev
+    residual blocks are replaced by two [1, tm, u] checkpoint blocks, and
+    the recompute holds the window's (h, c) in two [W, tm, u] f32
+    scratches — at W = L (full recompute) the scratch term dominates and
+    this model is what clamps tm instead of the compiler faulting.
     """
     q = 16 if itemsize == 2 else 8
     cap = 8 * 2**20  # leave VMEM headroom for the compiler's own buffers
 
     def fits(tm: int) -> bool:
         G = 4 * u
-        if D:
+        if D and W:
+            # windowed fused bwd, double-buffered: dhs [tm, u] + 2x ckpt
+            # [tm, u] ins, emb window [W, tm, D] in + demb [tm, D] out,
+            # weight ins with f32 cot outs; scratch adds the window's
+            # (h, c) pair [W, tm, u] f32 each.
+            blocks = (3 * tm * u + (W + 1) * tm * D) * itemsize * 2
+            blocks += (D * G + G + u * G) * (itemsize + 4) * 2
+            scratch = (2 * tm * u + u * G + D * G + G) * 4
+            scratch += 2 * W * tm * u * 4
+        elif D:
             # fused bwd, double-buffered: 4x [tm, u] state/cot ins, emb in
             # + demb out [tm, D], weight ins (emb-dtype wih + f32 b/whh ~
             # itemsize each, conservatively f32) with f32 dwih/db/dwhh
@@ -791,7 +830,7 @@ def _fused_specs(L, D, u, G, H, tm):
     return in_specs, out_idx, emb_idx, per_dir
 
 
-def _fused_fwd_call(emb_t, wih, b, whh, interpret: bool, tm: int):
+def _fused_fwd_call(emb_t, wih, b, whh, interpret: bool, tm: int, res_dt=None):
     L, Mp, D = emb_t.shape
     Gc, u, G = whh.shape
     H = Mp // tm
@@ -805,7 +844,9 @@ def _fused_fwd_call(emb_t, wih, b, whh, interpret: bool, tm: int):
         out_specs=[out_spec, out_spec],
         out_shape=[
             jax.ShapeDtypeStruct((L, Mp, Gc * u), dt),
-            jax.ShapeDtypeStruct((L, Mp, Gc * u), dt),
+            # cs is residual-only: it may ride a narrower dtype than the
+            # user-facing hs (cs_window=0 + residual_dtype=bf16 mode).
+            jax.ShapeDtypeStruct((L, Mp, Gc * u), res_dt or dt),
         ],
         scratch_shapes=[
             pltpu.VMEM((tm, u), jnp.float32),
@@ -906,21 +947,305 @@ def _fused_bwd_call(dhs, emb_t, cs, hs, wih, b, whh, interpret: bool, tm: int):
     return demb, dwih.astype(wih.dtype), db, dwhh
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _bilstm_fused_tm(emb_t, wih, b, whh, interpret=False, tm=_TM):
+# ---------------------------------------------------------------------------
+# Windowed-cs remat (round 8, module doc): the forward saves only one (h, c)
+# checkpoint pair per W-step window; the backward recomputes the window's
+# states ascending in VMEM from the seed, then runs the gradient sweep from
+# scratch. Windows are NATURAL-time blocks [bW, bW+W) so both directions'
+# block reads stay aligned: a natural block IS a contiguous kernel-time
+# window for the reverse direction too, just walked the other way. Per
+# direction, a checkpoint slot b holds the state at the block's kernel-LAST
+# step (highest nat for the forward direction, lowest nat for the reverse) —
+# exactly the seed the NEXT kernel-time window's recompute needs.
+# ---------------------------------------------------------------------------
+
+
+def _win_fwd_nat(i, t, H, L):
+    """Natural-time position of forward grid step t for tile i (the fused
+    forward's kernel time IS t; the reverse direction flips it)."""
+    return jnp.where(i // H == 1, L - 1 - t, t)
+
+
+def _fused_win_fwd_kernel(
+    emb_ref, wih_ref, b_ref, whh_ref, hs_ref, ch_ref, cc_ref, h_scr, c_scr
+):
+    # Identical recurrence to _fused_fwd_kernel; the only residuals that
+    # leave VMEM are the checkpoint pair blocks, written every step — the
+    # block flushes to HBM when its (window) index changes, so the
+    # surviving value is the window's kernel-last state, at 1/W the
+    # full-cs write traffic.
+    t = pl.program_id(1)
+    u = whh_ref.shape[1]
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[...] = jnp.zeros_like(h_scr)
+        c_scr[...] = jnp.zeros_like(c_scr)
+
+    a = (
+        jnp.dot(emb_ref[0], wih_ref[0], preferred_element_type=jnp.float32)
+        + b_ref[0]
+        + jnp.dot(h_scr[...], whh_ref[0], preferred_element_type=jnp.float32)
+    )
+    i, f, g, o = _gates(a, u)
+    c = f * c_scr[...] + i * g
+    h = o * jnp.tanh(c)
+    h_scr[...] = h
+    c_scr[...] = c
+    hs_ref[0] = h.astype(hs_ref.dtype)
+    ch_ref[0] = h.astype(ch_ref.dtype)
+    cc_ref[0] = c.astype(cc_ref.dtype)
+
+
+def _fused_win_bwd_kernel(
+    dhs_ref, emb_ref, ch_ref, cc_ref, wih_ref, b_ref, whh_ref,
+    demb_ref, dwih_ref, db_ref, dwhh_ref,
+    dh_scr, dc_scr, dwih_scr, db_scr, dwhh_scr, h_win, c_win,
+    *, W: int, H: int,
+):
+    i = pl.program_id(0)
+    t = pl.program_id(1)
+    L = pl.num_programs(1)
+    u = whh_ref.shape[1]
+    rev = i // H == 1
+    nat = jnp.where(rev, t, L - 1 - t)  # natural position being undone
+    base = (nat // W) * W
+    Wb = jnp.minimum(L - base, W)       # ragged last natural block
+    o = nat - base
+
+    @pl.when(t == 0)
+    def _():
+        dh_scr[...] = jnp.zeros_like(dh_scr)
+        dc_scr[...] = jnp.zeros_like(dc_scr)
+        dwih_scr[...] = jnp.zeros_like(dwih_scr)
+        db_scr[...] = jnp.zeros_like(db_scr)
+        dwhh_scr[...] = jnp.zeros_like(dwhh_scr)
+
+    wih = wih_ref[0]
+    bias = b_ref[0]
+    whh = whh_ref[0]
+
+    # Seed = the checkpoint pair of the kernel-PREVIOUS natural block (the
+    # index map points there); masked to the true zero initial state when
+    # this window is the direction's kernel-first one (fwd: block 0; rev:
+    # the last natural block — its window starts at kernel time 0).
+    first_win = jnp.where(rev, base + Wb >= L, base == 0)
+    live = jnp.where(first_win, 0.0, 1.0)
+    seed_h = ch_ref[0].astype(jnp.float32) * live
+    seed_c = cc_ref[0].astype(jnp.float32) * live
+
+    # Window entry (the window's kernel-LAST step, reached first walking
+    # backwards): replay the forward recurrence ascending in kernel time,
+    # stashing (h, c) per natural offset in VMEM. f32 throughout — bf16
+    # residuals round only the seeds. Ragged-block lanes (j >= Wb) read
+    # out-of-bounds emb rows whose values are unspecified; jnp.where
+    # SELECTS the carried state (no arithmetic with the garbage), and
+    # their stores land in never-read slots.
+    @pl.when(jnp.where(rev, o == 0, o == Wb - 1))
+    def _():
+        def step(j, carry):
+            h_prev, c_prev = carry
+            pos = jnp.clip(jnp.where(rev, Wb - 1 - j, j), 0, W - 1)
+            e = emb_ref[pl.ds(pos, 1)][0]
+            a = (
+                jnp.dot(e, wih, preferred_element_type=jnp.float32)
+                + bias
+                + jnp.dot(h_prev, whh, preferred_element_type=jnp.float32)
+            )
+            ig, fg, gg, og = _gates(a, u)
+            c = jnp.where(j < Wb, fg * c_prev + ig * gg, c_prev)
+            h = jnp.where(j < Wb, og * jnp.tanh(c), h_prev)
+            h_win[pl.ds(pos, 1)] = h[None]
+            c_win[pl.ds(pos, 1)] = c[None]
+            return h, c
+
+        jax.lax.fori_loop(0, W, step, (seed_h, seed_c))
+
+    # Gradient step: same math as _fused_bwd_kernel, but c_t / (h, c)_prev
+    # come from the recomputed window scratch (or the seed at the window's
+    # kernel-first step) instead of HBM residual streams.
+    at_seed = jnp.where(rev, o == Wb - 1, o == 0)
+    o_prev = jnp.where(rev, jnp.minimum(o + 1, W - 1), jnp.maximum(o - 1, 0))
+    c_t = c_win[pl.ds(o, 1)][0]
+    tc = jnp.tanh(c_t)
+    h_prev = jnp.where(at_seed, seed_h, h_win[pl.ds(o_prev, 1)][0])
+    c_prev = jnp.where(at_seed, seed_c, c_win[pl.ds(o_prev, 1)][0])
+
+    emb = emb_ref[pl.ds(o, 1)][0]
+    a = (
+        jnp.dot(emb, wih, preferred_element_type=jnp.float32)
+        + bias
+        + jnp.dot(h_prev, whh, preferred_element_type=jnp.float32)
+    )
+    i_g, f, g, o_g = _gates(a, u)
+
+    dh_t = dhs_ref[0].astype(jnp.float32) + dh_scr[...]
+    da_o = dh_t * tc * o_g * (1.0 - o_g)
+    dct = dc_scr[...] + dh_t * o_g * (1.0 - tc * tc)
+    da_i = dct * g * i_g * (1.0 - i_g)
+    da_g = dct * i_g * (1.0 - g * g)
+    da_f = dct * c_prev * f * (1.0 - f)
+    da = jnp.concatenate([da_i, da_f, da_g, da_o], axis=-1)  # [tm, 4u]
+
+    demb_ref[0, 0] = jax.lax.dot_general(
+        da, wih, (((1,), (1,)), ((), ())),  # da @ wihᵀ -> [tm, D]
+        preferred_element_type=jnp.float32,
+    ).astype(demb_ref.dtype)
+    dwih_scr[...] += jax.lax.dot_general(
+        emb.astype(jnp.float32), da, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    db_scr[...] += jnp.sum(da, axis=0, keepdims=True)
+    dh_scr[...] = jax.lax.dot_general(
+        da, whh, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dc_scr[...] = dct * f
+    dwhh_scr[...] += jax.lax.dot_general(
+        h_prev, da, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dwih_ref[0] = dwih_scr[...]
+    db_ref[0] = db_scr[...]
+    dwhh_ref[0] = dwhh_scr[...]
+
+
+def _fused_win_fwd_call(emb_t, wih, b, whh, interpret: bool, tm: int,
+                        W: int, res_dt):
+    L, Mp, D = emb_t.shape
+    Gc, u, G = whh.shape
+    H = Mp // tm
+    nB = -(-L // W)
+    in_specs, out_idx, _, _ = _fused_specs(L, D, u, G, H, tm)
+    ck_idx = lambda i, t: (_win_fwd_nat(i, t, H, L) // W, i % H, i // H)  # noqa: E731
+    ck_spec = pl.BlockSpec((1, tm, u), ck_idx)
+    hs, ch, cc = pl.pallas_call(
+        _fused_win_fwd_kernel,
+        grid=(Gc * H, L),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, tm, u), out_idx), ck_spec, ck_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((L, Mp, Gc * u), emb_t.dtype),
+            jax.ShapeDtypeStruct((nB, Mp, Gc * u), res_dt),
+            jax.ShapeDtypeStruct((nB, Mp, Gc * u), res_dt),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tm, u), jnp.float32),
+            pltpu.VMEM((tm, u), jnp.float32),
+        ],
+        interpret=interpret,
+    )(emb_t, wih, b, whh.astype(jnp.float32))
+    return hs, ch, cc
+
+
+def _fused_win_bwd_call(dhs, emb_t, ch, cc, wih, b, whh,
+                        interpret: bool, tm: int, W: int):
+    L, Mp, D = emb_t.shape
+    Gc, u, G = whh.shape
+    H = Mp // tm
+    ntiles = Gc * H
+    nB = ch.shape[0]
+
+    def p_idx(i, t):
+        g = i // H
+        return (jnp.where(g == 1, t, L - 1 - t), i % H, g)
+
+    def p_demb_idx(i, t):
+        g = i // H
+        return (g, jnp.where(g == 1, t, L - 1 - t), i % H, 0)
+
+    def blk_of(i, t):
+        return jnp.where(i // H == 1, t, L - 1 - t) // W
+
+    def emb_win_idx(i, t):
+        return (blk_of(i, t), i % H, 0)
+
+    def seed_idx(i, t):
+        # The kernel-previous natural block's checkpoint: one block down
+        # in natural time for the forward direction, one block UP for the
+        # reverse (its kernel time ascends as nat descends). Clamped at
+        # the edges, where the kernel masks the seed to zero anyway.
+        g = i // H
+        b = blk_of(i, t)
+        return (
+            jnp.clip(jnp.where(g == 1, b + 1, b - 1), 0, nB - 1),
+            i % H, g,
+        )
+
+    per_dir = lambda i, t: (i // H, 0, 0)  # noqa: E731
+    per_tile = lambda i, t: (i, 0, 0)      # noqa: E731
+    demb, dwih_p, db_p, dwhh_p = pl.pallas_call(
+        partial(_fused_win_bwd_kernel, W=W, H=H),
+        grid=(ntiles, L),
+        in_specs=[
+            pl.BlockSpec((1, tm, u), p_idx),       # dhs
+            pl.BlockSpec((W, tm, D), emb_win_idx),  # emb window
+            pl.BlockSpec((1, tm, u), seed_idx),    # ckpt h seed
+            pl.BlockSpec((1, tm, u), seed_idx),    # ckpt c seed
+            pl.BlockSpec((1, D, G), per_dir),      # wih
+            pl.BlockSpec((1, 1, G), per_dir),      # bias
+            pl.BlockSpec((1, u, G), per_dir),      # whh
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, tm, D), p_demb_idx),
+            pl.BlockSpec((1, D, G), per_tile),
+            pl.BlockSpec((1, 1, G), per_tile),
+            pl.BlockSpec((1, u, G), per_tile),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Gc, L, Mp, D), emb_t.dtype),
+            jax.ShapeDtypeStruct((ntiles, D, G), jnp.float32),
+            jax.ShapeDtypeStruct((ntiles, 1, G), jnp.float32),
+            jax.ShapeDtypeStruct((ntiles, u, G), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tm, u), jnp.float32),
+            pltpu.VMEM((tm, u), jnp.float32),
+            pltpu.VMEM((D, G), jnp.float32),
+            pltpu.VMEM((1, G), jnp.float32),
+            pltpu.VMEM((u, G), jnp.float32),
+            pltpu.VMEM((W, tm, u), jnp.float32),  # recomputed window h
+            pltpu.VMEM((W, tm, u), jnp.float32),  # recomputed window c
+        ],
+        interpret=interpret,
+    )(dhs, emb_t, ch, cc, wih, b, whh.astype(jnp.float32))
+    demb = demb[0] + demb[1]                                  # [L, Mp, D]
+    dwih = dwih_p.reshape(Gc, H, D, G).sum(axis=1)            # [Gc, D, G]
+    db = db_p.reshape(Gc, H, G).sum(axis=1)                   # [Gc, G]
+    dwhh = dwhh_p.reshape(Gc, H, u, G).sum(axis=1)            # [Gc, u, G]
+    return demb, dwih.astype(wih.dtype), db, dwhh
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _bilstm_fused_tm(emb_t, wih, b, whh, interpret=False, tm=_TM,
+                     cs_window=0, res_dt=None):
+    # Primal (no-grad) path is residual-free either way; the knobs only
+    # shape what the fwd RULE saves.
     return _fused_fwd_call_infer(emb_t, wih, b, whh, interpret, tm)
 
 
-def _bilstm_fused_fwd(emb_t, wih, b, whh, interpret, tm):
-    hs, cs = _fused_fwd_call(emb_t, wih, b, whh, interpret, tm)
+def _bilstm_fused_fwd(emb_t, wih, b, whh, interpret, tm, cs_window, res_dt):
+    res_dt = emb_t.dtype if res_dt is None else res_dt
+    if cs_window:
+        hs, ch, cc = _fused_win_fwd_call(
+            emb_t, wih, b, whh, interpret, tm, cs_window, res_dt
+        )
+        return hs, (emb_t, ch, cc, wih, b, whh)
+    hs, cs = _fused_fwd_call(emb_t, wih, b, whh, interpret, tm, res_dt)
     return hs, (emb_t, hs, cs, wih, b, whh)
 
 
-def _bilstm_fused_bwd(interpret, tm, res, dhs):
-    emb_t, hs, cs, wih, b, whh = res
-    demb, dwih, db, dwhh = _fused_bwd_call(
-        dhs, emb_t, cs, hs, wih, b, whh, interpret, tm
-    )
+def _bilstm_fused_bwd(interpret, tm, cs_window, res_dt, res, dhs):
+    if cs_window:
+        emb_t, ch, cc, wih, b, whh = res
+        demb, dwih, db, dwhh = _fused_win_bwd_call(
+            dhs, emb_t, ch, cc, wih, b, whh, interpret, tm, cs_window
+        )
+    else:
+        emb_t, hs, cs, wih, b, whh = res
+        demb, dwih, db, dwhh = _fused_bwd_call(
+            dhs, emb_t, cs, hs, wih, b, whh, interpret, tm
+        )
     return demb, dwih, db.reshape(b.shape), dwhh
 
 
@@ -933,6 +1258,8 @@ def bilstm_encoder_tm(
     b: jnp.ndarray,
     whh: jnp.ndarray,
     backend: str = "scan",
+    cs_window: int = 0,
+    residual_dtype=None,
 ) -> jnp.ndarray:
     """Projection + bidirectional recurrence over natural-time embeddings.
 
@@ -943,6 +1270,17 @@ def bilstm_encoder_tm(
     projected gates in HBM (see the fused-kernel section comment); the
     scan backend computes them explicitly and reuses the tm scan twin —
     identical math, different fp rounding order.
+
+    ``cs_window``: 0 = save the full hs/cs residual streams for the
+    backward (round-6 design); W > 0 = windowed-cs remat (module doc):
+    only one (h, c) checkpoint pair per W natural-time steps is saved and
+    the backward recomputes each window's states in VMEM. W is clamped to
+    L (W >= L means one window recomputed from the zero initial state).
+    ``residual_dtype``: storage dtype of the residual streams/checkpoints
+    (None = emb's dtype); carries and recompute stay f32. Both are pure
+    runtime knobs — parameters, outputs, and checkpoints are identical
+    across settings (pinned in tests/test_lstm.py); the scan backend
+    keeps no residuals and ignores them.
     """
     L, M, D = emb_t.shape
     Gc, u, G = whh.shape
@@ -953,7 +1291,9 @@ def bilstm_encoder_tm(
         return bilstm_recurrence_tm(xg_t, whh, backend="scan")
     if backend not in ("pallas", "interpret"):
         raise ValueError(f"unknown lstm backend {backend!r}")
-    tm = _pick_tm(M, u, jnp.dtype(emb_t.dtype).itemsize, D=D)
+    W = min(int(cs_window), L) if cs_window else 0
+    res_dt = jnp.dtype(residual_dtype) if residual_dtype is not None else None
+    tm = _pick_tm(M, u, jnp.dtype(emb_t.dtype).itemsize, D=D, W=W)
     pad = (-M) % tm
     if pad:
         # Pad rows feed zero embeddings through the recurrence; their
@@ -967,6 +1307,8 @@ def bilstm_encoder_tm(
         whh.astype(jnp.float32),
         backend == "interpret",
         tm,
+        W,
+        res_dt,
     )
     return out[:, :M] if pad else out
 

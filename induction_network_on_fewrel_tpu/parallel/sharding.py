@@ -265,17 +265,37 @@ def make_compact_demb_lookup(mesh: Mesh):
 
         def gather_bwd(idx, cot):
             def local_segsum(cot_l, ids_l):
-                # Per-shard tokens only -> partial [U, D]; ONE compact
-                # all-reduce instead of replicating [.., T, D] cotangent.
-                part = _local_segment_sum(cot_l, ids_l, num_rows)
-                return jax.lax.psum(part, "dp")
+                # Per-shard tokens only -> partial [U, D], stacked on a
+                # dp-sharded leading axis. NO collective here: this is
+                # the START half of the demb reduction.
+                return _local_segment_sum(cot_l, ids_l, num_rows)[None]
 
-            with jax.named_scope("demb/compact_allreduce"):
-                dtable = compat_shard_map(
+            # Round-8 overlap restructure: the round-7 spelling ran the
+            # [U, D] psum INSIDE the shard_map body, so the all-reduce
+            # executed inline at emb-backward time with its result bound
+            # to the region's output — zero scheduling freedom, part of
+            # the ~22% un-overlapped comms measured in round 6. Now the
+            # shard_map emits only the per-shard partials (start) and the
+            # cross-shard reduction is a free-floating sum over the
+            # dp-sharded axis (done) that GSPMD lowers to the SAME
+            # compact [U, D] all-reduce — but as an op whose only
+            # consumer is the word-table update at the end of the step.
+            # Everything between the partials and that update — the
+            # episode head's parameter-gradient matmuls (independent of
+            # the demb chain by dataflow), the main-param Adam update,
+            # the dp grad all-reduce — is schedulable while the
+            # reduction is in flight, and XLA's async-collective pass
+            # can split it into a start/done pair it latency-hides
+            # (chip wall-clock A/B queued in BASELINE.md round 8; the
+            # ledger reports the attributed row + async spelling).
+            with jax.named_scope("demb/compact_partials"):
+                partials = compat_shard_map(
                     local_segsum, mesh=mesh,
                     in_specs=(batch_spec(cot.ndim), batch_spec(idx.ndim)),
-                    out_specs=P(), check_vma=False,
-                )(cot, idx)
+                    out_specs=P("dp", None, None), check_vma=False,
+                )(cot, idx)  # [dp, U, D], leading axis dp-sharded
+            with jax.named_scope("demb/compact_allreduce"):
+                dtable = jnp.sum(partials, axis=0)
             return (
                 dtable.astype(table_dtype),
                 np.zeros(idx.shape, jax.dtypes.float0),
@@ -309,6 +329,19 @@ def demb_impl_for(cfg: ExperimentConfig, mesh: Mesh | None):
 # --- GSPMD steps -----------------------------------------------------------
 
 
+def _zero1_update_shardings(cfg: ExperimentConfig, st_sh):
+    """Param shardings for the explicit zero1 delta re-gather (round-8
+    attribution payoff, train/steps.make_update_body): under --zero_opt
+    the Adam moment math runs dp-sharded and the param deltas must come
+    back to the params' layout — spelling that reshard as a traced
+    with_sharding_constraint gives the all-gathers HLO metadata the
+    ledger can attribute. None everywhere else (plain apply_gradients);
+    the lazy table body keeps its own spelling either way."""
+    if not getattr(cfg, "zero_opt", False) or cfg.embed_optimizer == "lazy":
+        return None
+    return st_sh.params
+
+
 def make_sharded_train_step(model, cfg: ExperimentConfig, mesh: Mesh, state_example):
     """jit train step partitioned over ``mesh`` via NamedSharding.
 
@@ -320,7 +353,9 @@ def make_sharded_train_step(model, cfg: ExperimentConfig, mesh: Mesh, state_exam
     )
     repl = NamedSharding(mesh, P())
     sup_sh, qry_sh, lab_sh = episode_batch_shardings(mesh)
-    body = make_update_body(model, cfg)
+    body = make_update_body(
+        model, cfg, update_shardings=_zero1_update_shardings(cfg, st_sh)
+    )
 
     def step(state, support, query, label):
         return body(state, (support, query, label))
@@ -352,7 +387,9 @@ def make_sharded_multi_train_step(
         lambda s: NamedSharding(mesh, P(None, *s.spec)), sh,
         is_leaf=lambda x: isinstance(x, NamedSharding),
     )
-    body = make_update_body(model, cfg)
+    body = make_update_body(
+        model, cfg, update_shardings=_zero1_update_shardings(cfg, st_sh)
+    )
 
     def multi_step(state, support_s, query_s, label_s):
         return jax.lax.scan(body, state, (support_s, query_s, label_s))

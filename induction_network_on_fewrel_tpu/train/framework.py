@@ -203,6 +203,38 @@ class FewShotTrainer:
             }
             if comms_u_rows:
                 self._comms_record["demb_u_rows"] = float(comms_u_rows)
+        # Per-window HBM-roofline telemetry (ISSUE 6, kind="roofline"):
+        # the shared step-byte arithmetic at this config's residual knobs
+        # (utils/roofline.step_bytes — the formulas ROOFLINE_r*.json and
+        # bench.py stamp; the tier-1 regression gate holds them to the
+        # recorded round value). BiLSTM only — the formulas model the
+        # flagship BiLSTM kernel step. Like bench's stamp and unlike the
+        # comms record, this is the analytic MODEL of the step at this
+        # config, not a measurement of this process's backend: the window
+        # and dtype knobs come from cfg (what the kernel paths would run),
+        # so a CPU-honest session reports the same diet arithmetic a chip
+        # session verifies by wall clock.
+        self._roofline_record = None
+        if cfg.encoder == "bilstm":
+            from induction_network_on_fewrel_tpu.utils.roofline import (
+                lstm_residual_bytes,
+                step_bytes,
+            )
+
+            sb = step_bytes(cfg, corpus_rows=comms_u_rows)
+            self._roofline_record = {
+                "step_bytes": float(sb),
+                "step_mb": round(sb / 1e6, 3),
+                "lstm_residual_bytes": float(lstm_residual_bytes(cfg)),
+                "lstm_cs_window": float(getattr(cfg, "lstm_cs_window", 0)),
+            }
+            if comms_u_rows:
+                # Carried so obs_report's rebuilt per-component table can
+                # use the SAME corpus bound as the headline — without it
+                # the lazy demb/optimizer rows would silently fall back to
+                # the synthetic default and disagree with step_mb on a
+                # real corpus (the round-7 understatement, resurfacing).
+                self._roofline_record["corpus_rows"] = float(comms_u_rows)
         # FewRel 2.0 adversarial adaptation: AdvPieces bundle, or None. When
         # set, training runs the DANN step (few-shot loss + domain game)
         # instead of the plain step; eval/checkpointing are unchanged (the
@@ -432,6 +464,12 @@ class FewShotTrainer:
                     # the shared ledger arithmetic — obs_report's comms
                     # section headline is wire_mb_per_step.
                     self.logger.log(step, "comms", **self._comms_record)
+                if self._roofline_record is not None:
+                    # Per-window step-byte arithmetic (ISSUE 6 satellite)
+                    # — obs_report's roofline section headline is step_mb.
+                    self.logger.log(
+                        step, "roofline", **self._roofline_record
+                    )
                 t0 = time.monotonic()
                 last_logged = step
             if (

@@ -146,7 +146,7 @@ def loss_and_metrics(
     return loss, {"loss": task_loss, "accuracy": accuracy(logits, label)}
 
 
-def make_update_body(model, cfg: ExperimentConfig):
+def make_update_body(model, cfg: ExperimentConfig, update_shardings=None):
     """The one fwd+bwd+update body every step factory wraps: single-device
     jit, GSPMD-sharded jit, and the lax.scan fused variants of both all call
     this — one source of truth for the update math, so the per-step and
@@ -154,9 +154,25 @@ def make_update_body(model, cfg: ExperimentConfig):
 
     ``(state, (support, query, label)) -> (state, metrics)`` — the scan-body
     calling convention.
+
+    ``update_shardings``: optional pytree of NamedShardings matching
+    ``params`` (the GSPMD zero1 path passes its param shardings). When
+    given, the optimizer update is spelled as ``tx.update`` + an explicit
+    ``with_sharding_constraint`` pinning the param deltas back to the
+    params' layout, inside ``jax.named_scope("opt/zero1_gather")`` — the
+    SAME math ``apply_gradients`` runs (update, apply, step+1), but the
+    dp-sharded-moments -> replicated-params re-gather now happens at a
+    TRACED op carrying HLO metadata, so the ledger can attribute it
+    (tools/comms_ledger.py; a bare named_scope cannot reach the
+    partitioner-inserted collectives — they are not traced ops, which is
+    how the zero1 leg's 232 KB of all-gathers stayed metadata-less
+    through rounds 5-7, RUNBOOK §11 attribution debt).
     """
 
     if cfg.embed_optimizer == "lazy":
+        # The lazy table body has its own update spelling; zero1's
+        # explicit-gather attribution covers the plain-TrainState path
+        # only (remaining-debt note in BASELINE round 8).
         from induction_network_on_fewrel_tpu.train.lazy_embed import (
             make_lazy_update_body,
         )
@@ -174,7 +190,30 @@ def make_update_body(model, cfg: ExperimentConfig):
             )
 
         grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
-        return state.apply_gradients(grads=grads), metrics
+        if update_shardings is None:
+            return state.apply_gradients(grads=grads), metrics
+        # flax TrainState.apply_gradients, spelled out so the re-gather
+        # of the sharded param deltas is a named, attributable op. The
+        # outer scope also names the update MATH: GSPMD copies metadata
+        # from the op it partitions, so gathers it fuses into the Adam
+        # arithmetic surface as opt/zero1_update/... rows rather than a
+        # bare "mul".
+        with jax.named_scope("opt/zero1_update"):
+            updates, new_opt_state = state.tx.update(
+                grads, state.opt_state, state.params
+            )
+            with jax.named_scope("gather"):
+                updates = jax.lax.with_sharding_constraint(
+                    updates, update_shardings
+                )
+            new_params = optax.apply_updates(state.params, updates)
+        return (
+            state.replace(
+                step=state.step + 1, params=new_params,
+                opt_state=new_opt_state,
+            ),
+            metrics,
+        )
 
     return body
 
@@ -295,8 +334,13 @@ def make_grad_probe(model, cfg: ExperimentConfig):
         # The reference backward must be the PLAIN two-pass attention:
         # with remat_attn left on, the probe would compare the run
         # gradient against another kernel-backward gradient and a drift
-        # in the recompute path would be invisible.
-        remat_attn=False,
+        # in the recompute path would be invisible. Same principle for
+        # the round-8 lstm residual knobs: the scan backend keeps no
+        # residuals (so these are already inert there), but pin them
+        # explicitly so the reference stays exact if the backend pin
+        # ever changes — this probe is the run-time police for
+        # --lstm_residuals bf16 drift.
+        remat_attn=False, lstm_cs_window=0, lstm_residuals="f32",
     )
     ref_model = build_model(ref_cfg)
     aux_w = cfg.moe_aux_weight if cfg.moe_experts > 0 else 0.0

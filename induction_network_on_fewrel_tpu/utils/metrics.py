@@ -50,6 +50,18 @@ KNOWN_KINDS = frozenset({
     # wire_bytes_per_step, wire_mb_per_step, dp. obs_report's comms
     # section reads these (headline: wire_mb_per_step).
     "comms",
+    # HBM-roofline telemetry (ISSUE 6): one record per metric window on
+    # BiLSTM runs with the shared step-byte arithmetic at this config's
+    # residual knobs (utils/roofline.step_bytes — the SAME formulas
+    # bench.py stamps and ROOFLINE_r*.json records): step_bytes, step_mb,
+    # lstm_residual_bytes, lstm_cs_window, and corpus_rows when the real
+    # corpus bound is in hand (token-cache runs — obs_report rebuilds the
+    # component table at the same bound). The numbers model the fused-
+    # kernel flagship step AT THIS CONFIG (bench convention), whatever
+    # backend the local process resolved — obs_report's roofline section
+    # reads them (headline: step_mb) and rebuilds the per-component table
+    # from config.json.
+    "roofline",
 })
 
 

@@ -14,6 +14,16 @@ dW/db accumulate in VMEM), and with ``remat_attn`` the attention backward
 is the one-pass kernel (H read once, dH written once, the tanh projection
 and attention weights rebuilt in VMEM from the [M] softmax stats the
 forward saved instead of the [L, M, A] projection).
+
+Round 8 adds the BiLSTM residual knobs (ops/lstm.py windowed-cs remat):
+with ``lstm_cs_window = W > 0`` the forward writes one (h, c) checkpoint
+pair per W-step window instead of the full cs stream, and the backward
+reads d(hs) + the checkpoints + the emb stream only — the in-window
+states are recomputed in VMEM (an extra forward recurrence of FLOPs,
+cheap: the kernel is bytes-bound). ``lstm_residuals`` sets the STORAGE
+dtype of those residual streams/checkpoints independently of the compute
+dtype ("auto" follows it). Flagship at W=8 + bf16 residuals: kernel fwd
+146 -> 97, kernel bwd 227 -> 113, step 799 -> 635 MB (ROOFLINE_r08).
 """
 
 from __future__ import annotations
@@ -21,10 +31,22 @@ from __future__ import annotations
 from induction_network_on_fewrel_tpu.config import ExperimentConfig
 
 
+def _residual_itemsize(cfg: ExperimentConfig, lstm_residuals: str | None) -> int:
+    """Storage width (bytes) of the BiLSTM residual streams/checkpoints:
+    "auto" follows the compute dtype, matching models/build's resolver."""
+    if lstm_residuals is None:
+        lstm_residuals = getattr(cfg, "lstm_residuals", "auto")
+    if lstm_residuals == "auto":
+        return 2 if cfg.compute_dtype == "bfloat16" else 4
+    return {"f32": 4, "bf16": 2}[lstm_residuals]
+
+
 def step_components(
     cfg: ExperimentConfig,
     remat_attn: bool | None = None,
     corpus_rows: int | None = None,
+    lstm_cs_window: int | None = None,
+    lstm_residuals: str | None = None,
 ) -> list[tuple[str, float, float]]:
     """[(component, bytes/step, flops/step)] for the flagship train step.
 
@@ -35,9 +57,17 @@ def step_components(
     the round-5 ledger unchanged (two-pass attention saving the [L, M, A]
     tanh projection); the remat rows model the recompute-in-backward path
     (ops/attn.py "xla_remat").
+    ``lstm_cs_window`` / ``lstm_residuals`` (round 8): None follows the
+    config; window 0 is the round-6 full-residual kernel, W > 0 the
+    windowed-cs remat (module doc). Both model the fused KERNEL design —
+    the arithmetic describes the flagship TPU step regardless of which
+    backend the local process resolved to (same convention as the rest
+    of this ledger).
     """
     if remat_attn is None:
         remat_attn = getattr(cfg, "remat_attn", False)
+    if lstm_cs_window is None:
+        lstm_cs_window = getattr(cfg, "lstm_cs_window", 0)
     B, N, K, Q, L = cfg.batch_size, cfg.n, cfg.k, cfg.q, cfg.max_length
     TQ = N * Q
     M = B * (N * K + TQ)
@@ -57,13 +87,30 @@ def step_components(
     # windowed pos-offset matmul touches [L+1, L*P] windows (negligible).
     rows.append(("embed gather fwd (write emb + read table)", 2 * emb_b, 0))
 
+    # BiLSTM residual streams (round 8): W = 0 saves the full [L, M, 2u]
+    # cs stream (and the backward re-reads hs as a residual too); W > 0
+    # saves one (h, c) checkpoint pair per W-step window — ceil(L/W)
+    # blocks of [M, 2u] each, stored at the residual dtype. ONE home for
+    # the formula: lstm_residual_bytes (the bench diet headline) — the
+    # rows below must stay in sync with it by construction.
+    W = min(int(lstm_cs_window), L) if lstm_cs_window else 0
+    res_b = lstm_residual_bytes(cfg, lstm_cs_window, lstm_residuals)
+
     # Fused BiLSTM kernel FWD: reads emb_t once (gates computed in-kernel
-    # from the 60-wide embedding), writes hs AND cs (saved for backward —
-    # the hs-only variant was evaluated and rejected, ops/lstm.py: the
-    # atanh reconstruction of c from h is ill-conditioned at saturation).
+    # from the 60-wide embedding), writes hs plus the residuals the
+    # backward needs — the full cs stream (W=0; the hs-only variant was
+    # evaluated and rejected, ops/lstm.py: the atanh reconstruction of c
+    # from h is ill-conditioned at saturation) or the windowed (h, c)
+    # checkpoint pairs (W>0, 1/W the write traffic).
     proj_f = 2 * L * M * D * (8 * u)          # input projection, both dirs
     rec_f = 2 * L * M * u * (4 * u) * 2       # recurrence h@whh, both dirs
-    rows.append(("bilstm kernel fwd", emb_b + 2 * hs_b, proj_f + rec_f))
+    if W:
+        rows.append((
+            "bilstm kernel fwd (windowed-cs ckpts)",
+            emb_b + hs_b + res_b, proj_f + rec_f,
+        ))
+    else:
+        rows.append(("bilstm kernel fwd", emb_b + hs_b + res_b, proj_f + rec_f))
 
     att_f = 2 * L * M * 2 * u * A + 2 * L * M * 2 * u
     if remat_attn:
@@ -102,12 +149,24 @@ def step_components(
     rows.append(("episode head fwd (f32)", head_b, ind_f + qp_f + ntn_f))
     rows.append(("episode head bwd", 2 * head_b, 2 * (ind_f + qp_f + ntn_f)))
 
-    # Kernel bwd (recompute gates): reads hs, cs, emb, d(hs); writes demb.
-    # dW/db accumulate in VMEM -> no HBM term.
-    rows.append((
-        "bilstm kernel bwd (recompute gates)",
-        3 * hs_b + 2 * emb_b, 2 * (proj_f + rec_f) + proj_f,
-    ))
+    # Kernel bwd. Full-cs (W=0): reads d(hs), hs, cs, emb; writes demb;
+    # gates recomputed per step; dW/db accumulate in VMEM -> no HBM term.
+    # Windowed (W>0): reads d(hs), the checkpoint pairs, and the emb
+    # stream (the [W, tm, D] window block each recompute AND gradient
+    # sweep share from VMEM); writes demb. The in-window state replay
+    # costs one extra forward recurrence of FLOPs — cheap, the kernel is
+    # bytes-bound (ops/lstm.py module doc).
+    if W:
+        rows.append((
+            "bilstm kernel bwd (in-window recompute)",
+            hs_b + res_b + 2 * emb_b,
+            2 * (proj_f + rec_f) + proj_f + (proj_f + rec_f),
+        ))
+    else:
+        rows.append((
+            "bilstm kernel bwd (recompute gates)",
+            2 * hs_b + res_b + 2 * emb_b, 2 * (proj_f + rec_f) + proj_f,
+        ))
     rows.append(("embed scatter bwd (demb -> rows)", 2 * emb_b, 0))
 
     # Optimizer (f32): non-embedding params p, m, v read + write, grads
@@ -161,11 +220,37 @@ def step_bytes(
     cfg: ExperimentConfig,
     remat_attn: bool | None = None,
     corpus_rows: int | None = None,
+    lstm_cs_window: int | None = None,
+    lstm_residuals: str | None = None,
 ) -> int:
     """Total analytic HBM bytes for one flagship train step."""
     return int(sum(
-        b for _, b, _ in step_components(cfg, remat_attn, corpus_rows)
+        b for _, b, _ in step_components(
+            cfg, remat_attn, corpus_rows, lstm_cs_window, lstm_residuals
+        )
     ))
+
+
+def lstm_residual_bytes(
+    cfg: ExperimentConfig,
+    lstm_cs_window: int | None = None,
+    lstm_residuals: str | None = None,
+) -> int:
+    """Bytes/step the BiLSTM forward writes SOLELY for the backward (the
+    diet headline bench.py stamps): the full [L, M, 2u] cs stream at
+    W = 0, or the windowed (h, c) checkpoint pairs — 2 * ceil(L/W)
+    blocks of [M, 2u] — at W > 0, in the resolved residual dtype. The
+    user-facing hs stream is excluded (the forward writes it
+    regardless)."""
+    if lstm_cs_window is None:
+        lstm_cs_window = getattr(cfg, "lstm_cs_window", 0)
+    L, M = cfg.max_length, episode_rows(cfg)
+    u = cfg.lstm_hidden
+    res = _residual_itemsize(cfg, lstm_residuals)
+    W = min(int(lstm_cs_window), L) if lstm_cs_window else 0
+    if W:
+        return 2 * (-(-L // W)) * M * 2 * u * res
+    return L * M * 2 * u * res
 
 
 # --- collective (ICI) terms — round 7 --------------------------------------

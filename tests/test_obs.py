@@ -429,3 +429,61 @@ def test_evaluate_reports_ci95(tmp_path):
     # sampler would drift; instead just sanity-bound it: σ of accuracies
     # in [0,1] over 8 batches gives CI <= 1.96*0.5/sqrt(8) ~ 0.35.)
     assert m["acc_ci95"] <= 0.4
+
+
+# --- roofline section (ISSUE 6) -------------------------------------------
+
+
+def test_roofline_record_and_report_section(tmp_path, capsys):
+    """A bilstm trainer emits kind="roofline" per metric window (the
+    shared step-byte arithmetic at this config's residual knobs) and
+    obs_report renders the section — step_mb headline, per-component
+    table rebuilt from config.json — with --check green."""
+    cfg = _tiny_cfg(encoder="bilstm", lstm_hidden=8, att_dim=4,
+                    induction_dim=8, ntn_slices=4)
+    model, sampler = _setup(cfg)
+    logger = MetricsLogger(tmp_path, quiet=True)
+    trainer = FewShotTrainer(model, cfg, sampler, logger=logger)
+    try:
+        trainer.train(num_iters=3)
+    finally:
+        trainer.close()
+    (tmp_path / "config.json").write_text(cfg.to_json())
+
+    recs = [
+        json.loads(l)
+        for l in (tmp_path / "metrics.jsonl").read_text().splitlines()
+    ]
+    rl = [r for r in recs if r["kind"] == "roofline"]
+    assert rl, "bilstm run emitted no kind='roofline' records"
+    from induction_network_on_fewrel_tpu.utils.roofline import (
+        lstm_residual_bytes,
+        step_bytes,
+    )
+
+    assert rl[-1]["step_bytes"] == step_bytes(cfg)
+    assert rl[-1]["lstm_residual_bytes"] == lstm_residual_bytes(cfg)
+    assert rl[-1]["step_mb"] == round(step_bytes(cfg) / 1e6, 3)
+
+    assert obs_report.main([str(tmp_path), "--check"]) == 0
+    assert obs_report.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "roofline" in out and "step_mb" in out
+    # The component table came from the shared formulas via config.json.
+    assert "components_mb" in out and "bilstm kernel" in out
+
+
+def test_roofline_summary_without_config_is_headline_only(tmp_path):
+    """No config.json -> the section still carries the headline numbers
+    (the table is best-effort)."""
+    with MetricsLogger(tmp_path, quiet=True) as logger:
+        logger.log(1, "roofline", step_bytes=1000.0, step_mb=0.001,
+                   lstm_residual_bytes=100.0, lstm_cs_window=8.0)
+    summary = obs_report.roofline_summary(
+        [{"kind": "roofline", "step_mb": 0.001, "step_bytes": 1000.0,
+          "lstm_residual_bytes": 100.0, "lstm_cs_window": 8.0}],
+        tmp_path,
+    )
+    assert summary["step_mb"] == 0.001
+    assert "components_mb" not in summary
+    assert obs_report.main([str(tmp_path), "--check"]) == 0

@@ -1,0 +1,118 @@
+"""Tier-1 byte-regression gates (ISSUE 6 satellite): neither the HBM
+step-byte arithmetic nor the wire payload can silently regress.
+
+* STEP-BYTE GATE: ``utils/roofline.step_bytes`` at the flagship config
+  must stay within +2% of the value recorded in the newest
+  ``ROOFLINE_r*.json`` — a formula change (or a knob-default change) that
+  inflates the modeled step shows up here before it ships, the same way
+  the comms ±15% band guards the wire model. The gate reads the artifact
+  so re-emitting the ledger (tools/roofline_ledger.py --json) is the ONE
+  sanctioned way to move the recorded value.
+* FLAGSHIP --strict GATE: ``tools/comms_ledger.py --only-flagship
+  --strict`` must exit 0 — the compiled flagship-shape HLO keeps every
+  collective attributed and the payload inside the ±15% band. (The FULL
+  ledger still carries the documented attribution-debt legs — RUNBOOK
+  §11 — which is why tier-1 pins the flagship-only run, not the suite.)
+* Windowed-cs arithmetic sanity: the round-8 terms behave (windowed <
+  full-cs, bf16 halves residual storage, W >= L clamps) so the headline
+  drop in ROOFLINE_r08 is the formulas, not a transcription.
+"""
+
+import glob
+import json
+import os
+import sys
+
+import pytest
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.utils.roofline import (
+    lstm_residual_bytes,
+    step_bytes,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The flagship ledger config — must match tools/roofline_ledger.py main().
+FLAGSHIP = ExperimentConfig(
+    encoder="bilstm", n=5, k=5, q=5, batch_size=64, max_length=40,
+    vocab_size=400002, compute_dtype="bfloat16", steps_per_call=256,
+    token_cache=True, embed_optimizer="lazy", remat_attn=True,
+)
+
+
+def _latest_roofline() -> dict:
+    paths = sorted(glob.glob(os.path.join(REPO, "ROOFLINE_r*.json")))
+    assert paths, "no ROOFLINE_r*.json artifact in the repo root"
+    with open(paths[-1]) as f:
+        return json.load(f)
+
+
+def test_step_bytes_regression_gate():
+    """step_bytes at the flagship config (production knobs: remat_attn on,
+    the config-default cs window, auto residual dtype) <= the newest
+    recorded round value + 2%."""
+    rec = _latest_roofline()
+    got = step_bytes(FLAGSHIP)
+    ceiling = rec["step_bytes"] * 1.02
+    assert got <= ceiling, (
+        f"flagship step bytes {got} exceed the recorded "
+        f"{rec['step_bytes']} + 2% ({ceiling:.0f}) — a formula or "
+        "knob-default change inflated the modeled step; re-emit the "
+        "ledger (tools/roofline_ledger.py --json ROOFLINE_r<next>.json) "
+        "if the change is intended"
+    )
+    # The A/B twins recorded alongside (round-8 artifacts onward) gate the
+    # policy ladder too, so a regression can't hide in a non-default leg.
+    if "step_bytes_full_cs" in rec:
+        full = step_bytes(FLAGSHIP, lstm_cs_window=0)
+        assert full <= rec["step_bytes_full_cs"] * 1.02
+    if "step_bytes_no_remat" in rec:
+        no_remat = step_bytes(FLAGSHIP, remat_attn=False, lstm_cs_window=0)
+        assert no_remat <= rec["step_bytes_no_remat"] * 1.02
+
+
+def test_windowed_cs_arithmetic_sanity():
+    """Round-8 term behavior: windowed residuals shrink monotonically-ish
+    with W (1/W checkpoint traffic), bf16 halves the storage term, W >= L
+    clamps to one window, and the windowed step undercuts full-cs by the
+    ISSUE-6 target margin (>= 15%) at the flagship shape."""
+    full = lstm_residual_bytes(FLAGSHIP, lstm_cs_window=0)
+    w8 = lstm_residual_bytes(FLAGSHIP, lstm_cs_window=8)
+    w1 = lstm_residual_bytes(FLAGSHIP, lstm_cs_window=1)
+    assert w8 < full
+    # W=1 checkpoints BOTH h and c every step — 2x the cs-only stream.
+    assert w1 == 2 * full
+    assert lstm_residual_bytes(FLAGSHIP, lstm_cs_window=40) == \
+        lstm_residual_bytes(FLAGSHIP, lstm_cs_window=400)
+    assert lstm_residual_bytes(FLAGSHIP, lstm_residuals="bf16") * 2 == \
+        lstm_residual_bytes(FLAGSHIP, lstm_residuals="f32")
+
+    step_win = step_bytes(FLAGSHIP)                      # W=8 default
+    step_full = step_bytes(FLAGSHIP, lstm_cs_window=0)   # round-6/7 policy
+    assert step_win <= 0.85 * step_full, (
+        f"windowed step {step_win} not >=15% under full-cs {step_full} — "
+        "the ISSUE-6 acceptance margin regressed"
+    )
+
+
+def test_comms_ledger_flagship_strict(monkeypatch, capsys):
+    """tools/comms_ledger.py --only-flagship --strict exits 0: the
+    compiled flagship step keeps zero unattributed collectives and the
+    payload inside the ±15% band (the tier-1-automatable guard while the
+    full suite carries the documented debt legs)."""
+    import tools.comms_ledger as cl
+
+    monkeypatch.setattr(
+        sys, "argv", ["comms_ledger.py", "--only-flagship", "--strict"]
+    )
+    rc = cl.main()
+    out = capsys.readouterr().out
+    assert rc == 0, f"flagship strict ledger failed:\n{out}"
+    assert "demb overlap window" in out, (
+        "the compact-demb overlap report is missing from the flagship leg"
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

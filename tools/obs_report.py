@@ -286,6 +286,51 @@ def comms_summary(recs: list[dict]) -> dict | None:
     return out
 
 
+def roofline_summary(recs: list[dict], run_dir: Path) -> dict | None:
+    """HBM-roofline section (ISSUE 6, kind="roofline"): the headline is
+    step_mb — analytic HBM bytes per train step at this config's residual
+    knobs, from the shared arithmetic bench.py stamps and the tier-1 gate
+    holds to ROOFLINE_r*.json (utils/roofline.step_bytes). Per-window
+    restatements of a per-step constant, so the LAST record is the truth.
+    When the run dir carries a config.json the per-component byte table
+    is rebuilt from the same formulas (the full roofline-ledger view)."""
+    rl = [r for r in recs if r.get("kind") == "roofline"]
+    if not rl:
+        return None
+    last = rl[-1]
+    out = {"records": len(rl)}
+    for k in ("step_mb", "step_bytes", "lstm_residual_bytes",
+              "lstm_cs_window", "corpus_rows"):
+        if isinstance(last.get(k), (int, float)):
+            out[k] = last[k]
+    cfg_path = run_dir / "config.json"
+    if cfg_path.exists():
+        try:
+            from induction_network_on_fewrel_tpu.config import (
+                ExperimentConfig,
+            )
+            from induction_network_on_fewrel_tpu.utils.roofline import (
+                step_components,
+            )
+
+            cfg = ExperimentConfig.from_json(cfg_path.read_text())
+            # Same corpus bound as the headline (the record carries it on
+            # real-corpus lazy runs) — else the table's demb/optimizer
+            # rows fall back to the synthetic default and stop summing to
+            # step_mb.
+            u_rows = last.get("corpus_rows")
+            out["components_mb"] = {
+                name: round(b / 1e6, 1)
+                for name, b, _ in step_components(
+                    cfg,
+                    corpus_rows=int(u_rows) if u_rows else None,
+                )
+            }
+        except Exception as e:  # table is best-effort; headline stands
+            out["components_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def health_summary(recs: list[dict]) -> dict:
     events = [r for r in recs if r.get("kind") == "health"]
     by_event: dict[str, int] = {}
@@ -415,7 +460,7 @@ def render(report: dict) -> str:
     for e in errors[:10]:
         lines.append(f"  ! {e}")
     for section in ("train", "mfu", "eval", "serve", "ckpt",
-                    "input_pipeline", "comms", "health",
+                    "input_pipeline", "comms", "roofline", "health",
                     "flight_recorder", "overhead"):
         body = report.get(section)
         if body is None:
@@ -466,6 +511,7 @@ def main(argv=None) -> int:
         "ckpt": ckpt_summary(recs),
         "input_pipeline": data_summary(recs),
         "comms": comms_summary(recs),
+        "roofline": roofline_summary(recs, run_dir),
         "health": health_summary(recs),
         "flight_recorder": recorder_summary(run_dir),
     }
