@@ -12,6 +12,13 @@ today:
   every query routed identically, i.e. the class vectors collapsed.
 * **Serving queue stall** — queue depth > 0 while the served counter stops
   advancing for longer than ``queue_stall_s`` (a wedged batcher worker).
+* **Serving shed-load** — the per-tenant shed counter advancing between
+  serve windows: some tenant is over its admission share and actively
+  shedding traffic (ISSUE 7 fleet serving). Critical + once-latched, so a
+  sustained overload is one incident; re-arms after a shed-free window.
+  Hot-swap publishes (``event="snapshot_swap"`` serve records) surface as
+  WARNING events — an operator reading the health stream sees every
+  weight swap next to whatever it perturbed.
 * **Feed stall / poison** — the training input pipeline (datapipe/) starving
   its consumer: stall ticks (``kind="data"``) whose produced counter stops
   advancing for longer than ``queue_stall_s`` while the trainer waits, a
@@ -103,6 +110,8 @@ class HealthWatchdog:
         self._last_served: int | None = None
         self._stall_since: float | None = None
         self._stall_reported = False
+        # Shed-load state: last aggregate shed counter seen.
+        self._last_shed: int | None = None
         # Feed-stall state (training input pipeline): produced counter and
         # first time it was seen unchanged while the consumer waited.
         self._last_fed: int | None = None
@@ -156,10 +165,36 @@ class HealthWatchdog:
             if kind == "train" and "episodes_per_s" in rec:
                 self._check_throughput(step, float(rec["episodes_per_s"]))
             if kind == "serve":
-                self.observe_queue(
-                    int(rec.get("queue_depth", 0)),
-                    int(rec.get("served", 0)),
-                )
+                if rec.get("event") == "snapshot_swap":
+                    # Visibility, not a failure: every hot-swap publish
+                    # lands in the health stream next to whatever it
+                    # perturbed.
+                    # The logger normalizes scalars to float before hooks
+                    # see them; these two are counts.
+                    as_count = lambda v: (  # noqa: E731
+                        int(v) if isinstance(v, (int, float)) else v
+                    )
+                    self._emit(HealthEvent(
+                        event="snapshot_swap", severity=WARNING, step=step,
+                        message=(
+                            f"hot-swap published params_version "
+                            f"{as_count(rec.get('params_version'))} to "
+                            f"{as_count(rec.get('tenants'))} tenant(s)"
+                        ),
+                        data={
+                            k: rec[k] for k in
+                            ("params_version", "tenants", "slots")
+                            if k in rec
+                        },
+                    ))
+                elif "tenant" not in rec:
+                    # Aggregate serve windows only: per-tenant records
+                    # restate the same counters tenant-by-tenant.
+                    self.observe_queue(
+                        int(rec.get("queue_depth", 0)),
+                        int(rec.get("served", 0)),
+                    )
+                    self._check_shed(step, rec)
             if kind == "data":
                 self.observe_feed(
                     produced=int(rec.get("produced", 0)),
@@ -229,6 +264,39 @@ class HealthWatchdog:
                 return
         self._latched.discard("throughput")  # healthy window re-arms
         self._eps.append(eps)
+
+    def _check_shed(self, step: int, rec: dict) -> None:
+        """Shed-load detection over aggregate serve windows: the shed
+        counter advancing means some tenant is over its admission share
+        and actively shedding. Once-latched (a sustained overload is one
+        incident); a shed-free window re-arms."""
+        shed = rec.get("shed")
+        if not isinstance(shed, (int, float)):
+            return
+        shed = int(shed)
+        prev, self._last_shed = self._last_shed, shed
+        if prev is None:
+            # First window: a nonzero total is still news.
+            prev = 0
+        if shed > prev:
+            if "shed_load" in self._latched:
+                return
+            self._latched.add("shed_load")
+            self._emit(HealthEvent(
+                event="shed_load", severity=CRITICAL, step=step,
+                message=(
+                    f"shed-load active: {shed - prev} per-tenant share "
+                    f"rejections since the last serve window "
+                    f"(total {shed})"
+                ),
+                data={
+                    "shed": shed,
+                    "rejected": int(rec.get("rejected", 0)),
+                    "queue_depth": int(rec.get("queue_depth", 0)),
+                },
+            ))
+        else:
+            self._latched.discard("shed_load")
 
     def observe_feed(
         self,

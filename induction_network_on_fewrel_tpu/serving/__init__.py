@@ -1,25 +1,36 @@
-"""TPU-native few-shot inference engine (serving/).
+"""TPU-native few-shot inference engine (serving/) — fleet-scale.
 
 Turns a trained induction-network checkpoint into a low-latency
-query-answering engine. The induction network's structure makes serving
-cheap (ISSUE 1 / Geng et al. 2019): a support set is distilled ONCE by the
-dynamic-routing loop into per-class vectors, after which each query costs
-one encoder pass plus the neural-tensor score. The pieces:
+multi-tenant query-answering engine. The induction network's structure
+makes serving cheap (ISSUE 1 / Geng et al. 2019): a support set is
+distilled ONCE by the dynamic-routing loop into per-class vectors, after
+which each query costs one encoder pass plus the neural-tensor score.
+ISSUE 7 takes it to fleet shape: the registry is versioned, multi-tenant,
+copy-on-write — the system's public API surface — and the scheduler is a
+continuous cross-bucket batcher. The pieces:
 
-* ``registry``  — ClassVectorRegistry: support sets -> device-resident
-  [N, C] class vectors (encoded once, never re-encoded at query time).
-* ``buckets``   — fixed shape buckets + AOT-compiled query programs, so
-  steady-state serving runs with ZERO recompiles.
-* ``batcher``   — dynamic micro-batcher: request queue with deadlines,
-  bounded-depth backpressure, and partial-bucket flush under pressure.
-* ``stats``     — p50/p99 latency, queue depth, batch occupancy, recompile
-  counters, emitted through utils.metrics.MetricsLogger.
-* ``engine``    — InferenceEngine: wires the above behind submit()/classify(),
-  including the FewRel 2.0 NOTA "no_relation" verdict (Gao et al. 2019).
+* ``registry``  — TenantRegistry: tenant x relation-set support sets ->
+  device-resident [N, C] class vectors published as immutable CoW
+  ``Snapshot``s (shared slot pool, per-tenant NOTA thresholds, atomic
+  zero-recompile hot-swap from training checkpoints).
+* ``buckets``   — fixed shape buckets + AOT-compiled query programs
+  (optionally dp-sharded over a serving mesh), so steady-state serving
+  runs with ZERO recompiles.
+* ``batcher``   — ContinuousBatcher (fleet default): one admission
+  structure over all buckets, launch-on-free, deadline-aware cross-tenant
+  ordering, per-tenant shed-load; DynamicBatcher — the per-bucket
+  micro-batcher, kept as the A/B comparison arm.
+* ``stats``     — p50/p99 latency (aggregate + per tenant), queue depth,
+  batch occupancy, shed/swap/recompile counters, emitted through
+  utils.metrics.MetricsLogger.
+* ``engine``    — InferenceEngine: wires the above behind
+  submit()/classify()/publish_params(), including the FewRel 2.0 NOTA
+  "no_relation" verdict (Gao et al. 2019) under per-tenant thresholds.
 * ``cli``       — the ``serve.py`` entrypoint next to train.py/test.py.
 """
 
 from induction_network_on_fewrel_tpu.serving.batcher import (  # noqa: F401
+    ContinuousBatcher,
     DeadlineExceeded,
     DynamicBatcher,
     Saturated,
@@ -27,6 +38,7 @@ from induction_network_on_fewrel_tpu.serving.batcher import (  # noqa: F401
 from induction_network_on_fewrel_tpu.serving.buckets import (  # noqa: F401
     DEFAULT_BUCKETS,
     QueryProgramCache,
+    make_serving_mesh,
     pad_rows,
     select_bucket,
 )
@@ -34,7 +46,10 @@ from induction_network_on_fewrel_tpu.serving.engine import (  # noqa: F401
     InferenceEngine,
 )
 from induction_network_on_fewrel_tpu.serving.registry import (  # noqa: F401
+    DEFAULT_TENANT,
     ClassVectorRegistry,
+    Snapshot,
+    TenantRegistry,
 )
 from induction_network_on_fewrel_tpu.serving.stats import (  # noqa: F401
     ServingStats,

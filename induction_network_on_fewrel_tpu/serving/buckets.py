@@ -79,6 +79,22 @@ def stack_queries(
     return out
 
 
+def make_serving_mesh(dp: int):
+    """A 1-axis ("dp",) mesh over the first ``dp`` local devices for
+    sharded query scoring. Kept here (not parallel/mesh.py) because the
+    serving mesh has exactly one axis role: split the request batch."""
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if dp > len(devs):
+        raise ValueError(
+            f"serving dp={dp} exceeds the {len(devs)} visible devices"
+        )
+    return Mesh(_np.asarray(devs[:dp]), ("dp",))
+
+
 class QueryProgramCache:
     """AOT-compiled ``score_queries`` executables keyed by (n_classes, bucket).
 
@@ -86,14 +102,24 @@ class QueryProgramCache:
     [bucket, L]) -> logits [bucket, N(+1)]``: params and the class matrix are
     ARGUMENTS, not closure constants (constants bake into the program — the
     same tunneled-backend lesson train/token_cache.py records), so
-    re-registering a class never invalidates a compiled program.
+    re-registering a class never invalidates a compiled program — and a
+    params hot-swap (serving/registry.publish_params) reuses every
+    executable untouched, which is what makes the swap recompile-free.
+
+    ``mesh`` (fleet serving): a ``make_serving_mesh`` over dp devices.
+    Buckets divisible by dp compile with the request axis sharded over
+    ``dp`` (params + class matrix replicated, logits gathered at the
+    output) — the multi-device engine scores one batch across the mesh.
+    Smaller buckets fall back to single-device programs; the cache key is
+    unchanged, so the bucket set still compiles once each.
     """
 
-    def __init__(self, model, stats=None):
+    def __init__(self, model, stats=None, mesh=None):
         import jax
 
         self._jax = jax
         self._stats = stats
+        self._mesh = mesh
         self._exe: dict[tuple[int, int], Any] = {}
         self.compiles = 0
         self.in_warmup = False
@@ -117,7 +143,23 @@ class QueryProgramCache:
         query = {
             k: aval((bucket, max_length), dt) for k, dt in QUERY_DTYPES.items()
         }
-        exe = jax.jit(self._score).lower(p_avals, mat, query).compile()
+        if self._mesh is not None and bucket % self._mesh.shape["dp"] == 0:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(self._mesh, P())
+            row = NamedSharding(self._mesh, P("dp", None))
+            jitted = jax.jit(
+                self._score,
+                in_shardings=(
+                    jax.tree.map(lambda _: rep, p_avals),
+                    rep,
+                    {k: row for k in query},
+                ),
+                out_shardings=rep,
+            )
+        else:
+            jitted = jax.jit(self._score)
+        exe = jitted.lower(p_avals, mat, query).compile()
         self.compiles += 1
         if self._stats is not None:
             self._stats.record_compile(during_warmup=self.in_warmup)

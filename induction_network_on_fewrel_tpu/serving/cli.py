@@ -43,10 +43,27 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--buckets", default="1,2,4,8,16",
                    help="comma-separated micro-batch shape buckets (each is "
                         "one AOT-compiled program)")
+    p.add_argument("--scheduler", default="continuous",
+                   choices=["continuous", "microbatch"],
+                   help="continuous = cross-bucket launch-on-free scheduler "
+                        "(fleet default); microbatch = the per-bucket "
+                        "coalescing batcher (A/B baseline)")
+    p.add_argument("--dp", type=int, default=None,
+                   help="shard query scoring over this many local devices "
+                        "(buckets divisible by dp compile dp-sharded)")
+    p.add_argument("--tenant_share", type=float, default=0.5,
+                   help="per-tenant fraction of --queue_depth before that "
+                        "tenant sheds (continuous scheduler; binds only "
+                        "once a second tenant has submitted)")
+    p.add_argument("--nota_threshold", type=float, default=None,
+                   help="NOTA threshold for the default tenant: biases the "
+                        "learned no-relation logit (na_rate>0 checkpoints) "
+                        "or sets an open-set floor on the best class logit")
     p.add_argument("--queue_depth", type=int, default=64,
                    help="bounded request-queue depth (backpressure bound)")
     p.add_argument("--batch_window_ms", type=float, default=2.0,
-                   help="max time to wait coalescing a bucket")
+                   help="max time to wait coalescing a bucket "
+                        "(microbatch scheduler only)")
     p.add_argument("--deadline_ms", type=float, default=1000.0,
                    help="default per-request deadline")
     p.add_argument("--demo_queries", type=int, default=32,
@@ -96,7 +113,8 @@ def _fresh_engine(args, buckets, logger=None, watchdog=None):
         max_queue_depth=args.queue_depth,
         batch_window_s=args.batch_window_ms / 1e3,
         default_deadline_s=args.deadline_ms / 1e3,
-        logger=logger, watchdog=watchdog,
+        scheduler=args.scheduler, tenant_share=args.tenant_share,
+        dp=args.dp, logger=logger, watchdog=watchdog,
     )
 
 
@@ -151,7 +169,8 @@ def serve_main(argv=None) -> int:
             max_queue_depth=args.queue_depth,
             batch_window_s=args.batch_window_ms / 1e3,
             default_deadline_s=args.deadline_ms / 1e3,
-            logger=logger, watchdog=watchdog,
+            scheduler=args.scheduler, tenant_share=args.tenant_share,
+            dp=args.dp, logger=logger, watchdog=watchdog,
         )
     else:
         engine = _fresh_engine(args, buckets, logger=logger,
@@ -160,7 +179,10 @@ def serve_main(argv=None) -> int:
     try:
         ds = _support_dataset(args, engine.registry.k, seed=args.seed)
         names = engine.register_dataset(ds, max_classes=args.max_classes)
-        print(f"registered {len(names)} classes x {engine.registry.k} shots",
+        if args.nota_threshold is not None:
+            engine.set_nota_threshold(args.nota_threshold)
+        print(f"registered {len(names)} classes x {engine.registry.k} shots "
+              f"(scheduler={args.scheduler})",
               file=sys.stderr)
         compiled = engine.warmup()
         print(f"warmup: {compiled} bucket programs compiled "

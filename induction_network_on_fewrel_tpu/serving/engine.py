@@ -1,17 +1,30 @@
-"""InferenceEngine: checkpoint -> low-latency few-shot query answering.
+"""InferenceEngine: checkpoint -> low-latency multi-tenant few-shot serving.
 
-Wires the serving pieces end to end: a ``ClassVectorRegistry`` (supports
-distilled once, resident on device), a ``QueryProgramCache`` (AOT-compiled
-per-bucket query programs), a ``DynamicBatcher`` (deadlines, backpressure,
-partial flush), and ``ServingStats``. Steady state per query: host
-tokenization + one pre-compiled program run (encoder pass + NTN score
-against the resident class matrix) — no support work, no compiles.
+Wires the serving pieces end to end: a ``TenantRegistry`` (supports
+distilled once into immutable copy-on-write snapshots, resident on
+device), a ``QueryProgramCache`` (AOT-compiled per-bucket query programs,
+optionally dp-sharded over a device mesh), a scheduler (the continuous
+cross-bucket batcher by default; the per-bucket micro-batcher kept as the
+A/B arm), and ``ServingStats`` (aggregate + per-tenant). Steady state per
+query: host tokenization + one pre-compiled program run (encoder pass +
+NTN score against the tenant's resident class matrix) — no support work,
+no compiles.
 
-NOTA (FewRel 2.0, Gao et al. 2019): checkpoints trained with ``na_rate > 0``
-carry a learned none-of-the-above head; its logit is appended as class N,
-and a query that lands there gets the explicit ``"no_relation"`` verdict —
-the open-world answer a serving engine needs for traffic that matches no
-registered relation.
+Fleet behaviors (ISSUE 7):
+
+* **Tenancy end to end** — ``submit(..., tenant=...)`` scopes a query to
+  one tenant's snapshot: its relation set, its class matrix, its NOTA
+  threshold. Batches never mix tenants (one program call scores against
+  one class matrix).
+* **Atomic hot-swap** — ``publish_params``/``publish_checkpoint`` push
+  new weights from a training artifact into the live engine: in-flight
+  batches hold their pinned snapshot and finish on the old weights; no
+  query drops, nothing recompiles (programs take params as arguments).
+* **NOTA per tenant** (FewRel 2.0, Gao et al. 2019): checkpoints trained
+  with ``na_rate > 0`` carry a learned none-of-the-above head whose logit
+  is appended as class N; the tenant threshold biases it. Tenants served
+  by a no-NOTA checkpoint can still set an open-set floor: best-class
+  logit below the threshold -> ``"no_relation"``.
 """
 
 from __future__ import annotations
@@ -22,14 +35,22 @@ import time
 import numpy as np
 
 from induction_network_on_fewrel_tpu.obs.spans import span
-from induction_network_on_fewrel_tpu.serving.batcher import DynamicBatcher, Request
+from induction_network_on_fewrel_tpu.serving.batcher import (
+    ContinuousBatcher,
+    DynamicBatcher,
+    Request,
+)
 from induction_network_on_fewrel_tpu.serving.buckets import (
     DEFAULT_BUCKETS,
     QueryProgramCache,
+    make_serving_mesh,
     select_bucket,
     stack_queries,
 )
-from induction_network_on_fewrel_tpu.serving.registry import ClassVectorRegistry
+from induction_network_on_fewrel_tpu.serving.registry import (
+    DEFAULT_TENANT,
+    TenantRegistry,
+)
 from induction_network_on_fewrel_tpu.serving.stats import ServingStats
 
 NO_RELATION = "no_relation"
@@ -47,6 +68,9 @@ class InferenceEngine:
         max_queue_depth: int = 64,
         batch_window_s: float = 0.002,
         default_deadline_s: float = 1.0,
+        scheduler: str = "continuous",
+        tenant_share: float = 0.5,
+        dp: int | None = None,
         logger=None,
         watchdog=None,
         start: bool = True,
@@ -63,13 +87,18 @@ class InferenceEngine:
                 "encoder) — the serving engine cannot tokenize queries "
                 "through them; serve a full checkpoint instead"
             )
+        if scheduler not in ("continuous", "microbatch"):
+            raise ValueError(
+                f"scheduler must be 'continuous' or 'microbatch', "
+                f"got {scheduler!r}"
+            )
         self.cfg = cfg
         self.model = model
-        self.params = params
         self.tokenizer = tokenizer
         self.nota = cfg.na_rate > 0
         self.max_length = cfg.max_length
         self.default_deadline_s = default_deadline_s
+        self.scheduler = scheduler
         self._logger = logger
         self._emit_step = 0
         # Telemetry spine (obs/): serving counters join the shared
@@ -82,18 +111,38 @@ class InferenceEngine:
 
         self.stats = ServingStats()
         self.stats.bind_registry()
-        self.registry = ClassVectorRegistry(
-            model, params, tokenizer, k=k if k is not None else cfg.k
+        self.registry = TenantRegistry(
+            model, params, tokenizer,
+            k=k if k is not None else cfg.k, logger=logger,
         )
-        self.programs = QueryProgramCache(model, stats=self.stats)
-        self.batcher = DynamicBatcher(
-            self._execute_batch,
-            buckets=buckets,
-            max_queue_depth=max_queue_depth,
-            batch_window_s=batch_window_s,
-            stats=self.stats,
-            start=start,
+        self._mesh = make_serving_mesh(dp) if dp and dp > 1 else None
+        self.programs = QueryProgramCache(
+            model, stats=self.stats, mesh=self._mesh
         )
+        if scheduler == "continuous":
+            self.batcher = ContinuousBatcher(
+                self._execute_group,
+                buckets=buckets,
+                max_queue_depth=max_queue_depth,
+                tenant_share=tenant_share,
+                stats=self.stats,
+                start=start,
+            )
+        else:
+            self.batcher = DynamicBatcher(
+                self._execute_batch,
+                buckets=buckets,
+                max_queue_depth=max_queue_depth,
+                batch_window_s=batch_window_s,
+                stats=self.stats,
+                start=start,
+            )
+
+    # ``params`` stays readable for compat (loadgen parity harness, tests)
+    # but the truth lives in the registry — hot-swaps move it.
+    @property
+    def params(self):
+        return self.registry.params
 
     # --- construction from a trained artifact ----------------------------
 
@@ -174,41 +223,78 @@ class InferenceEngine:
         )
         return cls(model, state.params, cfg, tok, **kw)
 
-    # --- registration ----------------------------------------------------
+    # --- registration / tenant lifecycle ----------------------------------
 
-    def register_class(self, name: str, instances) -> None:
-        self.registry.register(name, instances)
+    def register_class(
+        self, name: str, instances, tenant: str = DEFAULT_TENANT
+    ) -> None:
+        self.registry.register(name, instances, tenant=tenant)
 
-    def register_dataset(self, dataset, max_classes: int | None = None) -> list[str]:
-        return self.registry.register_dataset(dataset, max_classes=max_classes)
+    def register_dataset(
+        self, dataset, max_classes: int | None = None,
+        tenant: str = DEFAULT_TENANT,
+    ) -> list[str]:
+        return self.registry.register_dataset(
+            dataset, max_classes=max_classes, tenant=tenant
+        )
+
+    def set_nota_threshold(
+        self, threshold: float | None, tenant: str = DEFAULT_TENANT
+    ) -> None:
+        self.registry.set_nota_threshold(threshold, tenant=tenant)
 
     @property
     def class_names(self) -> tuple[str, ...]:
         return self.registry.names
 
     def warmup(self) -> int:
-        """AOT-compile every bucket's query program for the current class
-        count; returns how many programs this call compiled. After warmup,
+        """AOT-compile every bucket's query program for every registered
+        tenant's class count; returns how many programs this call compiled
+        (tenants sharing a class count share programs). After warmup,
         steady-state traffic is zero-recompile (stats.steady_recompiles
         counts violations)."""
-        mat = np.asarray(self.registry.class_matrix())
-        n, c = mat.shape
-        return self.programs.warmup(
-            self.params, n, c, self.batcher.buckets, self.max_length
-        )
+        compiled = 0
+        for tenant in self.registry.tenants():
+            snap = self.registry.snapshot(tenant)
+            n, c = np.asarray(snap.matrix).shape
+            compiled += self.programs.warmup(
+                snap.params, n, c, self.batcher.buckets, self.max_length
+            )
+        return compiled
+
+    # --- hot-swap publish -------------------------------------------------
+
+    def publish_params(self, new_params) -> int:
+        """Atomic hot-swap: every tenant's class vectors re-distill with
+        ``new_params`` and republish; in-flight batches finish on their
+        pinned snapshot; zero recompiles. Returns the params_version."""
+        version = self.registry.publish_params(new_params)
+        self.stats.record_swap()
+        return version
+
+    def publish_checkpoint(self, ckpt_dir: str) -> int:
+        """Hot-swap straight from a training checkpoint directory."""
+        version = self.registry.publish_checkpoint(ckpt_dir)
+        self.stats.record_swap()
+        return version
 
     # --- query path ------------------------------------------------------
 
-    def submit(self, instance, deadline_s: float | None = None):
-        """Tokenize one query and enqueue it; returns a Future resolving to
-        the verdict dict. Raises ``Saturated`` under backpressure."""
-        if len(self.registry) == 0:
-            raise ValueError("no classes registered — register supports first")
+    def submit(
+        self, instance, deadline_s: float | None = None,
+        tenant: str = DEFAULT_TENANT,
+    ):
+        """Tokenize one query and enqueue it for ``tenant``; returns a
+        Future resolving to the verdict dict. Raises ``Saturated`` under
+        backpressure (with ``.tenant`` set when the breach is this
+        tenant's share — shed-load)."""
+        self.registry.snapshot(tenant)   # raises for unknown tenants
         t = self.tokenizer(self._as_instance(instance))
         query = {"word": t.word, "pos1": t.pos1, "pos2": t.pos2, "mask": t.mask}
         fut = self.batcher.submit(
             query,
             deadline_s if deadline_s is not None else self.default_deadline_s,
+            tenant=tenant,
         )
         if self.watchdog is not None:
             # Stall observation from the CLIENT thread: the execute-path
@@ -221,40 +307,90 @@ class InferenceEngine:
             )
         return fut
 
-    def classify(self, instance, deadline_s: float | None = None) -> dict:
+    def classify(
+        self, instance, deadline_s: float | None = None,
+        tenant: str = DEFAULT_TENANT,
+    ) -> dict:
         """Synchronous submit + wait."""
-        fut = self.submit(instance, deadline_s)
+        fut = self.submit(instance, deadline_s, tenant=tenant)
         timeout = (deadline_s or self.default_deadline_s) + 5.0
         return fut.result(timeout=timeout)
 
+    def _execute_group(self, tenant: str, batch: list[Request]) -> None:
+        """Continuous-scheduler callback: one tenant's batch."""
+        self._run_group(tenant, batch)
+        self._maybe_emit()
+
     def _execute_batch(self, batch: list[Request]) -> None:
-        # Atomic (names, matrix) snapshot: concurrent registration must not
-        # skew the verdict index -> name mapping (registry.snapshot doc).
-        names, class_mat = self.registry.snapshot()
+        """Micro-batcher callback: the collected batch may mix tenants
+        (the old scheduler's single queue is tenant-blind) — split and run
+        one program call per tenant sub-batch. This is exactly the
+        occupancy tax the continuous scheduler removes, kept as the honest
+        A/B baseline."""
+        by_tenant: dict[str, list[Request]] = {}
+        for r in batch:
+            by_tenant.setdefault(r.tenant, []).append(r)
+        for tenant, group in by_tenant.items():
+            try:
+                self._run_group(tenant, group)
+            except BaseException as e:  # noqa: BLE001 — isolate per tenant
+                # One tenant's failure (dropped mid-flight, bad matrix)
+                # fails ITS futures only; the other tenants' sub-batches
+                # still execute.
+                for r in group:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+        self._maybe_emit()
+
+    def _run_group(self, tenant: str, batch: list[Request]) -> None:
+        # Pinned snapshot: (params, matrix, names, threshold) captured
+        # atomically — concurrent registration or a hot-swap publish must
+        # not skew the verdict index -> name mapping mid-batch, and the
+        # batch must score against the weights its matrix was distilled
+        # with (registry.Snapshot doc).
+        snap = self.registry.snapshot(tenant)
         bucket = select_bucket(len(batch), self.batcher.buckets)
         with span("serve/stack", rows=len(batch), bucket=bucket):
             query = stack_queries([r.query for r in batch], bucket)
         t0 = time.monotonic()
         with span("serve/execute", rows=len(batch), bucket=bucket):
-            logits = self.programs.run(self.params, class_mat, query)
+            logits = self.programs.run(snap.params, snap.matrix, query)
         exec_s = time.monotonic() - t0
         self.stats.record_batch(len(batch), bucket, exec_s)
         now = time.monotonic()
         for row, req in zip(logits, batch):   # zip drops the pad rows
-            idx = int(np.argmax(row))
-            is_nota = self.nota and idx == len(names)
-            verdict = {
-                "label": NO_RELATION if is_nota else names[idx],
-                "class_index": -1 if is_nota else idx,
-                "nota": is_nota,
-                "logits": {n: float(row[i]) for i, n in enumerate(names)},
-                "latency_ms": round((now - req.enqueued_at) * 1e3, 3),
-            }
-            if self.nota:
-                verdict["logits"][NO_RELATION] = float(row[len(names)])
-            self.stats.record_done(now - req.enqueued_at)
+            verdict = self._verdict(row, snap)
+            verdict["latency_ms"] = round((now - req.enqueued_at) * 1e3, 3)
+            self.stats.record_done(now - req.enqueued_at, tenant=tenant)
             req.future.set_result(verdict)
-        self._maybe_emit()
+
+    def _verdict(self, row: np.ndarray, snap) -> dict:
+        """One logits row -> verdict dict under the tenant's NOTA policy.
+
+        With a trained NOTA head the snapshot threshold BIASES the
+        no-relation logit (0.0 = the head's own calibration, the
+        pre-fleet behavior); without one, a set threshold is an open-set
+        floor on the best class logit. Ties resolve toward the class —
+        matching the plain-argmax convention the pre-tenant engine had."""
+        names = snap.names
+        n = len(names)
+        best = int(np.argmax(row[:n]))
+        thr = snap.nota_threshold
+        if self.nota:
+            is_nota = float(row[n]) + (thr or 0.0) > float(row[best])
+        else:
+            is_nota = thr is not None and float(row[best]) < thr
+        verdict = {
+            "label": NO_RELATION if is_nota else names[best],
+            "class_index": -1 if is_nota else best,
+            "nota": is_nota,
+            "tenant": snap.tenant,
+            "snapshot_version": snap.version,
+            "logits": {nm: float(row[i]) for i, nm in enumerate(names)},
+        }
+        if self.nota:
+            verdict["logits"][NO_RELATION] = float(row[n])
+        return verdict
 
     # --- observability / lifecycle ---------------------------------------
 

@@ -1,11 +1,14 @@
 """Serving observability: latency percentiles, queue depth, batch occupancy,
-and recompile counters.
+recompile counters — aggregate AND per tenant (fleet serving, ISSUE 7).
 
 All counters are updated from two threads (submitters + the batcher worker),
 so every mutation holds one lock; reads produce a consistent ``snapshot()``
 dict that is also the record emitted through the existing
 ``utils.metrics.MetricsLogger`` (kind="serve" lines in metrics.jsonl — the
-same machine-readable channel train/val metrics use).
+same machine-readable channel train/val metrics use). Per-tenant state
+emits as ONE kind="serve" record per tenant carrying a ``tenant`` string
+field (scalar-only schema preserved); the aggregate record has no tenant
+field — tools/obs_report.py's serve section splits on that.
 """
 
 from __future__ import annotations
@@ -13,21 +16,60 @@ from __future__ import annotations
 import threading
 
 
-class ServingStats:
-    """Thread-safe serving counters + a bounded latency reservoir."""
+class _Reservoir:
+    """Bounded latency reservoir: deterministic round-robin replacement
+    past the cap — percentiles then reflect a sliding window over recent
+    traffic, which is the operationally useful view anyway."""
 
-    # Bounded reservoir: long soaks must not grow host memory without limit.
-    # Replacement is deterministic round-robin past the cap — percentiles
-    # then reflect a sliding window over recent traffic, which is the
-    # operationally useful view anyway.
+    __slots__ = ("cap", "ms", "nxt")
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self.ms: list[float] = []
+        self.nxt = 0
+
+    def add(self, ms: float) -> None:
+        if len(self.ms) < self.cap:
+            self.ms.append(ms)
+        else:
+            self.ms[self.nxt] = ms
+            self.nxt = (self.nxt + 1) % self.cap
+
+    def percentile(self, q: float) -> float | None:
+        lat = sorted(self.ms)
+        if not lat:
+            return None
+        i = min(len(lat) - 1, max(0, int(round(q / 100.0 * len(lat))) - 1))
+        return lat[i]
+
+
+class _TenantStats:
+    """Per-tenant slice of the counters (guarded by the owner's lock)."""
+
+    __slots__ = ("served", "rejected", "shed", "deadline_missed", "lat")
+
+    def __init__(self, reservoir_cap: int):
+        self.served = 0
+        self.rejected = 0
+        self.shed = 0
+        self.deadline_missed = 0
+        self.lat = _Reservoir(reservoir_cap)
+
+
+class ServingStats:
+    """Thread-safe serving counters + bounded latency reservoirs."""
+
+    # Long soaks must not grow host memory without limit.
     MAX_SAMPLES = 65536
+    TENANT_SAMPLES = 8192   # per-tenant reservoirs are narrower
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._lat_ms: list[float] = []
-        self._lat_next = 0          # round-robin slot past MAX_SAMPLES
+        self._lat = _Reservoir(self.MAX_SAMPLES)
+        self._tenants: dict[str, _TenantStats] = {}
         self.served = 0             # futures resolved with a verdict
         self.rejected = 0           # backpressure rejections at submit
+        self.shed = 0               # per-tenant share breaches (shed-load)
         self.deadline_missed = 0    # expired before execution
         self.batches = 0            # bucket executions
         self.batch_rows = 0         # real (unpadded) rows executed
@@ -37,26 +79,56 @@ class ServingStats:
         self.warmup_compiles = 0    # programs compiled by warmup()
         self.steady_compiles = 0    # programs compiled AFTER warmup — the
         #                             zero-recompile acceptance counter
+        self.swaps = 0              # atomic hot-swap publishes applied
 
     # --- recording -------------------------------------------------------
 
-    def record_done(self, latency_s: float) -> None:
+    def _tenant(self, tenant: str | None) -> _TenantStats | None:
+        if tenant is None:
+            return None
+        ts = self._tenants.get(tenant)
+        if ts is None:
+            ts = self._tenants[tenant] = _TenantStats(self.TENANT_SAMPLES)
+        return ts
+
+    def record_done(self, latency_s: float, tenant: str | None = None) -> None:
         with self._lock:
             self.served += 1
             ms = latency_s * 1e3
-            if len(self._lat_ms) < self.MAX_SAMPLES:
-                self._lat_ms.append(ms)
-            else:
-                self._lat_ms[self._lat_next] = ms
-                self._lat_next = (self._lat_next + 1) % self.MAX_SAMPLES
+            self._lat.add(ms)
+            ts = self._tenant(tenant)
+            if ts is not None:
+                ts.served += 1
+                ts.lat.add(ms)
 
-    def record_rejected(self) -> None:
+    def record_rejected(self, tenant: str | None = None) -> None:
         with self._lock:
             self.rejected += 1
+            ts = self._tenant(tenant)
+            if ts is not None:
+                ts.rejected += 1
 
-    def record_deadline_miss(self) -> None:
+    def record_shed(self, tenant: str) -> None:
+        """A per-tenant share breach: THIS tenant sheds while the queue
+        still admits others (counted in rejected too — a shed is a
+        rejection, with attribution)."""
+        with self._lock:
+            self.rejected += 1
+            self.shed += 1
+            ts = self._tenant(tenant)
+            ts.rejected += 1
+            ts.shed += 1
+
+    def record_swap(self) -> None:
+        with self._lock:
+            self.swaps += 1
+
+    def record_deadline_miss(self, tenant: str | None = None) -> None:
         with self._lock:
             self.deadline_missed += 1
+            ts = self._tenant(tenant)
+            if ts is not None:
+                ts.deadline_missed += 1
 
     def record_batch(self, rows: int, bucket: int, exec_s: float) -> None:
         with self._lock:
@@ -90,11 +162,7 @@ class ServingStats:
         """Nearest-rank percentile over the latency reservoir (no numpy
         import on the submit path; the reservoir is small)."""
         with self._lock:
-            lat = sorted(self._lat_ms)
-        if not lat:
-            return None
-        i = min(len(lat) - 1, max(0, int(round(q / 100.0 * len(lat))) - 1))
-        return lat[i]
+            return self._lat.percentile(q)
 
     def bind_registry(self, registry=None, prefix: str = "serve") -> None:
         """Expose these counters through the shared obs/ CounterRegistry
@@ -117,6 +185,8 @@ class ServingStats:
 
         attr("served", "futures resolved with a verdict")
         attr("rejected", "backpressure rejections at submit")
+        attr("shed", "per-tenant share breaches (shed-load)")
+        attr("swaps", "atomic hot-swap publishes applied")
         attr("deadline_missed", "requests expired before execution")
         attr("batches", "bucket executions")
         attr("warmup_compiles", "programs compiled by warmup()")
@@ -149,14 +219,16 @@ class ServingStats:
         self._bound_fns = []
 
     def snapshot(self, queue_depth: int | None = None) -> dict:
-        p50, p99 = self.percentile_ms(50), self.percentile_ms(99)
         with self._lock:
+            p50 = self._lat.percentile(50)
+            p99 = self._lat.percentile(99)
             occ = (
                 self.batch_rows / self.batch_slots if self.batch_slots else 0.0
             )
             snap = {
                 "served": self.served,
                 "rejected": self.rejected,
+                "shed": self.shed,
                 "deadline_missed": self.deadline_missed,
                 "batches": self.batches,
                 "batch_occupancy": round(occ, 4),
@@ -164,11 +236,34 @@ class ServingStats:
                 "p99_ms": round(p99, 3) if p99 is not None else 0.0,
                 "warmup_compiles": self.warmup_compiles,
                 "steady_recompiles": self.steady_compiles,
+                "swaps": self.swaps,
             }
         if queue_depth is not None:
             snap["queue_depth"] = queue_depth
         return snap
 
+    def tenant_snapshot(self) -> dict[str, dict]:
+        """Consistent per-tenant view: {tenant: {served, rejected, shed,
+        deadline_missed, p50_ms, p99_ms}}."""
+        with self._lock:
+            out = {}
+            for name, ts in self._tenants.items():
+                p50, p99 = ts.lat.percentile(50), ts.lat.percentile(99)
+                out[name] = {
+                    "served": ts.served,
+                    "rejected": ts.rejected,
+                    "shed": ts.shed,
+                    "deadline_missed": ts.deadline_missed,
+                    "p50_ms": round(p50, 3) if p50 is not None else 0.0,
+                    "p99_ms": round(p99, 3) if p99 is not None else 0.0,
+                }
+            return out
+
     def emit(self, logger, step: int, queue_depth: int | None = None) -> None:
-        """One kind="serve" record through utils.metrics.MetricsLogger."""
+        """The aggregate kind="serve" record plus ONE kind="serve" record
+        per tenant (distinguished by the ``tenant`` string field — every
+        field stays a scalar, so the metrics.jsonl schema contract and
+        ``obs_report --check`` hold unchanged)."""
         logger.log(step, kind="serve", **self.snapshot(queue_depth))
+        for tenant, snap in sorted(self.tenant_snapshot().items()):
+            logger.log(step, kind="serve", tenant=tenant, **snap)

@@ -30,6 +30,15 @@ from typing import Callable
 
 # The kinds the telemetry stream is allowed to carry — the contract
 # tools/obs_report.py --check enforces. Extend here, not ad hoc.
+#
+# kind="serve" carries three record shapes since the fleet upgrade
+# (ISSUE 7), all scalar-only so the schema contract is unchanged: the
+# AGGREGATE counters record (no ``tenant``/``event`` field), one
+# PER-TENANT record per registered tenant carrying ``tenant`` (str) with
+# that tenant's served/rejected/shed/p50_ms/p99_ms slice, and
+# CONTROL-PLANE event records (``event="snapshot_swap"`` with
+# params_version/tenants/slots) marking atomic hot-swap publishes.
+# tools/obs_report.py's serve section splits on those fields.
 KNOWN_KINDS = frozenset({
     "train", "val", "eval", "test", "profile", "serve", "health",
     "divergence", "divergence_stop",

@@ -359,3 +359,64 @@ def test_collective_bytes_matches_rows():
     per_op = cl.collective_bytes(_HLO_SNIPPET)
     assert per_op["all-gather"]["bytes"] == 16 * 96 * 50 * 4
     assert per_op["all-reduce"]["count"] == 3
+
+
+_HLO_PROVENANCE = """\
+HloModule jit_step
+ENTRY %main {
+  %p0 = f32[64,8]{1,0} parameter(0)
+  %named = f32[64,8]{1,0} add(f32[64,8]{1,0} %p0, f32[64,8]{1,0} %p0), metadata={op_name="jit(step)/jit(main)/opt/zero1_update/add" source_file="s.py"}
+  %fused = f32[64,8]{1,0} fusion(f32[64,8]{1,0} %named), kind=kLoop, calls=%fc.1
+  %reshard = f32[64,16]{1,0} all-gather(f32[64,8]{1,0} %fused), channel_id=9, dimensions={1}
+  %orphan.1 = f32[4]{0} parameter(1)
+  %orphan.2 = f32[8]{0} all-gather(f32[4]{0} %orphan.1), channel_id=10, dimensions={0}
+}
+"""
+
+
+def test_provenance_resolves_gspmd_reshards():
+    """A metadata-less collective (GSPMD-inserted reshard) attributes via
+    its operand chain to the nearest op_name — labeled reshard:<producer>
+    and marked derived — so the four round-8 debt legs (zero1/dp4_tp2/
+    sp/ep) name every row and full-suite --strict can gate tier-1. A
+    collective whose ancestors carry NO metadata stays None (still a
+    strict failure): provenance is a resolution mechanism, not a blanket
+    pass."""
+    rows = cl.collective_rows(_HLO_PROVENANCE)
+    by_bytes = {r["bytes"]: r for r in rows}
+    resolved = by_bytes[64 * 16 * 4]
+    assert resolved["source"] == "reshard:fwd:opt/zero1_update/add"
+    assert resolved["derived"] is True
+    # Operand chain dead-ends at a parameter -> genuinely unattributable.
+    assert by_bytes[8 * 4]["source"] is None
+    assert cl.check_attribution("prov", rows) == 8 * 4
+
+
+def test_provenance_never_rewrites_direct_attribution():
+    """Ops with their own op_name keep it verbatim — the derived label
+    only fills gaps (the _HLO_SNIPPET expectations above already pin
+    this; here the explicit invariant)."""
+    rows = cl.collective_rows(_HLO_SNIPPET)
+    for r in rows:
+        if r["source"] is not None:
+            assert not r["source"].startswith("reshard:") or r.get("derived")
+
+
+def test_comms_ledger_full_suite_strict(monkeypatch, capsys):
+    """ROADMAP item 5 closed: the FULL dryrun ledger (every parallelism
+    leg) runs --strict and exits 0 — zero unattributed collective bytes
+    anywhere, including the four formerly metadata-less GSPMD reshard
+    legs (zero1 49 KB, dp4_tp2 12.7 KB, sp 6.1 KB, ep 1.6 KB) now
+    resolved by dataflow provenance. The flagship leg's --strict twin
+    runs in tests/test_roofline.py; together tier-1 gates the complete
+    suite, so an anonymous collective can never land again."""
+    import sys as _sys
+
+    monkeypatch.setattr(
+        _sys, "argv", ["comms_ledger.py", "--skip-flagship", "--strict"]
+    )
+    rc = cl.main()
+    out = capsys.readouterr()
+    assert rc == 0, f"full-suite strict ledger failed:\n{out.err}\n{out.out}"
+    assert "UNATTRIBUTED" not in out.out
+    assert "reshard:" in out.out or "zero1" in out.out

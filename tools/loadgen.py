@@ -1,12 +1,24 @@
 #!/usr/bin/env python3
-"""Serving load generator: closed- and open-loop traffic against the
-inference engine, reporting a throughput/latency table.
+"""Serving load generator: multi-tenant closed/open-loop traffic against the
+inference engine, with a continuous-vs-microbatch scheduler A/B and a
+hot-swap-under-load drill, stamped into a ``SERVE_r*.json`` artifact.
 
-The acceptance demo for serving/ (ISSUE 1): on CPU against a synthetic-data
-checkpoint it must show ZERO recompiles after warmup (the query path
-compiles at most one program per shape bucket) and print p50/p99 latency +
-throughput; it also verifies registry-based scoring matches the direct
-episodic forward pass to numerical tolerance before generating load.
+The acceptance harness for serving/ (ISSUE 1, fleet-scaled by ISSUE 7). On
+CPU against a synthetic-data checkpoint it must show:
+
+* **Parity** — registry-based scoring matches the direct episodic forward
+  pass to numerical tolerance, PER TENANT, before any load is generated.
+* **Zero recompiles** — after warmup, steady-state traffic of every batch
+  size and every tenant compiles nothing (the acceptance gate).
+* **Scheduler A/B** (``--scheduler ab``) — the same offered load runs once
+  under the continuous cross-bucket scheduler and once under the
+  per-bucket micro-batcher; the artifact records sustained qps and
+  p50/p99 per arm, per tenant.
+* **Hot-swap drill** (``--swap_drill``) — a dedicated open-loop phase in
+  which a new params version publishes into the live engine mid-load (the
+  train->serve recipe); the drill asserts ZERO dropped queries and ZERO
+  recompiles across the swap. Separate phase so the publish's device
+  contention never skews the scheduler A/B numbers.
 
 * closed loop: C workers, each submitting synchronously — throughput is
   latency-bound, the classic "how fast can N clients go" number.
@@ -15,6 +27,8 @@ episodic forward pass to numerical tolerance before generating load.
 
 Usage:
     python tools/loadgen.py [--ckpt DIR] [--mode closed|open|both]
+        [--scheduler continuous|microbatch|ab] [--tenants 2]
+        [--swap_drill] [--artifact SERVE_r01.json]
         [--concurrency 4] [--rate 200] [--duration 5] [--N 5] [--K 5]
 
 No --ckpt: a synthetic-data checkpoint is created in a temp dir (fresh-init
@@ -34,37 +48,51 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def parse_args():
+def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--ckpt", default=None,
                    help="checkpoint dir to serve (default: build a "
                         "synthetic-data checkpoint in a temp dir)")
     p.add_argument("--mode", default="both", choices=["closed", "open", "both"])
+    p.add_argument("--scheduler", default="ab",
+                   choices=["continuous", "microbatch", "ab"],
+                   help="which scheduler to drive; 'ab' runs the same load "
+                        "under both and records the comparison")
+    p.add_argument("--tenants", type=int, default=2,
+                   help="registered tenants, each with its own synthetic "
+                        "relation set; traffic round-robins across them")
+    p.add_argument("--swap_drill", action="store_true",
+                   help="publish a new params version mid-load and assert "
+                        "zero dropped queries + zero recompiles")
+    p.add_argument("--artifact", default=None, metavar="PATH",
+                   help="write the SERVE_r*.json artifact here")
     p.add_argument("--concurrency", type=int, default=4,
                    help="closed-loop client threads")
     p.add_argument("--rate", type=float, default=200.0,
-                   help="open-loop offered rate (queries/s)")
+                   help="open-loop offered rate (queries/s, all tenants)")
     p.add_argument("--duration", type=float, default=5.0,
                    help="seconds per load phase")
-    p.add_argument("--N", type=int, default=5, help="registered classes")
+    p.add_argument("--N", type=int, default=5, help="classes per tenant")
     p.add_argument("--K", type=int, default=5, help="shots per class")
     p.add_argument("--na_rate", type=int, default=0,
                    help="train-config NOTA rate for the synthetic checkpoint "
                         "(>0 builds the no-relation head)")
     p.add_argument("--buckets", default="1,2,4,8,16")
     p.add_argument("--queue_depth", type=int, default=64)
+    p.add_argument("--tenant_share", type=float, default=0.5)
     p.add_argument("--deadline_ms", type=float, default=1000.0)
     p.add_argument("--batch_window_ms", type=float, default=2.0)
+    p.add_argument("--serving_dp", type=int, default=None,
+                   help="dp-shard query scoring over this many devices")
     p.add_argument("--device", default="cpu", choices=["cpu", "tpu"])
     p.add_argument("--seed", type=int, default=0)
-    return p.parse_args()
+    return p.parse_args(argv)
 
 
 def make_synthetic_checkpoint(args, tmpdir: str) -> str:
     """Fresh-init induction weights saved through the real CheckpointManager
     (so the engine exercises the genuine restore path)."""
     import jax
-    import numpy as np
 
     from induction_network_on_fewrel_tpu.config import ExperimentConfig
     from induction_network_on_fewrel_tpu.data import make_synthetic_glove
@@ -95,14 +123,48 @@ def make_synthetic_checkpoint(args, tmpdir: str) -> str:
     return ckpt
 
 
-def check_registry_parity(engine, ds) -> float:
+def build_engine(args, ckpt: str, scheduler: str):
+    from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
+
+    return InferenceEngine.from_checkpoint(
+        ckpt, device=args.device, k=args.K,
+        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        max_queue_depth=args.queue_depth,
+        batch_window_s=args.batch_window_ms / 1e3,
+        default_deadline_s=args.deadline_ms / 1e3,
+        scheduler=scheduler, tenant_share=args.tenant_share,
+        dp=args.serving_dp,
+    )
+
+
+def register_tenants(engine, args) -> dict:
+    """``--tenants`` synthetic relation sets, one per tenant (distinct
+    seeds -> distinct supports, the multi-tenant workload); returns
+    {tenant: dataset}."""
+    from induction_network_on_fewrel_tpu.data import make_synthetic_fewrel
+
+    tenants = {}
+    for t in range(max(args.tenants, 1)):
+        name = f"tenant{t}"
+        ds = make_synthetic_fewrel(
+            num_relations=args.N, instances_per_relation=args.K + 10,
+            vocab_size=2000, seed=args.seed + 101 * t,
+        )
+        engine.register_dataset(ds, tenant=name)
+        tenants[name] = ds
+    return tenants
+
+
+def check_registry_parity(engine, ds, tenant: str = "default") -> float:
     """Registry scoring vs the direct episodic forward pass: one episode of
     the registered supports + held-out queries through BOTH paths."""
     import numpy as np
 
     from induction_network_on_fewrel_tpu.serving.buckets import QUERY_DTYPES
 
-    k, names = engine.registry.k, list(engine.class_names)
+    k = engine.registry.k
+    snap = engine.registry.snapshot(tenant)
+    names = list(snap.names)
     tok = engine.tokenizer
 
     def stack(insts, lead):
@@ -118,7 +180,9 @@ def check_registry_parity(engine, ds) -> float:
         (len(names), k),
     )
     qry = stack([ds.instances[r][-1] for r in names], (len(names),))
-    direct = np.asarray(engine.model.apply(engine.params, sup, qry))[0]
+    direct = np.asarray(
+        engine.model.apply(snap.params, sup, qry)
+    )[0]
     # The served side pads to a real shape bucket (exactly what the batcher
     # does), so this check reuses warmed programs instead of compiling a
     # one-off shape that would trip the steady-recompile counter.
@@ -129,14 +193,26 @@ def check_registry_parity(engine, ds) -> float:
 
     bucket = select_bucket(len(names), engine.batcher.buckets)
     served = engine.programs.run(
-        engine.params, engine.registry.class_matrix(),
+        snap.params, snap.matrix,
         {key: pad_rows(qry[key][0], bucket) for key in qry},
     )[: len(names)]
     return float(np.max(np.abs(direct - served)))
 
 
-def run_closed(engine, pool, concurrency, duration, rng):
-    lat, errs = [], [0]
+def _pools(tenants: dict, k: int) -> dict:
+    """Held-out (post-support) query instances per tenant."""
+    return {
+        t: [inst for r in ds.rel_names for inst in ds.instances[r][k:]]
+        for t, ds in tenants.items()
+    }
+
+
+def run_closed(engine, pools, concurrency, duration, rng):
+    """C synchronous workers round-robining tenants; returns per-tenant
+    latency lists + error count + wall."""
+    names = list(pools)
+    lat = {t: [] for t in names}
+    errs = [0]
     stop = time.monotonic() + duration
     lock = threading.Lock()
 
@@ -144,18 +220,23 @@ def run_closed(engine, pool, concurrency, duration, rng):
         import numpy as np
 
         r = np.random.default_rng(seed)
-        mine = []
+        mine = {t: [] for t in names}
+        i = seed
         while time.monotonic() < stop:
+            tenant = names[i % len(names)]
+            i += 1
+            pool = pools[tenant]
             inst = pool[int(r.integers(len(pool)))]
             t0 = time.monotonic()
             try:
-                engine.classify(inst)
-                mine.append(time.monotonic() - t0)
+                engine.classify(inst, tenant=tenant)
+                mine[tenant].append(time.monotonic() - t0)
             except Exception:  # noqa: BLE001 — counted, load continues
                 with lock:
                     errs[0] += 1
         with lock:
-            lat.extend(mine)
+            for t in names:
+                lat[t].extend(mine[t])
 
     threads = [
         threading.Thread(target=worker, args=(i,)) for i in range(concurrency)
@@ -169,38 +250,71 @@ def run_closed(engine, pool, concurrency, duration, rng):
     return lat, errs[0], wall
 
 
-def run_open(engine, pool, rate, duration, rng):
-    """Poisson arrivals at ``rate``/s; non-adaptive (futures collected at
-    the end) — saturation surfaces as Saturated rejections + p99 growth."""
-    futures, lat, rejected = [], [], 0
-    stop = time.monotonic() + duration
-    next_t = time.monotonic()
+def run_open(engine, pools, rate, duration, rng, swap_at=None, swap_fn=None):
+    """Poisson arrivals at ``rate``/s round-robining tenants; non-adaptive
+    (futures collected at the end) — saturation surfaces as Saturated
+    rejections + p99 growth. ``swap_fn`` fires once after ``swap_at``
+    seconds (the hot-swap-under-load drill)."""
+    names = list(pools)
+    futures, rejected = [], 0
+    lat = {t: [] for t in names}
+    start = time.monotonic()
+    stop = start + duration
+    next_t = start
     i = 0
+    swap_info = None
+    swap_thread = None
     while time.monotonic() < stop:
         now = time.monotonic()
+        if (swap_fn is not None and swap_info is None
+                and now - start >= swap_at):
+            # Publish from a SIDE thread — the control plane is not the
+            # request path, and a publish that blocked arrivals would
+            # understate the offered load it is drilled under.
+            swap_info = {
+                "at_s": round(now - start, 3),
+                "inflight_at_swap": engine.batcher.queue_depth,
+            }
+
+            def _publish(info=swap_info):
+                t0 = time.monotonic()
+                try:
+                    info["params_version"] = swap_fn()
+                except Exception as e:  # noqa: BLE001 — drill must report, not die
+                    info["error"] = repr(e)
+                info["publish_s"] = round(time.monotonic() - t0, 4)
+
+            swap_thread = threading.Thread(target=_publish)
+            swap_thread.start()
+            continue
         if now < next_t:
             time.sleep(min(next_t - now, 0.01))
             continue
         next_t += rng.exponential(1.0 / rate)
+        tenant = names[i % len(names)]
+        pool = pools[tenant]
         inst = pool[int(rng.integers(len(pool)))]
-        t0 = time.monotonic()
         try:
-            futures.append((t0, engine.submit(inst)))
+            futures.append((tenant, engine.submit(inst, tenant=tenant)))
         except Exception:  # noqa: BLE001 — Saturated backpressure
             rejected += 1
         i += 1
     t_end = time.monotonic()
-    deadline_miss = 0
-    for t0, fut in futures:
+    if swap_thread is not None:
+        swap_thread.join(timeout=60.0)
+    deadline_miss = dropped = 0
+    for tenant, fut in futures:
         try:
             # The verdict's own latency_ms (enqueue -> verdict), not the
             # time of this post-hoc result() call — futures resolve while
             # the arrival loop is still generating.
-            lat.append(fut.result(timeout=30.0)["latency_ms"] / 1e3)
-        except Exception:  # noqa: BLE001 — DeadlineExceeded etc.
+            lat[tenant].append(fut.result(timeout=30.0)["latency_ms"] / 1e3)
+        except TimeoutError:  # DeadlineExceeded subclasses TimeoutError
             deadline_miss += 1
-    wall = t_end - (stop - duration)
-    return lat, rejected, deadline_miss, wall, i
+        except Exception:  # noqa: BLE001 — anything else IS a dropped query
+            dropped += 1
+    wall = t_end - start
+    return lat, rejected, deadline_miss, dropped, wall, i, swap_info
 
 
 def pct(lat, q):
@@ -210,8 +324,113 @@ def pct(lat, q):
     return s[min(len(s) - 1, max(0, int(round(q / 100 * len(s))) - 1))] * 1e3
 
 
-def main() -> int:
-    args = parse_args()
+def pct_ms(lat, q):
+    """Artifact-safe percentile: None (valid JSON) when the list is empty
+    — a fully-shed tenant or fully-rejected phase must not write NaN into
+    SERVE_r*.json."""
+    return round(pct(lat, q), 2) if lat else None
+
+
+def _flat(lat_by_tenant: dict) -> list:
+    return [x for lats in lat_by_tenant.values() for x in lats]
+
+
+def _per_tenant(lat_by_tenant: dict) -> dict:
+    return {
+        t: {
+            "served": len(lats),
+            "p50_ms": pct_ms(lats, 50),
+            "p99_ms": pct_ms(lats, 99),
+        }
+        for t, lats in sorted(lat_by_tenant.items())
+    }
+
+
+def drive_one(engine, args, rng, swap_fn=None) -> dict:
+    """Full load sequence against one engine: parity per tenant, warmup,
+    closed + open phases, then the hot-swap drill as its OWN open-loop
+    phase. Returns the result dict for this scheduler arm.
+
+    The drill phase is deliberately separate from the measured A/B
+    phases: the publish re-distills every slot on the same device the
+    query programs run on, so overlapping it with a measured phase
+    attributes publish contention to the scheduler under test (measured:
+    it doubled the open-loop p99 of whichever arm it ran in)."""
+    tenants = register_tenants(engine, args)
+    compiled = engine.warmup()
+    print(f"warmup: {compiled} bucket programs "
+          f"(buckets={list(engine.batcher.buckets)}, "
+          f"tenants={len(tenants)}, scheduler={engine.scheduler})",
+          file=sys.stderr)
+
+    parity = {}
+    for tenant, ds in tenants.items():
+        delta = check_registry_parity(engine, ds, tenant=tenant)
+        parity[tenant] = delta
+        print(f"parity[{tenant}]: registry vs direct forward "
+              f"max|delta| = {delta:.2e}", file=sys.stderr)
+
+    pools = _pools(tenants, args.K)
+    out = {
+        "scheduler": engine.scheduler,
+        "parity_max_delta": {t: float(d) for t, d in parity.items()},
+        "warmup_compiles": compiled,
+    }
+    if any(not (d < 1e-4) for d in parity.values()):
+        out["parity_ok"] = False
+        return out
+    out["parity_ok"] = True
+
+    if args.mode in ("closed", "both"):
+        lat, errs, wall = run_closed(
+            engine, pools, args.concurrency, args.duration, rng
+        )
+        flat = _flat(lat)
+        out["closed"] = {
+            "concurrency": args.concurrency,
+            "qps": round(len(flat) / wall, 1),
+            "p50_ms": pct_ms(flat, 50),
+            "p99_ms": pct_ms(flat, 99),
+            "errors": errs,
+            "per_tenant": _per_tenant(lat),
+        }
+    if args.mode in ("open", "both"):
+        lat, rej, miss, dropped, wall, offered, _ = run_open(
+            engine, pools, args.rate, args.duration, rng,
+        )
+        flat = _flat(lat)
+        out["open"] = {
+            "offered_qps": round(offered / wall, 1),
+            "qps": round(len(flat) / wall, 1),
+            "p50_ms": pct_ms(flat, 50),
+            "p99_ms": pct_ms(flat, 99),
+            "rejected": rej, "deadline_miss": miss, "dropped": dropped,
+            "per_tenant": _per_tenant(lat),
+        }
+    if swap_fn is not None:
+        drill_s = max(2.0, args.duration / 2)
+        lat, rej, miss, dropped, wall, offered, swap_info = run_open(
+            engine, pools, args.rate, drill_s, rng,
+            swap_at=drill_s / 2, swap_fn=swap_fn,
+        )
+        flat = _flat(lat)
+        swap_info.update({
+            "offered_qps": round(offered / wall, 1),
+            "served": len(flat),
+            "p50_ms": pct_ms(flat, 50),
+            "p99_ms": pct_ms(flat, 99),
+            "rejected": rej, "deadline_miss": miss, "dropped": dropped,
+        })
+        out["swap_drill"] = swap_info
+
+    snap = engine.stats.snapshot(queue_depth=engine.batcher.queue_depth)
+    out["stats"] = snap
+    out["per_tenant_stats"] = engine.stats.tenant_snapshot()
+    return out
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
     import numpy as np
 
     from induction_network_on_fewrel_tpu.cli import select_device
@@ -219,10 +438,6 @@ def main() -> int:
 
     select_device(ExperimentConfig(device=args.device), "auto")
 
-    from induction_network_on_fewrel_tpu.data import make_synthetic_fewrel
-    from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
-
-    rng = np.random.default_rng(args.seed)
     tmp = None
     ckpt = args.ckpt
     if ckpt is None:
@@ -230,78 +445,99 @@ def main() -> int:
         print("building synthetic-data checkpoint...", file=sys.stderr)
         ckpt = make_synthetic_checkpoint(args, tmp.name)
 
-    engine = InferenceEngine.from_checkpoint(
-        ckpt, device=args.device, k=args.K,
-        buckets=tuple(int(b) for b in args.buckets.split(",")),
-        max_queue_depth=args.queue_depth,
-        batch_window_s=args.batch_window_ms / 1e3,
-        default_deadline_s=args.deadline_ms / 1e3,
+    arms = (
+        ["continuous", "microbatch"] if args.scheduler == "ab"
+        else [args.scheduler]
     )
+    results = {}
+    rc = 0
     try:
-        ds = make_synthetic_fewrel(
-            num_relations=args.N, instances_per_relation=args.K + 10,
-            vocab_size=2000, seed=args.seed,
-        )
-        engine.register_dataset(ds)
-        compiled = engine.warmup()
-        print(f"warmup: {compiled} bucket programs "
-              f"(buckets={list(engine.batcher.buckets)})", file=sys.stderr)
+        for arm in arms:
+            rng = np.random.default_rng(args.seed)  # same arrivals per arm
+            engine = build_engine(args, ckpt, arm)
+            try:
+                swap_fn = None
+                if args.swap_drill:
+                    # Re-publish the engine's own weights: the full swap
+                    # machinery runs (re-distill every slot, republish
+                    # every tenant, bump params_version) under live load —
+                    # the drill measures disruption, not verdict change.
+                    swap_fn = lambda e=engine: e.publish_params(e.params)  # noqa: E731
+                results[arm] = drive_one(engine, args, rng, swap_fn=swap_fn)
+            finally:
+                engine.close()
 
-        delta = check_registry_parity(engine, ds)
-        print(f"registry vs direct forward: max|delta| = {delta:.2e}",
-              file=sys.stderr)
-        if not delta < 1e-4:
-            print("FAIL: registry parity out of tolerance", file=sys.stderr)
-            return 1
+            r = results[arm]
+            if not r.get("parity_ok"):
+                print(f"FAIL[{arm}]: registry parity out of tolerance",
+                      file=sys.stderr)
+                rc = 1
+            snap = r.get("stats", {})
+            print(f"[{arm}] occupancy {snap.get('batch_occupancy')} "
+                  f"served {snap.get('served')} "
+                  f"recompiles {snap.get('steady_recompiles')}")
+            if snap.get("steady_recompiles", 0) > 0:
+                print(f"FAIL[{arm}]: query path recompiled after warmup",
+                      file=sys.stderr)
+                rc = 1
+            drill = r.get("swap_drill")
+            if drill is not None:
+                if drill.get("params_version") is None:
+                    # Publish thread raised (recorded in drill["error"]) or
+                    # never finished — the drill FAILED, not the loadgen.
+                    print(f"FAIL[{arm}]: hot-swap publish did not complete: "
+                          f"{drill.get('error', 'publish thread hung')}",
+                          file=sys.stderr)
+                    rc = 1
+                else:
+                    print(f"[{arm}] swap drill: published "
+                          f"v{drill['params_version']} "
+                          f"in {drill.get('publish_s')}s with "
+                          f"{drill['inflight_at_swap']} in flight -> "
+                          f"dropped {drill['dropped']}")
+                if drill["dropped"] > 0:
+                    print(f"FAIL[{arm}]: hot-swap dropped queries",
+                          file=sys.stderr)
+                    rc = 1
 
-        pool = [
-            inst for r in ds.rel_names for inst in ds.instances[r][args.K:]
-        ]
-        rows = []
-        if args.mode in ("closed", "both"):
-            lat, errs, wall = run_closed(
-                engine, pool, args.concurrency, args.duration, rng
-            )
-            rows.append({
-                "mode": f"closed c={args.concurrency}",
-                "offered_qps": "-",
-                "qps": round(len(lat) / wall, 1),
-                "p50_ms": round(pct(lat, 50), 2),
-                "p99_ms": round(pct(lat, 99), 2),
-                "rejected": errs, "deadline_miss": 0,
-            })
-        if args.mode in ("open", "both"):
-            lat, rej, miss, wall, offered = run_open(
-                engine, pool, args.rate, args.duration, rng
-            )
-            rows.append({
-                "mode": f"open r={args.rate:g}/s",
-                "offered_qps": round(offered / wall, 1),
-                "qps": round(len(lat) / wall, 1),
-                "p50_ms": round(pct(lat, 50), 2),
-                "p99_ms": round(pct(lat, 99), 2),
-                "rejected": rej, "deadline_miss": miss,
-            })
+        report = {
+            "config": {
+                "tenants": args.tenants, "N": args.N, "K": args.K,
+                "buckets": args.buckets, "queue_depth": args.queue_depth,
+                "tenant_share": args.tenant_share,
+                "rate": args.rate, "concurrency": args.concurrency,
+                "duration": args.duration, "device": args.device,
+                "serving_dp": args.serving_dp, "seed": args.seed,
+                "swap_drill": bool(args.swap_drill),
+            },
+            "arms": results,
+        }
+        if len(results) == 2:
+            c, m = results["continuous"], results["microbatch"]
+            comparison = {}
+            if "closed" in c and "closed" in m:
+                comparison["closed_qps_continuous"] = c["closed"]["qps"]
+                comparison["closed_qps_microbatch"] = m["closed"]["qps"]
+                comparison["closed_qps_ratio"] = round(
+                    c["closed"]["qps"] / max(m["closed"]["qps"], 1e-9), 3
+                )
+            if "open" in c and "open" in m:
+                comparison["open_p99_continuous_ms"] = c["open"]["p99_ms"]
+                comparison["open_p99_microbatch_ms"] = m["open"]["p99_ms"]
+                if m["open"]["p99_ms"]:
+                    comparison["open_p99_ratio"] = round(
+                        c["open"]["p99_ms"] / m["open"]["p99_ms"], 3
+                    )
+            report["comparison"] = comparison
+            print("A/B: " + json.dumps(comparison))
 
-        snap = engine.stats.snapshot(queue_depth=engine.batcher.queue_depth)
-        hdr = ("mode", "offered_qps", "qps", "p50_ms", "p99_ms",
-               "rejected", "deadline_miss")
-        widths = [max(len(h), *(len(str(r[h])) for r in rows)) for h in hdr]
-        print("  ".join(h.ljust(w) for h, w in zip(hdr, widths)))
-        for r in rows:
-            print("  ".join(str(r[h]).ljust(w) for h, w in zip(hdr, widths)))
-        print(f"batch occupancy: {snap['batch_occupancy']:.2f}  "
-              f"batches: {snap['batches']}  served: {snap['served']}")
-        print(f"recompiles after warmup: {snap['steady_recompiles']} "
-              f"(warmup compiled {snap['warmup_compiles']})")
-        print(json.dumps({"parity_max_delta": delta, **snap,
-                          "rows": rows}))
-        if snap["steady_recompiles"] > 0:
-            print("FAIL: query path recompiled after warmup", file=sys.stderr)
-            return 1
-        return 0
+        print(json.dumps(report))
+        if args.artifact:
+            with open(args.artifact, "w") as f:
+                json.dump(report, f, indent=1)
+            print(f"wrote {args.artifact}", file=sys.stderr)
+        return rc
     finally:
-        engine.close()
         if tmp is not None:
             tmp.cleanup()
 

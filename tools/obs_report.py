@@ -165,20 +165,56 @@ def eval_summary(recs: list[dict]) -> dict | None:
 
 
 def serve_summary(recs: list[dict]) -> dict | None:
+    """Serving section (ISSUE 7 fleet upgrade): the aggregate stream is
+    the records WITHOUT a ``tenant`` field; per-tenant records restate the
+    counters tenant-by-tenant (one kind="serve" record per tenant per emit
+    — serving/stats.ServingStats.emit), and ``event`` records mark
+    control-plane actions (hot-swap publishes). The section renders the
+    aggregate headline, a per-tenant p50/p99 table, and shed/swap event
+    counts."""
     serves = [r for r in recs if r.get("kind") == "serve"]
     if not serves:
         return None
-    last = serves[-1]
-    return {
-        "records": len(serves),
-        **{
+    events = [r for r in serves if isinstance(r.get("event"), str)]
+    tenant_recs = [
+        r for r in serves
+        if isinstance(r.get("tenant"), str) and not isinstance(
+            r.get("event"), str
+        )
+    ]
+    aggregate = [
+        r for r in serves
+        if not isinstance(r.get("event"), str)
+        and not isinstance(r.get("tenant"), str)
+    ]
+    out: dict = {"records": len(serves)}
+    if aggregate:
+        last = aggregate[-1]
+        out.update({
             k: last[k] for k in (
-                "served", "rejected", "deadline_missed", "batches",
+                "served", "rejected", "shed", "deadline_missed", "batches",
                 "batch_occupancy", "p50_ms", "p99_ms", "queue_depth",
-                "steady_recompiles",
+                "steady_recompiles", "swaps",
             ) if k in last
-        },
-    }
+        })
+    if tenant_recs:
+        # Last record per tenant is that tenant's current counters.
+        by_tenant: dict[str, dict] = {}
+        for r in tenant_recs:
+            by_tenant[r["tenant"]] = {
+                k: r[k] for k in (
+                    "served", "rejected", "shed", "deadline_missed",
+                    "p50_ms", "p99_ms",
+                ) if k in r
+            }
+        out["tenants"] = {t: by_tenant[t] for t in sorted(by_tenant)}
+    swaps = [r for r in events if r.get("event") == "snapshot_swap"]
+    if swaps:
+        out["swap_events"] = len(swaps)
+        last_swap = swaps[-1]
+        if isinstance(last_swap.get("params_version"), (int, float)):
+            out["params_version"] = int(last_swap["params_version"])
+    return out
 
 
 def ckpt_summary(recs: list[dict]) -> dict | None:
@@ -467,7 +503,16 @@ def render(report: dict) -> str:
             continue
         lines.append(f"-- {section} --")
         for k, v in body.items():
-            lines.append(f"  {k}: {v}")
+            if isinstance(v, dict) and all(
+                isinstance(sv, dict) for sv in v.values()
+            ) and v:
+                # Table-of-dicts (e.g. serve.tenants): one row per key.
+                lines.append(f"  {k}:")
+                for sk in v:
+                    row = " ".join(f"{a}={b}" for a, b in v[sk].items())
+                    lines.append(f"    {sk}: {row}")
+            else:
+                lines.append(f"  {k}: {v}")
     return "\n".join(lines)
 
 
