@@ -393,14 +393,17 @@ def _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips) -> i
 
 def _serving_leg(jax, seconds: float = 1.5, tenants: int = 2,
                  concurrency: int = 4):
-    """{scheduler: {qps, p50_ms, p99_ms, occupancy, steady_recompiles}} —
-    the same closed loop driven through the continuous and micro-batch
-    schedulers on a small in-process engine (2 tenants, fresh-init
-    weights; tiny cnn encoder so the leg's 2x4 bucket compiles stay
-    seconds on CPU). The comparison is scheduler-relative: everything
-    else — model, tenants, traffic — is identical across arms. The load
-    loop and percentile convention are tools/loadgen.py's own (one home —
-    a fix to either applies to both harnesses)."""
+    """{scheduler: {qps, p50_ms, p99_ms, occupancy, steady_recompiles,
+    trace}} — the same closed loop driven through the continuous and
+    micro-batch schedulers on a small in-process engine (2 tenants,
+    fresh-init weights; tiny cnn encoder so the leg's 2x4 bucket compiles
+    stay seconds on CPU). The comparison is scheduler-relative:
+    everything else — model, tenants, traffic — is identical across
+    arms. The load loop and percentile convention are tools/loadgen.py's
+    own (one home — a fix to either applies to both harnesses). ``trace``
+    carries the sampled segment-breakdown medians + exemplar trace_ids
+    (ISSUE 9), so a scheduler A/B in the BENCH trajectory attributes
+    WHICH stage moved (queue vs pack vs execute), not just e2e p99."""
     import argparse
 
     import numpy as np
@@ -432,6 +435,7 @@ def _serving_leg(jax, seconds: float = 1.5, tenants: int = 2,
     for sched in ("continuous", "microbatch"):
         engine = InferenceEngine(
             model, params, cfg, tok, scheduler=sched, buckets=(1, 2, 4, 8),
+            trace_sample=0.25,
         )
         try:
             pools = _pools(register_tenants(engine, gen_args), cfg.k)
@@ -448,6 +452,10 @@ def _serving_leg(jax, seconds: float = 1.5, tenants: int = 2,
                 "p99_ms": round(pct(flat, 99), 2) if flat else None,
                 "occupancy": snap["batch_occupancy"],
                 "steady_recompiles": snap["steady_recompiles"],
+                # Sampled segment medians + exemplar trace_ids: the A/B
+                # attributes the stage (queue/pack/execute/respond), not
+                # just the end-to-end number.
+                "trace": engine.stats.trace_summary(),
             }
             print(
                 f"bench: serving[{sched}]: {out[sched]['qps']} qps, "

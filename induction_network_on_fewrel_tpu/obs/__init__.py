@@ -3,42 +3,62 @@
 One shared model for what used to be three fragmented mechanisms:
 
 * ``spans``    — host-side timed regions (ring-buffered, named-scope
-                 bridged to XPlane traces).
+                 bridged to XPlane traces) + request-scoped trace
+                 contexts with cross-thread propagation (ISSUE 9).
 * ``health``   — run-health watchdog over the metrics stream (NaN/Inf,
-                 throughput regression, routing collapse, queue stall).
+                 throughput regression, routing collapse, queue stall),
+                 plus the per-tenant SLO burn-rate engine with
+                 auto-capture diagnostics.
 * ``recorder`` — flight recorder; dumps the last-N window on crash,
                  SIGTERM, or a watchdog trip.
-* ``export``   — counter/gauge registry + Prometheus text exposition.
+* ``export``   — counter/gauge/histogram registry + Prometheus text
+                 exposition (latency histograms carry exemplar
+                 trace_ids).
 
 ``tools/obs_report.py`` renders the emitted stream (metrics.jsonl +
-flight_recorder.json) into a single run report and schema-checks it.
+flight_recorder.json) into a single run report — per-request trace
+waterfalls included — and schema-checks it.
 """
 
 from induction_network_on_fewrel_tpu.obs.export import (
     CounterRegistry,
+    Histogram,
     get_registry,
     set_registry,
 )
 from induction_network_on_fewrel_tpu.obs.health import (
+    DiagnosticsCapture,
     HealthEvent,
     HealthWatchdog,
+    SLOEngine,
+    SLOObjective,
 )
 from induction_network_on_fewrel_tpu.obs.recorder import FlightRecorder
 from induction_network_on_fewrel_tpu.obs.spans import (
     SpanTracker,
+    TraceContext,
+    TraceSampler,
     get_tracker,
+    new_trace_id,
     set_tracker,
     span,
 )
 
 __all__ = [
     "CounterRegistry",
+    "DiagnosticsCapture",
     "FlightRecorder",
     "HealthEvent",
     "HealthWatchdog",
+    "Histogram",
+    "SLOEngine",
+    "SLOObjective",
     "SpanTracker",
+    "TraceContext",
+    "TraceSampler",
     "get_registry",
     "get_tracker",
+    "new_trace_id",
     "set_registry",
     "set_tracker",
     "span",

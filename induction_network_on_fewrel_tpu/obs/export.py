@@ -9,11 +9,21 @@ the same model:
 * ``gauge(name)`` — last-written values.
 * ``gauge_fn(name, fn)`` — computed at render time (e.g. queue depth read
   from the live batcher instead of mirrored on every mutation).
+* ``histogram(name)`` — bucketed distributions (serving latency), rendered
+  as the standard ``_bucket``/``_sum``/``_count`` family. Each bucket
+  remembers the most recent **exemplar trace_id** observed into it
+  (ISSUE 9), emitted in OpenMetrics exemplar syntax — a scrape of the
+  p99 bucket hands the operator a concrete traced request to pull the
+  waterfall for, closing the metric -> trace loop.
 
 ``to_prometheus()`` renders the standard text exposition format
 (``# TYPE``/``# HELP`` + one sample per line) so the output can be served
 from any HTTP handler or dropped into a textfile collector; nothing here
-imports an HTTP server or a client library.
+imports an HTTP server or a client library. Exemplars use the
+OpenMetrics spelling (`` # {trace_id="..."} value`` after a bucket
+sample) — scrapers speaking only the legacy format should be pointed at
+an OpenMetrics-capable endpoint when histograms are bound, or the
+exemplars stripped (they appear ONLY on histogram ``_bucket`` lines).
 """
 
 from __future__ import annotations
@@ -71,6 +81,59 @@ class Gauge:
             return self._value
 
 
+class Histogram:
+    """Fixed-bucket histogram with per-bucket exemplars.
+
+    ``observe(v, exemplar=trace_id)`` increments the first bucket whose
+    upper bound holds ``v`` (cumulative rendering happens at exposition
+    time) and stamps that bucket's exemplar. Buckets are upper bounds in
+    the metric's own unit; +Inf is implicit.
+    """
+
+    DEFAULT_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                  1000.0, 2500.0)
+
+    __slots__ = ("bounds", "_counts", "_sum", "_total", "_exemplars", "_lock")
+
+    def __init__(self, bounds: tuple[float, ...] = DEFAULT_MS):
+        self.bounds = tuple(sorted(bounds))
+        self._counts = [0] * (len(self.bounds) + 1)   # last = +Inf
+        self._exemplars: list[tuple[str, float] | None] = (
+            [None] * (len(self.bounds) + 1)
+        )
+        self._sum = 0.0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float, exemplar: str | None = None) -> None:
+        i = 0
+        for i, b in enumerate(self.bounds):  # noqa: B007 — i used after
+            if v <= b:
+                break
+        else:
+            i = len(self.bounds)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._total += 1
+            if exemplar is not None:
+                self._exemplars[i] = (exemplar, float(v))
+
+    @property
+    def value(self) -> float:
+        """Registry-snapshot scalar: the observation count (histograms
+        render fully only in the Prometheus exposition)."""
+        with self._lock:
+            return float(self._total)
+
+    def state(self) -> tuple[list[int], float, int, list]:
+        with self._lock:
+            return (
+                list(self._counts), self._sum, self._total,
+                list(self._exemplars),
+            )
+
+
 class CounterRegistry:
     """Named counters/gauges with idempotent registration: asking for the
     same name twice returns the same instrument, so independent modules
@@ -105,6 +168,27 @@ class CounterRegistry:
                 )
             return inst
 
+    def histogram(
+        self, name: str, bounds: tuple[float, ...] = Histogram.DEFAULT_MS,
+        help: str = "",
+    ) -> Histogram:
+        """Bucketed distribution; idempotent like counter/gauge (the
+        FIRST registration's bounds win — re-asking returns the existing
+        instrument unchanged)."""
+        _check_name(name)
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                if name in self._fns:
+                    raise ValueError(f"{name!r} already registered as gauge_fn")
+                inst = self._instruments[name] = Histogram(bounds)
+                self._help[name] = help
+            elif not isinstance(inst, Histogram):
+                raise ValueError(
+                    f"{name!r} already registered as {type(inst).__name__}"
+                )
+            return inst
+
     def gauge_fn(self, name: str, fn: Callable[[], float], help: str = "") -> None:
         """Register a pull-style gauge evaluated at render time.
         Re-registration replaces the callback (latest wins) — a fresh
@@ -116,18 +200,26 @@ class CounterRegistry:
             self._fns[name] = fn
             self._help[name] = help
 
-    def unregister(self, name: str, fn: Callable[[], float] | None = None) -> None:
+    def unregister(
+        self, name: str, fn: Callable[[], float] | None = None,
+        inst=None,
+    ) -> None:
         """Drop an instrument or gauge_fn. Idempotent. Lets a closing
         component (e.g. ServingStats.unbind_registry) release the
         callbacks that would otherwise pin it in the global registry and
-        keep rendering stale values after its engine is gone. With ``fn``,
-        the gauge_fn is removed only if it is STILL the registered one —
-        a closing engine must not delete the live gauges a successor
-        engine re-registered under the same names (latest-wins)."""
+        keep rendering stale values after its engine is gone. With ``fn``
+        (or ``inst`` for push instruments like histograms), removal is
+        identity-checked: a closing engine must not delete the live
+        instrument a successor engine re-registered under the same name."""
         with self._lock:
             if fn is not None:
                 if self._fns.get(name) is fn:
                     self._fns.pop(name)
+                    self._help.pop(name, None)
+                return
+            if inst is not None:
+                if self._instruments.get(name) is inst:
+                    self._instruments.pop(name)
                     self._help.pop(name, None)
                 return
             self._instruments.pop(name, None)
@@ -158,10 +250,27 @@ class CounterRegistry:
         values = self.snapshot()
         for name in sorted(values):
             full = f"{self.prefix}_{name}"
-            mtype = (
-                "counter"
-                if isinstance(insts.get(name), Counter) else "gauge"
-            )
+            inst = insts.get(name)
+            if isinstance(inst, Histogram):
+                if helps.get(name):
+                    lines.append(f"# HELP {full} {helps[name]}")
+                lines.append(f"# TYPE {full} histogram")
+                counts, total_sum, total, exemplars = inst.state()
+                cum = 0
+                for i, bound in enumerate((*inst.bounds, float("inf"))):
+                    cum += counts[i]
+                    le = "+Inf" if bound == float("inf") else f"{bound:g}"
+                    line = f'{full}_bucket{{le="{le}"}} {cum}'
+                    ex = exemplars[i]
+                    if ex is not None:
+                        # OpenMetrics exemplar: the last traced request
+                        # that landed in this bucket — scrape-to-waterfall.
+                        line += f' # {{trace_id="{ex[0]}"}} {ex[1]:g}'
+                    lines.append(line)
+                lines.append(f"{full}_sum {total_sum:g}")
+                lines.append(f"{full}_count {total}")
+                continue
+            mtype = "counter" if isinstance(inst, Counter) else "gauge"
             if name in fns:
                 mtype = "gauge"
             if helps.get(name):

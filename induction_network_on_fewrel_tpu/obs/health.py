@@ -25,6 +25,15 @@ today:
   dead producer thread, or a poisoned batch — the feed-side generalization
   of the serving queue-stall detector.
 
+This module also hosts the per-tenant **SLO burn-rate engine** (ISSUE 9):
+``SLOEngine`` turns per-request serving outcomes into multi-window
+error-budget burn rates (fast 5m-equivalent / slow 1h-equivalent,
+injectable clock like the watchdog above) and — on a fast-window CRITICAL
+— auto-captures diagnostics through ``DiagnosticsCapture`` (flight-
+recorder dump + a ``jax.profiler`` trace when the runtime cooperates,
+host-span snapshot as the CPU-honest guaranteed artifact), so the
+evidence for a tail regression is on disk before anyone asks.
+
 Wiring: the watchdog is installed as a ``MetricsLogger`` hook, so every
 record every execution path emits (train/val/serve) flows through
 ``observe_record`` with no extra calls at the emit sites. Events are
@@ -391,3 +400,439 @@ class HealthWatchdog:
                     data={"queue_depth": queue_depth, "served": served},
                 ))
             self._last_served = served
+
+
+# --- per-tenant SLOs: multi-window burn rates (ISSUE 9) -------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOObjective:
+    """One tenant's service-level objective.
+
+    ``availability`` is the target GOOD fraction (error budget =
+    1 - availability). A request is BAD when it errors (shed, rejected,
+    deadline-missed, execution failure) or — with ``latency_ms`` set —
+    when it completes slower than the threshold. Folding latency into
+    the same budget is the standard "latency SLI as availability"
+    spelling: one burn rate, one alert policy, for both failure modes.
+    """
+
+    availability: float = 0.99
+    latency_ms: float | None = None
+
+    def __post_init__(self):
+        if not 0.0 < self.availability < 1.0:
+            raise ValueError(
+                f"availability must be in (0, 1), got {self.availability}"
+            )
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.availability
+
+
+class DiagnosticsCapture:
+    """Auto-capture on an SLO CRITICAL: put the evidence on disk.
+
+    Three artifacts, in decreasing order of certainty:
+
+    * ``flight_recorder.json`` — the recorder's last-N window (metrics,
+      health events, spans), when a recorder is attached.
+    * ``slo_spans_<n>.json`` — a host-span snapshot from the tracker:
+      the GUARANTEED artifact, written synchronously on every capture
+      (CPU-honest — no profiler runtime required).
+    * ``slo_profile_<n>/`` — a ``jax.profiler`` trace bracketing
+      ``profile_s`` seconds of whatever executes next, captured from a
+      background thread so the caller (a serving worker or submit path)
+      never blocks on it. Best-effort: an unavailable/occupied profiler
+      (another trace already active, no jax) downgrades to the span
+      snapshot alone, and the returned dict says so. ``profile=False``
+      disables the attempt entirely — the CLIs default to that on this
+      image, where a profiler session concurrent with the threaded
+      serving worker corrupts the heap and segfaults at interpreter
+      exit (RUNBOOK §14; chip sessions opt in via ``--slo_profile``).
+    """
+
+    def __init__(
+        self,
+        out_dir,
+        recorder=None,
+        tracker=None,
+        profile_s: float = 0.5,
+        profile: bool = True,
+    ):
+        from pathlib import Path
+
+        self.out_dir = Path(out_dir)
+        self.recorder = recorder
+        self._tracker = tracker
+        self.profile_s = profile_s
+        self.profile = profile
+        self.captures = 0
+        self._lock = threading.Lock()
+        self._profiling = False
+
+    def _get_tracker(self):
+        if self._tracker is not None:
+            return self._tracker
+        from induction_network_on_fewrel_tpu.obs.spans import get_tracker
+
+        return get_tracker()
+
+    def capture(self, reason: str) -> dict:
+        """Run one capture; returns {flight_dump, span_snapshot, profile,
+        profile_state} with paths (str) or None per artifact."""
+        import json
+
+        with self._lock:
+            self.captures += 1
+            n = self.captures
+        self.out_dir.mkdir(parents=True, exist_ok=True)
+        out: dict = {"reason": reason}
+        if self.recorder is not None:
+            out["flight_dump"] = str(self.recorder.dump(reason=reason))
+        else:
+            out["flight_dump"] = None
+        snap_path = self.out_dir / f"slo_spans_{n}.json"
+        snap_path.write_text(json.dumps({
+            "reason": reason,
+            "captured_unix_s": time.time(),
+            "spans": self._get_tracker().snapshot(),
+        }, default=str, indent=1))
+        out["span_snapshot"] = str(snap_path)
+        out["profile"], out["profile_state"] = self._start_profile(n)
+        return out
+
+    def _start_profile(self, n: int) -> tuple[str | None, str]:
+        if not self.profile:
+            return None, "disabled"
+        with self._lock:
+            if self._profiling:
+                # One profile at a time: a second critical during the
+                # capture window keeps its span snapshot + dump.
+                return None, "already_capturing"
+            self._profiling = True
+        prof_dir = self.out_dir / f"slo_profile_{n}"
+
+        def _run():
+            try:
+                import jax
+
+                jax.profiler.start_trace(str(prof_dir))
+                try:
+                    time.sleep(self.profile_s)
+                finally:
+                    jax.profiler.stop_trace()
+            except Exception:
+                # Profiler unavailable/occupied: the span snapshot above
+                # is the capture. Nothing to clean up — start_trace either
+                # took the global session (stopped in the finally) or
+                # refused before touching it.
+                pass
+            finally:
+                with self._lock:
+                    self._profiling = False
+
+        # Non-daemon on purpose: a daemon profiler thread racing
+        # interpreter teardown segfaulted inside the profiler's C++
+        # session (observed in the loadgen ab drill). The thread is
+        # bounded at ~profile_s, so a clean exit waits at most that.
+        t = threading.Thread(target=_run, name=f"slo-profile-{n}")
+        t.start()
+        self._profile_thread = t
+        return str(prof_dir), "started"
+
+    def wait(self, timeout: float | None = None) -> None:
+        """Join an in-flight profiler capture (tests / orderly shutdown)."""
+        t = getattr(self, "_profile_thread", None)
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
+
+class SLOEngine:
+    """Per-tenant SLO evaluation as multi-window burn rates.
+
+    The SRE-standard alert shape: burn rate = (bad fraction over a
+    window) / error budget. A burn of 1.0 spends the budget exactly over
+    the SLO period; the FAST window (5m-equivalent) at a high threshold
+    catches "the budget is vaporizing right now" (CRITICAL), the SLOW
+    window (1h-equivalent) at a lower threshold catches sustained
+    erosion (WARNING). Defaults are the classic 14.4x/6x pair.
+
+    Mechanics:
+
+    * ``record(tenant, latency_ms=..., error=...)`` per request outcome —
+      ``ServingStats`` calls this from its existing recording points, so
+      the engine's hot path gains no new locks beyond this object's own.
+    * Outcomes land in fixed-width time buckets per tenant (ring of
+      ``slow_window_s / bucket_s`` [good, bad] pairs — bounded memory per
+      tenant, thousand-tenant soaks stay flat).
+    * ``evaluate()`` sweeps tenants and emits once-latched events: a
+      burning tenant is ONE incident until its fast window drops back
+      under threshold (re-arm), not one critical per evaluation.
+    * A fast-window CRITICAL triggers ``DiagnosticsCapture`` (flight
+      dump + profiler-or-span-snapshot) exactly once per latch. The
+      dump + span snapshot are SYNCHRONOUS on the evaluating thread by
+      design: the evidence must be durable before the process can die
+      of whatever is burning the budget, and the cost (tens of ms,
+      once per incident) lands on one request of an already-burning
+      tenant. Only the profiler leg backgrounds (it brackets future
+      work by nature).
+    * The clock is injectable everywhere (``now=``), like the watchdog's
+      stall detectors, so tests and drills compress the "5m" windows to
+      whatever wall-time they actually have.
+
+    Scale note (recorded, not blocking — same class as the batcher's
+    O(active tenants) pop scan, BASELINE round 9): one evaluate() sweep
+    is O(tenants x window cells) under this object's lock, paid once
+    per bucket width (fast_window/12) by whichever data-plane thread
+    ticks it. Fine at the hundreds-of-tenants scale the loadgen drives;
+    a 10k-tenant engine wants per-tenant running window sums (O(tenants)
+    per sweep) and/or a dedicated evaluator thread.
+    """
+
+    MIN_COUNT = 10   # don't alert a window on fewer requests than this
+
+    def __init__(
+        self,
+        objective: SLOObjective | None = None,
+        fast_window_s: float = 300.0,
+        slow_window_s: float = 3600.0,
+        fast_burn: float = 14.4,
+        slow_burn: float = 6.0,
+        bucket_s: float | None = None,
+        logger=None,
+        recorder=None,
+        capture: DiagnosticsCapture | None = None,
+        on_event: Callable[[HealthEvent], None] | None = None,
+    ):
+        if slow_window_s < fast_window_s:
+            raise ValueError(
+                f"slow window ({slow_window_s}s) must be >= fast window "
+                f"({fast_window_s}s)"
+            )
+        self.default_objective = objective or SLOObjective()
+        self.fast_window_s = fast_window_s
+        self.slow_window_s = slow_window_s
+        self.fast_burn = fast_burn
+        self.slow_burn = slow_burn
+        self.bucket_s = bucket_s or max(fast_window_s / 12.0, 1e-3)
+        self._n_buckets = int(math.ceil(slow_window_s / self.bucket_s)) + 1
+        self.logger = logger
+        self.recorder = recorder
+        self.capture = capture
+        self.on_event = on_event
+        self._lock = threading.RLock()
+        self._objectives: dict[str, SLOObjective] = {}
+        # tenant -> (ring of [good, bad], ring-position bucket index).
+        self._rings: dict[str, list[list[float]]] = {}
+        self._ring_at: dict[str, int] = {}
+        self.events: deque[HealthEvent] = deque(maxlen=512)
+        self.tripped = False
+        self._latched: set[str] = set()
+        self.captured: dict[str, dict] = {}   # latch key -> capture result
+        self._t0: float | None = None
+        self._last_eval_bucket = -1
+
+    # --- objectives -------------------------------------------------------
+
+    def set_objective(self, tenant: str, objective: SLOObjective) -> None:
+        with self._lock:
+            self._objectives[tenant] = objective
+
+    def objective_for(self, tenant: str) -> SLOObjective:
+        return self._objectives.get(tenant, self.default_objective)
+
+    # --- recording --------------------------------------------------------
+
+    def _bucket_index(self, now: float) -> int:
+        if self._t0 is None:
+            self._t0 = now
+        return int((now - self._t0) / self.bucket_s)
+
+    def _ring(self, tenant: str) -> list[list[float]]:
+        ring = self._rings.get(tenant)
+        if ring is None:
+            ring = self._rings[tenant] = [
+                [0.0, 0.0] for _ in range(self._n_buckets)
+            ]
+            self._ring_at[tenant] = -1
+        return ring
+
+    def _advance(self, tenant: str, bucket: int) -> list[float]:
+        """The tenant's CURRENT bucket cell, zeroing any skipped cells
+        between the last write and now (idle gaps must not leak stale
+        counts into a later window)."""
+        ring = self._ring(tenant)
+        at = self._ring_at[tenant]
+        if at >= 0 and bucket > at:
+            for b in range(at + 1, min(bucket, at + self._n_buckets) + 1):
+                cell = ring[b % self._n_buckets]
+                cell[0] = cell[1] = 0.0
+        if at < 0 or bucket > at:
+            self._ring_at[tenant] = bucket
+        return ring[bucket % self._n_buckets]
+
+    def record(
+        self,
+        tenant: str,
+        latency_ms: float | None = None,
+        error: bool = False,
+        now: float | None = None,
+    ) -> None:
+        """One request outcome. ``error=True`` is always bad; otherwise
+        the tenant's latency threshold (when set) decides."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            obj = self.objective_for(tenant)
+            bad = error or (
+                obj.latency_ms is not None
+                and latency_ms is not None
+                and latency_ms > obj.latency_ms
+            )
+            cell = self._advance(tenant, self._bucket_index(now))
+            cell[1 if bad else 0] += 1.0
+
+    # --- evaluation -------------------------------------------------------
+
+    def _window_counts(
+        self, tenant: str, window_s: float, bucket: int
+    ) -> tuple[float, float]:
+        ring = self._rings[tenant]
+        at = self._ring_at[tenant]
+        span_buckets = min(
+            int(math.ceil(window_s / self.bucket_s)), self._n_buckets
+        )
+        good = bad = 0.0
+        for b in range(bucket - span_buckets + 1, bucket + 1):
+            if b < 0 or b < at - (self._n_buckets - 1) or b > at:
+                continue
+            cell = ring[b % self._n_buckets]
+            good += cell[0]
+            bad += cell[1]
+        return good, bad
+
+    def burn_rates(
+        self, tenant: str, now: float | None = None
+    ) -> dict | None:
+        """{burn_fast, burn_slow, bad_fast, total_fast, ...} for a tenant
+        with recorded traffic; None otherwise."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if tenant not in self._rings:
+                return None
+            bucket = self._bucket_index(now)
+            obj = self.objective_for(tenant)
+            out = {"budget": obj.budget}
+            for label, window in (
+                ("fast", self.fast_window_s), ("slow", self.slow_window_s)
+            ):
+                good, bad = self._window_counts(tenant, window, bucket)
+                total = good + bad
+                frac = bad / total if total else 0.0
+                out[f"total_{label}"] = int(total)
+                out[f"bad_{label}"] = int(bad)
+                out[f"burn_{label}"] = (
+                    round(frac / obj.budget, 3) if obj.budget > 0 else 0.0
+                )
+            return out
+
+    def evaluate(self, now: float | None = None) -> list[HealthEvent]:
+        """Sweep every tenant's windows; emit (and return) new events.
+        Cheap enough to call per stats emit; the serving engine also
+        throttles it to once per bucket on the submit path.
+
+        Lock discipline: judgments (window sums + latch transitions)
+        happen under the lock; the EMISSION side effects — logger line,
+        recorder event, diagnostics capture's file writes — run after
+        releasing it. A capture at trip time writing the flight dump
+        under this lock would stall every ``record()`` on the serving
+        data plane for the duration, injecting the observer into the
+        very incident it is documenting. The latch set (mutated under
+        the lock) guarantees each event is claimed by exactly one
+        evaluating thread."""
+        now = time.monotonic() if now is None else now
+        pending: list[tuple[HealthEvent, str]] = []
+        with self._lock:
+            for tenant in list(self._rings):
+                rates = self.burn_rates(tenant, now=now)
+                if rates is None:
+                    continue
+                pending.extend(self._judge(tenant, "fast", rates, CRITICAL,
+                                           self.fast_burn))
+                pending.extend(self._judge(tenant, "slow", rates, WARNING,
+                                           self.slow_burn))
+        for ev, latch in pending:
+            self._emit(ev, latch)
+        return [ev for ev, _ in pending]
+
+    def maybe_evaluate(self, now: float | None = None) -> None:
+        """evaluate() at most once per bucket width — the submit-path
+        spelling (cheap steady-state: one int compare)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            bucket = self._bucket_index(now)
+            if bucket == self._last_eval_bucket:
+                return
+            self._last_eval_bucket = bucket
+        self.evaluate(now=now)
+
+    def _judge(
+        self, tenant: str, label: str, rates: dict, severity: str,
+        threshold: float,
+    ) -> list[tuple[HealthEvent, str]]:
+        """Latch transition + event construction ONLY (call with the lock
+        held); the caller emits after releasing the lock."""
+        latch = f"slo_burn:{tenant}:{label}"
+        burn = rates[f"burn_{label}"]
+        total = rates[f"total_{label}"]
+        if burn >= threshold and total >= self.MIN_COUNT:
+            if latch in self._latched:
+                return []
+            self._latched.add(latch)
+            ev = HealthEvent(
+                event=f"slo_{label}_burn", severity=severity, step=total,
+                message=(
+                    f"tenant {tenant!r} burning its error budget "
+                    f"{burn:.1f}x over the {label} window "
+                    f"({rates[f'bad_{label}']}/{total} bad, "
+                    f"budget {rates['budget']:.4g})"
+                ),
+                data={
+                    "tenant": tenant,
+                    f"burn_{label}": burn,
+                    "burn_fast": rates["burn_fast"],
+                    "burn_slow": rates["burn_slow"],
+                    "bad": rates[f"bad_{label}"],
+                    "total": total,
+                },
+            )
+            return [(ev, latch)]
+        if burn < threshold:
+            self._latched.discard(latch)   # healthy window re-arms
+        return []
+
+    def _emit(self, ev: HealthEvent, latch: str) -> None:
+        self.events.append(ev)
+        if ev.severity == CRITICAL:
+            self.tripped = True
+        if self.recorder is not None:
+            self.recorder.record_event(ev.to_dict())
+        if self.logger is not None:
+            self.logger.log(
+                ev.step, kind="health", event=ev.event,
+                severity=ev.severity, message=ev.message, **ev.data,
+            )
+        if ev.severity == CRITICAL:
+            # Auto-capture: the whole point — the flight dump + profiler
+            # (or host-span) evidence is on disk at trip time, once per
+            # latch. Falls back to a bare recorder dump with no capture
+            # configured.
+            if self.capture is not None:
+                self.captured[latch] = self.capture.capture(
+                    reason=f"slo: {ev.message}"
+                )
+            elif self.recorder is not None:
+                self.recorder.dump(reason=f"slo: {ev.message}")
+        if self.on_event is not None:
+            self.on_event(ev)

@@ -15,6 +15,24 @@ Two deliberate bridges to the device side:
   deque append, and a thread-local push/pop. Measured by
   ``tools/obs_report.py --overhead`` against the run's own p50 step time
   (acceptance: < 2% of step time on the headline config).
+
+Request-scoped tracing (ISSUE 9) rides on the same ring:
+
+* Every span carries a ``span_id`` (allocated at ENTRY, so children can
+  point at their parent) and, when a trace context is active on the
+  thread, a ``trace_id`` — the request/step identity that ties spans
+  together ACROSS threads (a serving request is admitted on a client
+  thread and executed on the batcher worker).
+* ``TraceContext`` is the tiny handle that crosses threads: stash it on
+  the unit of work at admission, then ``tracker.trace(ctx)`` in the
+  worker and every span opened there joins the same trace.
+* Fan-in is first-class: one batch-execute span can ``links`` many
+  request trace ids (N admissions -> one launch), which is how the
+  continuous batcher's packing stays attributable per request.
+* ``TraceSampler`` is the head-sampling decision: deterministic 1-in-N.
+  Rate 0 short-circuits to a no-op that allocates NOTHING — the hot
+  path's tracing tax is gated < 2% of p50 exec with sampling on
+  (tests/test_tracing.py) and exactly zero with it off.
 """
 
 from __future__ import annotations
@@ -23,9 +41,63 @@ import contextlib
 import dataclasses
 import functools
 import itertools
+import os
 import threading
 import time
 from typing import Any, Callable, Iterator
+
+
+class TraceContext:
+    """The cross-thread trace handle: the trace id plus the span id of
+    the originating span (0 = none yet; the FIRST span opened under a
+    fresh context fills it in). Callers propagate it, never mutate it."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: int = 0):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self) -> str:  # debugging aid only
+        return f"TraceContext({self.trace_id!r}, span_id={self.span_id})"
+
+
+_TRACE_IDS = itertools.count(1)
+_TRACE_PREFIX = f"{os.getpid() & 0xFFFF:04x}"
+
+
+def new_trace_id() -> str:
+    """Process-unique trace id: pid prefix + monotonic counter. Cheap (one
+    string format), collision-free within a process, and distinguishable
+    across the processes of one run directory."""
+    return f"{_TRACE_PREFIX}-{next(_TRACE_IDS):08x}"
+
+
+class TraceSampler:
+    """Deterministic head sampler: trace every ``round(1/rate)``-th call.
+
+    ``rate <= 0`` pins ``stride = 0`` and ``maybe_trace`` returns None
+    after one attribute test — no counter advance, no allocation — so an
+    untraced deployment pays nothing on the hot path. ``rate >= 1``
+    traces every request. Deterministic (not random) on purpose: load
+    tests and the loadgen get reproducible exemplar counts.
+    """
+
+    __slots__ = ("rate", "stride", "_count")
+
+    def __init__(self, rate: float):
+        self.rate = max(0.0, float(rate))
+        self.stride = 0 if self.rate <= 0 else max(1, round(1.0 / self.rate))
+        # itertools.count.__next__ is atomic under the GIL — submitters on
+        # many threads share this sampler without a lock.
+        self._count = itertools.count() if self.stride else None
+
+    def maybe_trace(self) -> TraceContext | None:
+        if not self.stride:
+            return None
+        if next(self._count) % self.stride:
+            return None
+        return TraceContext(new_trace_id())
 
 
 @dataclasses.dataclass
@@ -41,6 +113,10 @@ class Span:
     thread: str
     span_id: int
     attrs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    trace_id: str | None = None   # request/step trace this span belongs to
+    parent_id: int | None = None  # enclosing span's id (same thread), or
+    #                               the originating span across threads
+    links: tuple[str, ...] = ()   # fan-in: trace ids merged into this span
 
     def to_dict(self) -> dict:
         d = {
@@ -52,6 +128,12 @@ class Span:
             "thread": self.thread,
             "span_id": self.span_id,
         }
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+        if self.parent_id is not None:
+            d["parent_id"] = self.parent_id
+        if self.links:
+            d["links"] = list(self.links)
         if self.attrs:
             d["attrs"] = self.attrs
         return d
@@ -76,14 +158,16 @@ class SpanTracker:
         self._ring: list[Span] = []
         self._next_slot = 0            # round-robin slot once full
         self.evicted = 0
-        self._ids = itertools.count()
+        # Span ids start at 1: TraceContext.span_id == 0 means "no
+        # originating span yet", so id 0 would be indistinguishable.
+        self._ids = itertools.count(1)
         self._tls = threading.local()
         self._t0 = time.monotonic()
         self._xplane = xplane_bridge
 
     # --- recording -------------------------------------------------------
 
-    def _stack(self) -> list[str]:
+    def _stack(self) -> list[tuple[str, int]]:
         st = getattr(self._tls, "stack", None)
         if st is None:
             st = self._tls.stack = []
@@ -98,14 +182,71 @@ class SpanTracker:
                 self._next_slot = (self._next_slot + 1) % self.capacity
                 self.evicted += 1
 
+    # --- trace context (request/step-scoped ids) --------------------------
+
+    def current_trace(self) -> TraceContext | None:
+        """The thread's active trace context, if any."""
+        return getattr(self._tls, "ctx", None)
+
+    def set_trace(self, ctx: TraceContext | None) -> TraceContext | None:
+        """Replace the thread's trace context; returns the previous one.
+        The train loop's per-step pattern: a fresh context each iteration
+        (no context-manager nesting across a loop body), cleared once
+        after the loop."""
+        prev = getattr(self._tls, "ctx", None)
+        self._tls.ctx = ctx
+        return prev
+
+    def new_context(self) -> TraceContext:
+        return TraceContext(new_trace_id())
+
     @contextlib.contextmanager
-    def span(self, name: str, **attrs: Any) -> Iterator[dict]:
+    def trace(self, ctx: TraceContext | None = None) -> Iterator[TraceContext]:
+        """Activate a trace context for the block: spans opened inside (on
+        THIS thread) carry its trace id. Pass the context a request
+        carried across threads to adopt it (cross-thread propagation);
+        omit it to start a fresh trace."""
+        ctx = ctx if ctx is not None else self.new_context()
+        prev = self.set_trace(ctx)
+        try:
+            yield ctx
+        finally:
+            self.set_trace(prev)
+
+    @contextlib.contextmanager
+    def span(
+        self, name: str, links: tuple[str, ...] = (), xplane: bool = True,
+        **attrs: Any,
+    ) -> Iterator[dict]:
         """Time a region. Yields the attrs dict so the body can attach
-        results (e.g. ``s["rows"] = len(batch)``) before the span closes."""
+        results (e.g. ``s["rows"] = len(batch)``) before the span closes.
+        ``links`` records fan-in: the trace ids of work merged into this
+        span (N admissions -> one batch execute). ``xplane=False`` skips
+        the jax.named_scope bridge for spans wrapping PURE host code
+        (e.g. serving-side tokenization): the scope would name nothing in
+        a device profile, and entering it perturbs jax's jit dispatch
+        fast path for the NEXT program launch — measured ~80 µs on the
+        in-process engine, the dominant term of the tracing tax before
+        this knob existed (tests/test_tracing.py's 2% gate)."""
         stack = self._stack()
-        parent = stack[-1] if stack else None
-        stack.append(name)
-        scope = _named_scope(name) if self._xplane else None
+        # Span id at ENTRY (not exit): children close before their parent,
+        # so a parent id is only known if allocated when the parent opens.
+        span_id = next(self._ids)
+        ctx = getattr(self._tls, "ctx", None)
+        if stack:
+            parent, parent_id = stack[-1]
+        else:
+            parent = None
+            # Cross-thread stitch: a top-level span in a worker thread
+            # points at the originating span of its adopted trace.
+            parent_id = ctx.span_id if ctx is not None and ctx.span_id else None
+        if ctx is not None and not ctx.span_id:
+            # First span of a fresh trace: it IS the originating span —
+            # spans opened under this context on OTHER threads will
+            # parent to it.
+            ctx.span_id = span_id
+        stack.append((name, span_id))
+        scope = _named_scope(name) if (self._xplane and xplane) else None
         if scope is not None:
             scope.__enter__()
         t0 = time.monotonic()
@@ -123,8 +264,11 @@ class SpanTracker:
                 depth=len(stack),
                 parent=parent,
                 thread=threading.current_thread().name,
-                span_id=next(self._ids),
+                span_id=span_id,
                 attrs=attrs,
+                trace_id=ctx.trace_id if ctx is not None else None,
+                parent_id=parent_id,
+                links=tuple(links),
             ))
 
     def wrap(self, name: str | None = None) -> Callable:

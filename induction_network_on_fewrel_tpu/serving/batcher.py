@@ -77,6 +77,12 @@ class Request:
     future: Future
     enqueued_at: float
     tenant: str = "default"     # verdict/registry scope (fleet serving)
+    # Request-scoped tracing (ISSUE 9): the TraceContext minted at
+    # admission when this request was head-sampled, carried across the
+    # client->worker thread hop so the execute path can attribute its
+    # queue/pack/execute/respond segments to one trace id. None (the
+    # default, and always with sampling off) costs the hot path nothing.
+    trace: object | None = None
 
 
 class DynamicBatcher:
@@ -117,7 +123,8 @@ class DynamicBatcher:
         return batches_ahead * max(est, 1e-4)
 
     def submit(
-        self, query: dict, deadline_s: float, tenant: str = "default"
+        self, query: dict, deadline_s: float, tenant: str = "default",
+        trace=None,
     ) -> Future:
         """Enqueue one tokenized query; returns its Future. Raises
         ``Saturated`` (with a retry-after hint) when the queue is full."""
@@ -126,7 +133,7 @@ class DynamicBatcher:
         now = time.monotonic()
         req = Request(
             query=query, deadline=now + deadline_s, future=Future(),
-            enqueued_at=now, tenant=tenant,
+            enqueued_at=now, tenant=tenant, trace=trace,
         )
         try:
             self._q.put_nowait(req)
@@ -336,7 +343,8 @@ class ContinuousBatcher:
         return batches_ahead * max(est, 1e-4)
 
     def submit(
-        self, query: dict, deadline_s: float, tenant: str = "default"
+        self, query: dict, deadline_s: float, tenant: str = "default",
+        trace=None,
     ) -> Future:
         """Admit one tokenized query for ``tenant``; returns its Future.
         Raises ``Saturated`` when the global queue is at bound, or
@@ -346,7 +354,7 @@ class ContinuousBatcher:
         now = time.monotonic()
         req = Request(
             query=query, deadline=now + deadline_s, future=Future(),
-            enqueued_at=now, tenant=tenant,
+            enqueued_at=now, tenant=tenant, trace=trace,
         )
         with self._cv:
             if self._closed:

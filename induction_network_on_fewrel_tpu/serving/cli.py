@@ -6,6 +6,10 @@ synthetic fixtures), AOT-warm the bucket programs, then answer queries —
 JSON-lines from --input (or stdin with ``--input -``), or a built-in demo
 batch sampled from the registered corpus. One verdict JSON per line on
 stdout; serving metrics go to stderr and metrics.jsonl (kind="serve").
+``--trace_sample`` adds per-request kind="trace" segment records (verdicts
+carry trace_id); ``--slo_latency_ms`` arms the per-tenant SLO burn-rate
+engine, whose fast-window CRITICAL auto-captures diagnostics to
+``--run_dir`` (RUNBOOK §14).
 """
 
 from __future__ import annotations
@@ -75,11 +79,38 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
                         "detection + NaN checks over the serve stream; "
                         "critical events dump flight_recorder.json to "
                         "--run_dir")
+    p.add_argument("--trace_sample", type=float, default=0.0,
+                   help="request-trace head-sampling rate (0 = off, the "
+                        "zero-overhead default; 0.1 traces every 10th "
+                        "request). Sampled requests emit kind='trace' "
+                        "segment records to --run_dir, rendered as "
+                        "waterfalls by tools/obs_report.py")
+    p.add_argument("--slo_latency_ms", type=float, default=None,
+                   help="per-request latency objective; setting it turns "
+                        "on the per-tenant SLO burn-rate engine (requests "
+                        "slower than this, or shed/rejected/expired, burn "
+                        "the error budget; a fast-window burn CRITICAL "
+                        "auto-captures diagnostics to --run_dir)")
+    p.add_argument("--slo_availability", type=float, default=0.99,
+                   help="SLO good-fraction target (error budget = 1 - "
+                        "this); only meaningful with --slo_latency_ms")
+    p.add_argument("--slo_fast_s", type=float, default=300.0,
+                   help="fast burn window seconds (5m-equivalent; shrink "
+                        "for drills)")
+    p.add_argument("--slo_slow_s", type=float, default=3600.0,
+                   help="slow burn window seconds (1h-equivalent)")
+    p.add_argument("--slo_profile", action="store_true",
+                   help="also attempt a jax.profiler trace in the SLO "
+                        "auto-capture (default off on this image — a "
+                        "profiler session concurrent with the serving "
+                        "worker corrupts the heap at exit, RUNBOOK §14; "
+                        "span snapshot + flight dump always capture)")
     p.add_argument("--seed", type=int, default=0)
     return p
 
 
-def _fresh_engine(args, buckets, logger=None, watchdog=None):
+def _fresh_engine(args, buckets, logger=None, watchdog=None, slo=None,
+                  trace_sample=0.0):
     """Demo path: synthetic vocab + fresh-init induction weights (no
     checkpoint on disk). The serving machinery is identical; only the
     verdict quality is untrained."""
@@ -115,6 +146,7 @@ def _fresh_engine(args, buckets, logger=None, watchdog=None):
         default_deadline_s=args.deadline_ms / 1e3,
         scheduler=args.scheduler, tenant_share=args.tenant_share,
         dp=args.dp, logger=logger, watchdog=watchdog,
+        slo=slo, trace_sample=trace_sample,
     )
 
 
@@ -150,17 +182,35 @@ def serve_main(argv=None) -> int:
     # persistent metrics.jsonl handle on exit.
     logger = MetricsLogger(args.run_dir) if args.run_dir else None
     watchdog = None
-    if args.watchdog:
-        from induction_network_on_fewrel_tpu.obs import (
-            FlightRecorder,
-            HealthWatchdog,
-        )
+    recorder = None
+    if args.watchdog or args.slo_latency_ms is not None:
+        from induction_network_on_fewrel_tpu.obs import FlightRecorder
 
         recorder = FlightRecorder(out_dir=args.run_dir)
         recorder.install_sigterm_handler()
-        watchdog = HealthWatchdog(logger=logger, recorder=recorder)
         if logger is not None:
             logger.add_hook(recorder.record_metric)
+    if args.watchdog:
+        from induction_network_on_fewrel_tpu.obs import HealthWatchdog
+
+        watchdog = HealthWatchdog(logger=logger, recorder=recorder)
+    slo = None
+    if args.slo_latency_ms is not None:
+        from induction_network_on_fewrel_tpu.obs import (
+            DiagnosticsCapture,
+            SLOEngine,
+            SLOObjective,
+        )
+
+        slo = SLOEngine(
+            SLOObjective(availability=args.slo_availability,
+                         latency_ms=args.slo_latency_ms),
+            fast_window_s=args.slo_fast_s, slow_window_s=args.slo_slow_s,
+            logger=logger, recorder=recorder,
+            capture=DiagnosticsCapture(args.run_dir or ".",
+                                       recorder=recorder,
+                                       profile=args.slo_profile),
+        )
     if args.load_ckpt:
         engine = InferenceEngine.from_checkpoint(
             args.load_ckpt, device=args.device,
@@ -171,10 +221,12 @@ def serve_main(argv=None) -> int:
             default_deadline_s=args.deadline_ms / 1e3,
             scheduler=args.scheduler, tenant_share=args.tenant_share,
             dp=args.dp, logger=logger, watchdog=watchdog,
+            slo=slo, trace_sample=args.trace_sample,
         )
     else:
         engine = _fresh_engine(args, buckets, logger=logger,
-                               watchdog=watchdog)
+                               watchdog=watchdog, slo=slo,
+                               trace_sample=args.trace_sample)
 
     try:
         ds = _support_dataset(args, engine.registry.k, seed=args.seed)

@@ -25,6 +25,32 @@ Fleet behaviors (ISSUE 7):
   is appended as class N; the tenant threshold biases it. Tenants served
   by a no-NOTA checkpoint can still set an open-set floor: best-class
   logit below the threshold -> ``"no_relation"``.
+
+Request-scoped tracing + SLOs (ISSUE 9):
+
+* ``trace_sample=r`` head-samples 1-in-round(1/r) admissions: a sampled
+  request mints a ``TraceContext`` at submit, carries it across the
+  client->worker thread hop on the Request, and the execute path
+  attributes its latency to four contiguous segments — **queue**
+  (admission -> the worker starts stacking its batch), **pack** (host
+  stacking/padding), **execute** (device program), **respond** (the
+  post-execute host work: batch accounting + per-row verdict build;
+  future DELIVERY falls after the stamp — a verdict cannot carry the
+  time of its own resolution) — which sum to the request's measured
+  end-to-end latency BY CONSTRUCTION (same timestamps). Each sampled
+  request emits one ``kind="trace"`` record (buffered, flushed with the
+  periodic stats emit and at close — the jsonl write is the one
+  per-trace cost worth deferring; rendered as a waterfall by
+  tools/obs_report.py) and its verdict carries ``trace_id``. The batch's
+  ``serve/execute`` span links every sampled trace id it served (fan-in:
+  N admissions -> one launch). Rate 0 (default) short-circuits to a
+  no-op before any allocation — the tracing tax is gated < 2% of p50
+  exec at the production sampling rate (tests/test_tracing.py).
+* ``slo=SLOEngine(...)`` evaluates per-tenant availability+latency
+  objectives as multi-window burn rates: every outcome (done, shed,
+  rejected, deadline-missed) feeds it through ``ServingStats``, and the
+  submit/emit paths tick its evaluation, so a burning tenant trips a
+  CRITICAL (with auto-captured diagnostics) without any polling loop.
 """
 
 from __future__ import annotations
@@ -34,7 +60,11 @@ import time
 
 import numpy as np
 
-from induction_network_on_fewrel_tpu.obs.spans import span
+from induction_network_on_fewrel_tpu.obs.spans import (
+    TraceSampler,
+    get_tracker,
+    span,
+)
 from induction_network_on_fewrel_tpu.serving.batcher import (
     ContinuousBatcher,
     DynamicBatcher,
@@ -73,6 +103,8 @@ class InferenceEngine:
         dp: int | None = None,
         logger=None,
         watchdog=None,
+        slo=None,
+        trace_sample: float = 0.0,
         start: bool = True,
     ):
         if cfg.model != "induction":
@@ -108,9 +140,22 @@ class InferenceEngine:
         self.watchdog = watchdog
         if watchdog is not None and logger is not None:
             logger.add_hook(watchdog.observe_record)
+        # Request-scoped tracing (ISSUE 9): deterministic head sampler.
+        # Rate 0 = maybe_trace() is a no-op returning None — no trace
+        # contexts, no records, nothing on the hot path.
+        self._tracer = TraceSampler(trace_sample)
+        # Per-tenant SLO burn-rate engine (obs/health.SLOEngine): every
+        # outcome ServingStats records feeds its windows; the engine's
+        # logger/recorder default to ours when unset.
+        self.slo = slo
+        if slo is not None and slo.logger is None:
+            slo.logger = logger
 
-        self.stats = ServingStats()
+        self.stats = ServingStats(slo=slo)
         self.stats.bind_registry()
+        # Sampled trace records awaiting their deferred jsonl flush
+        # (_emit_trace / _flush_traces).
+        self._pending_traces: list[dict] = []
         self.registry = TenantRegistry(
             model, params, tokenizer,
             k=k if k is not None else cfg.k, logger=logger,
@@ -264,19 +309,43 @@ class InferenceEngine:
 
     # --- hot-swap publish -------------------------------------------------
 
+    def _traced_publish(self, publish_fn, **span_attrs) -> int:
+        """Control-plane tracing shared by both publish spellings: the
+        publish runs under its own trace context (always — this is not
+        the hot path), so the publish span and the registry's re-distill
+        spans share one trace id, and a ``kind="trace"`` control record
+        (op="publish") lands next to the request waterfalls it may have
+        perturbed."""
+        tracker = get_tracker()
+        t0 = time.monotonic()
+        with tracker.trace() as ctx:
+            with tracker.span("serve/publish", **span_attrs):
+                version = publish_fn()
+        self.stats.record_swap()
+        self._emit_trace({
+            "trace_id": ctx.trace_id,
+            "op": "publish",
+            "publish_ms": round((time.monotonic() - t0) * 1e3, 3),
+            "params_version": float(version),
+            "tenants": float(len(self.registry.tenants())),
+        })
+        return version
+
     def publish_params(self, new_params) -> int:
         """Atomic hot-swap: every tenant's class vectors re-distill with
         ``new_params`` and republish; in-flight batches finish on their
         pinned snapshot; zero recompiles. Returns the params_version."""
-        version = self.registry.publish_params(new_params)
-        self.stats.record_swap()
-        return version
+        return self._traced_publish(
+            lambda: self.registry.publish_params(new_params)
+        )
 
     def publish_checkpoint(self, ckpt_dir: str) -> int:
-        """Hot-swap straight from a training checkpoint directory."""
-        version = self.registry.publish_checkpoint(ckpt_dir)
-        self.stats.record_swap()
-        return version
+        """Hot-swap straight from a training checkpoint directory (traced
+        like publish_params — the restore rides the same publish span)."""
+        return self._traced_publish(
+            lambda: self.registry.publish_checkpoint(ckpt_dir),
+            source=ckpt_dir,
+        )
 
     # --- query path ------------------------------------------------------
 
@@ -289,13 +358,40 @@ class InferenceEngine:
         backpressure (with ``.tenant`` set when the breach is this
         tenant's share — shed-load)."""
         self.registry.snapshot(tenant)   # raises for unknown tenants
-        t = self.tokenizer(self._as_instance(instance))
+        trace = self._tracer.maybe_trace()   # None on the unsampled path
+        if trace is None:
+            t = self.tokenizer(self._as_instance(instance))
+        else:
+            # The admission span: the first span of a fresh trace becomes
+            # its originating span (ctx.span_id), so the worker-side
+            # execute spans stitch back to it across the thread hop.
+            tracker = get_tracker()
+            with tracker.trace(trace):
+                # xplane=False: host-only tokenization — the named-scope
+                # bridge would name nothing in a device profile and its
+                # jit-dispatch perturbation was the dominant tracing tax.
+                with tracker.span("serve/submit", xplane=False,
+                                  tenant=tenant):
+                    t = self.tokenizer(self._as_instance(instance))
         query = {"word": t.word, "pos1": t.pos1, "pos2": t.pos2, "mask": t.mask}
-        fut = self.batcher.submit(
-            query,
-            deadline_s if deadline_s is not None else self.default_deadline_s,
-            tenant=tenant,
-        )
+        try:
+            fut = self.batcher.submit(
+                query,
+                deadline_s if deadline_s is not None
+                else self.default_deadline_s,
+                tenant=tenant,
+                trace=trace,
+            )
+        finally:
+            if self.slo is not None:
+                # Burn-rate tick from the client thread (throttled to
+                # once per bucket internally), in a finally ON PURPOSE:
+                # a rejected/shed submit raises Saturated AFTER the
+                # batcher recorded the bad outcome, and a fully-shed
+                # tenant — the tenant MOST likely to be burning — would
+                # otherwise never get its windows evaluated (no batches
+                # execute, so the emit-path tick never fires either).
+                self.slo.maybe_evaluate()
         if self.watchdog is not None:
             # Stall observation from the CLIENT thread: the execute-path
             # observations below come from the worker itself, which is
@@ -350,19 +446,83 @@ class InferenceEngine:
         # with (registry.Snapshot doc).
         snap = self.registry.snapshot(tenant)
         bucket = select_bucket(len(batch), self.batcher.buckets)
-        with span("serve/stack", rows=len(batch), bucket=bucket):
+        # Fan-in: the sampled requests this launch serves. Their trace
+        # ids link into the batch spans, and each gets a per-request
+        # segment record after the futures resolve. The untraced fast
+        # path is one list-comp over fields already in hand.
+        traced = [r for r in batch if r.trace is not None]
+        links = tuple(r.trace.trace_id for r in traced)
+        t_stack = time.monotonic()
+        with span("serve/stack", links=links, rows=len(batch), bucket=bucket):
             query = stack_queries([r.query for r in batch], bucket)
         t0 = time.monotonic()
-        with span("serve/execute", rows=len(batch), bucket=bucket):
+        with span("serve/execute", links=links, rows=len(batch),
+                  bucket=bucket):
             logits = self.programs.run(snap.params, snap.matrix, query)
-        exec_s = time.monotonic() - t0
+        t_exec_end = time.monotonic()
+        exec_s = t_exec_end - t0
         self.stats.record_batch(len(batch), bucket, exec_s)
+        # Two passes on purpose: the verdict BUILD (per-row argmax + an
+        # N-class logits dict — the O(batch) host work after execute)
+        # happens before ``now`` so the respond segment and latency_ms
+        # include it; only the set_result delivery itself falls after
+        # the stamp (a verdict cannot carry the time of its own
+        # delivery).
+        resolved = [
+            (req, self._verdict(row, snap))
+            for row, req in zip(logits, batch)   # zip drops the pad rows
+        ]
         now = time.monotonic()
-        for row, req in zip(logits, batch):   # zip drops the pad rows
-            verdict = self._verdict(row, snap)
+        for req, verdict in resolved:
             verdict["latency_ms"] = round((now - req.enqueued_at) * 1e3, 3)
-            self.stats.record_done(now - req.enqueued_at, tenant=tenant)
+            if req.trace is not None:
+                verdict["trace_id"] = req.trace.trace_id
+            self.stats.record_done(
+                now - req.enqueued_at, tenant=tenant,
+                trace_id=req.trace.trace_id if req.trace is not None else None,
+            )
             req.future.set_result(verdict)
+        if traced:
+            # now - enqueued_at == queue + pack + execute + respond by
+            # construction: the four segments tile [enqueued_at, now]
+            # with the SAME timestamps the latency is measured from, so
+            # the waterfall obs_report renders sums to the measured
+            # latency exactly (the acceptance bar allows 5%; this is 0).
+            pack_ms = (t0 - t_stack) * 1e3
+            exec_ms = (t_exec_end - t0) * 1e3
+            respond_ms = (now - t_exec_end) * 1e3
+            for req in traced:
+                self._emit_trace({
+                    "trace_id": req.trace.trace_id,
+                    "tenant": tenant,
+                    "scheduler": self.scheduler,
+                    "bucket": float(bucket),
+                    "rows": float(len(batch)),
+                    "queue_ms": round((t_stack - req.enqueued_at) * 1e3, 3),
+                    "pack_ms": round(pack_ms, 3),
+                    "execute_ms": round(exec_ms, 3),
+                    "respond_ms": round(respond_ms, 3),
+                    "total_ms": round((now - req.enqueued_at) * 1e3, 3),
+                })
+
+    def _emit_trace(self, rec: dict) -> None:
+        """One sampled request's segment record: retained for artifact
+        summaries (stats) immediately; the kind="trace" jsonl line is
+        BUFFERED and flushed with the periodic stats emit — the logger's
+        per-record write+flush (crash-visibility for metrics) is the
+        single biggest per-trace cost, and deferring it keeps the
+        execute path's tracing tax under the 2%-of-p50-exec gate. List
+        appends are GIL-atomic; ``_flush_traces`` swaps the buffer out."""
+        self.stats.record_trace(rec)
+        if self._logger is not None:
+            self._pending_traces.append(rec)
+
+    def _flush_traces(self) -> None:
+        if self._logger is None or not self._pending_traces:
+            return
+        pending, self._pending_traces = self._pending_traces, []
+        for rec in pending:
+            self._logger.log(self.stats.served, kind="trace", **rec)
 
     def _verdict(self, row: np.ndarray, snap) -> dict:
         """One logits row -> verdict dict under the tenant's NOTA policy.
@@ -399,10 +559,13 @@ class InferenceEngine:
             self.watchdog.observe_queue(
                 self.batcher.queue_depth, self.stats.served
             )
+        if self.slo is not None:
+            self.slo.maybe_evaluate()
         if self._logger is None:
             return
         if self.stats.batches - self._emit_step >= every:
             self._emit_step = self.stats.batches
+            self._flush_traces()
             self.stats.emit(
                 self._logger, self._emit_step,
                 queue_depth=self.batcher.queue_depth,
@@ -413,6 +576,9 @@ class InferenceEngine:
             self.watchdog.observe_queue(
                 self.batcher.queue_depth, self.stats.served
             )
+        if self.slo is not None:
+            self.slo.evaluate()
+        self._flush_traces()
         if self._logger is not None:
             self.stats.emit(
                 self._logger, self.stats.batches,

@@ -57,6 +57,7 @@ from typing import Any
 
 import numpy as np
 
+from induction_network_on_fewrel_tpu.obs.spans import span
 from induction_network_on_fewrel_tpu.serving.buckets import QUERY_DTYPES
 
 DEFAULT_TENANT = "default"
@@ -455,7 +456,11 @@ class TenantRegistry:
         ]
         if missing:
             sup = self._stack_support([per_class[i] for i in missing])
-            vecs = np.asarray(self._distill(params, sup))[0]
+            # Control-plane span: under a publish this inherits the
+            # publish's trace context (obs/spans thread-local), so the
+            # re-distill cost shows up inside the publish trace.
+            with span("serve/distill", classes=len(missing)):
+                vecs = np.asarray(self._distill(params, sup))[0]
             for i, vec in zip(missing, vecs):
                 slot = self._next_slot
                 self._next_slot += 1

@@ -9,38 +9,82 @@ same machine-readable channel train/val metrics use). Per-tenant state
 emits as ONE kind="serve" record per tenant carrying a ``tenant`` string
 field (scalar-only schema preserved); the aggregate record has no tenant
 field — tools/obs_report.py's serve section splits on that.
+
+ISSUE 9 additions:
+
+* Per-tenant latency accumulators are true fixed-size **reservoir
+  samples** (Algorithm R, deterministic xorshift RNG): a thousand-tenant
+  month-long soak holds exactly ``TENANT_SAMPLES`` floats per tenant, and
+  the sample stays uniform over the tenant's whole history instead of a
+  recency window. The percentile convention (nearest-rank) is unchanged
+  and stays shared with ``tools/loadgen.py``'s ``pct``.
+* ``record_done`` forwards each outcome to an attached **SLO engine**
+  (obs/health.SLOEngine) and observes the bound latency **histogram**
+  (obs/export.Histogram) with the request's exemplar trace_id when it was
+  sampled — the Prometheus exposition then hands a scrape a concrete
+  traced request per bucket.
+* ``record_trace`` retains a bounded window of sampled per-request trace
+  records; ``trace_summary()`` reduces them to segment-breakdown medians
+  + exemplar ids for SERVE/BENCH artifacts.
 """
 
 from __future__ import annotations
 
 import threading
+from collections import deque
+
+
+def nearest_rank(xs: list[float], q: float) -> float | None:
+    """Nearest-rank percentile over unsorted samples; None when empty.
+    THE percentile convention of the serving stack — shared by the
+    reservoirs, trace summaries, and (by contract, asserted in
+    tests/test_tracing.py) tools/loadgen.py's ``pct`` and
+    tools/obs_report.py's ``_percentile``."""
+    s = sorted(xs)
+    if not s:
+        return None
+    i = min(len(s) - 1, max(0, int(round(q / 100.0 * len(s))) - 1))
+    return s[i]
 
 
 class _Reservoir:
-    """Bounded latency reservoir: deterministic round-robin replacement
-    past the cap — percentiles then reflect a sliding window over recent
-    traffic, which is the operationally useful view anyway."""
+    """Fixed-size uniform reservoir (Algorithm R) of latency samples.
 
-    __slots__ = ("cap", "ms", "nxt")
+    Below the cap it is exact; past the cap each new sample replaces a
+    random slot with probability cap/n, so the retained set stays a
+    uniform sample of EVERYTHING observed — bounded memory with honest
+    long-run percentiles (a round-robin window would instead forget every
+    sample older than the cap). The RNG is a tiny xorshift (no numpy on
+    the hot path) seeded per reservoir, so runs are deterministic."""
 
-    def __init__(self, cap: int):
+    __slots__ = ("cap", "ms", "n", "_rng")
+
+    def __init__(self, cap: int, seed: int = 0x9E3779B9):
         self.cap = cap
         self.ms: list[float] = []
-        self.nxt = 0
+        self.n = 0
+        self._rng = (seed or 1) & 0xFFFFFFFF
+
+    def _next_rand(self) -> int:
+        # xorshift32: cheap, stateful, plenty for replacement sampling.
+        x = self._rng
+        x ^= (x << 13) & 0xFFFFFFFF
+        x ^= x >> 17
+        x ^= (x << 5) & 0xFFFFFFFF
+        self._rng = x
+        return x
 
     def add(self, ms: float) -> None:
+        self.n += 1
         if len(self.ms) < self.cap:
             self.ms.append(ms)
-        else:
-            self.ms[self.nxt] = ms
-            self.nxt = (self.nxt + 1) % self.cap
+            return
+        j = self._next_rand() % self.n
+        if j < self.cap:
+            self.ms[j] = ms
 
     def percentile(self, q: float) -> float | None:
-        lat = sorted(self.ms)
-        if not lat:
-            return None
-        i = min(len(lat) - 1, max(0, int(round(q / 100.0 * len(lat))) - 1))
-        return lat[i]
+        return nearest_rank(self.ms, q)
 
 
 class _TenantStats:
@@ -59,14 +103,27 @@ class _TenantStats:
 class ServingStats:
     """Thread-safe serving counters + bounded latency reservoirs."""
 
-    # Long soaks must not grow host memory without limit.
+    # Long soaks must not grow host memory without limit. Per-tenant
+    # reservoirs are deliberately narrow: at 1024 floats each, a
+    # thousand-tenant fleet holds ~8 MB of latency state total (and the
+    # Algorithm-R reservoir keeps the percentile honest over the full
+    # history at that size — nearest-rank p99 needs ~100+ samples, which
+    # 1024 clears with margin).
     MAX_SAMPLES = 65536
-    TENANT_SAMPLES = 8192   # per-tenant reservoirs are narrower
+    TENANT_SAMPLES = 1024
+    MAX_TRACES = 512        # retained sampled per-request trace records
 
-    def __init__(self) -> None:
+    def __init__(self, slo=None) -> None:
         self._lock = threading.Lock()
         self._lat = _Reservoir(self.MAX_SAMPLES)
         self._tenants: dict[str, _TenantStats] = {}
+        # Optional obs/health.SLOEngine: every request outcome feeds the
+        # per-tenant burn-rate windows. None (default) costs one `if`.
+        self._slo = slo
+        # Bounded window of sampled trace records (dicts) — the source
+        # for trace_summary()'s segment medians + exemplar ids.
+        self._traces: deque[dict] = deque(maxlen=self.MAX_TRACES)
+        self._hist = None       # bound latency histogram (bind_registry)
         self.served = 0             # futures resolved with a verdict
         self.rejected = 0           # backpressure rejections at submit
         self.shed = 0               # per-tenant share breaches (shed-load)
@@ -91,7 +148,10 @@ class ServingStats:
             ts = self._tenants[tenant] = _TenantStats(self.TENANT_SAMPLES)
         return ts
 
-    def record_done(self, latency_s: float, tenant: str | None = None) -> None:
+    def record_done(
+        self, latency_s: float, tenant: str | None = None,
+        trace_id: str | None = None,
+    ) -> None:
         with self._lock:
             self.served += 1
             ms = latency_s * 1e3
@@ -100,6 +160,13 @@ class ServingStats:
             if ts is not None:
                 ts.served += 1
                 ts.lat.add(ms)
+            hist = self._hist
+        # Outside the counter lock: the histogram and SLO engine have
+        # their own locks, and neither ever calls back into this object.
+        if hist is not None:
+            hist.observe(ms, exemplar=trace_id)
+        if self._slo is not None and tenant is not None:
+            self._slo.record(tenant, latency_ms=ms)
 
     def record_rejected(self, tenant: str | None = None) -> None:
         with self._lock:
@@ -107,6 +174,8 @@ class ServingStats:
             ts = self._tenant(tenant)
             if ts is not None:
                 ts.rejected += 1
+        if self._slo is not None and tenant is not None:
+            self._slo.record(tenant, error=True)
 
     def record_shed(self, tenant: str) -> None:
         """A per-tenant share breach: THIS tenant sheds while the queue
@@ -118,6 +187,8 @@ class ServingStats:
             ts = self._tenant(tenant)
             ts.rejected += 1
             ts.shed += 1
+        if self._slo is not None:
+            self._slo.record(tenant, error=True)
 
     def record_swap(self) -> None:
         with self._lock:
@@ -129,6 +200,18 @@ class ServingStats:
             ts = self._tenant(tenant)
             if ts is not None:
                 ts.deadline_missed += 1
+        if self._slo is not None and tenant is not None:
+            self._slo.record(tenant, error=True)
+
+    def record_trace(self, rec: dict) -> None:
+        """Retain one sampled per-request trace record. Locked: appends
+        alone are GIL-atomic, but trace_summary() iterates this deque
+        from OTHER threads (loadgen reads it the moment the last future
+        resolves, while the worker is still appending the batch's
+        remaining records) and CPython raises on mutation-during-
+        iteration."""
+        with self._lock:
+            self._traces.append(rec)
 
     def record_batch(self, rows: int, bucket: int, exec_s: float) -> None:
         with self._lock:
@@ -164,17 +247,66 @@ class ServingStats:
         with self._lock:
             return self._lat.percentile(q)
 
+    @property
+    def slo(self):
+        return self._slo
+
+    def trace_summary(self) -> dict | None:
+        """Segment-breakdown medians + exemplar trace_ids over the
+        retained sampled traces (None with none recorded) — the stamp
+        SERVE_r*.json and bench.py's serving leg carry per arm, so a
+        scheduler A/B attributes WHICH stage moved, not just e2e p99.
+        Medians use the shared nearest-rank convention."""
+        with self._lock:
+            traces = [t for t in self._traces if "total_ms" in t]
+        if not traces:
+            return None
+
+        def med(key: str) -> float | None:
+            xs = [
+                float(t[key]) for t in traces
+                if isinstance(t.get(key), (int, float))
+            ]
+            p = nearest_rank(xs, 50)
+            return round(p, 3) if p is not None else None
+
+        return {
+            "sampled": len(traces),
+            "queue_ms_p50": med("queue_ms"),
+            "pack_ms_p50": med("pack_ms"),
+            "execute_ms_p50": med("execute_ms"),
+            "respond_ms_p50": med("respond_ms"),
+            "total_ms_p50": med("total_ms"),
+            # Exemplars: the most recent few — the ids an operator greps
+            # in metrics.jsonl (kind="trace") for the full waterfall.
+            "exemplar_trace_ids": [
+                t["trace_id"] for t in traces[-5:] if "trace_id" in t
+            ],
+        }
+
     def bind_registry(self, registry=None, prefix: str = "serve") -> None:
         """Expose these counters through the shared obs/ CounterRegistry
         (default: the process-global one) as pull-style gauges — the
         Prometheus exposition then reads live values at render time and
         the hot recording path above stays untouched. The trainer's
-        metrics and these serving counters land in ONE namespace."""
+        metrics and these serving counters land in ONE namespace. Also
+        binds the ``{prefix}_latency_ms`` histogram (push-style: the
+        record path observes into it) whose buckets carry exemplar
+        trace_ids of sampled requests."""
         from induction_network_on_fewrel_tpu.obs.export import get_registry
 
         reg = registry or get_registry()
         self._bound_registry = reg
         self._bound_fns: list[tuple[str, object]] = []
+        # Fresh histogram per bind (latest wins, like gauge_fn): a
+        # successor engine must not inherit — or be deleted with — a
+        # closed predecessor's counts.
+        reg.unregister(f"{prefix}_latency_ms")
+        self._hist = reg.histogram(
+            f"{prefix}_latency_ms",
+            help="request latency with exemplar trace_ids",
+        )
+        self._hist_name = f"{prefix}_latency_ms"
 
         def _register(full: str, f, help: str) -> None:
             self._bound_fns.append((full, f))
@@ -215,6 +347,9 @@ class ServingStats:
             return
         for name, f in self._bound_fns:
             reg.unregister(name, fn=f)
+        if self._hist is not None:
+            reg.unregister(self._hist_name, inst=self._hist)
+            self._hist = None
         self._bound_registry = None
         self._bound_fns = []
 

@@ -19,7 +19,7 @@ import numpy as np
 
 from induction_network_on_fewrel_tpu.config import ExperimentConfig
 from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
-from induction_network_on_fewrel_tpu.obs.spans import span
+from induction_network_on_fewrel_tpu.obs.spans import get_tracker, span
 from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
 from induction_network_on_fewrel_tpu.train.steps import (
     init_state,
@@ -370,7 +370,18 @@ class FewShotTrainer:
         profiling = profile_done = False
         diverged_stop = False
         step = start_step
+        # Step-scoped trace ids (ISSUE 9): each loop iteration (one
+        # dispatch — spc optimizer steps) runs under a fresh trace
+        # context, so the train-side spans (sample/dispatch/eval/
+        # checkpoint) carry trace ids and join the same ring/waterfall
+        # machinery the serving data plane uses. Cost per iteration: one
+        # tiny object + one string. Cleared at loop entry too — a prior
+        # run that crashed mid-loop must not leak its last step's id
+        # into this one's spans.
+        tracker = get_tracker()
+        tracker.set_trace(None)
         while step < end_step:
+            tracker.set_trace(tracker.new_context())
             # Trace steps [1, 1+profile_steps): the first call (the compile)
             # stays outside the trace so it doesn't drown the steady state.
             if self.profile_dir is not None:
@@ -582,6 +593,7 @@ class FewShotTrainer:
                         break
                 t0 = time.monotonic()
                 last_logged = step
+        tracker.set_trace(None)   # end of the last step's trace scope
         if profiling:
             jax.profiler.stop_trace()  # run ended inside the trace window
         if self._materialize is not None and not diverged_stop:

@@ -59,6 +59,19 @@ KNOWN_KINDS = frozenset({
     # wire_bytes_per_step, wire_mb_per_step, dp. obs_report's comms
     # section reads these (headline: wire_mb_per_step).
     "comms",
+    # Request-scoped tracing (ISSUE 9): one record per SAMPLED serving
+    # request with trace_id (str), tenant (str), scheduler (str), and the
+    # segment breakdown in ms — queue_ms (admission -> worker starts
+    # stacking), pack_ms (host stack/pad), execute_ms (device program),
+    # respond_ms (post-execute host work: batch accounting + per-row
+    # verdict build; future delivery falls after the stamp) — whose sum
+    # equals
+    # total_ms, the request's measured end-to-end latency (same
+    # timestamps by construction; obs_report renders the waterfall and
+    # checks the sum within 5%). Control-plane actions emit the same kind
+    # with op="publish" + publish_ms instead of the request segments. All
+    # scalar/str fields — the schema contract is unchanged.
+    "trace",
     # HBM-roofline telemetry (ISSUE 6): one record per metric window on
     # BiLSTM runs with the shared step-byte arithmetic at this config's
     # residual knobs (utils/roofline.step_bytes — the SAME formulas

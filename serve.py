@@ -5,6 +5,11 @@ Example:
     python serve.py --load_ckpt ./ckpt/bilstm_5w5s \
         --support_file data/val_wiki.json --K 5 --input queries.jsonl
 
+Observability (ISSUE 9): add `--run_dir out --trace_sample 0.1` for
+per-request trace waterfalls (tools/obs_report.py) and
+`--slo_latency_ms 250` for the per-tenant SLO burn-rate engine with
+auto-captured diagnostics on a fast-window CRITICAL.
+
 No checkpoint / no data? `python serve.py` runs a fully synthetic demo
 (fresh-init weights, synthetic support corpus, built-in demo queries).
 """

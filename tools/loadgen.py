@@ -19,6 +19,16 @@ CPU against a synthetic-data checkpoint it must show:
   train->serve recipe); the drill asserts ZERO dropped queries and ZERO
   recompiles across the swap. Separate phase so the publish's device
   contention never skews the scheduler A/B numbers.
+* **Request tracing** (``--trace_sample``, ISSUE 9) — head-sampled
+  requests emit kind="trace" segment records (queue/pack/execute/respond)
+  to ``--run_dir``; the artifact stamps segment-breakdown medians +
+  exemplar trace_ids per arm, so a scheduler A/B attributes WHICH stage
+  moved. Render waterfalls with ``tools/obs_report.py RUN_DIR``.
+* **Burn-rate drill** (``--burn_drill``, ISSUE 9) — a dedicated overload
+  phase: open-loop traffic at several times the offered rate drives
+  latency through the SLO threshold; the drill asserts the fast window
+  trips a once-latched CRITICAL and that the auto-captured diagnostics
+  (flight dump + profiler trace or host-span snapshot) are on disk.
 
 * closed loop: C workers, each submitting synchronously — throughput is
   latency-bound, the classic "how fast can N clients go" number.
@@ -30,6 +40,8 @@ Usage:
         [--scheduler continuous|microbatch|ab] [--tenants 2]
         [--swap_drill] [--artifact SERVE_r01.json]
         [--concurrency 4] [--rate 200] [--duration 5] [--N 5] [--K 5]
+        [--run_dir OUT] [--trace_sample 0.1]
+        [--burn_drill] [--slo_latency_ms 50] [--slo_fast_s 3]
 
 No --ckpt: a synthetic-data checkpoint is created in a temp dir (fresh-init
 weights saved + restored through the real CheckpointManager read path).
@@ -85,8 +97,43 @@ def parse_args(argv=None):
     p.add_argument("--serving_dp", type=int, default=None,
                    help="dp-shard query scoring over this many devices")
     p.add_argument("--device", default="cpu", choices=["cpu", "tpu"])
+    p.add_argument("--run_dir", default=None,
+                   help="telemetry dir: metrics.jsonl (kind='serve'/'trace'"
+                        "), flight dumps + SLO captures land here; render "
+                        "with tools/obs_report.py")
+    p.add_argument("--trace_sample", type=float, default=0.1,
+                   help="request-trace head-sampling rate (0 = off); "
+                        "sampled segment records reach --run_dir and the "
+                        "artifact's per-arm trace summary")
+    p.add_argument("--slo_latency_ms", type=float, default=None,
+                   help="per-request latency objective (arms the SLO "
+                        "burn-rate engine; the burn drill derives one "
+                        "from measured p50 when unset)")
+    p.add_argument("--slo_availability", type=float, default=0.99,
+                   help="SLO good-fraction target")
+    p.add_argument("--slo_fast_s", type=float, default=3.0,
+                   help="fast burn window seconds (drill-scaled stand-in "
+                        "for the production 5m window)")
+    p.add_argument("--slo_slow_s", type=float, default=30.0,
+                   help="slow burn window seconds (1h-equivalent)")
+    p.add_argument("--burn_drill", action="store_true",
+                   help="overload phase per arm: drive latency through "
+                        "the SLO threshold, assert the fast window trips "
+                        "a once-latched CRITICAL + diagnostics captured "
+                        "(requires --run_dir for the artifacts)")
+    p.add_argument("--slo_profile", action="store_true",
+                   help="also attempt a jax.profiler trace in the SLO "
+                        "auto-capture (default off: on this image a "
+                        "profiler session concurrent with the threaded "
+                        "serving worker corrupts the heap and segfaults "
+                        "at interpreter exit — RUNBOOK §14; the host-span "
+                        "snapshot + flight dump are the guaranteed "
+                        "artifacts, chip sessions can flip this on)")
     p.add_argument("--seed", type=int, default=0)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.burn_drill and not args.run_dir:
+        p.error("--burn_drill needs --run_dir (captures land there)")
+    return args
 
 
 def make_synthetic_checkpoint(args, tmpdir: str) -> str:
@@ -123,7 +170,7 @@ def make_synthetic_checkpoint(args, tmpdir: str) -> str:
     return ckpt
 
 
-def build_engine(args, ckpt: str, scheduler: str):
+def build_engine(args, ckpt: str, scheduler: str, logger=None, slo=None):
     from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
 
     return InferenceEngine.from_checkpoint(
@@ -134,6 +181,24 @@ def build_engine(args, ckpt: str, scheduler: str):
         default_deadline_s=args.deadline_ms / 1e3,
         scheduler=scheduler, tenant_share=args.tenant_share,
         dp=args.serving_dp,
+        logger=logger, slo=slo, trace_sample=args.trace_sample,
+    )
+
+
+def build_slo(args, logger=None, recorder=None, capture=None):
+    """One SLOEngine per arm (fresh burn windows — the A/B arms must not
+    share budget history); the DiagnosticsCapture is SHARED across arms
+    (its per-capture counter keeps every arm's snapshots distinct on
+    disk). None when nothing asked for it."""
+    if args.slo_latency_ms is None and not args.burn_drill:
+        return None
+    from induction_network_on_fewrel_tpu.obs import SLOEngine, SLOObjective
+
+    return SLOEngine(
+        SLOObjective(availability=args.slo_availability,
+                     latency_ms=args.slo_latency_ms),
+        fast_window_s=args.slo_fast_s, slow_window_s=args.slo_slow_s,
+        logger=logger, recorder=recorder, capture=capture,
     )
 
 
@@ -250,11 +315,14 @@ def run_closed(engine, pools, concurrency, duration, rng):
     return lat, errs[0], wall
 
 
-def run_open(engine, pools, rate, duration, rng, swap_at=None, swap_fn=None):
+def run_open(engine, pools, rate, duration, rng, swap_at=None, swap_fn=None,
+             deadline_s=None):
     """Poisson arrivals at ``rate``/s round-robining tenants; non-adaptive
     (futures collected at the end) — saturation surfaces as Saturated
     rejections + p99 growth. ``swap_fn`` fires once after ``swap_at``
-    seconds (the hot-swap-under-load drill)."""
+    seconds (the hot-swap-under-load drill). ``deadline_s`` overrides the
+    engine default per request (the burn drill submits with the SLO
+    threshold as the deadline — clients give up at the objective)."""
     names = list(pools)
     futures, rejected = [], 0
     lat = {t: [] for t in names}
@@ -295,7 +363,9 @@ def run_open(engine, pools, rate, duration, rng, swap_at=None, swap_fn=None):
         pool = pools[tenant]
         inst = pool[int(rng.integers(len(pool)))]
         try:
-            futures.append((tenant, engine.submit(inst, tenant=tenant)))
+            futures.append((tenant, engine.submit(
+                inst, deadline_s=deadline_s, tenant=tenant,
+            )))
         except Exception:  # noqa: BLE001 — Saturated backpressure
             rejected += 1
         i += 1
@@ -426,7 +496,90 @@ def drive_one(engine, args, rng, swap_fn=None) -> dict:
     snap = engine.stats.snapshot(queue_depth=engine.batcher.queue_depth)
     out["stats"] = snap
     out["per_tenant_stats"] = engine.stats.tenant_snapshot()
+    # Per-arm trace summary (ISSUE 9): segment-breakdown medians +
+    # exemplar trace_ids over the sampled requests of THIS arm's engine —
+    # the artifact-side attribution of where each scheduler spends a
+    # request's latency (full waterfalls: obs_report on --run_dir).
+    out["trace"] = engine.stats.trace_summary()
+    if args.burn_drill:
+        # LAST, after the measured numbers are snapshotted: the drill
+        # deliberately overloads the engine and would pollute every
+        # percentile recorded after it.
+        out["burn_drill"] = run_burn_drill(engine, pools, args, rng)
     return out
+
+
+def run_burn_drill(engine, pools, args, rng) -> dict:
+    """Overload phase: ESCALATING open-loop arrival rates drive latency
+    (and, at the top multipliers, queue rejections) through the SLO
+    objective; the fast-window burn must trip a once-latched CRITICAL
+    whose diagnostics auto-capture is on disk before this returns.
+
+    The latency objective is 2x the arm's measured p50 — an honest
+    threshold the healthy phases satisfied, so the trip is caused by the
+    overload, not by an impossible objective. Drill submits carry the
+    threshold as their DEADLINE (clients give up at the objective), so a
+    queue-delayed request burns budget as a deadline miss even when the
+    device itself stays fast. Escalation (4x/16x/64x the configured
+    rate, ~1.2 s each, stop at first trip) makes the drill
+    machine-speed-independent: a host fast enough to absorb one
+    multiplier cleanly meets the next one."""
+    from induction_network_on_fewrel_tpu.obs.health import SLOObjective
+
+    slo = engine.slo
+    baseline_p50 = engine.stats.percentile_ms(50) or 5.0
+    thr = args.slo_latency_ms or round(max(1.0, 2.0 * baseline_p50), 3)
+    slo.default_objective = SLOObjective(
+        availability=args.slo_availability, latency_ms=thr
+    )
+    phase_s = max(1.2, args.duration / 4)
+    totals = {"offered": 0, "served": 0, "rejected": 0,
+              "deadline_miss": 0, "dropped": 0}
+    all_lat: dict[str, list] = {t: [] for t in pools}
+    tripped_at = None
+    for mult in (4, 16, 64):
+        rate = max(args.rate * mult, 100.0)
+        print(f"burn drill: rate {rate}/s for {phase_s}s against "
+              f"latency SLO {thr} ms (fast window {args.slo_fast_s}s)",
+              file=sys.stderr)
+        lat, rej, miss, dropped, wall, offered, _ = run_open(
+            engine, pools, rate, phase_s, rng, deadline_s=thr / 1e3,
+        )
+        for t, xs in lat.items():
+            all_lat[t].extend(xs)
+        totals["offered"] += offered
+        totals["served"] += sum(len(x) for x in lat.values())
+        totals["rejected"] += rej
+        totals["deadline_miss"] += miss
+        totals["dropped"] += dropped
+        slo.evaluate()
+        if any(e.event == "slo_fast_burn" for e in slo.events):
+            tripped_at = mult
+            break
+    fast = [e for e in slo.events if e.event == "slo_fast_burn"]
+    # Once-latch: a second sweep while still burning must emit nothing new.
+    relatch = slo.evaluate()
+    flat = _flat(all_lat)
+    return {
+        "threshold_ms": thr,
+        "tripped_at_rate_multiplier": tripped_at,
+        "p99_ms": pct_ms(flat, 99),
+        **totals,
+        "tripped": slo.tripped,
+        "fast_burn_events": len(fast),
+        "once_latched": len(relatch) == 0,
+        "burn_rates": {
+            t: slo.burn_rates(t) for t in sorted(all_lat)
+            if slo.burn_rates(t) is not None
+        },
+        "captures": {
+            latch: {
+                k: cap.get(k) for k in
+                ("flight_dump", "span_snapshot", "profile", "profile_state")
+            }
+            for latch, cap in slo.captured.items()
+        },
+    }
 
 
 def main(argv=None) -> int:
@@ -449,12 +602,35 @@ def main(argv=None) -> int:
         ["continuous", "microbatch"] if args.scheduler == "ab"
         else [args.scheduler]
     )
+    # Shared telemetry sinks (one metrics.jsonl across arms — records
+    # carry the scheduler, so obs_report can split); SLO engines are
+    # per-arm (fresh burn windows each).
+    logger = recorder = capture = None
+    if args.run_dir:
+        from induction_network_on_fewrel_tpu.obs import (
+            DiagnosticsCapture,
+            FlightRecorder,
+        )
+        from induction_network_on_fewrel_tpu.utils.metrics import (
+            MetricsLogger,
+        )
+
+        logger = MetricsLogger(args.run_dir)
+        recorder = FlightRecorder(out_dir=args.run_dir)
+        logger.add_hook(recorder.record_metric)
+        capture = DiagnosticsCapture(
+            args.run_dir, recorder=recorder, profile=args.slo_profile,
+        )
     results = {}
     rc = 0
     try:
         for arm in arms:
             rng = np.random.default_rng(args.seed)  # same arrivals per arm
-            engine = build_engine(args, ckpt, arm)
+            engine = build_engine(
+                args, ckpt, arm, logger=logger,
+                slo=build_slo(args, logger=logger, recorder=recorder,
+                              capture=capture),
+            )
             try:
                 swap_fn = None
                 if args.swap_drill:
@@ -499,6 +675,21 @@ def main(argv=None) -> int:
                     print(f"FAIL[{arm}]: hot-swap dropped queries",
                           file=sys.stderr)
                     rc = 1
+            burn = r.get("burn_drill")
+            if burn is not None:
+                got_capture = any(
+                    c.get("flight_dump") or c.get("span_snapshot")
+                    for c in burn["captures"].values()
+                )
+                print(f"[{arm}] burn drill: tripped={burn['tripped']} "
+                      f"fast_events={burn['fast_burn_events']} "
+                      f"once_latched={burn['once_latched']} "
+                      f"captures={len(burn['captures'])}")
+                if not (burn["tripped"] and burn["fast_burn_events"] >= 1
+                        and burn["once_latched"] and got_capture):
+                    print(f"FAIL[{arm}]: burn drill did not trip/latch/"
+                          f"capture as required", file=sys.stderr)
+                    rc = 1
 
         report = {
             "config": {
@@ -509,6 +700,10 @@ def main(argv=None) -> int:
                 "duration": args.duration, "device": args.device,
                 "serving_dp": args.serving_dp, "seed": args.seed,
                 "swap_drill": bool(args.swap_drill),
+                "trace_sample": args.trace_sample,
+                "burn_drill": bool(args.burn_drill),
+                "slo_latency_ms": args.slo_latency_ms,
+                "slo_availability": args.slo_availability,
             },
             "arms": results,
         }
@@ -536,8 +731,19 @@ def main(argv=None) -> int:
             with open(args.artifact, "w") as f:
                 json.dump(report, f, indent=1)
             print(f"wrote {args.artifact}", file=sys.stderr)
+        if args.run_dir:
+            print(f"telemetry in {args.run_dir} — render with "
+                  f"'python tools/obs_report.py {args.run_dir}'",
+                  file=sys.stderr)
         return rc
     finally:
+        if capture is not None:
+            # Join an in-flight background profiler capture: letting the
+            # interpreter tear down around the profiler's C++ session
+            # segfaulted at exit.
+            capture.wait(10.0)
+        if logger is not None:
+            logger.close()
         if tmp is not None:
             tmp.cleanup()
 

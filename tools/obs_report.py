@@ -9,9 +9,11 @@ Modes:
 
 * default        — human-readable report: p50/p99 step time, episodes/sec
                    trend, MFU (when the chip is known), eval accuracy ± CI,
-                   serving percentiles, health events, flight-recorder
-                   summary. Always schema-checks first; a malformed stream
-                   is a finding, not a crash.
+                   serving percentiles, request-trace waterfalls (sampled
+                   kind="trace" records; segment sums checked within 5% of
+                   measured latency), per-tenant SLO burn events, health
+                   events, flight-recorder summary. Always schema-checks
+                   first; a malformed stream is a finding, not a crash.
 * ``--check``    — schema validation only; exit 1 on any violation. This
                    is the machine gate tier-1 runs (tests/test_obs.py).
 * ``--json``     — the report as one JSON object (for dashboards/CI).
@@ -367,6 +369,114 @@ def roofline_summary(recs: list[dict], run_dir: Path) -> dict | None:
     return out
 
 
+SEGMENTS = ("queue", "pack", "execute", "respond")
+
+
+def _waterfall_lines(t: dict, width: int = 32) -> list[str]:
+    """One request trace -> ASCII waterfall: each segment drawn at its
+    offset within [0, total_ms], so the eye reads WHERE the latency went
+    (a long leading gap = queueing; a long tail = device execute)."""
+    total = float(t.get("total_ms") or 0.0)
+    segs = [(s, float(t.get(f"{s}_ms", 0.0))) for s in SEGMENTS]
+    ssum = sum(d for _, d in segs)
+    ok = total > 0 and abs(ssum - total) <= 0.05 * total
+    head = (
+        f"trace {t.get('trace_id')} tenant={t.get('tenant')} "
+        f"scheduler={t.get('scheduler')} bucket={int(t.get('bucket', 0))} "
+        f"total={total:.3f}ms (segments sum {ssum:.3f}ms, "
+        f"{'ok' if ok else 'MISMATCH > 5%'})"
+    )
+    lines = [head]
+    scale = width / total if total > 0 else 0.0
+    offset = 0.0
+    for name, dur in segs:
+        a = int(round(offset * scale))
+        b = max(a + 1, int(round((offset + dur) * scale)))
+        bar = " " * a + "#" * min(b - a, width - a)
+        lines.append(f"  {name:<8}{dur:9.3f}ms |{bar:<{width}}|")
+        offset += dur
+    return lines
+
+
+def trace_summary(recs: list[dict]) -> dict | None:
+    """Request-scoped tracing section (ISSUE 9, kind="trace"): sampled
+    per-request segment records from the serving data plane. Headlines:
+    segment medians (which stage owns the latency), the fraction of
+    traces whose segments sum to the measured end-to-end latency within
+    5% (the tentpole's consistency bar), and a rendered waterfall of the
+    slowest sampled request. Control-plane records (op="publish") are
+    counted separately."""
+    traces = [
+        r for r in recs
+        if r.get("kind") == "trace"
+        and isinstance(r.get("total_ms"), (int, float))
+    ]
+    control = [r for r in recs if r.get("kind") == "trace" and r.get("op")]
+    if not traces and not control:
+        return None
+    out: dict = {"records": len(traces) + len(control)}
+    if traces:
+        out["sampled_requests"] = len(traces)
+
+        def med(key: str) -> float | None:
+            xs = [
+                float(r[key]) for r in traces
+                if isinstance(r.get(key), (int, float))
+            ]
+            return round(_percentile(xs, 50), 3) if xs else None
+
+        for s in SEGMENTS:
+            out[f"{s}_ms_p50"] = med(f"{s}_ms")
+        out["total_ms_p50"] = med("total_ms")
+        sums_ok = sum(
+            1 for r in traces
+            if r["total_ms"] > 0 and abs(
+                sum(float(r.get(f"{s}_ms", 0.0)) for s in SEGMENTS)
+                - float(r["total_ms"])
+            ) <= 0.05 * float(r["total_ms"])
+        )
+        out["segments_sum_ok_frac"] = round(sums_ok / len(traces), 4)
+        by_tenant: dict[str, int] = {}
+        for r in traces:
+            tn = str(r.get("tenant"))
+            by_tenant[tn] = by_tenant.get(tn, 0) + 1
+        out["by_tenant"] = by_tenant
+        slowest = max(traces, key=lambda r: float(r["total_ms"]))
+        out["waterfall"] = _waterfall_lines(slowest)
+    if control:
+        out["control_records"] = len(control)
+        last = control[-1]
+        if isinstance(last.get("publish_ms"), (int, float)):
+            out["last_publish_ms"] = last["publish_ms"]
+    return out
+
+
+def slo_summary(recs: list[dict]) -> dict | None:
+    """SLO burn-rate section (ISSUE 9): kind="health" events named
+    slo_fast_burn / slo_slow_burn, grouped per tenant with the latest
+    burn rates — the at-a-glance "who is burning budget" table."""
+    events = [
+        r for r in recs
+        if r.get("kind") == "health"
+        and str(r.get("event", "")).startswith("slo_")
+    ]
+    if not events:
+        return None
+    out: dict = {"records": len(events)}
+    by_tenant: dict[str, dict] = {}
+    for e in events:
+        tn = str(e.get("tenant"))
+        row = by_tenant.setdefault(tn, {"events": 0})
+        row["events"] += 1
+        row["last_event"] = e.get("event")
+        row["severity"] = e.get("severity")
+        for k in ("burn_fast", "burn_slow"):
+            if isinstance(e.get(k), (int, float)):
+                row[k] = e[k]
+    out["tenants"] = {t: by_tenant[t] for t in sorted(by_tenant)}
+    return out
+
+
 def health_summary(recs: list[dict]) -> dict:
     events = [r for r in recs if r.get("kind") == "health"]
     by_event: dict[str, int] = {}
@@ -495,8 +605,8 @@ def render(report: dict) -> str:
     lines.append(f"schema: {n} records, {len(errors)} errors")
     for e in errors[:10]:
         lines.append(f"  ! {e}")
-    for section in ("train", "mfu", "eval", "serve", "ckpt",
-                    "input_pipeline", "comms", "roofline", "health",
+    for section in ("train", "mfu", "eval", "serve", "traces", "slo",
+                    "ckpt", "input_pipeline", "comms", "roofline", "health",
                     "flight_recorder", "overhead"):
         body = report.get(section)
         if body is None:
@@ -511,6 +621,13 @@ def render(report: dict) -> str:
                 for sk in v:
                     row = " ".join(f"{a}={b}" for a, b in v[sk].items())
                     lines.append(f"    {sk}: {row}")
+            elif isinstance(v, list) and v and all(
+                isinstance(x, str) for x in v
+            ):
+                # Preformatted block (the trace waterfall): one line each.
+                lines.append(f"  {k}:")
+                for x in v:
+                    lines.append(f"    {x}")
             else:
                 lines.append(f"  {k}: {v}")
     return "\n".join(lines)
@@ -553,6 +670,8 @@ def main(argv=None) -> int:
         "mfu": mfu_summary(run_dir, train),
         "eval": eval_summary(recs),
         "serve": serve_summary(recs),
+        "traces": trace_summary(recs),
+        "slo": slo_summary(recs),
         "ckpt": ckpt_summary(recs),
         "input_pipeline": data_summary(recs),
         "comms": comms_summary(recs),
