@@ -6,6 +6,7 @@ from induction_network_on_fewrel_tpu.data.fewrel import (  # noqa: F401
 from induction_network_on_fewrel_tpu.data.glove import GloveVocab  # noqa: F401
 from induction_network_on_fewrel_tpu.data.tokenizer import GloveTokenizer  # noqa: F401
 from induction_network_on_fewrel_tpu.data.synthetic import (  # noqa: F401
+    make_domain_shifted_fewrel,
     make_synthetic_fewrel,
     make_synthetic_glove,
 )

@@ -66,3 +66,78 @@ def make_synthetic_fewrel(
             )
         relations[f"P{9000 + r}"] = insts
     return FewRelDataset(relations)
+
+
+def make_domain_shifted_fewrel(
+    num_relations: int = 10,
+    instances_per_relation: int = 30,
+    vocab_size: int = 200,
+    sentence_len: tuple[int, int] = (8, 20),
+    triggers_per_relation: int = 3,
+    shift: float = 1.0,
+    seed: int = 0,
+) -> FewRelDataset:
+    """A domain-shifted twin of ``make_synthetic_fewrel`` (ISSUE 10).
+
+    Same relation names, same episode geometry — but each relation's
+    identifying trigger words move to a DISJOINT vocabulary block with
+    probability ``shift`` per occurrence (relation r's trigger t becomes
+    word ``n_trigger + r*tpr + t`` instead of ``r*tpr + t``). This is the
+    synthetic analog of FewRel 2.0's wiki -> pubmed transfer: relation
+    semantics are unchanged, the surface vocabulary that carries them is
+    not. A model trained on the source domain degrades toward chance as
+    ``shift`` -> 1.0 unless it has seen target-domain episodes (e.g. via
+    a datapipe mixture ramp) — exactly the silent quality cliff the
+    scenarios harness (tools/scenarios.py) measures.
+
+    ``shift=0.0`` reproduces the source domain's trigger placement
+    (though with an independent sentence draw); pass the same ``seed`` as
+    the source dataset so relation names line up.
+    """
+    if not 0.0 <= shift <= 1.0:
+        raise ValueError(f"shift must be in [0, 1], got {shift}")
+    rng = np.random.default_rng(seed + 0x5D1F7)
+    n_trigger = num_relations * triggers_per_relation
+    if vocab_size <= 2 * n_trigger + 10:
+        raise ValueError(
+            "vocab too small for disjoint source+shifted trigger blocks"
+        )
+
+    relations: dict[str, list[Instance]] = {}
+    for r in range(num_relations):
+        src_trig = [
+            f"w{r * triggers_per_relation + t}"
+            for t in range(triggers_per_relation)
+        ]
+        tgt_trig = [
+            f"w{n_trigger + r * triggers_per_relation + t}"
+            for t in range(triggers_per_relation)
+        ]
+        insts = []
+        for _ in range(instances_per_relation):
+            L = int(rng.integers(*sentence_len))
+            # Background draws start past BOTH trigger blocks so a
+            # shifted trigger is as exclusive to its relation as a source
+            # trigger is in the source domain.
+            toks = [
+                f"w{int(i)}" for i in rng.integers(2 * n_trigger, vocab_size, L)
+            ]
+            for t in range(int(rng.integers(1, 4))):
+                which = int(rng.integers(triggers_per_relation))
+                word = (
+                    tgt_trig[which] if rng.random() < shift
+                    else src_trig[which]
+                )
+                toks[int(rng.integers(0, L))] = word
+            h, t_ = rng.choice(L, 2, replace=False)
+            insts.append(
+                Instance(
+                    tokens=tuple(toks),
+                    head_pos=(int(h),),
+                    tail_pos=(int(t_),),
+                    head_name=toks[int(h)],
+                    tail_name=toks[int(t_)],
+                )
+            )
+        relations[f"P{9000 + r}"] = insts
+    return FewRelDataset(relations)

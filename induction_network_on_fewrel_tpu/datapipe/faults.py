@@ -81,6 +81,111 @@ class FeedFaults:
         )
 
 
+# --- query-side episode perturbation (ISSUE 10, scenarios harness) --------
+#
+# The fault plan above corrupts the FEED (a systems failure: bad DMA,
+# wedged producer). The perturbations below corrupt the *queries inside a
+# well-formed episode* — the model-quality failure modes a serving fleet
+# actually meets: noisy tokenization, truncated inputs, out-of-domain
+# garbage. Supports are left untouched on purpose: in the serving split
+# the class vectors are distilled once from clean supports and only the
+# query stream degrades. Same grammar discipline as FeedFaults.parse —
+# one spec string shared by tools/scenarios.py, the tests, and any drill.
+
+QUERY_PERTURBATIONS = ("token_noise", "mask_drop", "blank")
+
+
+def parse_perturbation(spec: str) -> tuple[str, float]:
+    """``"token_noise:0.3"`` -> ("token_noise", 0.3). Unknown modes or
+    rates outside [0, 1] raise (a typoed leg that silently evaluates
+    clean episodes would report a fake robustness number)."""
+    name, _, arg = spec.strip().partition(":")
+    if name not in QUERY_PERTURBATIONS:
+        raise ValueError(
+            f"unknown query perturbation {name!r} "
+            f"(known: {', '.join(QUERY_PERTURBATIONS)})"
+        )
+    rate = float(arg) if arg else 1.0
+    if not 0.0 <= rate <= 1.0:
+        raise ValueError(f"perturbation rate must be in [0, 1], got {rate}")
+    return name, rate
+
+
+def perturb_query_batch(batch, mode: str, rate: float, rng):
+    """Perturb the QUERY side of one EpisodeBatch (numpy, shape- and
+    dtype-preserving; supports and labels untouched).
+
+    * ``token_noise`` — each unmasked query token is replaced, with
+      probability ``rate``, by a token drawn from the batch's own
+      unmasked-token marginal (stays in-vocab by construction).
+    * ``mask_drop``  — the trailing ``rate`` fraction of each query's
+      mask zeroes out (input truncation).
+    * ``blank``      — a ``rate`` fraction of query ROWS have every
+      unmasked token replaced by the batch's single most frequent token
+      (constant out-of-domain garbage — the strongest leg).
+    """
+    import numpy as np
+
+    word = np.array(batch.query_word)          # writable copies
+    mask = np.array(batch.query_mask)
+    on = mask > 0
+    if mode == "token_noise":
+        pool = word[on]
+        flip = on & (rng.random(word.shape) < rate)
+        word[flip] = rng.choice(pool, size=int(flip.sum()))
+    elif mode == "mask_drop":
+        lengths = on.sum(axis=-1, keepdims=True)           # [..., 1]
+        # Floor at one kept token: a fully-masked query drives the
+        # encoder's masked_max to -inf (NaN logits) — that would measure
+        # a numerics artifact, not robustness to truncation.
+        keep = np.maximum(np.ceil(lengths * (1.0 - rate)), 1.0)
+        pos = np.cumsum(on, axis=-1)                       # 1-based in-mask
+        mask = np.where(on & (pos > keep), 0.0, mask).astype(
+            batch.query_mask.dtype
+        )
+    elif mode == "blank":
+        pool = word[on]
+        vals, counts = np.unique(pool, return_counts=True)
+        fill = vals[np.argmax(counts)]
+        rows = rng.random(word.shape[:-1]) < rate          # [B, TQ]
+        word = np.where((rows[..., None] & on), fill, word)
+    else:
+        raise ValueError(f"unknown query perturbation {mode!r}")
+    return batch._replace(
+        query_word=word.astype(batch.query_word.dtype), query_mask=mask
+    )
+
+
+class PerturbedSampler:
+    """Wrap any episode sampler so every batch's queries pass through one
+    perturbation leg — drops into ``FewShotTrainer.evaluate(sampler=...)``
+    unchanged (exposes ``batch_size``/``total_q``/``sample_batch``).
+    Deterministic given (sampler seed, ``seed``)."""
+
+    def __init__(self, sampler, spec: str, seed: int = 0):
+        import numpy as np
+
+        self.mode, self.rate = parse_perturbation(spec)
+        self.spec = spec
+        self._sampler = sampler
+        self._rng = np.random.default_rng(seed)
+        self.batch_size = sampler.batch_size
+        self.total_q = sampler.total_q
+
+    def sample_batch(self):
+        return perturb_query_batch(
+            self._sampler.sample_batch(), self.mode, self.rate, self._rng
+        )
+
+    def __iter__(self):
+        while True:
+            yield self.sample_batch()
+
+    def close(self) -> None:
+        if hasattr(self._sampler, "close"):
+            self._sampler.close()
+
+
 def poison_tree(tree):
     """NaN-poison float leaves, negate int leaves (shape-preserving, so the
     corruption models bad VALUES, not a feed bug the shape check would

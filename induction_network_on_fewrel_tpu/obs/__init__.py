@@ -9,6 +9,9 @@ One shared model for what used to be three fragmented mechanisms:
                  throughput regression, routing collapse, queue stall),
                  plus the per-tenant SLO burn-rate engine with
                  auto-capture diagnostics.
+* ``drift``    — online prediction-drift detector over serving verdicts
+                 (per-tenant NOTA rate / margin / entropy vs a
+                 calibration baseline, re-armed on publish; ISSUE 10).
 * ``recorder`` — flight recorder; dumps the last-N window on crash,
                  SIGTERM, or a watchdog trip.
 * ``export``   — counter/gauge/histogram registry + Prometheus text
@@ -26,6 +29,7 @@ from induction_network_on_fewrel_tpu.obs.export import (
     get_registry,
     set_registry,
 )
+from induction_network_on_fewrel_tpu.obs.drift import DriftDetector
 from induction_network_on_fewrel_tpu.obs.health import (
     DiagnosticsCapture,
     HealthEvent,
@@ -47,6 +51,7 @@ from induction_network_on_fewrel_tpu.obs.spans import (
 __all__ = [
     "CounterRegistry",
     "DiagnosticsCapture",
+    "DriftDetector",
     "FlightRecorder",
     "HealthEvent",
     "HealthWatchdog",

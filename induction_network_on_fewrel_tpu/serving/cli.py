@@ -9,7 +9,9 @@ stdout; serving metrics go to stderr and metrics.jsonl (kind="serve").
 ``--trace_sample`` adds per-request kind="trace" segment records (verdicts
 carry trace_id); ``--slo_latency_ms`` arms the per-tenant SLO burn-rate
 engine, whose fast-window CRITICAL auto-captures diagnostics to
-``--run_dir`` (RUNBOOK §14).
+``--run_dir`` (RUNBOOK §14); ``--drift`` arms the online prediction-drift
+detector (per-tenant NOTA rate / margin / entropy vs a calibration
+baseline, re-armed on every publish — RUNBOOK §15).
 """
 
 from __future__ import annotations
@@ -99,6 +101,22 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
                         "for drills)")
     p.add_argument("--slo_slow_s", type=float, default=3600.0,
                    help="slow burn window seconds (1h-equivalent)")
+    p.add_argument("--drift", action="store_true",
+                   help="arm the online prediction-drift detector "
+                        "(obs/drift.py): per-tenant NOTA rate / top-1 "
+                        "margin / score entropy vs a calibration baseline "
+                        "captured from the first post-arm traffic; a "
+                        "shift past band trips a once-latched WARNING/"
+                        "CRITICAL with diagnostics captured to --run_dir; "
+                        "every publish re-arms the baseline (RUNBOOK §15)")
+    p.add_argument("--drift_window", type=int, default=128,
+                   help="drift detection window (verdicts per tenant)")
+    p.add_argument("--drift_baseline", type=int, default=64,
+                   help="verdicts that form the calibration baseline "
+                        "after (re-)arming")
+    p.add_argument("--drift_band", type=float, default=4.0,
+                   help="alert band width in standard errors of the "
+                        "window mean (CRITICAL at 2x)")
     p.add_argument("--slo_profile", action="store_true",
                    help="also attempt a jax.profiler trace in the SLO "
                         "auto-capture (default off on this image — a "
@@ -110,7 +128,7 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
 
 
 def _fresh_engine(args, buckets, logger=None, watchdog=None, slo=None,
-                  trace_sample=0.0):
+                  drift=None, trace_sample=0.0):
     """Demo path: synthetic vocab + fresh-init induction weights (no
     checkpoint on disk). The serving machinery is identical; only the
     verdict quality is untrained."""
@@ -146,7 +164,7 @@ def _fresh_engine(args, buckets, logger=None, watchdog=None, slo=None,
         default_deadline_s=args.deadline_ms / 1e3,
         scheduler=args.scheduler, tenant_share=args.tenant_share,
         dp=args.dp, logger=logger, watchdog=watchdog,
-        slo=slo, trace_sample=trace_sample,
+        slo=slo, drift=drift, trace_sample=trace_sample,
     )
 
 
@@ -183,7 +201,10 @@ def serve_main(argv=None) -> int:
     logger = MetricsLogger(args.run_dir) if args.run_dir else None
     watchdog = None
     recorder = None
-    if args.watchdog or args.slo_latency_ms is not None:
+    needs_obs = (
+        args.watchdog or args.slo_latency_ms is not None or args.drift
+    )
+    if needs_obs:
         from induction_network_on_fewrel_tpu.obs import FlightRecorder
 
         recorder = FlightRecorder(out_dir=args.run_dir)
@@ -194,10 +215,18 @@ def serve_main(argv=None) -> int:
         from induction_network_on_fewrel_tpu.obs import HealthWatchdog
 
         watchdog = HealthWatchdog(logger=logger, recorder=recorder)
+    # One DiagnosticsCapture shared by the SLO and drift engines: its
+    # per-capture counter keeps their snapshots distinct on disk.
+    capture = None
+    if args.slo_latency_ms is not None or args.drift:
+        from induction_network_on_fewrel_tpu.obs import DiagnosticsCapture
+
+        capture = DiagnosticsCapture(args.run_dir or ".",
+                                     recorder=recorder,
+                                     profile=args.slo_profile)
     slo = None
     if args.slo_latency_ms is not None:
         from induction_network_on_fewrel_tpu.obs import (
-            DiagnosticsCapture,
             SLOEngine,
             SLOObjective,
         )
@@ -206,10 +235,16 @@ def serve_main(argv=None) -> int:
             SLOObjective(availability=args.slo_availability,
                          latency_ms=args.slo_latency_ms),
             fast_window_s=args.slo_fast_s, slow_window_s=args.slo_slow_s,
-            logger=logger, recorder=recorder,
-            capture=DiagnosticsCapture(args.run_dir or ".",
-                                       recorder=recorder,
-                                       profile=args.slo_profile),
+            logger=logger, recorder=recorder, capture=capture,
+        )
+    drift = None
+    if args.drift:
+        from induction_network_on_fewrel_tpu.obs import DriftDetector
+
+        drift = DriftDetector(
+            window=args.drift_window, baseline_n=args.drift_baseline,
+            band_sigma=args.drift_band,
+            logger=logger, recorder=recorder, capture=capture,
         )
     if args.load_ckpt:
         engine = InferenceEngine.from_checkpoint(
@@ -221,11 +256,11 @@ def serve_main(argv=None) -> int:
             default_deadline_s=args.deadline_ms / 1e3,
             scheduler=args.scheduler, tenant_share=args.tenant_share,
             dp=args.dp, logger=logger, watchdog=watchdog,
-            slo=slo, trace_sample=args.trace_sample,
+            slo=slo, drift=drift, trace_sample=args.trace_sample,
         )
     else:
         engine = _fresh_engine(args, buckets, logger=logger,
-                               watchdog=watchdog, slo=slo,
+                               watchdog=watchdog, slo=slo, drift=drift,
                                trace_sample=args.trace_sample)
 
     try:

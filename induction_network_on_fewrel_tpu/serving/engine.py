@@ -51,6 +51,21 @@ Request-scoped tracing + SLOs (ISSUE 9):
   rejected, deadline-missed) feeds it through ``ServingStats``, and the
   submit/emit paths tick its evaluation, so a burning tenant trips a
   CRITICAL (with auto-captured diagnostics) without any polling loop.
+
+Prediction-quality observability (ISSUE 10):
+
+* Every verdict carries its **quality features** — ``nota``, ``margin``
+  (top-1 class score minus runner-up) and ``entropy`` (softmax entropy
+  of the class scores) — computed in ``_verdict`` from the logits row
+  already in hand. They feed the per-tenant quality reservoirs in
+  ``ServingStats`` (one ``kind="quality"`` record per tenant per emit)
+  and, when armed, the online drift detector.
+* ``drift=DriftDetector(...)`` (obs/drift.py) compares windowed NOTA
+  rate / margin / entropy against a calibration baseline captured from
+  the first post-(re)arm traffic; a shift past band trips a once-latched
+  WARNING/CRITICAL with auto-captured diagnostics. Every hot-swap
+  publish **re-arms** the baseline (``rearm()`` in ``_traced_publish``)
+  — new weights legitimately move the prediction distribution.
 """
 
 from __future__ import annotations
@@ -60,6 +75,7 @@ import time
 
 import numpy as np
 
+from induction_network_on_fewrel_tpu.obs.drift import quality_features
 from induction_network_on_fewrel_tpu.obs.spans import (
     TraceSampler,
     get_tracker,
@@ -104,6 +120,7 @@ class InferenceEngine:
         logger=None,
         watchdog=None,
         slo=None,
+        drift=None,
         trace_sample: float = 0.0,
         start: bool = True,
     ):
@@ -150,6 +167,12 @@ class InferenceEngine:
         self.slo = slo
         if slo is not None and slo.logger is None:
             slo.logger = logger
+        # Online prediction-drift detector (obs/drift.py, ISSUE 10): fed
+        # one observation per verdict from the emit path; re-armed on
+        # every hot-swap publish. None (default) costs one `if`.
+        self.drift = drift
+        if drift is not None and drift.logger is None:
+            drift.logger = logger
 
         self.stats = ServingStats(slo=slo)
         self.stats.bind_registry()
@@ -274,19 +297,34 @@ class InferenceEngine:
         self, name: str, instances, tenant: str = DEFAULT_TENANT
     ) -> None:
         self.registry.register(name, instances, tenant=tenant)
+        self._drift_rearm(tenant, f"register_class {name!r}")
 
     def register_dataset(
         self, dataset, max_classes: int | None = None,
         tenant: str = DEFAULT_TENANT,
     ) -> list[str]:
-        return self.registry.register_dataset(
+        names = self.registry.register_dataset(
             dataset, max_classes=max_classes, tenant=tenant
         )
+        self._drift_rearm(tenant, f"register_dataset ({len(names)} classes)")
+        return names
 
     def set_nota_threshold(
         self, threshold: float | None, tenant: str = DEFAULT_TENANT
     ) -> None:
         self.registry.set_nota_threshold(threshold, tenant=tenant)
+        self._drift_rearm(tenant, "nota_threshold change")
+
+    def _drift_rearm(self, tenant: str, reason: str) -> None:
+        """Per-tenant control-plane changes (new classes, a threshold
+        adjustment) legitimately move THAT tenant's prediction
+        distribution just like a publish moves everyone's — the drift
+        baseline re-arms so a routine registry action never reads as a
+        model-quality incident. No-op (and event-free) when the tenant
+        has no accumulated drift state, so setup-time registration stays
+        silent."""
+        if self.drift is not None:
+            self.drift.rearm(tenant, reason=reason)
 
     @property
     def class_names(self) -> tuple[str, ...]:
@@ -322,6 +360,14 @@ class InferenceEngine:
             with tracker.span("serve/publish", **span_attrs):
                 version = publish_fn()
         self.stats.record_swap()
+        if self.drift is not None:
+            # A publish legitimately moves the prediction distribution
+            # (new weights, re-distilled class vectors): drop baselines +
+            # windows + latches and re-calibrate from the first
+            # post-publish traffic — a publish must never read as drift,
+            # and post-publish drift must be judged against the NEW
+            # normal.
+            self.drift.rearm(reason=f"snapshot_swap v{version}")
         self._emit_trace({
             "trace_id": ctx.trace_id,
             "op": "publish",
@@ -480,8 +526,21 @@ class InferenceEngine:
             self.stats.record_done(
                 now - req.enqueued_at, tenant=tenant,
                 trace_id=req.trace.trace_id if req.trace is not None else None,
+                nota=verdict["nota"], margin=verdict["margin"],
+                entropy=verdict["entropy"],
             )
             req.future.set_result(verdict)
+        if self.drift is not None:
+            # AFTER the resolution loop on purpose: a drift CRITICAL
+            # writes its diagnostics capture synchronously on this
+            # thread, and doing that mid-loop would stall delivery of
+            # the batch's remaining futures on disk I/O. Detection lags
+            # by at most one batch; clients never wait on a capture.
+            for _, verdict in resolved:
+                self.drift.observe(
+                    tenant, nota=verdict["nota"],
+                    margin=verdict["margin"], entropy=verdict["entropy"],
+                )
         if traced:
             # now - enqueued_at == queue + pack + execute + respond by
             # construction: the four segments tile [enqueued_at, now]
@@ -540,10 +599,18 @@ class InferenceEngine:
             is_nota = float(row[n]) + (thr or 0.0) > float(row[best])
         else:
             is_nota = thr is not None and float(row[best]) < thr
+        # Quality features (ISSUE 10): shared formula home in
+        # obs/drift.quality_features (class scores only — see its doc),
+        # so the offline calibration baseline and this online path can
+        # never disagree. O(n) numpy on the row in hand.
+        m_arr, e_arr = quality_features(row[:n])
+        margin, entropy = float(m_arr), float(e_arr)
         verdict = {
             "label": NO_RELATION if is_nota else names[best],
             "class_index": -1 if is_nota else best,
             "nota": is_nota,
+            "margin": round(margin, 6),
+            "entropy": round(entropy, 6),
             "tenant": snap.tenant,
             "snapshot_version": snap.version,
             "logits": {nm: float(row[i]) for i, nm in enumerate(names)},
@@ -570,6 +637,8 @@ class InferenceEngine:
                 self._logger, self._emit_step,
                 queue_depth=self.batcher.queue_depth,
             )
+            if self.drift is not None:
+                self.drift.emit(self._logger, self._emit_step)
 
     def emit_stats(self) -> None:
         if self.watchdog is not None:
@@ -584,6 +653,8 @@ class InferenceEngine:
                 self._logger, self.stats.batches,
                 queue_depth=self.batcher.queue_depth,
             )
+            if self.drift is not None:
+                self.drift.emit(self._logger, self.stats.batches)
 
     def close(self) -> None:
         self.batcher.close()

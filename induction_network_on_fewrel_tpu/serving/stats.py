@@ -26,6 +26,14 @@ ISSUE 9 additions:
 * ``record_trace`` retains a bounded window of sampled per-request trace
   records; ``trace_summary()`` reduces them to segment-breakdown medians
   + exemplar ids for SERVE/BENCH artifacts.
+
+ISSUE 10 addition: per-tenant **prediction-quality** counters — NOTA
+verdict counts plus top-1-margin and score-entropy reservoirs, fed from
+the verdict emit path — emitted as one ``kind="quality"`` record per
+tenant alongside the serve records. These are the same features the
+online drift detector (obs/drift.py) compares against its calibration
+baseline; the stats copy exists so the quality stream is observable even
+with no detector armed.
 """
 
 from __future__ import annotations
@@ -88,9 +96,17 @@ class _Reservoir:
 
 
 class _TenantStats:
-    """Per-tenant slice of the counters (guarded by the owner's lock)."""
+    """Per-tenant slice of the counters (guarded by the owner's lock).
 
-    __slots__ = ("served", "rejected", "shed", "deadline_missed", "lat")
+    The quality slice (ISSUE 10): ``nota`` counts ``no_relation``
+    verdicts, ``margin``/``entropy`` are reservoirs of the per-verdict
+    top-1 margin and score entropy — the same three features the online
+    drift detector (obs/drift.py) watches, kept here so the periodic
+    ``kind="quality"`` record states what the tenant's traffic looks
+    like even when no detector is armed."""
+
+    __slots__ = ("served", "rejected", "shed", "deadline_missed", "lat",
+                 "nota", "quality_n", "margin", "entropy")
 
     def __init__(self, reservoir_cap: int):
         self.served = 0
@@ -98,6 +114,12 @@ class _TenantStats:
         self.shed = 0
         self.deadline_missed = 0
         self.lat = _Reservoir(reservoir_cap)
+        self.nota = 0
+        self.quality_n = 0   # verdicts that CARRIED quality features —
+        #                      the honest nota_rate denominator when
+        #                      quality-less legacy completions mix in
+        self.margin = _Reservoir(reservoir_cap, seed=0x51F15EED)
+        self.entropy = _Reservoir(reservoir_cap, seed=0x5EED5EED)
 
 
 class ServingStats:
@@ -151,7 +173,13 @@ class ServingStats:
     def record_done(
         self, latency_s: float, tenant: str | None = None,
         trace_id: str | None = None,
+        nota: bool | None = None,
+        margin: float | None = None,
+        entropy: float | None = None,
     ) -> None:
+        """``nota``/``margin``/``entropy`` are the verdict's quality
+        features (engine._verdict computes them from the logits row);
+        None = caller has no quality signal (legacy paths)."""
         with self._lock:
             self.served += 1
             ms = latency_s * 1e3
@@ -160,6 +188,14 @@ class ServingStats:
             if ts is not None:
                 ts.served += 1
                 ts.lat.add(ms)
+                if nota is not None:
+                    ts.quality_n += 1
+                    if nota:
+                        ts.nota += 1
+                if margin is not None:
+                    ts.margin.add(float(margin))
+                if entropy is not None:
+                    ts.entropy.add(float(entropy))
             hist = self._hist
         # Outside the counter lock: the histogram and SLO engine have
         # their own locks, and neither ever calls back into this object.
@@ -394,11 +430,39 @@ class ServingStats:
                 }
             return out
 
+    def quality_snapshot(self) -> dict[str, dict]:
+        """Per-tenant prediction-quality view (ISSUE 10): {tenant:
+        {served, nota_rate, margin_p50, entropy_p50}} for tenants whose
+        verdicts carried quality features. The traffic-side half of the
+        quality record — obs/drift.py's ``emit`` adds the drift-state
+        half (baseline vs current vs band)."""
+        with self._lock:
+            out = {}
+            for name, ts in self._tenants.items():
+                if ts.quality_n == 0:
+                    continue
+                m50 = ts.margin.percentile(50)
+                e50 = ts.entropy.percentile(50)
+                out[name] = {
+                    "served": ts.served,
+                    # Rate over quality-BEARING verdicts only: mixing in
+                    # legacy nota=None completions would dilute it.
+                    "nota_rate": round(ts.nota / ts.quality_n, 4),
+                    "margin_p50": round(m50, 4) if m50 is not None else 0.0,
+                    "entropy_p50": round(e50, 4) if e50 is not None else 0.0,
+                }
+            return out
+
     def emit(self, logger, step: int, queue_depth: int | None = None) -> None:
         """The aggregate kind="serve" record plus ONE kind="serve" record
         per tenant (distinguished by the ``tenant`` string field — every
         field stays a scalar, so the metrics.jsonl schema contract and
-        ``obs_report --check`` hold unchanged)."""
+        ``obs_report --check`` hold unchanged), plus ONE ``kind="quality"``
+        record per tenant with quality-bearing verdicts (nota_rate /
+        margin_p50 / entropy_p50 — the model-quality stream next to the
+        latency stream, ISSUE 10)."""
         logger.log(step, kind="serve", **self.snapshot(queue_depth))
         for tenant, snap in sorted(self.tenant_snapshot().items()):
             logger.log(step, kind="serve", tenant=tenant, **snap)
+        for tenant, snap in sorted(self.quality_snapshot().items()):
+            logger.log(step, kind="quality", tenant=tenant, **snap)

@@ -72,6 +72,27 @@ KNOWN_KINDS = frozenset({
     # with op="publish" + publish_ms instead of the request segments. All
     # scalar/str fields — the schema contract is unchanged.
     "trace",
+    # Prediction-quality telemetry (ISSUE 10, two record shapes, all
+    # scalar/str): (a) per-tenant TRAFFIC records from serving — one per
+    # tenant per stats emit with tenant (str), served, nota_rate (NOTA
+    # verdict fraction), margin_p50 (top-1 class margin), entropy_p50
+    # (softmax entropy of the class scores) — serving/stats.py
+    # quality_snapshot; (b) DRIFT-STATE records from obs/drift.py's emit
+    # with tenant (str), probe="drift", window, latched, and per feature
+    # f in {nota_rate, margin, entropy}: f_base / f_cur / f_band —
+    # calibration baseline vs current window vs alert band. obs_report's
+    # quality section splits on the ``probe`` field.
+    "quality",
+    # Scenario-harness results (ISSUE 10, tools/scenarios.py): one record
+    # per evaluated scenario leg with leg (str: "in_domain" |
+    # "cross_domain" | "da_mixture" | "nota_calibration" | an adversarial
+    # perturbation spec), accuracy, acc_ci95, and leg-specific scalars
+    # (shift for cross-domain legs, best_f1/best_tau + baseline stats for
+    # the NOTA calibration, the perturbation rate for adversarial legs).
+    # The SCENARIOS_r*.json artifact carries the same numbers; the
+    # records exist so a scenarios run is a first-class telemetry run
+    # (obs_report renders a scenarios section, --check validates).
+    "scenario",
     # HBM-roofline telemetry (ISSUE 6): one record per metric window on
     # BiLSTM runs with the shared step-byte arithmetic at this config's
     # residual knobs (utils/roofline.step_bytes — the SAME formulas

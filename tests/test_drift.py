@@ -1,0 +1,394 @@
+"""Online prediction-drift detection (ISSUE 10, obs/drift.py).
+
+Covers: baseline capture + band math, the injectable-clock evaluation
+throttle, once-latched WARNING/CRITICAL with diagnostics auto-capture,
+re-arm on return-to-band and on rearm() (the publish path), explicit
+set_baseline (publish-time calibration), the engine-level drill in
+miniature (OOV traffic shift trips a once-latched CRITICAL; a publish
+re-arms; kind="quality" records pass obs_report --check), and the SLO
+engine's quality-feature plumbing through ServingStats.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.data.fewrel import Instance
+from induction_network_on_fewrel_tpu.data.tokenizer import GloveTokenizer
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.obs import (
+    DiagnosticsCapture,
+    DriftDetector,
+    FlightRecorder,
+)
+from induction_network_on_fewrel_tpu.obs.drift import quality_features
+from induction_network_on_fewrel_tpu.serving.buckets import zero_batch
+from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
+from induction_network_on_fewrel_tpu.serving.stats import ServingStats
+from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+_TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+)
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import obs_report  # noqa: E402
+
+
+def _feed(det, tenant, n, nota_p, margin, entropy, t0=0.0, dt=1.0,
+          rng=None):
+    """n observations with an evenly spread nota pattern at rate nota_p
+    (Bresenham accumulator: exact long-run rate at any window size)."""
+    import math
+
+    evs = []
+    for i in range(n):
+        nota = math.floor((i + 1) * nota_p) > math.floor(i * nota_p)
+        evs += det.observe(tenant, nota=nota, margin=margin,
+                           entropy=entropy, now=t0 + i * dt)
+    return evs
+
+
+def test_baseline_capture_then_quiet_on_stable_traffic():
+    det = DriftDetector(window=32, baseline_n=16, min_count=8,
+                        eval_interval_s=0.0)
+    assert not det.armed("t")
+    evs = _feed(det, "t", 16, nota_p=0.1, margin=1.0, entropy=0.5)
+    assert det.armed("t") and evs == []
+    base = det.baseline_for("t")
+    assert abs(base["nota_rate"][0] - 0.1) < 0.05
+    # Same-distribution traffic stays quiet.
+    evs = _feed(det, "t", 64, nota_p=0.1, margin=1.0, entropy=0.5, t0=100)
+    assert evs == [] and not det.tripped
+
+
+def test_shift_trips_once_latched_critical_with_capture(tmp_path):
+    rec = FlightRecorder(out_dir=tmp_path)
+    det = DriftDetector(
+        window=32, baseline_n=16, min_count=8, eval_interval_s=0.0,
+        recorder=rec,
+        capture=DiagnosticsCapture(tmp_path, recorder=rec, profile=False),
+    )
+    _feed(det, "t", 16, nota_p=0.0, margin=1.0, entropy=0.5)
+    # Injected shift: NOTA rate 0 -> 1. Must cross the critical band
+    # (floor 0.05 * crit_factor 2 = 0.1 shift) well within one window.
+    evs = _feed(det, "t", 32, nota_p=1.0, margin=1.0, entropy=0.5, t0=100)
+    crits = [e for e in evs if e.severity == "critical"]
+    assert det.tripped and len(crits) == 1
+    assert crits[0].event == "prediction_drift"
+    assert crits[0].data["feature"] == "nota_rate"
+    # Once-latched: continued shift emits nothing new.
+    evs = _feed(det, "t", 32, nota_p=1.0, margin=1.0, entropy=0.5, t0=200)
+    assert [e for e in evs if e.severity == "critical"] == []
+    # Diagnostics on disk (CPU-honest: span snapshot + flight dump).
+    (latch, cap), = det.captured.items()
+    assert latch == "drift:t:nota_rate:critical"
+    assert cap["span_snapshot"] and os.path.exists(cap["span_snapshot"])
+    assert cap["flight_dump"] and os.path.exists(cap["flight_dump"])
+
+
+def test_return_to_band_rearms_latch():
+    det = DriftDetector(window=16, baseline_n=8, min_count=8,
+                        eval_interval_s=0.0)
+    _feed(det, "t", 8, nota_p=0.0, margin=1.0, entropy=0.5)
+    evs = _feed(det, "t", 16, nota_p=1.0, margin=1.0, entropy=0.5, t0=100)
+    assert any(e.severity == "critical" for e in evs)
+    # Back inside the band: the window refills with baseline-like
+    # traffic, the latch re-arms, a second excursion re-trips.
+    evs = _feed(det, "t", 32, nota_p=0.0, margin=1.0, entropy=0.5, t0=200)
+    assert not any(e.event == "prediction_drift" for e in evs)
+    evs = _feed(det, "t", 16, nota_p=1.0, margin=1.0, entropy=0.5, t0=300)
+    assert any(e.severity == "critical" for e in evs)
+
+
+def test_critical_latch_holds_through_dip_to_warning():
+    """Shift noise around the critical boundary is ONE incident: a dip
+    from critical to merely-warning territory must not re-arm the
+    critical latch (else each re-crossing fires a fresh capture). Only
+    returning fully inside the band re-arms."""
+    det = DriftDetector(window=20, baseline_n=8, min_count=20,
+                        eval_interval_s=0.0, nota_rate_floor=0.1)
+    _feed(det, "t", 8, nota_p=0.0, margin=1.0, entropy=0.5)
+    # Window mean 0.25 > 2*0.1 -> CRITICAL.
+    _feed(det, "t", 20, nota_p=0.25, margin=1.0, entropy=0.5, t0=100)
+    # Dip to 0.15 (warning band), then back to 0.25: no second critical.
+    _feed(det, "t", 20, nota_p=0.15, margin=1.0, entropy=0.5, t0=200)
+    _feed(det, "t", 20, nota_p=0.25, margin=1.0, entropy=0.5, t0=300)
+    crits = [e for e in det.events if e.severity == "critical"]
+    assert len(crits) == 1, crits
+
+
+def test_quality_snapshot_rate_over_quality_bearing_only():
+    """nota_rate's denominator is the quality-BEARING verdict count:
+    legacy record_done calls without quality features must not dilute
+    it."""
+    stats = ServingStats()
+    for _ in range(50):
+        stats.record_done(0.001, tenant="a")            # legacy, no quality
+    for i in range(50):
+        stats.record_done(0.001, tenant="a", nota=(i < 10), margin=0.5,
+                          entropy=1.0)
+    snap = stats.quality_snapshot()["a"]
+    assert snap["served"] == 100
+    assert abs(snap["nota_rate"] - 0.2) < 1e-6, snap
+
+
+def test_warning_band_before_critical():
+    det = DriftDetector(window=100, baseline_n=16, min_count=100,
+                        eval_interval_s=0.0, nota_rate_floor=0.1)
+    _feed(det, "t", 16, nota_p=0.0, margin=1.0, entropy=0.5)
+    # Shift the window mean to ~0.15: past the 0.1 band, inside the 0.2
+    # critical band -> WARNING only.
+    evs = _feed(det, "t", 100, nota_p=0.15, margin=1.0, entropy=0.5,
+                t0=100)
+    drift = [e for e in evs if e.event == "prediction_drift"]
+    assert drift and all(e.severity == "warning" for e in drift)
+    assert not det.tripped
+
+
+def test_eval_interval_throttles_with_injectable_clock():
+    det = DriftDetector(window=16, baseline_n=8, min_count=8,
+                        eval_interval_s=10.0)
+    _feed(det, "t", 8, nota_p=0.0, margin=1.0, entropy=0.5)
+    # All observations inside one eval interval: at most ONE judgment
+    # runs, so at most one event despite a full-window shift.
+    evs = _feed(det, "t", 16, nota_p=1.0, margin=1.0, entropy=0.5,
+                t0=100, dt=0.01)
+    assert len([e for e in evs if e.event == "prediction_drift"]) <= 1
+    # Advancing the injected clock past the interval judges again (the
+    # nota_rate latch is held, but margin is clean — no flood either).
+    evs = det.observe("t", nota=True, margin=1.0, entropy=0.5, now=500.0)
+    assert [e.event for e in evs] in ([], ["prediction_drift"])
+
+
+def test_rearm_drops_baseline_and_recaptures():
+    det = DriftDetector(window=16, baseline_n=8, min_count=8,
+                        eval_interval_s=0.0)
+    _feed(det, "t", 8, nota_p=0.0, margin=1.0, entropy=0.5)
+    _feed(det, "t", 16, nota_p=1.0, margin=1.0, entropy=0.5, t0=100)
+    assert det.tripped
+    det.rearm(reason="publish v2")
+    assert not det.armed("t") and det.rearms == 1
+    rearms = [e for e in det.events if e.event == "drift_rearm"]
+    assert len(rearms) == 1 and "publish v2" in rearms[0].message
+    # Post-rearm the SHIFTED distribution becomes the new baseline —
+    # steady shifted traffic is the new normal, no events.
+    evs = _feed(det, "t", 40, nota_p=1.0, margin=1.0, entropy=0.5, t0=200)
+    assert det.armed("t")
+    assert not any(e.event == "prediction_drift" for e in evs)
+
+
+def test_set_baseline_from_calibration_artifact():
+    det = DriftDetector(window=16, baseline_n=8, min_count=8,
+                        eval_interval_s=0.0)
+    det.set_baseline("t", {
+        "nota_rate": (0.1, 0.3), "margin": (1.0, 0.2),
+        "entropy": (0.5, 0.1),
+    })
+    assert det.armed("t")     # no traffic needed
+    evs = _feed(det, "t", 16, nota_p=0.1, margin=1.0, entropy=0.5)
+    assert evs == []
+    evs = _feed(det, "t", 16, nota_p=1.0, margin=1.0, entropy=0.5, t0=100)
+    assert any(e.severity == "critical" for e in evs)
+    with pytest.raises(ValueError):
+        det.set_baseline("t", {"nota_rate": (0.0, 0.0)})
+
+
+def test_min_count_never_exceeds_window():
+    """A detector whose min_count can never be reached (window-capped
+    deque) would be a silent no-op: explicit min_count > window is
+    refused, and the default adapts to small windows so they are judged
+    when full."""
+    with pytest.raises(ValueError):
+        DriftDetector(window=16, min_count=32)
+    det = DriftDetector(window=16, baseline_n=8)   # default min_count
+    assert det.min_count == 16
+    _feed(det, "t", 8, nota_p=0.0, margin=1.0, entropy=0.5)
+    evs = _feed(det, "t", 32, nota_p=1.0, margin=1.0, entropy=0.5, t0=100)
+    assert any(e.severity == "critical" for e in evs)   # it judges
+
+
+def test_quality_features_formula():
+    m, e = quality_features(np.array([2.0, 1.0, 0.0]))
+    assert abs(float(m) - 1.0) < 1e-9
+    p = np.exp([2.0, 1.0, 0.0])
+    p /= p.sum()
+    assert abs(float(e) - float(-(p * np.log(p)).sum())) < 1e-9
+    # Vectorized + n=1 degenerate.
+    m2, _ = quality_features(np.zeros((4, 1)))
+    assert m2.shape == (4,) and float(m2.max()) == 0.0
+
+
+def test_stats_quality_snapshot_and_emit(tmp_path):
+    stats = ServingStats()
+    for i in range(10):
+        stats.record_done(0.001, tenant="a", nota=(i < 3), margin=0.5,
+                          entropy=1.2)
+    snap = stats.quality_snapshot()["a"]
+    assert snap["served"] == 10 and abs(snap["nota_rate"] - 0.3) < 1e-6
+    assert snap["margin_p50"] == 0.5 and snap["entropy_p50"] == 1.2
+    logger = MetricsLogger(tmp_path, quiet=True)
+    stats.emit(logger, step=1)
+    logger.close()
+    lines = [json.loads(ln) for ln in
+             (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    quality = [r for r in lines if r["kind"] == "quality"]
+    assert len(quality) == 1 and quality[0]["tenant"] == "a"
+    n, errors = obs_report.check_schema(tmp_path / "metrics.jsonl")
+    assert errors == []
+
+
+# --- engine-level drill in miniature ---------------------------------------
+
+CFG = ExperimentConfig(
+    model="induction", encoder="cnn", hidden_size=16,
+    vocab_size=122, word_dim=8, pos_dim=2, max_length=16,
+    induction_dim=8, ntn_slices=4, routing_iters=2,
+    n=3, train_n=3, k=2, q=2, device="cpu",
+)
+
+
+@pytest.fixture(scope="module")
+def world():
+    vocab = make_synthetic_glove(vocab_size=CFG.vocab_size - 2,
+                                 word_dim=CFG.word_dim)
+    tok = GloveTokenizer(vocab, max_length=CFG.max_length)
+    model = build_model(CFG, glove_init=vocab.vectors)
+    params = model.init(
+        jax.random.key(0),
+        zero_batch(CFG.max_length, (1, CFG.n, CFG.k)),
+        zero_batch(CFG.max_length, (1, 2)),
+    )
+    ds = make_synthetic_fewrel(
+        num_relations=4, instances_per_relation=10,
+        vocab_size=CFG.vocab_size - 2, seed=1,
+    )
+    return tok, model, params, ds
+
+
+def _drain(eng):
+    while eng.batcher.queue_depth:
+        eng.batcher.drain_once(block_s=0.01)
+
+
+def test_engine_drift_drill_miniature(tmp_path, world):
+    """The loadgen drift drill's logic at tier-1 scale: calibrated NOTA
+    floor -> baseline -> OOV shift trips once-latched critical with
+    capture -> publish re-arms -> clean re-baseline; the run's
+    kind='quality' records pass obs_report --check."""
+    from tools.loadgen import _nota_gap, calibrate_drift_floor
+
+    tok, model, params, ds = world
+    logger = MetricsLogger(tmp_path, quiet=True)
+    det = DriftDetector(
+        window=24, baseline_n=16, min_count=12, eval_interval_s=0.0,
+        capture=DiagnosticsCapture(tmp_path, recorder=None, profile=False),
+    )
+    eng = InferenceEngine(
+        model, params, CFG, tok, k=CFG.k, buckets=(1, 8),
+        logger=logger, drift=det, start=False,
+    )
+    try:
+        eng.register_dataset(ds, tenant="acme")
+        eng.warmup()
+        pool = [i for r in ds.rel_names for i in ds.instances[r][CFG.k:]]
+        oov = Instance(tokens=tuple("zqx%d" % j for j in range(8)),
+                       head_pos=(0,), tail_pos=(1,))
+
+        def classify(inst):
+            fut = eng.submit(inst, tenant="acme")
+            _drain(eng)
+            return fut.result(timeout=5.0)
+
+        # Verdicts carry the quality features.
+        v = classify(pool[0])
+        assert {"nota", "margin", "entropy"} <= set(v)
+
+        probe_in = [classify(p) for p in pool]
+        probe_oov = [classify(oov) for _ in range(3)]
+        cal = calibrate_drift_floor(
+            [_nota_gap(x) for x in probe_in],
+            [_nota_gap(x) for x in probe_oov],
+        )
+        # Deterministic calibration: the floor splits the clean pool
+        # from the OOV point mass completely, and the clean pool covers
+        # a real fraction of the in-domain traffic.
+        assert cal["clean_idx"] and cal["clean_frac"] > 0
+        clean = [pool[i] for i in cal["clean_idx"]]
+        # The probe traffic armed the detector; changing the tenant's
+        # threshold is a control-plane distribution change and must
+        # re-arm it automatically (engine._drift_rearm).
+        assert det.armed("acme")
+        eng.set_nota_threshold(cal["threshold"], tenant="acme")
+        assert not det.armed("acme")
+        for i in range(det.baseline_n + det.min_count):
+            classify(clean[i % len(clean)])
+        assert det.armed("acme")
+        assert det.baseline_for("acme")["nota_rate"][0] == cal["base_rate"]
+
+        for _ in range(det.window):
+            classify(oov)
+            if det.tripped:
+                break
+        assert det.tripped, det.drift_state("acme")
+        crits = [e for e in det.events if e.severity == "critical"]
+        assert any(e.data.get("feature") == "nota_rate" for e in crits)
+        for _ in range(det.min_count):          # once-latch
+            classify(oov)
+        # Once-latch is per (tenant, feature): a sustained shift emits
+        # at most ONE critical per feature (margin may legitimately
+        # latch after nota_rate — a second feature, not a re-fire).
+        from collections import Counter
+
+        per_feature = Counter(
+            e.data.get("feature") for e in det.events
+            if e.severity == "critical"
+        )
+        assert all(v == 1 for v in per_feature.values()), per_feature
+        assert det.captured            # capture on disk
+        cap = next(iter(det.captured.values()))
+        assert os.path.exists(cap["span_snapshot"])
+
+        # Publish re-arms; clean-pool traffic re-baselines quietly (the
+        # NOTA rate over the clean pool is deterministic, so no
+        # nota_rate event and nothing critical; margin/entropy cycling
+        # warnings are a different feature and tolerated).
+        eng.publish_params(eng.params)
+        assert det.rearms >= 1 and not det.armed("acme")
+        before = len([e for e in det.events
+                      if e.event == "prediction_drift"])
+        for i in range(det.baseline_n + det.min_count):
+            classify(clean[i % len(clean)])
+        assert det.armed("acme")
+        new = [
+            e for e in det.events if e.event == "prediction_drift"
+        ][before:]
+        assert not any(
+            e.severity == "critical"
+            or e.data.get("feature") == "nota_rate"
+            for e in new
+        ), new
+        eng.emit_stats()
+    finally:
+        eng.close()
+        logger.close()
+    n, errors = obs_report.check_schema(tmp_path / "metrics.jsonl")
+    assert errors == [], errors
+    recs = obs_report.load_records(tmp_path / "metrics.jsonl")
+    q = obs_report.quality_summary(recs)
+    assert q and "acme" in q.get("tenants", {})
+    assert q.get("drift_events", 0) >= 1 and q.get("rearms", 0) >= 1
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -1,0 +1,245 @@
+"""Scenario-harness tier-1 gates (ISSUE 10, tools/scenarios.py).
+
+The same artifact discipline as tests/test_roofline.py: the committed
+``SCENARIOS_r*.json`` carries a ``tier1`` section (the miniature DA+NOTA
+run + regression band), and this file REPLAYS that run in-process — a
+change that silently tanks in-domain accuracy, cross-domain accuracy,
+DA-mixture recovery, NOTA calibration F1, or adversarial robustness
+fails tier-1 before it ships. Re-emitting the artifact
+(``python tools/scenarios.py --artifact SCENARIOS_r<next>.json``) is the
+ONE sanctioned way to move the recorded numbers.
+
+Plus the pure-math pins: NOTA sweep monotonicity/endpoints/determinism,
+query-perturbation shape/dtype discipline, and the domain-shifted
+dataset's trigger disjointness.
+"""
+
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from induction_network_on_fewrel_tpu.data import (
+    GloveTokenizer,
+    make_domain_shifted_fewrel,
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.datapipe.faults import (
+    PerturbedSampler,
+    parse_perturbation,
+    perturb_query_batch,
+)
+from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import obs_report  # noqa: E402
+import scenarios  # noqa: E402
+
+
+def _latest_artifact() -> dict:
+    paths = sorted(glob.glob(os.path.join(_REPO, "SCENARIOS_r*.json")))
+    assert paths, "no SCENARIOS_r*.json artifact in the repo root"
+    with open(paths[-1]) as f:
+        return json.load(f)
+
+
+# --- NOTA sweep math --------------------------------------------------------
+
+
+def test_nota_operating_points_monotone_and_endpoints():
+    """The decision is NOTA iff tau > gap, so the predicted set grows
+    with tau: recall and nota_rate nondecreasing. Endpoints: below every
+    gap nothing is predicted (precision-1.0-by-convention, recall 0);
+    above every gap everything is (recall 1.0)."""
+    rng = np.random.default_rng(0)
+    gap = rng.normal(0.0, 1.0, 400)
+    truth = gap < rng.normal(0.2, 1.0, 400)   # correlated ground truth
+    taus = scenarios.default_tau_grid(gap)
+    ops = scenarios.nota_operating_points(gap, truth, taus)
+    recalls = [o["recall"] for o in ops]
+    rates = [o["nota_rate"] for o in ops]
+    assert recalls == sorted(recalls)
+    assert rates == sorted(rates)
+    assert ops[0]["nota_rate"] == 0.0 and ops[0]["precision"] == 1.0
+    assert ops[0]["recall"] == 0.0
+    assert ops[-1]["recall"] == 1.0 and ops[-1]["nota_rate"] == 1.0
+    assert 0.0 in [o["tau"] for o in ops]    # the head's own calibration
+    # Deterministic: same inputs -> identical grid and points.
+    assert scenarios.nota_operating_points(gap, truth, taus) == ops
+    assert scenarios.default_tau_grid(gap) == taus
+
+
+# --- query perturbations ----------------------------------------------------
+
+
+def test_parse_perturbation_grammar():
+    assert parse_perturbation("token_noise:0.3") == ("token_noise", 0.3)
+    assert parse_perturbation("blank") == ("blank", 1.0)
+    with pytest.raises(ValueError):
+        parse_perturbation("gamma_rays:0.5")
+    with pytest.raises(ValueError):
+        parse_perturbation("token_noise:1.5")
+
+
+def _tiny_sampler(seed=0):
+    vocab = make_synthetic_glove(vocab_size=120)
+    ds = make_synthetic_fewrel(
+        num_relations=4, instances_per_relation=8, vocab_size=120, seed=seed
+    )
+    tok = GloveTokenizer(vocab, max_length=12)
+    return EpisodeSampler(ds, tok, n=2, k=2, q=2, batch_size=2, seed=seed)
+
+
+def test_perturb_query_batch_shapes_and_supports_untouched():
+    sampler = _tiny_sampler()
+    batch = sampler.sample_batch()
+    for mode, rate in (("token_noise", 0.5), ("mask_drop", 0.5),
+                       ("blank", 1.0)):
+        rng = np.random.default_rng(7)
+        out = perturb_query_batch(batch, mode, rate, rng)
+        for f in batch._fields:
+            assert getattr(out, f).shape == getattr(batch, f).shape
+            assert getattr(out, f).dtype == getattr(batch, f).dtype
+            if f.startswith("support") or f == "label":
+                assert np.array_equal(getattr(out, f), getattr(batch, f)), f
+        # Determinism under a fixed rng seed.
+        out2 = perturb_query_batch(batch, mode, rate,
+                                   np.random.default_rng(7))
+        assert np.array_equal(out.query_word, out2.query_word)
+        assert np.array_equal(out.query_mask, out2.query_mask)
+    noisy = perturb_query_batch(batch, "token_noise", 1.0,
+                                np.random.default_rng(3))
+    on = batch.query_mask > 0
+    assert (noisy.query_word[on] != batch.query_word[on]).mean() > 0.5
+    dropped = perturb_query_batch(batch, "mask_drop", 0.5,
+                                  np.random.default_rng(3))
+    assert dropped.query_mask.sum() < batch.query_mask.sum()
+
+
+def test_perturbed_sampler_wraps_and_closes():
+    ps = PerturbedSampler(_tiny_sampler(), "blank:1.0", seed=5)
+    assert ps.batch_size == 2 and ps.total_q == 4
+    b = ps.sample_batch()
+    on = b.query_mask > 0
+    # Every unmasked query token collapsed to one fill value.
+    assert len(np.unique(b.query_word[on])) == 1
+    ps.close()
+
+
+# --- domain-shifted twin ----------------------------------------------------
+
+
+def test_domain_shifted_fewrel_trigger_disjointness():
+    src = make_synthetic_fewrel(num_relations=3, instances_per_relation=6,
+                                vocab_size=120, seed=4)
+    tgt = make_domain_shifted_fewrel(num_relations=3,
+                                     instances_per_relation=6,
+                                     vocab_size=120, shift=1.0, seed=4)
+    assert tgt.rel_names == src.rel_names
+    n_trigger = 3 * 3
+    src_block = {f"w{i}" for i in range(n_trigger)}
+    tgt_tokens = {
+        t for rel in tgt.rel_names for inst in tgt.instances[rel]
+        for t in inst.tokens
+    }
+    # At shift=1.0 the source trigger block never appears in the target
+    # domain — the signal the source-trained model keys on is GONE.
+    assert not (tgt_tokens & src_block)
+    shifted_block = {f"w{i}" for i in range(n_trigger, 2 * n_trigger)}
+    assert tgt_tokens & shifted_block
+    with pytest.raises(ValueError):
+        make_domain_shifted_fewrel(shift=1.5)
+
+
+# --- the tier-1 regression gate --------------------------------------------
+
+
+def test_scenarios_tier1_regression_gate(tmp_path):
+    """Replay the committed artifact's miniature leg in-process; every
+    gated quality number must stay within its band (one-sided: quality
+    may improve, never silently regress). Also proves the harness emits
+    schema-clean kind='scenario' records."""
+    art = _latest_artifact()
+    t1 = art["tier1"]
+    band = t1["band"]["accuracy_abs"]
+    f1_band = t1["band"]["f1_abs"]
+    logger = MetricsLogger(tmp_path, quiet=True)
+    try:
+        res = scenarios.run_tier1(seed=int(t1["seed"]), logger=logger)
+    finally:
+        logger.close()
+    head = scenarios.tier1_headline(res)
+    for key in ("in_domain_accuracy", "cross_domain_accuracy",
+                "da_mixture_accuracy"):
+        assert head[key] >= t1[key] - band, (
+            f"{key} {head[key]} fell below the recorded {t1[key]} - "
+            f"{band} band — a model/loss/sampler change regressed "
+            f"scenario quality; re-emit the artifact "
+            f"(tools/scenarios.py --artifact) if intended"
+        )
+    assert head["nota_best_f1"] >= t1["nota_best_f1"] - f1_band
+    for spec, acc in t1["adversarial_accuracy"].items():
+        assert head["adversarial_accuracy"][spec] >= acc - band, spec
+    # Structure: the miniature world still exhibits the cross-domain
+    # cliff the harness exists to observe (disjoint triggers at
+    # shift=1.0 are untransferable without DA).
+    assert head["in_domain_accuracy"] >= \
+        head["cross_domain_accuracy"] + 0.2
+    # And the DA-mixture arm recovers a real fraction of it.
+    assert head["da_mixture_accuracy"] >= \
+        head["cross_domain_accuracy"] + 0.2
+
+    # Telemetry: every leg landed as a schema-clean kind="scenario"
+    # record, rendered by the obs_report scenarios section.
+    n, errors = obs_report.check_schema(tmp_path / "metrics.jsonl")
+    assert errors == [], errors
+    recs = obs_report.load_records(tmp_path / "metrics.jsonl")
+    scen = obs_report.scenario_summary(recs)
+    legs = scen["legs"]
+    # Grid legs carry their discriminator in the key (cross_domain per
+    # shift, nota_calibration per na_rate) so a grid run keeps every row.
+    for leg in ("in_domain", "cross_domain", "da_mixture",
+                "nota_calibration"):
+        assert any(k == leg or k.startswith(leg + "[") for k in legs), (
+            leg, sorted(legs),
+        )
+    assert scen["cross_domain_gap"] >= 0.2
+    assert any(leg.startswith("token_noise") for leg in legs)
+
+
+def test_scenarios_artifact_complete():
+    """Acceptance shape: the committed artifact carries cross-domain
+    accuracy + CI, the NOTA precision/recall sweep, adversarial legs,
+    and the tier1 band block the gate above replays."""
+    art = _latest_artifact()
+    full = art["full"]
+    ind = full["cross_domain"]["in_domain"]
+    assert {"accuracy", "acc_ci95"} <= set(ind)
+    assert full["cross_domain"]["by_shift"]
+    for leg in full["cross_domain"]["by_shift"].values():
+        assert {"accuracy", "acc_ci95", "shift"} <= set(leg)
+    assert "da_mixture" in full["cross_domain"]
+    for na, block in full["nota"].items():
+        ops = block["operating_points"]
+        assert len(ops) >= 5
+        assert all({"tau", "precision", "recall", "f1"} <= set(o)
+                   for o in ops)
+        assert {"nota_rate", "margin", "entropy"} <= set(block["baseline"])
+    adv = [k for k in full["adversarial"] if k != "clean"]
+    assert len(adv) >= 2
+    t1 = art["tier1"]
+    assert {"in_domain_accuracy", "cross_domain_accuracy",
+            "da_mixture_accuracy", "nota_best_f1", "band"} <= set(t1)
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

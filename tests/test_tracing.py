@@ -448,6 +448,50 @@ def test_slo_fast_window_trips_once_latched_and_rearms(tmp_path):
     assert "slo_fast_burn" in [e.event for e in evs]
 
 
+def test_slo_evaluate_cell_count_independent():
+    """Round-10 scale follow-up PAID: per-tenant running-sum windows.
+    A month-long slow window at 1 s buckets is 2.6M window cells — the
+    old ring design allocated that many cells PER TENANT up front and
+    summed O(window cells) per evaluate(). The running-sum design must
+    hold only TOUCHED bucket cells and answer burn_rates from maintained
+    totals, so this config is instant and tiny instead of gigabytes and
+    seconds."""
+    slo = SLOEngine(
+        SLOObjective(availability=0.99, latency_ms=10.0),
+        fast_window_s=300.0, slow_window_s=30 * 86400.0, bucket_s=1.0,
+    )
+    t = 0.0
+    for tenant in ("a", "b", "c"):
+        for i in range(100):
+            slo.record(tenant, latency_ms=99.0, now=t + i * 0.5)  # all bad
+    t0 = time.perf_counter()
+    evs = slo.evaluate(now=t + 60)
+    dt = time.perf_counter() - t0
+    assert {e.data["tenant"] for e in evs
+            if e.event == "slo_fast_burn"} == {"a", "b", "c"}
+    # Structural pin (the real gate — not timing): storage per tenant is
+    # bounded by TOUCHED buckets (100 records over 50 distinct seconds),
+    # never by the 2.6M-cell window capacity.
+    for tenant in ("a", "b", "c"):
+        wins = slo._windows[tenant]
+        assert len(wins["slow"].cells) <= 51
+        assert len(wins["fast"].cells) <= 51
+    # And the sweep is not proportional to window cells (generous bound
+    # for sandbox noise; the old design took seconds here).
+    assert dt < 1.0, f"evaluate() took {dt:.3f}s on a 2.6M-cell window"
+    # Running sums stay honest as cells expire: far in the future the
+    # fast window is empty, the month-long slow window still holds all.
+    rates = slo.burn_rates("a", now=t + 400)
+    assert rates["total_fast"] == 0 and rates["total_slow"] == 100
+    # Reads are windows on BOTH sides and read-only: a query at an
+    # EARLIER moment (buckets 0..10 of the 0..49 recorded) excludes the
+    # later traffic instead of counting the whole history, and neither
+    # that read nor the far-future one above destroyed any state.
+    past = slo.burn_rates("a", now=t + 10)
+    assert past["total_fast"] == 22 and past["total_slow"] == 22
+    assert slo.burn_rates("a", now=t + 60)["total_slow"] == 100
+
+
 def test_slo_min_count_guards_thin_windows():
     slo = SLOEngine(SLOObjective(availability=0.99, latency_ms=10.0))
     t = 0.0

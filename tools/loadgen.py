@@ -29,6 +29,16 @@ CPU against a synthetic-data checkpoint it must show:
   latency through the SLO threshold; the drill asserts the fast window
   trips a once-latched CRITICAL and that the auto-captured diagnostics
   (flight dump + profiler trace or host-span snapshot) are on disk.
+* **Drift drill** (``--drift_drill``, ISSUE 10) — a dedicated
+  model-quality phase on its own engine: calibrate an open-set NOTA
+  floor from live verdict gaps (a deterministic split between the
+  in-domain clean pool and a constant out-of-vocabulary probe), arm the
+  prediction-drift detector (obs/drift.py), baseline in-domain traffic,
+  then inject an OOV traffic shift. The drill asserts the NOTA-rate
+  shift trips a once-latched CRITICAL with diagnostics captured, that
+  continued shifted traffic emits nothing new (once-latch), and that a
+  hot-swap publish re-arms the baseline so post-publish in-domain
+  traffic is judged clean against the NEW normal.
 
 * closed loop: C workers, each submitting synchronously — throughput is
   latency-bound, the classic "how fast can N clients go" number.
@@ -121,6 +131,13 @@ def parse_args(argv=None):
                         "the SLO threshold, assert the fast window trips "
                         "a once-latched CRITICAL + diagnostics captured "
                         "(requires --run_dir for the artifacts)")
+    p.add_argument("--drift_drill", action="store_true",
+                   help="model-quality phase on its own engine: calibrate "
+                        "a NOTA floor, baseline in-domain traffic, inject "
+                        "an out-of-vocabulary shift, assert the drift "
+                        "detector trips a once-latched CRITICAL with "
+                        "captures and that a publish re-arms the baseline "
+                        "(requires --run_dir)")
     p.add_argument("--slo_profile", action="store_true",
                    help="also attempt a jax.profiler trace in the SLO "
                         "auto-capture (default off: on this image a "
@@ -133,6 +150,8 @@ def parse_args(argv=None):
     args = p.parse_args(argv)
     if args.burn_drill and not args.run_dir:
         p.error("--burn_drill needs --run_dir (captures land there)")
+    if args.drift_drill and not args.run_dir:
+        p.error("--drift_drill needs --run_dir (captures land there)")
     return args
 
 
@@ -170,7 +189,8 @@ def make_synthetic_checkpoint(args, tmpdir: str) -> str:
     return ckpt
 
 
-def build_engine(args, ckpt: str, scheduler: str, logger=None, slo=None):
+def build_engine(args, ckpt: str, scheduler: str, logger=None, slo=None,
+                 drift=None):
     from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
 
     return InferenceEngine.from_checkpoint(
@@ -181,7 +201,8 @@ def build_engine(args, ckpt: str, scheduler: str, logger=None, slo=None):
         default_deadline_s=args.deadline_ms / 1e3,
         scheduler=scheduler, tenant_share=args.tenant_share,
         dp=args.serving_dp,
-        logger=logger, slo=slo, trace_sample=args.trace_sample,
+        logger=logger, slo=slo, drift=drift,
+        trace_sample=args.trace_sample,
     )
 
 
@@ -582,6 +603,228 @@ def run_burn_drill(engine, pools, args, rng) -> dict:
     }
 
 
+def _oov_instance(i: int = 0):
+    """A constant out-of-vocabulary query: every token misses the GloVe
+    vocab and maps to UNK, so repeated submissions are a POINT MASS in
+    logit space — which makes the drill's calibrated floor split the
+    clean pool from the probe deterministically (a point mass is always
+    strictly on one side of a threshold)."""
+    from induction_network_on_fewrel_tpu.data.fewrel import Instance
+
+    toks = tuple(f"zqxdrift{i}" for _ in range(8))
+    return Instance(tokens=toks, head_pos=(0,), tail_pos=(1,))
+
+
+def _nota_gap(verdict: dict) -> float:
+    """The scalar the engine's NOTA decision thresholds on, verdict-side:
+    with a trained NOTA head (na_rate>0 checkpoints) the verdict is NOTA
+    iff ``thr > best - nota_logit``; with the open-set floor it is NOTA
+    iff ``thr > best``. Both are "NOTA iff gap < thr" on THIS gap, so
+    the drill's floor calibration works identically for either kind of
+    checkpoint."""
+    from induction_network_on_fewrel_tpu.serving.engine import NO_RELATION
+
+    best = max(
+        v for k, v in verdict["logits"].items() if k != NO_RELATION
+    )
+    if NO_RELATION in verdict["logits"]:
+        return best - verdict["logits"][NO_RELATION]
+    return best
+
+
+def calibrate_drift_floor(in_gaps, oov_gaps) -> dict:
+    """Pick the NOTA threshold + the "clean pool" that make the drill
+    DETERMINISTIC. Inputs are per-verdict ``_nota_gap`` values (the
+    scalar the engine thresholds on — best class logit, minus the NOTA
+    logit when a trained head exists, so the calibration is correct for
+    BOTH checkpoint kinds): the OOV probe is a point mass at ``v`` (one
+    constant instance -> one gap), so a threshold strictly between ``v``
+    and the in-domain gaps on the more-populated side of it gives a
+    baseline NOTA rate of exactly 0 (or exactly 1, when most in-domain
+    gaps sit BELOW v — drift is |delta|, both directions trip) and a
+    shifted rate of exactly 1 (or 0). No sampling noise: the injected
+    shift moves the windowed rate by 1.0, and clean post-publish traffic
+    from the clean pool reproduces the baseline rate exactly.
+
+    Returns {threshold, clean_idx (indices into in_gaps for the
+    baseline/clean phases), clean_frac, base_rate, shift_rate}."""
+    import numpy as np
+
+    in_l = np.asarray(in_gaps, dtype=np.float64)
+    v = float(np.median(np.asarray(oov_gaps, dtype=np.float64)))
+    eps = max(1e-9, 1e-6 * max(abs(v), 1.0))
+    above = np.flatnonzero(in_l > v + eps)
+    below = np.flatnonzero(in_l < v - eps)
+    if len(above) == 0 and len(below) == 0:
+        return {"threshold": None, "clean_idx": [], "clean_frac": 0.0,
+                "base_rate": None, "shift_rate": None}
+    if len(above) >= len(below):
+        # Floor between v and the smallest clean-pool gap: clean pool
+        # never verdicts NOTA (rate 0), the OOV point mass always does.
+        thr = (v + float(in_l[above].min())) / 2.0
+        clean, base_rate, shift_rate = above, 0.0, 1.0
+    else:
+        thr = (float(in_l[below].max()) + v) / 2.0
+        clean, base_rate, shift_rate = below, 1.0, 0.0
+    return {
+        "threshold": round(thr, 6),
+        "clean_idx": [int(i) for i in clean],
+        # Honest coverage: the fraction of the in-domain pool the floor
+        # classifies deterministically (the minority side straddling v
+        # is EXCLUDED from drill traffic, not misreported as separated).
+        "clean_frac": round(len(clean) / max(len(in_l), 1), 4),
+        "base_rate": base_rate,
+        "shift_rate": shift_rate,
+    }
+
+
+def run_drift_drill(args, ckpt, logger, recorder, capture) -> dict:
+    """The ISSUE 10 model-quality drill, on its own engine (the injected
+    shift would pollute every measured arm's quality stream):
+
+    1. probe — in-domain + constant-OOV traffic; calibrate the open-set
+       NOTA floor from the verdicts' NOTA gaps (deterministic split).
+    2. baseline — re-arm the detector, then in-domain traffic until the
+       calibration baseline captures and the detection window fills.
+    3. shift — OOV traffic; the NOTA rate (and typically margin/entropy)
+       must shift past the critical band: once-latched CRITICAL with
+       diagnostics on disk.
+    4. once-latch — more shifted traffic emits nothing new.
+    5. publish re-arm — hot-swap the engine's own params; the detector
+       re-arms (a publish legitimately moves the distribution), then
+       clean in-domain traffic re-baselines without tripping.
+    """
+    from induction_network_on_fewrel_tpu.obs import DriftDetector
+
+    tenant = "tenant0"
+    drift = DriftDetector(
+        window=64, baseline_n=48, min_count=24,
+        eval_interval_s=0.0,          # drill: judge every observation
+        logger=logger, recorder=recorder, capture=capture,
+    )
+    engine = build_engine(args, ckpt, "continuous", logger=logger,
+                          drift=drift)
+    out: dict = {}
+    try:
+        tenants = register_tenants(engine, args)
+        engine.warmup()
+        pool = _pools(tenants, args.K)[tenant]
+        oov = _oov_instance()
+
+        def classify_many(insts) -> list[dict]:
+            return [engine.classify(i, tenant=tenant) for i in insts]
+
+        # 1. probe + floor calibration (pre-baseline: everything the
+        # probe feeds the detector is discarded by the re-arm below).
+        # Each pool instance is probed ONCE — its logit is a constant,
+        # so the calibrated clean pool has a deterministic NOTA rate.
+        probe_in = classify_many(pool)
+        probe_oov = classify_many([oov] * 3)
+        cal = calibrate_drift_floor(
+            [_nota_gap(v) for v in probe_in],
+            [_nota_gap(v) for v in probe_oov],
+        )
+        out["calibration"] = {
+            k: cal[k] for k in
+            ("threshold", "base_rate", "shift_rate", "clean_frac")
+        }
+        out["clean_pool"] = len(cal["clean_idx"])
+        if cal["threshold"] is None or not cal["clean_idx"]:
+            out["tripped"] = False
+            return out
+        clean = [pool[i] for i in cal["clean_idx"]]
+        # Setting the threshold re-arms the tenant's drift baseline
+        # automatically (a control-plane change legitimately moves the
+        # distribution — engine._drift_rearm), discarding the probe
+        # traffic's state.
+        engine.set_nota_threshold(cal["threshold"], tenant=tenant)
+        out["rearmed_on_calibration"] = not drift.armed(tenant)
+        # Drill accounting starts HERE: a large pool can arm the
+        # detector DURING the probe phase and latch something on probe
+        # traffic (legitimately — it is real drift vs the probe mix);
+        # those pre-calibration events and the sticky `tripped` flag
+        # must not leak into the verdict, so every assertion below
+        # slices the event history from this point.
+        drill_start = len(drift.events)
+
+        def drill_events():
+            return list(drift.events)[drill_start:]
+
+        # 2. fresh baseline under the calibrated floor, from the clean
+        # pool (deterministic NOTA rate; cycled so every phase sees the
+        # same composition).
+        n_base = drift.baseline_n + drift.min_count + 8
+        classify_many(clean[i % len(clean)] for i in range(n_base))
+        out["baseline_armed"] = drift.armed(tenant)
+        out["baseline"] = drift.baseline_for(tenant)
+
+        # 3. injected shift: constant-OOV traffic.
+        tripped_after = None
+        for i in range(drift.window):
+            engine.classify(oov, tenant=tenant)
+            if any(e.severity == "critical" for e in drill_events()):
+                tripped_after = i + 1
+                break
+        crits = [e for e in drill_events() if e.severity == "critical"]
+        out["tripped"] = bool(crits)
+        out["tripped_after"] = tripped_after
+        out["critical_events"] = len(crits)
+        out["drift_features"] = sorted({
+            e.data.get("feature") for e in crits
+        })
+        out["state_at_trip"] = drift.drift_state(tenant)
+
+        # 4. once-latch: continued shift re-fires nothing — at most ONE
+        # critical per (tenant, feature); a second FEATURE latching late
+        # (margin often follows nota_rate) is a new latch, not a re-fire.
+        from collections import Counter
+
+        classify_many([oov] * drift.min_count)
+        per_feature = Counter(
+            e.data.get("feature") for e in drill_events()
+            if e.severity == "critical"
+        )
+        out["once_latched"] = bool(per_feature) and all(
+            v == 1 for v in per_feature.values()
+        )
+        out["captures"] = {
+            latch: {k: cap.get(k) for k in
+                    ("flight_dump", "span_snapshot", "profile_state")}
+            for latch, cap in drift.captured.items()
+        }
+
+        # 5. publish re-arms; clean traffic re-baselines quietly. The
+        # NOTA rate is deterministic over the clean pool, so no
+        # nota_rate event may fire and nothing may go CRITICAL;
+        # margin/entropy warnings from clean-pool composition cycling
+        # are tolerated (recorded, not failed).
+        rearms_before = drift.rearms
+        version = engine.publish_params(engine.params)
+        out["published_version"] = version
+        out["rearmed_on_publish"] = (
+            drift.rearms == rearms_before + 1
+            and not drift.armed(tenant)
+        )
+        events_before = [
+            e for e in drift.events if e.event == "prediction_drift"
+        ]
+        classify_many(clean[i % len(clean)] for i in range(n_base))
+        new_events = [
+            e for e in drift.events if e.event == "prediction_drift"
+        ][len(events_before):]
+        out["rebaselined"] = drift.armed(tenant)
+        out["post_publish_events"] = len(new_events)
+        out["clean_after_publish"] = not any(
+            e.severity == "critical"
+            or e.data.get("feature") == "nota_rate"
+            for e in new_events
+        )
+        engine.emit_stats()   # kind="quality" records land in metrics.jsonl
+        return out
+    finally:
+        engine.close()
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     import numpy as np
@@ -691,6 +934,37 @@ def main(argv=None) -> int:
                           f"capture as required", file=sys.stderr)
                     rc = 1
 
+        drift_drill_result = None
+        if args.drift_drill:
+            drill = run_drift_drill(args, ckpt, logger, recorder, capture)
+            drift_drill_result = drill
+            got_capture = any(
+                c.get("flight_dump") or c.get("span_snapshot")
+                for c in drill.get("captures", {}).values()
+            )
+            ok = (
+                drill.get("tripped")
+                and drill.get("critical_events", 0) >= 1
+                and drill.get("once_latched")
+                and got_capture
+                and drill.get("rearmed_on_publish")
+                and drill.get("rebaselined")
+                and drill.get("clean_after_publish")
+            )
+            print(f"[drift drill] calibrated floor "
+                  f"{drill['calibration']['threshold']} "
+                  f"(clean_frac {drill['calibration']['clean_frac']}) -> "
+                  f"tripped={drill.get('tripped')} "
+                  f"after={drill.get('tripped_after')} shifted queries, "
+                  f"features={drill.get('drift_features')}, "
+                  f"once_latched={drill.get('once_latched')}, "
+                  f"publish_rearm={drill.get('rearmed_on_publish')}, "
+                  f"clean_after={drill.get('clean_after_publish')}")
+            if not ok:
+                print("FAIL[drift drill]: did not trip/latch/capture/"
+                      "re-arm as required", file=sys.stderr)
+                rc = 1
+
         report = {
             "config": {
                 "tenants": args.tenants, "N": args.N, "K": args.K,
@@ -702,12 +976,15 @@ def main(argv=None) -> int:
                 "swap_drill": bool(args.swap_drill),
                 "trace_sample": args.trace_sample,
                 "burn_drill": bool(args.burn_drill),
+                "drift_drill": bool(args.drift_drill),
                 "slo_latency_ms": args.slo_latency_ms,
                 "slo_availability": args.slo_availability,
             },
             "arms": results,
         }
-        if len(results) == 2:
+        if drift_drill_result is not None:
+            report["drift_drill"] = drift_drill_result
+        if "continuous" in results and "microbatch" in results:
             c, m = results["continuous"], results["microbatch"]
             comparison = {}
             if "closed" in c and "closed" in m:

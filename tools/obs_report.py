@@ -11,9 +11,12 @@ Modes:
                    trend, MFU (when the chip is known), eval accuracy ± CI,
                    serving percentiles, request-trace waterfalls (sampled
                    kind="trace" records; segment sums checked within 5% of
-                   measured latency), per-tenant SLO burn events, health
-                   events, flight-recorder summary. Always schema-checks
-                   first; a malformed stream is a finding, not a crash.
+                   measured latency), per-tenant SLO burn events, the
+                   prediction-quality table + drift state (kind="quality",
+                   ISSUE 10), scenario-harness legs (kind="scenario"),
+                   health events, flight-recorder summary. Always
+                   schema-checks first; a malformed stream is a finding,
+                   not a crash.
 * ``--check``    — schema validation only; exit 1 on any violation. This
                    is the machine gate tier-1 runs (tests/test_obs.py).
 * ``--json``     — the report as one JSON object (for dashboards/CI).
@@ -477,6 +480,104 @@ def slo_summary(recs: list[dict]) -> dict | None:
     return out
 
 
+def quality_summary(recs: list[dict]) -> dict | None:
+    """Prediction-quality section (ISSUE 10, kind="quality"): two record
+    shapes split on the ``probe`` field — per-tenant TRAFFIC records
+    (serving/stats.quality_snapshot: nota_rate / margin_p50 /
+    entropy_p50) and DRIFT-STATE records (obs/drift.emit: baseline vs
+    current vs band per feature). Headlines: the per-tenant quality
+    table, the drift table, and prediction_drift / drift_rearm health
+    event counts."""
+    quality = [r for r in recs if r.get("kind") == "quality"]
+    drift_events = [
+        r for r in recs
+        if r.get("kind") == "health"
+        and r.get("event") in ("prediction_drift", "drift_rearm")
+    ]
+    if not quality and not drift_events:
+        return None
+    out: dict = {"records": len(quality)}
+    traffic = [r for r in quality if r.get("probe") != "drift"]
+    drift = [r for r in quality if r.get("probe") == "drift"]
+    if traffic:
+        by_tenant: dict[str, dict] = {}
+        for r in traffic:
+            if isinstance(r.get("tenant"), str):
+                by_tenant[r["tenant"]] = {
+                    k: r[k] for k in
+                    ("served", "nota_rate", "margin_p50", "entropy_p50")
+                    if k in r
+                }
+        if by_tenant:
+            out["tenants"] = {t: by_tenant[t] for t in sorted(by_tenant)}
+    if drift:
+        by_tenant = {}
+        for r in drift:
+            if isinstance(r.get("tenant"), str):
+                by_tenant[r["tenant"]] = {
+                    k: r[k] for k in (
+                        "window", "latched",
+                        "nota_rate_base", "nota_rate_cur", "nota_rate_band",
+                        "margin_base", "margin_cur", "margin_band",
+                        "entropy_base", "entropy_cur", "entropy_band",
+                    ) if k in r
+                }
+        if by_tenant:
+            out["drift"] = {t: by_tenant[t] for t in sorted(by_tenant)}
+    drifts = [e for e in drift_events if e.get("event") == "prediction_drift"]
+    if drifts:
+        out["drift_events"] = len(drifts)
+        last = drifts[-1]
+        out["last_drift"] = (
+            f"{last.get('severity')}: tenant={last.get('tenant')} "
+            f"feature={last.get('feature')} "
+            f"current={last.get('current')} vs baseline="
+            f"{last.get('baseline')} (band {last.get('band')})"
+        )
+    rearms = [e for e in drift_events if e.get("event") == "drift_rearm"]
+    if rearms:
+        out["rearms"] = len(rearms)
+    return out
+
+
+def scenario_summary(recs: list[dict]) -> dict | None:
+    """Scenario-harness section (ISSUE 10, kind="scenario"): one row per
+    evaluated leg from tools/scenarios.py — cross-domain accuracy ± CI,
+    the DA-mixture recovery, NOTA calibration best-F1, adversarial
+    degradation. The LAST record per leg wins (a re-run supersedes)."""
+    scen = [r for r in recs if r.get("kind") == "scenario"]
+    if not scen:
+        return None
+    by_leg: dict[str, dict] = {}
+    for r in scen:
+        # Distinct legs can share a leg NAME (one cross_domain record per
+        # shift, one nota_calibration per na_rate): fold the discriminator
+        # into the key so a grid run keeps every row instead of the last.
+        leg = str(r.get("leg"))
+        if isinstance(r.get("shift"), (int, float)):
+            leg = f"{leg}[shift={r['shift']:g}]"
+        if isinstance(r.get("na_rate"), (int, float)):
+            leg = f"{leg}[na={r['na_rate']:g}]"
+        by_leg[leg] = {
+            k: r[k] for k in (
+                "accuracy", "acc_ci95", "shift", "degradation",
+                "best_f1", "best_tau", "na_rate",
+                "nota_precision", "nota_recall",
+            ) if k in r
+        }
+    out: dict = {"records": len(scen), "legs": by_leg}
+    ind = by_leg.get("in_domain", {}).get("accuracy")
+    cross = [
+        v["accuracy"] for k, v in by_leg.items()
+        if k.startswith("cross_domain")
+        and isinstance(v.get("accuracy"), (int, float))
+    ]
+    if isinstance(ind, (int, float)) and cross:
+        # Gap at the WORST shift — the headline degradation.
+        out["cross_domain_gap"] = round(ind - min(cross), 4)
+    return out
+
+
 def health_summary(recs: list[dict]) -> dict:
     events = [r for r in recs if r.get("kind") == "health"]
     by_event: dict[str, int] = {}
@@ -606,6 +707,7 @@ def render(report: dict) -> str:
     for e in errors[:10]:
         lines.append(f"  ! {e}")
     for section in ("train", "mfu", "eval", "serve", "traces", "slo",
+                    "quality", "scenarios",
                     "ckpt", "input_pipeline", "comms", "roofline", "health",
                     "flight_recorder", "overhead"):
         body = report.get(section)
@@ -672,6 +774,8 @@ def main(argv=None) -> int:
         "serve": serve_summary(recs),
         "traces": trace_summary(recs),
         "slo": slo_summary(recs),
+        "quality": quality_summary(recs),
+        "scenarios": scenario_summary(recs),
         "ckpt": ckpt_summary(recs),
         "input_pipeline": data_summary(recs),
         "comms": comms_summary(recs),

@@ -1,0 +1,497 @@
+#!/usr/bin/env python3
+"""Model-quality scenario harness (ISSUE 10): the regression-gated eval
+layer for FewRel 2.0 domain adaptation, open-world NOTA, and noisy /
+adversarial episodes — ROADMAP item 3 with the same artifact discipline
+as perf (ROOFLINE), comms (COMMS), and latency (SERVE).
+
+Three scenario families, all CPU-honest on the synthetic corpus (the
+sandbox has no FewRel files; the synthetic generator plants a learnable
+per-relation trigger signal, and ``make_domain_shifted_fewrel`` moves
+that signal to a disjoint vocabulary block — the wiki -> pubmed transfer
+in miniature):
+
+* **Cross-domain (DA)** — train on the source domain, evaluate on the
+  source (in-domain) and on shifted twins at each ``--shift`` (accuracy
+  with the existing ``acc_ci95``). A second arm trains through the
+  datapipe mixture machinery (``datapipe/mixture.MixtureSchedule`` —
+  the FewRel 2.0 wiki+pubmed curriculum spelling) and shows how much of
+  the cross-domain cliff a mixture ramp recovers.
+* **NOTA calibration** — sweep the none-of-the-above decision threshold
+  over a quantile grid of operating points (precision/recall/F1 per
+  tau, per ``na_rate``), pick the best-F1 point, and record the quality
+  BASELINE at that point (nota_rate / margin / entropy mean+std via the
+  shared ``obs/drift.quality_features``) — exactly the calibration
+  baseline ``obs/drift.DriftDetector.set_baseline`` consumes at publish
+  time.
+* **Adversarial** — re-evaluate the trained model on episodes whose
+  QUERIES pass through ``datapipe/faults``-style perturbations
+  (token noise, truncation, constant-garbage rows; supports stay clean,
+  matching the serving split where class vectors distill once).
+
+Artifact: ``SCENARIOS_r*.json`` — full-mode results plus a ``tier1``
+section (the miniature run + regression band) that
+``tests/test_scenarios.py`` replays IN-PROCESS against the committed
+artifact, the same pattern as tests/test_roofline.py: a change that
+silently tanks in-domain accuracy, cross-domain accuracy, DA recovery,
+NOTA F1, or adversarial robustness fails tier-1 before it ships.
+Re-emitting the artifact (``python tools/scenarios.py --artifact
+SCENARIOS_r<next>.json``) is the ONE sanctioned way to move the band.
+
+With ``--run_dir`` every leg also lands as a ``kind="scenario"`` record
+in metrics.jsonl (rendered by tools/obs_report.py's scenarios section,
+validated by ``--check``).
+
+Usage:
+    python tools/scenarios.py [--artifact SCENARIOS_r01.json]
+        [--mode full|tier1] [--seed 0] [--run_dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The tier-1 regression band: one-sided quality floors (a LOWER number
+# than recorded-minus-band fails; improvements never do). Abs tolerances
+# sized to the miniature run's episode-sampling noise (~3 sigma of the
+# observed acc_ci95) — the gate catches cliffs (broken routing, a loss
+# regression, an episode-sampler bug), not weather.
+TIER1_BAND = {
+    "accuracy_abs": 0.12,
+    "f1_abs": 0.15,
+}
+
+# Miniature (tier-1) scenario config: the smallest world where the
+# trigger signal trains to well-above-chance in ~150 steps on CPU. CE
+# loss on purpose — the MSE fixture's degenerate basin (test_train.py
+# seed notes) is a loss pathology, not the quality signal this harness
+# gates. seed=1 matches the NOTA overfit test's pinned rationale.
+TIER1 = dict(
+    num_relations=5, instances_per_relation=20, iters=150,
+    eval_episodes=48, shifts=(1.0,), na_grid=(1,),
+    adversarial=("token_noise:0.4", "blank:1.0"),
+    cfg=dict(
+        model="induction", encoder="cnn", hidden_size=64,
+        induction_dim=32, ntn_slices=32, routing_iters=2,
+        train_n=2, n=2, k=2, q=2, na_rate=1, batch_size=4,
+        max_length=16, vocab_size=302, word_dim=50,
+        compute_dtype="float32", loss="ce", lr=5e-3,
+        weight_decay=0.0, val_step=0, device="cpu", seed=1,
+    ),
+)
+
+# Full-mode config: the 5-way 5-shot FewRel geometry on a larger
+# synthetic corpus, a shift grid, an na_rate grid, and the mixture-ramp
+# DA arm. Minutes on CPU — artifact generation, not tier-1.
+FULL = dict(
+    num_relations=10, instances_per_relation=20, iters=600,
+    eval_episodes=160, shifts=(0.5, 1.0), na_grid=(1, 2),
+    adversarial=(
+        "token_noise:0.3", "token_noise:0.6", "mask_drop:0.5", "blank:1.0",
+    ),
+    cfg=dict(
+        model="induction", encoder="cnn", hidden_size=64,
+        induction_dim=32, ntn_slices=32, routing_iters=2,
+        train_n=5, n=5, k=5, q=5, na_rate=1, batch_size=4,
+        max_length=16, vocab_size=302, word_dim=50,
+        compute_dtype="float32", loss="ce", lr=5e-3,
+        weight_decay=0.0, val_step=0, device="cpu", seed=1,
+    ),
+)
+
+
+def _world(plan: dict, seed: int):
+    """(cfg, tokenizer, source ds, {shift: shifted ds}, glove vectors)."""
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.data import (
+        GloveTokenizer,
+        make_domain_shifted_fewrel,
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+
+    cfg = ExperimentConfig(**plan["cfg"])
+    vocab = make_synthetic_glove(vocab_size=cfg.vocab_size - 2,
+                                 word_dim=cfg.word_dim)
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    src = make_synthetic_fewrel(
+        num_relations=plan["num_relations"],
+        instances_per_relation=plan["instances_per_relation"],
+        vocab_size=cfg.vocab_size - 2, seed=seed,
+    )
+    tgts = {
+        shift: make_domain_shifted_fewrel(
+            num_relations=plan["num_relations"],
+            instances_per_relation=plan["instances_per_relation"],
+            vocab_size=cfg.vocab_size - 2, shift=shift, seed=seed,
+        )
+        for shift in plan["shifts"]
+    }
+    return cfg, tok, src, tgts, vocab
+
+
+def _sampler(ds, tok, cfg, seed, na_rate=None):
+    from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+
+    return EpisodeSampler(
+        ds, tok, n=cfg.n, k=cfg.k, q=cfg.q, batch_size=cfg.batch_size,
+        na_rate=cfg.na_rate if na_rate is None else na_rate, seed=seed,
+    )
+
+
+def _train(cfg, vocab, sampler, iters):
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.train import FewShotTrainer
+    from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+    model = build_model(cfg, glove_init=vocab.vectors)
+    trainer = FewShotTrainer(
+        model, cfg, sampler, logger=MetricsLogger(quiet=True)
+    )
+    state = trainer.train(num_iters=iters)
+    return model, trainer, state
+
+
+def _eval_leg(trainer, params, sampler, episodes) -> dict:
+    m = trainer.evaluate(
+        params, num_episodes=episodes, sampler=sampler, return_metrics=True
+    )
+    out = {
+        "accuracy": round(m["accuracy"], 4),
+        "acc_ci95": round(m["acc_ci95"], 4),
+    }
+    for k in ("nota_precision", "nota_recall"):
+        if k in m:
+            out[k] = round(m[k], 4)
+    return out
+
+
+# --- NOTA threshold calibration -------------------------------------------
+
+
+def nota_operating_points(gap, is_true_nota, taus) -> list[dict]:
+    """Precision/recall/F1 per threshold bias tau.
+
+    ``gap``: per-query (best class score − NOTA logit); the decision is
+    NOTA iff ``nota_logit + tau > best`` ⇔ ``tau > gap``, so the
+    predicted-NOTA set GROWS monotonically in tau — recall is
+    nondecreasing, the predicted count nondecreasing (pinned in
+    tests/test_scenarios.py). Convention at the empty end: precision 1.0
+    with zero predictions (nothing asserted, nothing wrong)."""
+    import numpy as np
+
+    gap = np.asarray(gap, dtype=np.float64)
+    truth = np.asarray(is_true_nota, dtype=bool)
+    out = []
+    for tau in taus:
+        pred = gap < float(tau)
+        tp = float(np.sum(pred & truth))
+        n_pred = float(np.sum(pred))
+        n_true = float(np.sum(truth))
+        precision = tp / n_pred if n_pred else 1.0
+        recall = tp / n_true if n_true else 0.0
+        f1 = (
+            2 * precision * recall / (precision + recall)
+            if precision + recall > 0 else 0.0
+        )
+        out.append({
+            "tau": round(float(tau), 4),
+            "precision": round(precision, 4),
+            "recall": round(recall, 4),
+            "f1": round(f1, 4),
+            "nota_rate": round(n_pred / max(len(gap), 1), 4),
+        })
+    return out
+
+
+def default_tau_grid(gap, points: int = 13):
+    """Quantile grid over the observed gap distribution (every tau is a
+    real operating point), bracketed by all-NOTA / no-NOTA endpoints and
+    always including 0.0 — the learned head's own calibration."""
+    import numpy as np
+
+    gap = np.asarray(gap, dtype=np.float64)
+    qs = np.quantile(gap, np.linspace(0.02, 0.98, points))
+    taus = sorted(set(
+        [round(float(t), 4) for t in qs]
+        + [0.0, round(float(gap.min()) - 1.0, 4),
+           round(float(gap.max()) + 1.0, 4)]
+    ))
+    return taus
+
+
+def nota_calibration(model, params, cfg, ds, tok, episodes, na_rate,
+                     seed) -> dict:
+    """Collect logits over NOTA-bearing eval episodes, sweep the
+    threshold grid, pick best-F1, and record the quality baseline at
+    that operating point (the drift detector's publish-time
+    calibration)."""
+    import jax
+    import numpy as np
+
+    from induction_network_on_fewrel_tpu.models.build import (
+        batch_to_model_inputs,
+    )
+    from induction_network_on_fewrel_tpu.obs.drift import quality_features
+
+    sampler = _sampler(ds, tok, cfg, seed=seed + 31, na_rate=na_rate)
+    apply = jax.jit(lambda p, s, q: model.apply(p, s, q))
+    rows, labels = [], []
+    n_batches = max(1, episodes // cfg.batch_size)
+    for _ in range(n_batches):
+        sup, qry, lab = batch_to_model_inputs(sampler.sample_batch())
+        logits = np.asarray(apply(params, sup, qry))   # [B, TQ, n+1]
+        rows.append(logits.reshape(-1, logits.shape[-1]))
+        labels.append(np.asarray(lab).reshape(-1))
+    rows = np.concatenate(rows)
+    labels = np.concatenate(labels)
+    n = cfg.n
+    best = rows[:, :n].max(axis=-1)
+    gap = best - rows[:, n]
+    truth = labels == n
+    taus = default_tau_grid(gap)
+    ops = nota_operating_points(gap, truth, taus)
+    best_op = max(ops, key=lambda o: o["f1"])
+    # Quality baseline AT the chosen operating point: what the drift
+    # detector should consider "normal" for traffic like this eval's.
+    margin, entropy = quality_features(rows[:, :n])
+    pred = gap < best_op["tau"]
+    baseline = {
+        "nota_rate": [round(float(pred.mean()), 4),
+                      round(float(pred.std()), 4)],
+        "margin": [round(float(margin.mean()), 4),
+                   round(float(margin.std()), 4)],
+        "entropy": [round(float(entropy.mean()), 4),
+                    round(float(entropy.std()), 4)],
+    }
+    return {
+        "na_rate": na_rate,
+        "queries": int(len(gap)),
+        "operating_points": ops,
+        "best": best_op,
+        "baseline": baseline,
+    }
+
+
+# --- the harness ----------------------------------------------------------
+
+
+def run(plan: dict, seed: int, logger=None, step0: int = 0,
+        tag: str = "") -> dict:
+    """Run every scenario family under ``plan``; returns the result dict
+    and (with ``logger``) emits one kind="scenario" record per leg.
+    ``tag`` prefixes the emitted leg names — the full-mode artifact run
+    emits its tier1 miniature with tag="tier1:" so the two configs'
+    records never collide in one metrics.jsonl (obs_report's scenario
+    table is last-record-wins per leg key)."""
+    from induction_network_on_fewrel_tpu.datapipe.faults import (
+        PerturbedSampler,
+    )
+    from induction_network_on_fewrel_tpu.datapipe.mixture import (
+        MixtureSampler,
+        MixtureSchedule,
+    )
+
+    t0 = time.monotonic()
+    cfg, tok, src, tgts, vocab = _world(plan, seed)
+    step = step0
+
+    def emit(leg: str, fields: dict) -> None:
+        nonlocal step
+        if logger is not None:
+            scalars = {
+                k: v for k, v in fields.items()
+                if isinstance(v, (int, float, str))
+            }
+            logger.log(step, kind="scenario", leg=tag + leg, **scalars)
+        step += 1
+
+    # -- source-domain training + cross-domain evals -----------------------
+    model, trainer, state = _train(
+        cfg, vocab, _sampler(src, tok, cfg, seed=seed + 1), plan["iters"]
+    )
+    in_domain = _eval_leg(
+        trainer, state.params, _sampler(src, tok, cfg, seed=seed + 2),
+        plan["eval_episodes"],
+    )
+    emit("in_domain", in_domain)
+    cross = {}
+    for shift, tgt in sorted(tgts.items()):
+        r = _eval_leg(
+            trainer, state.params, _sampler(tgt, tok, cfg, seed=seed + 3),
+            plan["eval_episodes"],
+        )
+        r["shift"] = shift
+        cross[f"{shift:g}"] = r
+        emit("cross_domain", r)
+
+    # -- DA arm: train THROUGH the mixture machinery -----------------------
+    # The FewRel 2.0 curriculum spelling: source at weight 1.0, the
+    # hardest shifted twin ramping in over the first 60% of training
+    # (weights move, episode geometry doesn't — static shapes).
+    hardest = max(tgts)
+    ramp_at = max(int(plan["iters"] * 0.6), 1)
+    schedule = MixtureSchedule.parse(
+        f"src:1.0;tgt:0.2@0,1.0@{ramp_at}"
+    )
+    mix = MixtureSampler(
+        [("src", _sampler(src, tok, cfg, seed=seed + 5)),
+         ("tgt", _sampler(tgts[hardest], tok, cfg, seed=seed + 6))],
+        schedule, seed=seed,
+    )
+    _, da_trainer, da_state = _train(cfg, vocab, mix, plan["iters"])
+    da = _eval_leg(
+        da_trainer, da_state.params,
+        _sampler(tgts[hardest], tok, cfg, seed=seed + 3),
+        plan["eval_episodes"],
+    )
+    da["shift"] = hardest
+    da["schedule"] = schedule.to_spec()
+    da["mixture_counts"] = dict(mix.counts)
+    emit("da_mixture", {k: v for k, v in da.items()
+                        if not isinstance(v, dict)})
+
+    # -- NOTA threshold calibration ----------------------------------------
+    nota = {}
+    for na in plan["na_grid"]:
+        r = nota_calibration(
+            model, state.params, cfg, src, tok, plan["eval_episodes"],
+            na_rate=na, seed=seed,
+        )
+        nota[str(na)] = r
+        emit("nota_calibration", {
+            "na_rate": float(na), "queries": float(r["queries"]),
+            "best_tau": r["best"]["tau"], "best_f1": r["best"]["f1"],
+            "best_precision": r["best"]["precision"],
+            "best_recall": r["best"]["recall"],
+            "baseline_nota_rate": r["baseline"]["nota_rate"][0],
+            "baseline_margin": r["baseline"]["margin"][0],
+            "baseline_entropy": r["baseline"]["entropy"][0],
+        })
+
+    # -- adversarial / noisy episode legs ----------------------------------
+    adversarial = {"clean": in_domain}
+    for spec in plan["adversarial"]:
+        r = _eval_leg(
+            trainer, state.params,
+            PerturbedSampler(
+                _sampler(src, tok, cfg, seed=seed + 2), spec, seed=seed + 9
+            ),
+            plan["eval_episodes"],
+        )
+        r["degradation"] = round(in_domain["accuracy"] - r["accuracy"], 4)
+        adversarial[spec] = r
+        emit(spec, r)
+
+    cross_worst = min(c["accuracy"] for c in cross.values())
+    return {
+        "config": dict(plan["cfg"]),
+        "seed": seed,
+        "iters": plan["iters"],
+        "eval_episodes": plan["eval_episodes"],
+        "wall_s": round(time.monotonic() - t0, 1),
+        "cross_domain": {
+            "in_domain": in_domain,
+            "by_shift": cross,
+            "gap_at_worst_shift": round(
+                in_domain["accuracy"] - cross_worst, 4
+            ),
+            "da_mixture": da,
+        },
+        "nota": nota,
+        "adversarial": adversarial,
+    }
+
+
+def run_tier1(seed: int = 1, logger=None, tag: str = "") -> dict:
+    """The miniature leg: what tests/test_scenarios.py replays in-process
+    against the committed SCENARIOS artifact, and what bench.py stamps.
+    Deterministic under a fixed seed on a fixed stack."""
+    return run(TIER1, seed=seed, logger=logger, tag=tag)
+
+
+def tier1_headline(res: dict) -> dict:
+    """The gated numbers, flat — the artifact's ``tier1`` block."""
+    # key=float: the dict keys are stringified numbers, and lexicographic
+    # max/min would pick the wrong leg on grids like ("0.5", "1e-05") or
+    # na rates ("2", "10").
+    hardest = max(res["cross_domain"]["by_shift"], key=float)
+    na0 = min(res["nota"], key=float)
+    adv = {
+        spec: leg["accuracy"]
+        for spec, leg in res["adversarial"].items() if spec != "clean"
+    }
+    return {
+        "seed": res["seed"],
+        "in_domain_accuracy": res["cross_domain"]["in_domain"]["accuracy"],
+        "cross_domain_accuracy":
+            res["cross_domain"]["by_shift"][hardest]["accuracy"],
+        "da_mixture_accuracy": res["cross_domain"]["da_mixture"]["accuracy"],
+        "nota_best_f1": res["nota"][na0]["best"]["f1"],
+        "adversarial_accuracy": adv,
+        "band": dict(TIER1_BAND),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="model-quality scenario harness (DA + NOTA + noise)"
+    )
+    ap.add_argument("--artifact", default=None, metavar="PATH",
+                    help="write SCENARIOS_r*.json here (full + tier1)")
+    ap.add_argument("--mode", default="full", choices=["full", "tier1"],
+                    help="tier1 = the miniature gate leg only")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--run_dir", default=None,
+                    help="also emit kind='scenario' records to this dir's "
+                         "metrics.jsonl (tools/obs_report.py renders them)")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    logger = None
+    if args.run_dir:
+        from induction_network_on_fewrel_tpu.utils.metrics import (
+            MetricsLogger,
+        )
+
+        logger = MetricsLogger(args.run_dir)
+
+    try:
+        if args.mode == "tier1":
+            res = run_tier1(seed=args.seed, logger=logger)
+            report = {"tier1_run": res, "tier1": tier1_headline(res)}
+        else:
+            print("scenarios: full mode (DA grid + na grid + mixture arm)",
+                  file=sys.stderr)
+            full = run(FULL, seed=args.seed, logger=logger)
+            print(f"scenarios: full done in {full['wall_s']}s; tier1 leg...",
+                  file=sys.stderr)
+            # tier1: tagged leg names, so the miniature config's records
+            # never overwrite the full-mode rows in one metrics.jsonl.
+            t1 = run_tier1(seed=args.seed, logger=logger, tag="tier1:")
+            report = {
+                "round": 1,
+                "generated_by": "tools/scenarios.py",
+                "generated_unix_s": int(time.time()),
+                "full": full,
+                "tier1_run": t1,
+                "tier1": tier1_headline(t1),
+            }
+        print(json.dumps(report.get("tier1", report), indent=1))
+        if args.artifact:
+            with open(args.artifact, "w") as f:
+                json.dump(report, f, indent=1)
+            print(f"wrote {args.artifact}", file=sys.stderr)
+        return 0
+    finally:
+        if logger is not None:
+            logger.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
