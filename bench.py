@@ -358,7 +358,7 @@ def _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips) -> i
         int(table_np["uids"].shape[0]) if "uids" in table_np else None
     )
 
-    print(json.dumps({
+    summary = {
         "metric": (
             f"train_episodes_per_sec_per_chip"
             f"[5w5s,bilstm,L40,bf16,{backend},e2e,tokencache,"
@@ -399,8 +399,33 @@ def _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips) -> i
         "datapipe": datapipe_leg,
         "serving": serving_leg,
         "scenarios": scenarios_leg,
-    }))
+    }
+    print(json.dumps(summary))
+    _append_trend_input(summary, backend)
     return 0
+
+
+def _append_trend_input(summary: dict, backend: str) -> None:
+    """Append this run's summary to the bench-trajectory input (ISSUE 11):
+    tools/bench_trend.py folds every row of TREND_INPUT.jsonl into the
+    TREND.json timeseries next to the committed BENCH_r*.json artifacts,
+    so the trajectory is populated by every bench run from now on — not
+    only by driver-committed rounds. Append-only JSON lines; the metric
+    string carries the backend, so CPU-fallback rows never share a band
+    with TPU rounds. Best-effort: a read-only checkout must not sink the
+    bench. BENCH_TREND_FILE overrides the destination ('' disables)."""
+    dest = os.environ.get("BENCH_TREND_FILE")
+    if dest == "":
+        return
+    path = dest or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "TREND_INPUT.jsonl")
+    row = {"unix_s": round(time.time(), 1), "backend": backend, **summary}
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        print(f"bench: appended run summary to {path}", file=sys.stderr)
+    except OSError as e:
+        print(f"bench: trend-input append failed: {e!r}", file=sys.stderr)
 
 
 def _scenarios_leg():

@@ -352,6 +352,15 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
                             "LOGGED loss with NaN once past step N "
                             "(training unaffected; exercises the watchdog "
                             "trip + flight-recorder dump; debug)")
+        p.add_argument("--perf", action="store_true",
+                       help="performance-attribution observability "
+                            "(obs/perf.py + obs/compile.py): per-window "
+                            "step-time decomposition (kind='perf' segments "
+                            "tile the window), XLA compile forensics "
+                            "(kind='compile' with fn/shapes/elapsed/"
+                            "trigger + the steady-recompile gate), and "
+                            "named-cause classification of slow windows "
+                            "with auto-captured diagnostics (RUNBOOK §16)")
     return p
 
 
@@ -439,6 +448,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         watchdog=getattr(args, "watchdog", False),
         grad_probe_every=getattr(args, "grad_probe_every", 0),
         nan_inject_step=getattr(args, "nan_inject_step", 0),
+        perf=getattr(args, "perf", False),
         zero_opt=getattr(args, "zero_opt", False),
         compact_demb=getattr(args, "compact_demb", "auto"),
         device=args.device, compute_dtype=compute, seed=args.seed,
@@ -1250,12 +1260,61 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         recorder = FlightRecorder(out_dir=run_dir)
         recorder.install_sigterm_handler()
         watchdog = HealthWatchdog(recorder=recorder)
+    logger = MetricsLogger(
+        run_dir, tensorboard_dir=getattr(args, "tensorboard", None)
+    )
+    perf_obs = compile_watcher = None
+    if cfg.perf:
+        # Performance-attribution observability (ISSUE 11): the perf
+        # observer decomposes each metric window (kind="perf"); the
+        # compile watcher stamps every XLA compile (kind="compile") and
+        # holds the loop to the steady-state zero-recompile invariant.
+        # Perf criticals ride the watchdog's emitter when one exists
+        # (same health stream, same flight-recorder dump); diagnostics
+        # auto-capture into the run dir (profile off: the RUNBOOK §14
+        # profiler/thread caveat applies here too).
+        from induction_network_on_fewrel_tpu.obs import (
+            CompileWatcher,
+            DiagnosticsCapture,
+            PerfObserver,
+            bind_health,
+        )
+
+        capture = None
+        if run_dir is not None:
+            # recorder=None on purpose: with --watchdog on, the perf
+            # critical already dumps the flight recorder through the
+            # watchdog emitter below — the capture adds the span snapshot
+            # (its guaranteed artifact) instead of dumping twice.
+            capture = DiagnosticsCapture(
+                out_dir=run_dir, recorder=None, profile=False
+            )
+        floor_ms = None
+        if cfg.encoder == "bilstm":
+            # The shared roofline projection at nominal v5e — the same
+            # formulas the ledger and bench stamp (utils/roofline.py),
+            # recorded next to every measured window.
+            from induction_network_on_fewrel_tpu.utils.roofline import (
+                projected_floor_ms,
+            )
+
+            floor_ms = projected_floor_ms(
+                cfg, corpus_rows=corpus_rows.get("train")
+            )
+        compile_watcher = CompileWatcher(logger=logger).install()
+        if watchdog is not None:
+            bind_health(compile_watcher, watchdog._emit)
+        perf_obs = PerfObserver(
+            logger=logger,
+            compile_watcher=compile_watcher,
+            capture=capture,
+            on_event=watchdog._emit if watchdog is not None else None,
+            floor_ms=floor_ms,
+        )
     trainer = FewShotTrainer(
         model, cfg, train_sampler, val_sampler,
         ckpt_dir=None if only_test else args.save_ckpt,
-        logger=MetricsLogger(
-            run_dir, tensorboard_dir=getattr(args, "tensorboard", None)
-        ),
+        logger=logger,
         train_step=train_step, eval_step=eval_step, fused_step=fused_step,
         fused_eval=fused_eval,
         initial_state=state,
@@ -1265,6 +1324,7 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
         watchdog=watchdog, recorder=recorder,
         comms_u_rows=corpus_rows.get("train"),
         comms_compact=demb_impl is not None,
+        perf=perf_obs, compile_watcher=compile_watcher,
     )
     if getattr(args, "debug_nans", False):
         from induction_network_on_fewrel_tpu.utils.debug import checkify_step

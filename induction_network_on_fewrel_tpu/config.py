@@ -211,6 +211,16 @@ class ExperimentConfig:
     # exercises watchdog trip + flight-recorder dump end-to-end the way
     # fault_step exercises crash/recovery. 0 = off. Debug-only knob.
     nan_inject_step: int = 0
+    # Performance-attribution observability (ISSUE 11, obs/perf.py +
+    # obs/compile.py): per-window step-time decomposition into segments
+    # that tile the measured window (kind="perf"), XLA compile forensics
+    # with the steady-state-recompile gate (kind="compile"), and named-
+    # cause classification of out-of-band windows (feed_stall /
+    # recompile_burst / checkpoint_spike / gc_pause /
+    # neighbor_contention) as once-latched critical events with
+    # auto-captured diagnostics. Host-side only; measured tax < 2% of
+    # p50 step (tests/test_perf.py).
+    perf: bool = False
 
     # --- FewRel 2.0 adversarial domain adaptation (training-time only) ---
     adv: bool = False         # train encoder against a domain discriminator

@@ -12,6 +12,15 @@ One shared model for what used to be three fragmented mechanisms:
 * ``drift``    — online prediction-drift detector over serving verdicts
                  (per-tenant NOTA rate / margin / entropy vs a
                  calibration baseline, re-armed on publish; ISSUE 10).
+* ``perf``     — online step-time decomposition (ISSUE 11): per-window
+                 data-wait / dispatch / device-sync / checkpoint segments
+                 that TILE the measured window, out-of-band windows
+                 classified into named causes with auto-captured
+                 diagnostics.
+* ``compile``  — XLA compile forensics: every backend compile stamped
+                 with fn / shape signature / elapsed / trigger, with the
+                 training twin of serving's zero-steady-state-recompile
+                 gate.
 * ``recorder`` — flight recorder; dumps the last-N window on crash,
                  SIGTERM, or a watchdog trip.
 * ``export``   — counter/gauge/histogram registry + Prometheus text
@@ -23,6 +32,10 @@ flight_recorder.json) into a single run report — per-request trace
 waterfalls included — and schema-checks it.
 """
 
+from induction_network_on_fewrel_tpu.obs.compile import (
+    CompileWatcher,
+    bind_health,
+)
 from induction_network_on_fewrel_tpu.obs.export import (
     CounterRegistry,
     Histogram,
@@ -30,6 +43,7 @@ from induction_network_on_fewrel_tpu.obs.export import (
     set_registry,
 )
 from induction_network_on_fewrel_tpu.obs.drift import DriftDetector
+from induction_network_on_fewrel_tpu.obs.perf import PerfObserver
 from induction_network_on_fewrel_tpu.obs.health import (
     DiagnosticsCapture,
     HealthEvent,
@@ -49,6 +63,7 @@ from induction_network_on_fewrel_tpu.obs.spans import (
 )
 
 __all__ = [
+    "CompileWatcher",
     "CounterRegistry",
     "DiagnosticsCapture",
     "DriftDetector",
@@ -56,11 +71,13 @@ __all__ = [
     "HealthEvent",
     "HealthWatchdog",
     "Histogram",
+    "PerfObserver",
     "SLOEngine",
     "SLOObjective",
     "SpanTracker",
     "TraceContext",
     "TraceSampler",
+    "bind_health",
     "get_registry",
     "get_tracker",
     "new_trace_id",

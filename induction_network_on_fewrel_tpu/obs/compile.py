@@ -1,0 +1,369 @@
+"""XLA compile forensics: every compile observed, stamped, attributable.
+
+Serving has counted its compiles since ISSUE 1 (``QueryProgramCache`` ->
+``ServingStats.steady_compiles``, the zero-steady-state-recompile
+acceptance gate). Training had nothing: a shape leak (a ragged tail
+batch, a knob flipped mid-run, a donated-buffer dtype drift) recompiles
+the 30-second flagship step silently and the only symptom is a
+throughput crater nobody can attribute. This module closes that gap
+(ISSUE 11 tentpole, layer 2).
+
+Mechanism — two hooks, one record:
+
+* ``jax.monitoring`` duration events: ``/jax/core/compile/
+  backend_compile_duration`` fires once per actual XLA backend compile,
+  on the compiling thread, with the elapsed seconds. This is the
+  authoritative "a compile happened" signal (cache hits never fire it).
+* The ``jax._src.interpreters.pxla`` DEBUG log line ``"Compiling <fn>
+  with global shapes and types [...]"`` carries what monitoring does not:
+  the jitted function's NAME and its argument SHAPE SIGNATURE. A
+  logging.Handler parses it into a thread-local pending slot; the
+  monitoring event closes the slot into one ``CompileRecord``. (With
+  ``jax_log_compiles`` off the line is emitted at DEBUG — the handler
+  listens at DEBUG without promoting anything to the console.)
+
+Attribution: the record also captures the innermost OPEN host span on
+the compiling thread (obs/spans) — a compile observed inside
+``train/dispatch`` vs ``train/eval`` vs ``serve/execute`` names its
+trigger — plus the active trace id, so a recompile burst lands in the
+same waterfall as the step that paid for it.
+
+Steady-state gate (the serving invariant, mirrored): two layers.
+
+* The ``phase`` stamped on every record is a NOVELTY rule — the first
+  compile of each distinct function name is ``warmup`` (train step, eval
+  step, grad probe all compile once, whenever they first run); a SEEN
+  function compiling a NEW shape signature is a ``recompile``; a seen
+  (fn, signature) pair re-compiling (cache eviction, weak-type quirks)
+  is a ``dup``. Pure forensics — every compile is recorded either way.
+* The GATE (``steady_recompiles`` + the once-latched CRITICAL
+  ``recompile_burst``) counts only recompiles observed after
+  ``arm_steady()`` (the trainer arms at the first metric window, once
+  the setup storm of single-primitive utility pjits — convert/concat/
+  threefry, which legitimately compile many shapes — is over) AND
+  costing at least ``gate_min_s``: the invariant exists to catch the
+  multi-second flagship step recompiling mid-run, not a 10 ms
+  convert_element_type shape variant at an eval boundary. Ungated
+  novelty counts stay visible as ``shape_variant_compiles``.
+
+Process-global plumbing: jax.monitoring listeners cannot be unregistered
+individually, so ONE module-level dispatcher registers lazily and fans
+out to the active watchers (a set this module owns). ``uninstall()``
+detaches a watcher from the set and the logging handler; tests create
+and drop watchers freely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import re
+import threading
+from collections import deque
+from typing import Callable
+
+_PXLA_LOGGER = "jax._src.interpreters.pxla"
+# "Compiling <fn> with global shapes and types [ShapedArray(...), ...].
+#  Argument mapping: (...)." — greedy capture: the signature itself
+# contains "]" (float32[4,4]), so the match must run to the LAST bracket.
+_COMPILING_RE = re.compile(
+    r"Compiling ([^\s]+) with global shapes and types \[(.*)\]", re.S
+)
+
+
+@dataclasses.dataclass
+class CompileRecord:
+    fn: str                  # jitted function name ("?" if the log line
+    #                          was missed — monitoring still counts it)
+    shapes: str              # argument shape signature, as pxla prints it
+    elapsed_s: float         # backend compile seconds (monitoring)
+    trigger: str             # innermost open host span, or "untraced"
+    thread: str
+    step: int                # last step stamped via observe_step()
+    phase: str               # "warmup" | "recompile" | "dup"
+    trace_id: str | None = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# --- process-global dispatch ----------------------------------------------
+
+_active: set["CompileWatcher"] = set()
+_dispatch_lock = threading.Lock()
+_monitoring_registered = False
+# (level, propagate) of the pxla logger BEFORE the first watcher lowered
+# it — module-global (not per-watcher) so overlapping watchers restore
+# correctly: with per-watcher state, A-installs/B-installs/A-uninstalls
+# (B's handler blocks A's restore, A clears its state)/B-uninstalls
+# (B saved nothing) left the logger at DEBUG+no-propagate forever.
+_pxla_saved: tuple[int, bool] | None = None
+# Thread-local pending (fn, shapes) parsed from the pxla log line, shared
+# by every watcher: the log fires immediately before the backend compile
+# on the same thread.
+_tls = threading.local()
+
+
+def _on_duration(event: str, duration: float, **kw) -> None:
+    if event != "/jax/core/compile/backend_compile_duration":
+        return
+    pending = getattr(_tls, "pending", None)
+    _tls.pending = None
+    with _dispatch_lock:
+        watchers = list(_active)
+    for w in watchers:
+        w._observe_compile(pending, duration)
+
+
+class _PendingHandler(logging.Handler):
+    """Parses the pxla "Compiling <fn> ..." line into the thread-local
+    pending slot. One instance per installed watcher set is enough, but a
+    per-watcher instance keeps uninstall symmetrical and idempotent.
+
+    While a watcher is installed the pxla logger's propagation is OFF
+    (the lowered DEBUG level would otherwise print one "Compiling ..."
+    line per compile through the root handler) — so this handler must
+    FORWARD the records that would have reached the console anyway:
+    anything at WARNING or above (real pxla diagnostics — sharding
+    warnings, jax_log_compiles-promoted lines) is re-dispatched to the
+    root logger's handlers. Only the sub-WARNING noise our level change
+    surfaced is dropped, which is exactly the pre-watcher behavior."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            m = _COMPILING_RE.match(record.getMessage())
+            if m:
+                _tls.pending = (m.group(1), m.group(2))
+            if record.levelno >= logging.WARNING:
+                logging.getLogger().handle(record)
+        except Exception:  # a logging handler must never raise
+            pass
+
+
+def _ensure_monitoring() -> None:
+    global _monitoring_registered
+    with _dispatch_lock:
+        if _monitoring_registered:
+            return
+        try:
+            import jax.monitoring as monitoring
+
+            monitoring.register_event_duration_secs_listener(_on_duration)
+            _monitoring_registered = True
+        except Exception:
+            # No jax in this process: the watcher stays installable (it
+            # just never observes anything) — the obs layer must not
+            # require a device runtime (obs/spans discipline).
+            pass
+
+
+class CompileWatcher:
+    """Bounded ring of CompileRecords + the steady-recompile gate.
+
+    ``logger`` (a MetricsLogger) gets one ``kind="compile"`` record per
+    observed compile; ``on_recompile`` (usually a HealthWatchdog-shaped
+    emitter — see ``bind_health``) fires once-latched on the first
+    steady-state recompile. All counters are plain ints read without the
+    lock for display (GIL-atomic); mutation is locked.
+    """
+
+    GATE_MIN_S = 0.05   # a gated recompile must cost at least this
+
+    def __init__(self, logger=None, capacity: int = 256,
+                 on_recompile: Callable[[CompileRecord], None] | None = None,
+                 gate_min_s: float | None = None):
+        self.logger = logger
+        self.on_recompile = on_recompile
+        self.gate_min_s = (
+            self.GATE_MIN_S if gate_min_s is None else gate_min_s
+        )
+        self.records: deque[CompileRecord] = deque(maxlen=capacity)
+        self.compiles = 0
+        self.warmup_compiles = 0
+        self.shape_variant_compiles = 0
+        self.steady_recompiles = 0
+        self.dup_compiles = 0
+        self.compile_s_total = 0.0
+        self.armed = False
+        self._sigs: dict[str, set[str]] = {}   # fn -> seen signatures
+        self._step = 0
+        self._lock = threading.Lock()
+        self._latched = False
+        self._installed = False
+        self._handler: _PendingHandler | None = None
+
+    # --- lifecycle --------------------------------------------------------
+
+    def install(self) -> "CompileWatcher":
+        """Start observing this process's compiles. Idempotent."""
+        global _pxla_saved
+        if self._installed:
+            return self
+        _ensure_monitoring()
+        self._handler = _PendingHandler(level=logging.DEBUG)
+        pxla = logging.getLogger(_PXLA_LOGGER)
+        with _dispatch_lock:
+            if not any(
+                isinstance(h, _PendingHandler) for h in pxla.handlers
+            ):
+                # FIRST watcher: save the logger's pre-watcher state
+                # (module-global — the LAST uninstalling watcher restores
+                # it, whoever that is), then lower the level so the DEBUG
+                # "Compiling ..." line reaches our handler, and stop
+                # propagation so it does NOT also print through the root
+                # handler this image's absl logging installs (one line of
+                # console noise per compile otherwise).
+                _pxla_saved = (pxla.level, pxla.propagate)
+                if pxla.level == logging.NOTSET or pxla.level > logging.DEBUG:
+                    pxla.setLevel(logging.DEBUG)
+                pxla.propagate = False
+            pxla.addHandler(self._handler)
+            _active.add(self)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        """Stop observing; the LAST uninstalling watcher restores the
+        pxla logger's saved level/propagation."""
+        global _pxla_saved
+        if not self._installed:
+            return
+        pxla = logging.getLogger(_PXLA_LOGGER)
+        with _dispatch_lock:
+            _active.discard(self)
+            if self._handler is not None:
+                pxla.removeHandler(self._handler)
+                self._handler = None
+            others = any(
+                isinstance(h, _PendingHandler) for h in pxla.handlers
+            )
+            if not others and _pxla_saved is not None:
+                level, propagate = _pxla_saved
+                pxla.setLevel(level)
+                pxla.propagate = propagate
+                _pxla_saved = None
+        self._installed = False
+
+    def __enter__(self) -> "CompileWatcher":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # --- feeding ----------------------------------------------------------
+
+    def observe_step(self, step: int) -> None:
+        """Stamp the current training step onto subsequent records (the
+        trainer calls this once per loop iteration — one int store)."""
+        self._step = int(step)
+
+    def _observe_compile(
+        self, pending: tuple[str, str] | None, duration: float
+    ) -> None:
+        fn, shapes = pending if pending else ("?", "")
+        trigger, trace_id = self._attribution()
+        with self._lock:
+            self.compiles += 1
+            self.compile_s_total += duration
+            seen = self._sigs.get(fn)
+            gated = False
+            if seen is None:
+                phase = "warmup"
+                self.warmup_compiles += 1
+                self._sigs[fn] = {shapes}
+            elif shapes not in seen:
+                phase = "recompile"
+                self.shape_variant_compiles += 1
+                seen.add(shapes)
+                gated = self.armed and duration >= self.gate_min_s
+                if gated:
+                    self.steady_recompiles += 1
+            else:
+                phase = "dup"
+                self.dup_compiles += 1
+            rec = CompileRecord(
+                fn=fn, shapes=shapes, elapsed_s=round(duration, 6),
+                trigger=trigger,
+                thread=threading.current_thread().name,
+                step=self._step, phase=phase, trace_id=trace_id,
+            )
+            self.records.append(rec)
+            fire = gated and not self._latched
+            if fire:
+                self._latched = True
+        if self.logger is not None:
+            extra = {"trace_id": trace_id} if trace_id else {}
+            self.logger.log(
+                rec.step, kind="compile", fn=fn, shapes=shapes,
+                elapsed_ms=round(duration * 1e3, 3), trigger=trigger,
+                phase=phase, **extra,
+            )
+        if fire and self.on_recompile is not None:
+            self.on_recompile(rec)
+
+    def arm_steady(self) -> None:
+        """Begin steady state: from here on, a seen fn compiling a new
+        shape signature at >= ``gate_min_s`` is a gated recompile (the
+        trainer arms at its first metric window — the training twin of
+        ``ServingStats``'s warmup()/steady split)."""
+        self.armed = True
+
+    def rearm(self) -> None:
+        """Re-arm the once-latched recompile alert (an operator
+        acknowledged the burst; the next NEW burst is a new incident)."""
+        with self._lock:
+            self._latched = False
+
+    # --- reading ----------------------------------------------------------
+
+    def _attribution(self) -> tuple[str, str | None]:
+        """(innermost open span name, trace id) on THIS thread — the
+        compile's trigger. Reaches into the tracker's thread-local stack;
+        read-only, same-thread, so no lock is needed."""
+        try:
+            from induction_network_on_fewrel_tpu.obs.spans import get_tracker
+
+            tracker = get_tracker()
+            stack = getattr(tracker._tls, "stack", None)
+            ctx = tracker.current_trace()
+            trigger = stack[-1][0] if stack else "untraced"
+            return trigger, (ctx.trace_id if ctx is not None else None)
+        except Exception:
+            return "untraced", None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "compiles": self.compiles,
+                "warmup_compiles": self.warmup_compiles,
+                "shape_variant_compiles": self.shape_variant_compiles,
+                "steady_recompiles": self.steady_recompiles,
+                "dup_compiles": self.dup_compiles,
+                "compile_s_total": round(self.compile_s_total, 4),
+                "armed": self.armed,
+                "records": [r.to_dict() for r in self.records],
+            }
+
+def bind_health(watcher: CompileWatcher, health_emit) -> None:
+    """Wire the once-latched recompile burst into a HealthWatchdog-style
+    emitter: ``health_emit`` is called with an ``obs.health.HealthEvent``.
+    Kept as a free function so obs/compile.py has no import-time
+    dependency on obs/health.py."""
+    from induction_network_on_fewrel_tpu.obs.health import CRITICAL, HealthEvent
+
+    def _on(rec: CompileRecord) -> None:
+        health_emit(HealthEvent(
+            event="recompile_burst", severity=CRITICAL, step=rec.step,
+            message=(
+                f"steady-state recompile: {rec.fn} compiled a NEW shape "
+                f"signature mid-run ({rec.elapsed_s * 1e3:.1f} ms, "
+                f"trigger {rec.trigger})"
+            ),
+            data={
+                "fn": rec.fn, "trigger": rec.trigger,
+                "elapsed_ms": round(rec.elapsed_s * 1e3, 3),
+                "steady_recompiles": watcher.steady_recompiles,
+            },
+        ))
+
+    watcher.on_recompile = _on

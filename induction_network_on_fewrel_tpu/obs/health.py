@@ -168,10 +168,13 @@ class HealthWatchdog:
                 return
             step = int(rec.get("step", 0))
             if kind in ("train", "val", "eval", "test", "serve",
-                        "quality", "scenario"):
+                        "quality", "scenario", "perf", "compile"):
                 # quality/scenario carry model-score statistics — a NaN
                 # margin/entropy/accuracy means NaN logits upstream, the
                 # exact silent failure the non-finite check exists for.
+                # perf/compile carry timing decompositions (ISSUE 11) — a
+                # non-finite segment or elapsed means broken clocks or a
+                # division by a zero window, equally silent upstream.
                 self._check_finite(step, rec)
             if kind in ("train", "val", "eval"):
                 self._check_entropy(step, rec)

@@ -316,6 +316,33 @@ class ContinuousBatcher:
         # group -> deadline-ordered heap of (deadline, seq, Request); seq
         # breaks deadline ties FIFO (Requests don't order).
         self._pending: dict[str, list] = {}
+        # Indexed selection (ISSUE 11, the BASELINE round-9 scale
+        # paydown): the per-launch pop used to scan EVERY active group
+        # under the admission lock — O(active groups), the known ceiling
+        # of a 10k-tenant soak. Two lazy heaps replace the scan:
+        #
+        # * ``_urgent``  — global [deadline, seq, Request] min-heap, one
+        #   entry per ADMISSION, the SAME mutable list object the group
+        #   heap holds (deadline+seq order; seq is unique, so comparison
+        #   never reaches the Request slot). The globally-earliest
+        #   still-pending entry is necessarily the head of its group's
+        #   own deadline-ordered heap, so peeking it IS the urgent-group
+        #   lookup. Popping a batch NULLS each entry's Request slot in
+        #   place — the stale marker AND the memory release (a retained
+        #   tuple would pin the executed request's query payload +
+        #   result future until the entry drifted to the heap top, ~the
+        #   deadline horizon at high qps); stale entries are discarded
+        #   lazily, each pushed once and discarded at most once, so the
+        #   amortized pop cost is O(log pending).
+        # * ``_depth``   — lazy (-depth, seq, group) max-heap; a group is
+        #   (re)pushed when its depth GROWS. A popped entry whose stored
+        #   depth disagrees with the group's live depth is stale: it is
+        #   discarded and, when the group still has pending work, one
+        #   accurate entry is re-pushed before continuing — every stale
+        #   entry is consumed exactly once, so this also amortizes to
+        #   O(log) per selection instead of O(groups).
+        self._urgent: list = []
+        self._depth: list = []
         self._count = 0
         self._seq = 0
         self._closed = False
@@ -377,7 +404,10 @@ class ContinuousBatcher:
             if mine is None:
                 mine = self._pending[tenant] = []
             self._seq += 1
-            heapq.heappush(mine, (req.deadline, self._seq, req))
+            entry = [req.deadline, self._seq, req]
+            heapq.heappush(mine, entry)
+            heapq.heappush(self._urgent, entry)
+            heapq.heappush(self._depth, (-len(mine), self._seq, tenant))
             self._count += 1
             self._cv.notify()
         return req.future
@@ -393,15 +423,50 @@ class ContinuousBatcher:
         # Fail anything still admitted so no client blocks forever.
         with self._cv:
             for heap in self._pending.values():
-                for _, _, req in heap:
-                    if not req.future.done():
+                for entry in heap:
+                    req, entry[2] = entry[2], None
+                    if req is not None and not req.future.done():
                         req.future.set_exception(
                             RuntimeError("batcher closed")
                         )
             self._pending.clear()
+            self._urgent.clear()
+            self._depth.clear()
             self._count = 0
 
     # --- worker side -----------------------------------------------------
+
+    def _urgent_head_locked(self) -> Request | None:
+        """The globally most-urgent pending request, via the lazy global
+        deadline heap: discard stale (nulled-at-pop) entries from the
+        top, then peek. The surviving minimum is necessarily the head of
+        its own group's deadline-ordered heap — group heaps hold only
+        pending entries, ordered by the same (deadline, seq) key."""
+        heap = self._urgent
+        while heap and heap[0][2] is None:
+            heapq.heappop(heap)
+        return heap[0][2] if heap else None
+
+    def _deepest_group_locked(self) -> str | None:
+        """The group with the most pending requests, via the lazy depth
+        max-heap: a top entry whose stored depth disagrees with the live
+        depth is stale — consume it and, while the group still has work,
+        re-push ONE accurate entry before re-examining. Every admission
+        pushes one entry and every stale entry is consumed exactly once,
+        so the amortized cost is O(log pending) per selection — never a
+        scan over active groups."""
+        heap = self._depth
+        while heap:
+            d, _, group = heap[0]
+            live_heap = self._pending.get(group)
+            live = len(live_heap) if live_heap else 0
+            if live and -d == live:
+                return group
+            heapq.heappop(heap)
+            if live:
+                self._seq += 1
+                heapq.heappush(heap, (-live, self._seq, group))
+        return None
 
     def _pop_group_locked(self) -> tuple[str, list[Request]] | None:
         """Pop up to ``max(buckets)`` requests of the scheduled group (call
@@ -430,32 +495,37 @@ class ContinuousBatcher:
         still served within ~STALE_BUDGET_FRAC of its deadline instead
         of at its deadline.
 
-        The scan is O(active groups) under the admission lock — fine at
-        the hundreds-of-tenants scale the loadgen drives; a 10k+-tenant
-        engine wants a global deadline heap + depth index (O(log T) pop)
-        before the lock becomes the ceiling (recorded as future work,
-        BASELINE round 9)."""
-        urgent = deepest = None
-        for group, heap in self._pending.items():
-            if not heap:
-                continue
-            if urgent is None or heap[0][0] < urgent[1][0][0]:
-                urgent = (group, heap)
-            if deepest is None or len(heap) > len(deepest[1]):
-                deepest = (group, heap)
-        if urgent is None:
+        Selection is INDEXED (ISSUE 11, paying down the round-9 scale
+        follow-up): the urgent head comes off the lazy global deadline
+        heap and the deepest group off the lazy depth heap — both
+        amortized O(log pending) — so the per-launch cost under the
+        admission lock no longer scales with active groups (the 10k-
+        tenant soak ceiling). Pinned structurally in
+        tests/test_serving_fleet.py::test_pop_never_scans_groups."""
+        head = self._urgent_head_locked()
+        if head is None:
             return None
         exec_est = self._stats.exec_estimate_s() if self._stats else 0.005
         now = time.monotonic()
-        head = urgent[1][0][2]
         slack = head.deadline - now - exec_est
         budget = head.deadline - head.enqueued_at
         stale = (now - head.enqueued_at) > self.STALE_BUDGET_FRAC * budget
-        group, heap = urgent if slack < 2 * exec_est or stale else deepest
+        if slack < 2 * exec_est or stale:
+            group = head.tenant
+        else:
+            group = self._deepest_group_locked()
+            if group is None:       # urgent head exists => impossible,
+                group = head.tenant  # but never crash the worker on it
+        heap = self._pending[group]
         cap = self.buckets[-1]
         batch = []
         while heap and len(batch) < cap:
-            batch.append(heapq.heappop(heap)[2])
+            entry = heapq.heappop(heap)
+            batch.append(entry[2])
+            # Null the shared slot: marks the _urgent twin stale AND
+            # releases the executed request the moment it leaves the
+            # queue (see the index comment in __init__).
+            entry[2] = None
         if not heap:
             del self._pending[group]
         self._count -= len(batch)

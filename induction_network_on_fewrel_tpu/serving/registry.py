@@ -103,18 +103,24 @@ class TenantRegistry:
     """Named support sets distilled to class vectors, resident on device,
     versioned per tenant.
 
-    Control plane (register/unregister/threshold/publish/clone) serializes
-    on one lock — INCLUDING the distill device compute, so concurrent
-    registrations queue behind each other and a publish briefly blocks
-    registration (~0.1 s measured for a 3-tenant republish; queries are
-    never blocked — the data plane is lock-free). Fine at current scale;
-    a mass-onboarding workload wants distill-outside-lock with a
-    params_version re-validation before the publish (future work, noted
-    in BASELINE round 9's chip/scale list). The data plane (``snapshot``)
-    is a lock-free read of an immutable object. ``ClassVectorRegistry``
-    below is the single-tenant spelling of the same object (every method
-    defaults to the "default" tenant), kept so pre-fleet callers and the
-    simple CLI keep working.
+    Control plane (register/unregister/threshold/publish/clone) mutates
+    under one lock, but the DISTILL device compute runs OUTSIDE it
+    (ISSUE 11, paying down the BASELINE round-10 scale follow-up): a
+    registration plans its cache misses under the lock, releases it for
+    the device pass, then re-acquires and COMMITS with a params_version
+    re-validation — a publish that raced the distill invalidates it and
+    the registration re-distills against the new weights, so a committed
+    snapshot can never mix old-params vectors with a new params_version
+    (pinned in tests/test_serving_fleet.py::
+    test_publish_vs_register_consistency). Publishes serialize among
+    themselves on a dedicated ``_publish_serial`` lock held across their
+    snapshot -> distill -> swap cycle; registrations only contend for
+    the short plan/commit critical sections, so mass onboarding no
+    longer queues behind a republish's device time. The data plane
+    (``snapshot``) is a lock-free read of an immutable object.
+    ``ClassVectorRegistry`` below is the single-tenant spelling of the
+    same object (every method defaults to the "default" tenant), kept so
+    pre-fleet callers and the simple CLI keep working.
     """
 
     def __init__(self, model, params, tokenizer, k: int = 5, logger=None):
@@ -125,6 +131,11 @@ class TenantRegistry:
         self._model, self.params, self._tok, self.k = model, params, tokenizer, k
         self._logger = logger
         self._lock = threading.Lock()
+        # Publishes serialize among themselves here (held across their
+        # whole snapshot -> distill -> swap cycle) WITHOUT holding the
+        # control-plane lock through the distill — registrations keep
+        # flowing during a republish's device time (ISSUE 11).
+        self._publish_serial = threading.Lock()
         self._jax = jax
         self.params_version = 0
         self._version = 0                 # monotonic snapshot stamp
@@ -163,23 +174,28 @@ class TenantRegistry:
         tenant: str = DEFAULT_TENANT,
     ) -> np.ndarray:
         """Register from already-tokenized [L]-leaf dicts (the token-cache
-        wire form; position leaves may be compact per-sentence offsets)."""
+        wire form; position leaves may be compact per-sentence offsets).
+        The distill runs OUTSIDE the control-plane lock; the commit
+        re-validates params_version (see class doc)."""
         rows = self._normalize_shots(rows)
-        with self._lock:
-            slot = self._intern_locked(rows, self.params, self.params_version)
+
+        def commit(slots: list[int]) -> np.ndarray:
+            slot = slots[0]
             snap = self._tenants.get(tenant)
             names = list(snap.names) if snap else []
-            slots = list(snap.slots) if snap else []
+            cur = list(snap.slots) if snap else []
             if name in names:
-                slots[names.index(name)] = slot
+                cur[names.index(name)] = slot
             else:
                 names.append(name)
-                slots.append(slot)
-            self._publish_locked(tenant, names, slots)
+                cur.append(slot)
+            self._publish_locked(tenant, names, cur)
             # Copy: the pool's array is shared across tenants and stacked
             # into every future publish — the caller must not be able to
             # mutate it.
             return self._pool[slot].vec.copy()
+
+        return self._intern_classes([rows], commit)
 
     def register_dataset(
         self, dataset, max_classes: int | None = None,
@@ -204,10 +220,8 @@ class TenantRegistry:
                 for r in range(sizes[ci])
             ]
             per_class.append(self._normalize_shots(rows))
-        with self._lock:
-            slots_new = self._intern_bulk_locked(
-                per_class, self.params, self.params_version
-            )
+
+        def commit(slots_new: list[int]) -> list[str]:
             snap = self._tenants.get(tenant)
             cur_names = list(snap.names) if snap else []
             cur_slots = list(snap.slots) if snap else []
@@ -218,7 +232,9 @@ class TenantRegistry:
                     cur_names.append(name)
                     cur_slots.append(slot)
             self._publish_locked(tenant, cur_names, cur_slots)
-        return names
+            return names
+
+        return self._intern_classes(per_class, commit)
 
     def unregister(self, name: str, tenant: str = DEFAULT_TENANT) -> None:
         with self._lock:
@@ -271,41 +287,156 @@ class TenantRegistry:
             self._tenants[tenant] = snap
             return snap
 
+    # --- distill-outside-lock interning (ISSUE 11) ------------------------
+
+    # Plan/commit retries before the correctness escape hatch distills
+    # UNDER the lock (guaranteed progress when publishes/registrations
+    # churn faster than a device pass completes — pathological, but the
+    # loop must terminate).
+    _INTERN_RETRIES = 3
+
+    def _intern_classes(self, per_class, commit):
+        """Distill-or-reuse each class's K rows with the device pass
+        OUTSIDE the control-plane lock, then run ``commit(slots)`` under
+        it. The commit re-validates ``params_version``: a publish that
+        landed mid-distill invalidates the vectors (they were computed
+        against the old weights) and the loop re-plans against the new
+        ones — a committed snapshot can never mix generations."""
+        digests = [self._digest(rows) for rows in per_class]
+        for attempt in range(self._INTERN_RETRIES):
+            with self._lock:
+                params, pv = self.params, self.params_version
+                # Cache misses, deduped within the call (identical
+                # digests share one distill row and one slot).
+                missing = [
+                    i for i, d in enumerate(digests)
+                    if (pv, d) not in self._by_digest
+                    and i == digests.index(d)
+                ]
+            vecs = ()
+            if missing:
+                sup = self._stack_support([per_class[i] for i in missing])
+                # The device pass — the whole point: NO lock held here.
+                with span("serve/distill", classes=len(missing)):
+                    vecs = np.asarray(self._distill(params, sup))[0]
+            with self._lock:
+                if self.params_version != pv:
+                    continue    # a publish raced: re-distill on new weights
+                for i, vec in zip(missing, vecs):
+                    if (pv, digests[i]) in self._by_digest:
+                        continue   # a concurrent registration beat us
+                    slot = self._next_slot
+                    self._next_slot += 1
+                    self._pool[slot] = _Slot(
+                        vec=vec.astype(np.float32), rows=per_class[i],
+                        digest=digests[i],
+                    )
+                    self._by_digest[(pv, digests[i])] = slot
+                if any((pv, d) not in self._by_digest for d in digests):
+                    # A cached slot we planned to reuse was GC'd between
+                    # plan and commit (concurrent unregister) — re-plan.
+                    continue
+                return commit([self._by_digest[(pv, d)] for d in digests])
+        # Escape hatch: churn outran us — hold the lock through the
+        # distill (the pre-ISSUE-11 behavior; correct, briefly blocking).
+        with self._lock:
+            slots = self._intern_bulk_locked(
+                per_class, self.params, self.params_version
+            )
+            return commit(slots)
+
     # --- hot-swap publish -------------------------------------------------
 
     def publish_params(self, new_params) -> int:
         """Atomic hot-swap from a training artifact: re-distill every live
         slot with ``new_params`` and republish every tenant against the new
-        weights in one transaction. Query programs take params as an
-        argument, so NOTHING recompiles; queries in flight hold their old
-        snapshot (old params, old matrix) and finish unperturbed; queries
-        batched after the swap score on the new weights. Returns the new
-        params_version."""
-        with self._lock:
-            new_version = self.params_version + 1
-            # Re-distill the union of live slots, batched per tenant-set
-            # size so the [1, S, K] distill compiles match registration's
-            # (slots shared with an already-republished tenant drop out of
-            # ``todo``; _intern_bulk_locked's digest cache dedups the rest).
-            # Grouped by leaf-shape signature: one tenant can mix
+        weights in one control-plane transaction. Query programs take
+        params as an argument, so NOTHING recompiles; queries in flight
+        hold their old snapshot (old params, old matrix) and finish
+        unperturbed; queries batched after the swap score on the new
+        weights. Returns the new params_version.
+
+        The re-distill runs OUTSIDE the control-plane lock (ISSUE 11):
+        publishes serialize among themselves on ``_publish_serial``
+        (params_version is therefore stable for the duration), snapshot
+        the live slot set, distill, then swap under the lock — re-reading
+        the live set at swap time: slots a concurrent registration added
+        mid-distill are re-distilled in another pass before the swap
+        commits, so the published transaction covers EVERY slot live at
+        swap time (pinned in tests/test_serving_fleet.py)."""
+        with self._publish_serial:
+            return self._publish_params_serialized(new_params)
+
+    def _publish_params_serialized(self, new_params) -> int:
+        new_version = self.params_version + 1
+        # old slot id -> freshly distilled [C] vector (accumulated across
+        # passes; slots never mutate in place, so a vector distilled in
+        # pass 1 stays valid for the swap even if pass 2 adds more).
+        vec_of: dict[int, np.ndarray] = {}
+        # Bounded delta passes: registrations adding slots faster than a
+        # device pass completes must not spin this loop forever — after
+        # the bound, the swap's under-lock late path mops up the rest.
+        for _pass in range(self._INTERN_RETRIES):
+            with self._lock:
+                live = sorted({
+                    s for snap in self._tenants.values() for s in snap.slots
+                })
+                todo = [s for s in live if s not in vec_of]
+                rows_of = {s: self._pool[s].rows for s in todo}
+            if not todo:
+                break
+            # Group by leaf-shape signature: one tenant can mix
             # registration paths (token-cache compact position offsets vs
-            # full per-token ids) and mixed forms cannot co-stack.
-            live: dict[int, int] = {}   # old slot -> new slot
-            for snap in self._tenants.values():
-                groups: dict[tuple, list[int]] = {}
-                for s in snap.slots:
-                    if s in live:
-                        continue
-                    rows = self._pool[s].rows
-                    sig = tuple(
-                        (k, np.shape(v)) for k, v in sorted(rows[0].items())
-                    )
-                    groups.setdefault(sig, []).append(s)
-                for slots_g in groups.values():
-                    live.update(zip(slots_g, self._intern_bulk_locked(
-                        [self._pool[s].rows for s in slots_g],
-                        new_params, new_version,
-                    )))
+            # full per-token ids) and mixed forms cannot co-stack. Batched
+            # per group so the [1, S, K] distill compiles match
+            # registration's. NO lock held through the device pass.
+            groups: dict[tuple, list[int]] = {}
+            for s in todo:
+                sig = tuple(
+                    (k, np.shape(v)) for k, v in sorted(rows_of[s][0].items())
+                )
+                groups.setdefault(sig, []).append(s)
+            for slots_g in groups.values():
+                sup = self._stack_support([rows_of[s] for s in slots_g])
+                with span("serve/distill", classes=len(slots_g)):
+                    vecs = np.asarray(self._distill(new_params, sup))[0]
+                for s, vec in zip(slots_g, vecs):
+                    vec_of[s] = vec.astype(np.float32)
+            # Loop: a registration may have added live slots mid-distill;
+            # the next pass picks up exactly the delta.
+        with self._lock:
+            # Swap. Live set re-read ONCE more under the lock; a slot
+            # registered after the last pass above forces one more
+            # distill pass (rare — bounded by registration rate).
+            current = {
+                s for snap in self._tenants.values() for s in snap.slots
+            }
+            if current - set(vec_of):
+                # Late registration landed between the last pass and this
+                # lock acquisition: distill the stragglers UNDER the lock
+                # (bounded: only the delta) rather than looping forever.
+                late = sorted(current - set(vec_of))
+                for s in late:
+                    sup = self._stack_support([self._pool[s].rows])
+                    with span("serve/distill", classes=1):
+                        vec_of[s] = np.asarray(
+                            self._distill(new_params, sup)
+                        )[0][0].astype(np.float32)
+            live_map: dict[int, int] = {}   # old slot -> new slot
+            by_digest_new: dict[str, int] = {}
+            for s in sorted(current):
+                digest = self._pool[s].digest
+                if digest in by_digest_new:
+                    live_map[s] = by_digest_new[digest]
+                    continue
+                slot = self._next_slot
+                self._next_slot += 1
+                self._pool[slot] = _Slot(
+                    vec=vec_of[s], rows=self._pool[s].rows, digest=digest,
+                )
+                self._by_digest[(new_version, digest)] = slot
+                by_digest_new[digest] = slot
+                live_map[s] = slot
             self.params = new_params
             self.params_version = new_version
             for tenant, snap in list(self._tenants.items()):
@@ -315,18 +446,19 @@ class TenantRegistry:
                 self._publish_locked(
                     tenant,
                     list(snap.names),
-                    [live[s] for s in snap.slots],
+                    [live_map[s] for s in snap.slots],
                     nota_threshold=snap.nota_threshold,
                     gc=False,
                 )
             self._gc_slots_locked()
-            if self._logger is not None:
-                self._logger.log(
-                    new_version, kind="serve", event="snapshot_swap",
-                    params_version=new_version, tenants=len(self._tenants),
-                    slots=len(live),
-                )
-            return new_version
+            n_tenants, n_slots = len(self._tenants), len(live_map)
+        if self._logger is not None:
+            self._logger.log(
+                new_version, kind="serve", event="snapshot_swap",
+                params_version=new_version, tenants=n_tenants,
+                slots=n_slots,
+            )
+        return new_version
 
     def publish_checkpoint(self, ckpt_dir: str) -> int:
         """Hot-swap from a checkpoint directory (the training run's publish
@@ -432,11 +564,6 @@ class TenantRegistry:
                 h.update(key.encode())
                 h.update(np.ascontiguousarray(row[key]).tobytes())
         return h.hexdigest()
-
-    def _intern_locked(
-        self, rows: list[dict[str, np.ndarray]], params, params_version: int
-    ) -> int:
-        return self._intern_bulk_locked([rows], params, params_version)[0]
 
     def _intern_bulk_locked(
         self, per_class: list[list[dict[str, np.ndarray]]], params,

@@ -70,6 +70,8 @@ class FewShotTrainer:
         recorder=None,
         comms_u_rows=None,
         comms_compact=None,
+        perf=None,
+        compile_watcher=None,
     ):
         self.model = model
         self.cfg = cfg
@@ -92,6 +94,15 @@ class FewShotTrainer:
         # no per-site instrumentation. Both optional and host-side only.
         self.watchdog = watchdog
         self.recorder = recorder
+        # Performance-attribution observability (ISSUE 11): the perf
+        # observer decomposes each metric window into segments that tile
+        # it (obs/perf.py, kind="perf"); the compile watcher stamps every
+        # XLA compile with fn/shapes/elapsed/trigger (obs/compile.py,
+        # kind="compile") and holds the train loop to the steady-state
+        # zero-recompile invariant. Both optional, host-side only; the
+        # trainer OWNS them once passed (closed/uninstalled in close()).
+        self._perf = perf
+        self._compile_watcher = compile_watcher
         # Hook ORDER is load-bearing: the recorder must see each record
         # BEFORE the watchdog, whose critical events dump the recorder —
         # else the dump's metrics window excludes the record that tripped.
@@ -380,8 +391,16 @@ class FewShotTrainer:
         # into this one's spans.
         tracker = get_tracker()
         tracker.set_trace(None)
+        if self._perf is not None:
+            # Open the first decomposition window at loop entry, bound to
+            # THIS thread (only its spans tile the windows).
+            self._perf.begin(step)
         while step < end_step:
             tracker.set_trace(tracker.new_context())
+            if self._compile_watcher is not None:
+                # One int store: compiles observed anywhere in this
+                # iteration stamp the right step into kind="compile".
+                self._compile_watcher.observe_step(step)
             # Trace steps [1, 1+profile_steps): the first call (the compile)
             # stays outside the trace so it doesn't drown the steady state.
             if self.profile_dir is not None:
@@ -481,6 +500,17 @@ class FewShotTrainer:
                     self.logger.log(
                         step, "roofline", **self._roofline_record
                     )
+                if self._perf is not None:
+                    # Step-time decomposition (ISSUE 11): close the perf
+                    # window at this boundary — segments tile [last
+                    # observe, now], which includes any eval/checkpoint
+                    # spans since then (they get their own named tiles).
+                    self._perf.observe_window(step)
+                if self._compile_watcher is not None:
+                    # First window done = warmup over: from here a seen
+                    # fn compiling a NEW shape is a gated steady-state
+                    # recompile (serving's warmup()/steady split).
+                    self._compile_watcher.arm_steady()
                 t0 = time.monotonic()
                 last_logged = step
             if (
@@ -668,6 +698,10 @@ class FewShotTrainer:
         for s in (self.train_sampler, self.val_sampler):
             if hasattr(s, "close"):
                 s.close()
+        if self._perf is not None:
+            self._perf.close()          # gc.callbacks meter
+        if self._compile_watcher is not None:
+            self._compile_watcher.uninstall()
         self.logger.close()  # persistent metrics.jsonl handle
 
     def evaluate(self, params, num_episodes: int, sampler=None,

@@ -105,6 +105,27 @@ KNOWN_KINDS = frozenset({
     # reads them (headline: step_mb) and rebuilds the per-component table
     # from config.json.
     "roofline",
+    # Step-time decomposition (ISSUE 11, obs/perf.py): one record per
+    # metric window with the host-observed segments that TILE the window
+    # — data_wait_ms / host_dispatch_ms / device_sync_ms / checkpoint_ms /
+    # eval_ms / probe_ms / other_ms sum to window_s * 1e3 exactly
+    # (segments_sum_ms restates the sum so the report can verify) — plus
+    # steps, step_ms, overlapping context (compiles, compile_ms, gc_ms,
+    # gc_collections), the rolling baseline_step_ms, the shared roofline
+    # projection (floor_ms / device_over_floor when configured), and
+    # out-of-band classification: oob (0/1) and cause (str, one of
+    # obs/perf.CAUSES) on slow windows. obs_report's perf section reads
+    # these (headline: segment fractions + the cause table).
+    "perf",
+    # XLA compile forensics (ISSUE 11, obs/compile.py): one record per
+    # observed backend compile with fn (str, the jitted function), shapes
+    # (str, the argument shape signature), elapsed_ms, trigger (str, the
+    # innermost open host span — which code path paid), phase (str:
+    # warmup = first compile of a fn; recompile = a SEEN fn compiling a
+    # NEW signature, the steady-state invariant breach; dup = a seen
+    # (fn, signature) pair re-compiling), and trace_id when a trace was
+    # active. The training twin of serving's steady_recompiles counter.
+    "compile",
 })
 
 

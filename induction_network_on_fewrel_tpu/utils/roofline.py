@@ -231,6 +231,30 @@ def step_bytes(
     ))
 
 
+# Public v5e spec numbers — the nominal-silicon projection the ledger
+# prints ("projected floor on nominal v5e") and the perf observer stamps
+# into kind="perf" records (ISSUE 11). One home; tools/roofline_ledger.py
+# aliases these.
+NOMINAL_V5E_BW = 819e9      # HBM bytes/s
+NOMINAL_V5E_MXU = 197e12    # bf16 FLOP/s
+
+
+def projected_floor_ms(
+    cfg: ExperimentConfig,
+    bw: float = NOMINAL_V5E_BW,
+    mxu: float = NOMINAL_V5E_MXU,
+    corpus_rows: int | None = None,
+) -> float:
+    """Analytic per-step time floor (ms) at a given bandwidth/MXU rate:
+    each component pays max(bytes/bw, flops/mxu) — the roofline-ledger
+    floor formula, extracted so the ledger tool and the online perf
+    observer (obs/perf.py kind="perf" ``floor_ms``) share ONE spelling."""
+    return sum(
+        max(b / bw, f / mxu) * 1e3
+        for _, b, f in step_components(cfg, corpus_rows=corpus_rows)
+    )
+
+
 def lstm_residual_bytes(
     cfg: ExperimentConfig,
     lstm_cs_window: int | None = None,

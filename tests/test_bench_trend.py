@@ -1,0 +1,179 @@
+"""Regression-gated bench trajectory (ISSUE 11, tools/bench_trend.py):
+the committed TREND.json must cover every committed perf artifact, the
+--check gate must run green against the repo as committed, and a
+synthetic out-of-band leg must fail it — so the trajectory can never be
+empty or silently regress again.
+"""
+
+import glob
+import json
+import os
+import shutil
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+_TOOLS = str(_REPO / "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import bench_trend  # noqa: E402
+
+
+def _copy_artifacts(dst: Path) -> None:
+    for pattern, _ in bench_trend._EXTRACTORS:
+        for f in glob.glob(str(_REPO / pattern)):
+            shutil.copy(f, dst)
+
+
+def test_trend_covers_every_committed_artifact():
+    """Every BENCH/ROOFLINE/COMMS/SERVE artifact in the repo contributes
+    at least one point (zero-contribution = extractor drift, a problem),
+    and the headline series exist with the committed history."""
+    trend, problems = bench_trend.build_trend(_REPO)
+    assert not problems, problems
+    n_artifacts = sum(
+        len(glob.glob(str(_REPO / p))) for p, _ in bench_trend._EXTRACTORS
+    )
+    assert n_artifacts >= 15            # 5 BENCH + 3 ROOFLINE + 5 COMMS + 2 SERVE
+    assert len(trend["inputs"]) == n_artifacts
+    series = trend["series"]
+    assert any(k.startswith("bench.eps_per_s[") for k in series)
+    assert any(k.startswith("bench.mfu[") for k in series)
+    # The round-6 -> round-8 byte diet is IN the trajectory.
+    sb = [p["value"] for p in series["roofline.step_bytes"]["points"]]
+    assert sb == [798687980, 634847980]
+    # The comms diet (round-6 dense flagship -> compact) likewise.
+    comms = [
+        p["value"]
+        for p in series["comms.flagship_payload_bytes"]["points"]
+    ]
+    assert comms[0] == 33719548 and comms[-1] == 7746548
+    # Scheduler-A/B ratio present for both SERVE rounds.
+    assert len(series["serve.closed_qps_ratio"]["points"]) == 2
+
+
+def test_trend_json_committed_and_fresh():
+    """TREND.json is committed and regenerating it yields the committed
+    ARTIFACT-ONLY content — the staleness half of --check (live
+    TREND_INPUT.jsonl rows are machine-local and excluded from the
+    equality on both sides, so a local bench run never fails this)."""
+    committed = json.loads((_REPO / "TREND.json").read_text())
+    trend, _ = bench_trend.build_trend(_REPO)
+    assert bench_trend._strip_live(committed) == \
+        bench_trend._strip_live(trend), (
+        "TREND.json is stale — re-run tools/bench_trend.py and commit"
+    )
+
+
+def test_check_green_on_committed_repo():
+    """The tier-1 gate: --check exits 0 against the repo as committed."""
+    assert bench_trend.main(["--root", str(_REPO), "--check"]) == 0
+
+
+def test_check_fails_on_stale_trend(tmp_path):
+    """A new artifact without a TREND.json regeneration is a staleness
+    failure — committing a bench round WITHOUT refreshing the trajectory
+    can never pass tier-1."""
+    _copy_artifacts(tmp_path)
+    assert bench_trend.main(["--root", str(tmp_path)]) == 0
+    r5 = json.loads((_REPO / "BENCH_r05.json").read_text())
+    (tmp_path / "BENCH_r06.json").write_text(
+        json.dumps({"n": 6, "parsed": r5["parsed"]})
+    )
+    rc = bench_trend.main(["--root", str(tmp_path), "--check"])
+    assert rc == 1
+
+
+def test_check_fails_on_synthetic_out_of_band_leg(tmp_path, capsys):
+    """The demonstrated failure the acceptance asks for: a fresh BENCH
+    leg 50% below the committed band (same config string, so it shares
+    the series) fails --check even after the trajectory is regenerated."""
+    _copy_artifacts(tmp_path)
+    r5 = json.loads((_REPO / "BENCH_r05.json").read_text())
+    bad = dict(r5["parsed"], value=8000.0, mfu=0.10)
+    (tmp_path / "BENCH_r06.json").write_text(
+        json.dumps({"n": 6, "parsed": bad})
+    )
+    assert bench_trend.main(["--root", str(tmp_path)]) == 0  # regenerate
+    rc = bench_trend.main(["--root", str(tmp_path), "--check"])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "out of band" in err and "eps_per_s" in err
+
+
+def test_candidate_gate(tmp_path):
+    """--candidate validates a fresh bench summary against committed
+    bands without requiring a commit: in-band passes, out-of-band fails.
+    (The committed trend in tmp is a faithful copy, so the candidate's
+    config series exists.)"""
+    _copy_artifacts(tmp_path)
+    assert bench_trend.main(["--root", str(tmp_path)]) == 0
+    r5 = json.loads((_REPO / "BENCH_r05.json").read_text())
+    good = tmp_path / "cand_good.json"
+    good.write_text(json.dumps(dict(r5["parsed"], value=16900.0)))
+    assert bench_trend.main(
+        ["--root", str(tmp_path), "--check", "--candidate", str(good)]
+    ) == 0
+    bad = tmp_path / "cand_bad.json"
+    bad.write_text(json.dumps(dict(r5["parsed"], value=8000.0)))
+    assert bench_trend.main(
+        ["--root", str(tmp_path), "--check", "--candidate", str(bad)]
+    ) == 1
+
+
+def test_bench_appends_live_rows_and_trend_folds_them(tmp_path, monkeypatch):
+    """bench.py's trajectory-input append (the from-this-PR-onward
+    population path): a run summary appended to TREND_INPUT.jsonl is
+    folded into the trajectory as a live point, keyed by its own metric
+    bracket (a CPU fallback row never shares a TPU band)."""
+    sys.path.insert(0, str(_REPO))
+    import bench
+
+    dest = tmp_path / "TREND_INPUT.jsonl"
+    monkeypatch.setenv("BENCH_TREND_FILE", str(dest))
+    summary = {
+        "metric": "train_episodes_per_sec_per_chip[5w5s,bilstm,cpu,test]",
+        "value": 123.4, "mfu": None,
+    }
+    bench._append_trend_input(summary, "cpu")
+    bench._append_trend_input(dict(summary, value=125.0), "cpu")
+    rows = [json.loads(x) for x in dest.read_text().splitlines()]
+    assert [r["value"] for r in rows] == [123.4, 125.0]
+    assert rows[0]["backend"] == "cpu"
+
+    _copy_artifacts(tmp_path)   # dest already IS tmp_path/TREND_INPUT.jsonl
+    trend, problems = bench_trend.build_trend(tmp_path)
+    assert not problems
+    assert trend["live_rows"] == 2
+    live = trend["series"]["bench.eps_per_s[5w5s,bilstm,cpu,test]"]
+    assert [p["value"] for p in live["points"]] == [123.4, 125.0]
+    assert all(p["round"] is None for p in live["points"])
+    # BENCH_TREND_FILE='' disables the append (read-only checkouts).
+    monkeypatch.setenv("BENCH_TREND_FILE", "")
+    os.remove(dest)
+    bench._append_trend_input(summary, "cpu")
+    assert not dest.exists()
+
+
+def test_local_bench_run_does_not_trip_staleness_or_bands(tmp_path):
+    """A machine-local bench run (live rows in TREND_INPUT.jsonl with no
+    TREND.json regeneration) must NOT fail --check — neither the
+    staleness gate (artifact-only equality) nor the BAND gate (two
+    local runs under different sandbox weather must not fail tier-1 on
+    one machine; fresh runs gate via --candidate). A new committed
+    artifact still does (test_check_fails_on_stale_trend)."""
+    _copy_artifacts(tmp_path)
+    assert bench_trend.main(["--root", str(tmp_path)]) == 0
+    r5 = json.loads((_REPO / "BENCH_r05.json").read_text())
+    rows = [
+        {"metric": "train_episodes_per_sec_per_chip[5w5s,local,test]",
+         "value": 99.0, "backend": "cpu"},
+        # Wildly out of band for a COMMITTED config's series: still must
+        # not gate (live rows are recorded, not banded).
+        dict(r5["parsed"], value=10.0),
+    ]
+    (tmp_path / bench_trend.LIVE_NAME).write_text(
+        "\n".join(json.dumps(r) for r in rows) + "\n"
+    )
+    assert bench_trend.main(["--root", str(tmp_path), "--check"]) == 0

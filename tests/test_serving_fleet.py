@@ -735,3 +735,219 @@ def test_loadgen_parity_and_zero_recompile_gate(world):
         )
     finally:
         eng.close()
+
+
+# --- indexed pop (ISSUE 11: the round-9 O(active-groups) scan paydown) ----
+
+
+class _NoScanDict(dict):
+    """A _pending stand-in that forbids ITERATION (the O(groups) scan the
+    indexed pop replaced) while allowing keyed access. Structural pin:
+    if a future refactor reintroduces a per-launch sweep over active
+    groups, these raises fail the test immediately."""
+
+    def __iter__(self):
+        raise AssertionError("_pop_group_locked iterated _pending")
+
+    def items(self):
+        raise AssertionError("_pop_group_locked scanned _pending.items()")
+
+    def values(self):
+        raise AssertionError("_pop_group_locked scanned _pending.values()")
+
+    def keys(self):
+        raise AssertionError("_pop_group_locked scanned _pending.keys()")
+
+
+def test_pop_never_scans_groups():
+    """The per-launch pop must be indexed (lazy urgency + depth heaps),
+    never a scan over active groups — with 64 tenants admitted, popping
+    every batch touches _pending only by key."""
+    done = []
+
+    def execute(group, batch):
+        done.append((group, len(batch)))
+        for r in batch:
+            r.future.set_result(group)
+
+    b = ContinuousBatcher(execute, buckets=(1, 2, 4), start=False,
+                          max_queue_depth=4096, tenant_share=1.0)
+    b._pending = _NoScanDict(b._pending)
+    futs = []
+    for g in range(64):
+        for _ in range(3):
+            futs.append(
+                b.submit({"q": g}, deadline_s=30.0, tenant=f"t{g:02d}")
+            )
+    while b.queue_depth:
+        assert b.drain_once(block_s=0.01) > 0
+    for f in futs:
+        f.result(timeout=1.0)
+    assert len(done) == 64            # 3 rows per tenant, one launch each
+    assert all(n == 3 for _, n in done)
+    # close() legitimately sweeps _pending to fail leftover futures — the
+    # pin is on the POP path only (everything is drained here anyway).
+    b._pending = {}
+    b.close()
+
+
+def test_pop_index_consistency_under_mixed_urgency():
+    """The lazy heaps must stay consistent through interleaved urgent
+    overrides, deep-pack pops, and re-submissions: every admitted request
+    resolves exactly once, no launch exceeds the bucket cap, and the
+    index sees a group again after it empties and refills."""
+    served = []
+
+    def execute(group, batch):
+        served.append((group, len(batch)))
+        for r in batch:
+            r.future.set_result(group)
+
+    stats = ServingStats()
+    stats.record_batch(1, 1, 0.05)    # 50 ms exec estimate
+    b = ContinuousBatcher(execute, buckets=(1, 2, 4), start=False,
+                          stats=stats)
+    # Deep backlog + an at-risk head elsewhere: urgent wins the slot.
+    for _ in range(4):
+        b.submit({"q": 0}, deadline_s=10.0, tenant="bulk")
+    fu = b.submit({"q": 1}, deadline_s=0.12, tenant="urgent")
+    assert b.drain_once() == 1 and served[0] == ("urgent", 1)
+    assert fu.result(timeout=1.0) == "urgent"
+    # Depth entries for "bulk" are now stale-high; the lazy re-sync must
+    # still find it, pack the full backlog, and drop the group cleanly.
+    assert b.drain_once() == 4 and served[1] == ("bulk", 4)
+    assert b.queue_depth == 0 and not b._pending
+    # Refill the SAME group: fresh index entries, fresh pop.
+    fr = [b.submit({"q": 2}, deadline_s=10.0, tenant="bulk")
+          for _ in range(2)]
+    assert b.drain_once() == 2 and served[2] == ("bulk", 2)
+    for f in fr:
+        f.result(timeout=1.0)
+    b.close()
+
+
+# --- distill-outside-lock registry (ISSUE 11, round-10 scale paydown) -----
+
+
+def test_distill_runs_outside_control_plane_lock(world):
+    """Structural pin: the distill device pass must NEVER run while the
+    control-plane lock is held (registrations and publishes both) — the
+    exact serialization the round-10 follow-up recorded. The escape
+    hatch (_intern_bulk_locked after repeated plan/commit races) is the
+    one sanctioned exception and is not reachable without concurrent
+    churn."""
+    _, _, _, _, ds_a, _ = world
+    eng = _engine(world)
+    try:
+        reg = eng.registry
+        real = reg._distill
+        locked_calls = []
+
+        def spy(params, sup):
+            locked_calls.append(reg._lock.locked())
+            return real(params, sup)
+
+        reg._distill = spy
+        eng.register_dataset(ds_a, tenant="acme")
+        assert locked_calls and not any(locked_calls), (
+            "registration distilled under the control-plane lock"
+        )
+        locked_calls.clear()
+        reg.publish_params(reg.params)
+        assert locked_calls and not any(locked_calls), (
+            "publish distilled under the control-plane lock"
+        )
+    finally:
+        eng.close()
+
+
+def test_register_retries_when_publish_races_distill(world):
+    """A publish landing MID-DISTILL of a registration must invalidate
+    the in-flight vectors: the commit's params_version check fails, the
+    registration re-distills against the NEW weights, and the committed
+    snapshot is coherent — new params_version, vectors from the new
+    params. Deterministic: the distill spy triggers the publish from
+    another thread on its first registration call."""
+    _, _, _, _, ds_a, ds_b = world
+    eng = _engine(world)
+    try:
+        reg = eng.registry
+        eng.register_dataset(ds_b, tenant="resident")  # publish has work
+        real = reg._distill
+        state = {"fired": False, "calls": 0}
+
+        def spy(params, sup):
+            state["calls"] += 1
+            if not state["fired"]:
+                state["fired"] = True
+                t = threading.Thread(
+                    target=reg.publish_params, args=(reg.params,)
+                )
+                t.start()
+                t.join()          # the publish fully lands mid-"distill"
+            return real(params, sup)
+
+        reg._distill = spy
+        eng.register_dataset(ds_a, tenant="acme")
+        # The racing publish bumped the version; the registration must
+        # have retried (>= 2 distill calls for its single bulk group,
+        # plus the publish's own re-distill of the resident tenant).
+        assert reg.params_version == 1
+        snap = reg.snapshot("acme")
+        assert snap.params_version == 1
+        assert snap.params is reg.params
+        assert all(s in reg._pool for s in snap.slots)
+        # Every pool slot the snapshot references was interned at the
+        # CURRENT version (no old-generation vector survived the race).
+        for s in snap.slots:
+            assert reg._by_digest[(1, reg._pool[s].digest)] == s
+    finally:
+        eng.close()
+
+
+def test_publish_vs_register_consistency(world):
+    """Concurrency storm: registrations and publishes interleaving freely
+    must end with every tenant snapshot at the registry's params_version,
+    every referenced slot live in the pool, and every tenant's classes
+    intact — the publish-vs-snapshot consistency contract."""
+    _, _, _, _, ds_a, ds_b = world
+    eng = _engine(world)
+    try:
+        reg = eng.registry
+        eng.register_dataset(ds_a, tenant="seed")
+        errs = []
+
+        def registrar(ds, tenant):
+            try:
+                for _ in range(3):
+                    eng.register_dataset(ds, tenant=tenant)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        def publisher():
+            try:
+                for _ in range(3):
+                    reg.publish_params(reg.params)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=registrar, args=(ds_a, "acme")),
+            threading.Thread(target=registrar, args=(ds_b, "globex")),
+            threading.Thread(target=publisher),
+            threading.Thread(target=publisher),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        assert not errs, errs
+        assert reg.params_version == 6
+        for tenant, n_classes in (("seed", 4), ("acme", 4), ("globex", 3)):
+            snap = reg.snapshot(tenant)
+            assert snap.params_version == reg.params_version, tenant
+            assert snap.params is reg.params, tenant
+            assert len(snap.names) == n_classes, tenant
+            assert all(s in reg._pool for s in snap.slots), tenant
+    finally:
+        eng.close()

@@ -1,0 +1,471 @@
+#!/usr/bin/env python3
+"""Regression-gated bench trajectory: every committed perf artifact folded
+into ONE timeseries, with per-metric bands a fresh leg must stay inside.
+
+ISSUE 11 tentpole, layer 3. The repo carries five BENCH_r*.json, three
+ROOFLINE_r*.json, five COMMS_r*.json and two SERVE_r*.json — disconnected
+snapshots nobody reads side by side, so a perf regression is invisible
+until someone rereads old JSON by hand. This tool makes the trajectory a
+first-class artifact:
+
+* default            — fold every committed artifact (plus the live
+                       ``TREND_INPUT.jsonl`` rows bench.py appends per
+                       run) into ``TREND.json`` and write it.
+* ``--check``        — regenerate in memory and FAIL (exit 1) when (a)
+                       any committed artifact contributed zero points
+                       (the trajectory silently lost an input), (b) the
+                       committed TREND.json is stale (regeneration
+                       differs — new artifacts MUST re-run this tool),
+                       or (c) the newest point of any banded series sits
+                       outside the band its predecessors establish.
+                       Runs in tier-1 (tests/test_bench_trend.py), so
+                       the trajectory can never be empty or silently
+                       regress again.
+* ``--candidate F``  — additionally validate a fresh bench summary (the
+                       one-line JSON bench.py prints, or a file holding
+                       it) against the committed bands WITHOUT requiring
+                       it to be committed first — the pre-commit gate
+                       for a new bench leg.
+
+Series keying — like-for-like only: throughput series are keyed by the
+FULL bench metric string (the ``[5w5s,bilstm,...,vocab400002,B64,spc512,
+embed_lazy,hardsync]`` bracket), the same discipline as bench.py's
+per-config baseline dict: r02's full-vocab dense-Adam number must never
+sit in one band with r01's small-vocab number. Bands therefore only bind
+within a series holding >= 2 points of the SAME configuration.
+
+Band rules (direction-aware, tolerances stated in BANDS):
+
+* ``higher`` — newest >= (1 - tol) * best(previous). Throughput/MFU;
+  tol 0.35 covers the documented ±30% tunnel weather (BASELINE.md).
+* ``lower``  — newest <= (1 + tol) * best(previous) (best = min).
+  Byte diets; tol matches the tier-1 roofline gate's +2%.
+* ``floor``  — newest >= tol (an absolute floor; the scheduler-A/B qps
+  ratio must stay >= 1.0 — ratios are the stable signal, absolute qps
+  swings ~2x with sandbox neighbor load, BASELINE round 9).
+* ``zero``   — newest must be 0 (unattributed collective bytes on the
+  flagship leg; steady recompiles).
+
+Usage:
+    python tools/bench_trend.py [--root DIR] [--check] [--candidate F]
+        [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+
+TREND_NAME = "TREND.json"
+LIVE_NAME = "TREND_INPUT.jsonl"
+
+# series -> (rule, tolerance). Series not listed are recorded in the
+# trajectory but never gated (e.g. absolute serving qps: honest numbers,
+# documented-unstable on this sandbox).
+BANDS: dict[str, tuple[str, float]] = {
+    # Per-config throughput/MFU (keyed by the full metric bracket at
+    # build time — see _bench_points): the two entries below are PREFIX
+    # rules applied to every config-keyed series of that family.
+    "bench.eps_per_s[": ("higher", 0.35),
+    "bench.mfu[": ("higher", 0.35),
+    "bench.step_ms[": ("lower", 0.55),   # 1/eps at the band's tolerance
+    # Analytic byte diets: monotone by construction; the +2% matches
+    # tests/test_roofline.py's artifact gate.
+    "roofline.step_bytes": ("lower", 0.02),
+    "roofline.step_bytes_no_remat": ("lower", 0.02),
+    # floor_ms_nominal_v5e is recorded but NOT banded: remat designs
+    # legitimately trade recompute FLOPs for bytes (the round-8
+    # windowed-cs kernel RAISED the compute floor 1.349 -> 1.599 ms while
+    # cutting step bytes 21% — an accepted tradeoff this tool's first run
+    # flagged). step_bytes is the gated diet headline.
+    # Comms: the flagship leg is the headline; unattributed bytes on it
+    # must stay zero (the round-7 ledger discipline).
+    "comms.flagship_payload_bytes": ("lower", 0.15),
+    "comms.flagship_unattributed_bytes": ("zero", 0.0),
+    "comms.dp8_lazy_payload_bytes": ("lower", 0.15),
+    # Serving: the scheduler-A/B ratio plus the hot-swap drill's zero-
+    # drop invariant (absolute qps/p99 recorded, not gated).
+    "serve.closed_qps_ratio": ("floor", 1.0),
+    "serve.drill_dropped.continuous": ("zero", 0.0),
+    "serve.drill_dropped.microbatch": ("zero", 0.0),
+    "serve.drill_rejected.continuous": ("zero", 0.0),
+    "serve.drill_rejected.microbatch": ("zero", 0.0),
+}
+
+
+def _band_rule(series: str) -> tuple[str, float] | None:
+    if series in BANDS:
+        return BANDS[series]
+    for prefix, rule in BANDS.items():
+        if prefix.endswith("[") and series.startswith(prefix):
+            return rule
+    return None
+
+
+# --- extraction -----------------------------------------------------------
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def _round_of(path: str) -> int | None:
+    m = _ROUND_RE.search(path)
+    return int(m.group(1)) if m else None
+
+
+def _point(points: dict, series: str, rnd, source: str, value) -> None:
+    if value is None or not isinstance(value, (int, float)):
+        return
+    points.setdefault(series, []).append({
+        "round": rnd, "source": source, "value": value,
+    })
+
+
+_B_RE = re.compile(r"[\[,]B(\d+)[,\]]")
+
+
+def _bench_points(points: dict, path: str, data: dict) -> int:
+    """BENCH_r*.json: the driver wrapper carries the bench.py summary in
+    ``parsed``. Throughput series key = the full metric bracket (per-
+    config, like-for-like); byte/comms stamps are config-independent
+    projections and key flat. Returns points contributed."""
+    parsed = data.get("parsed") or {}
+    return _bench_summary_points(
+        points, _round_of(path), os.path.basename(path), parsed
+    )
+
+
+def _bench_summary_points(points: dict, rnd, source: str, parsed: dict) -> int:
+    before = sum(len(v) for v in points.values())
+    metric = str(parsed.get("metric", ""))
+    bracket = metric[metric.find("["):] if "[" in metric else "[unkeyed]"
+    _point(points, f"bench.eps_per_s{bracket}", rnd, source,
+           parsed.get("value"))
+    _point(points, f"bench.mfu{bracket}", rnd, source, parsed.get("mfu"))
+    mb = _B_RE.search(bracket)
+    if mb and isinstance(parsed.get("value"), (int, float)) \
+            and parsed["value"] > 0:
+        # Derived step time at this config's episode batch: B / eps * 1e3.
+        _point(points, f"bench.step_ms{bracket}", rnd, source,
+               round(int(mb.group(1)) / parsed["value"] * 1e3, 4))
+    for key in ("step_bytes", "step_bytes_windowed", "lstm_residual_bytes",
+                "comms_bytes_per_step", "comms_wire_bytes_per_step"):
+        _point(points, f"bench.{key}", rnd, source, parsed.get(key))
+    serving = parsed.get("serving") or {}
+    _point(points, "bench.serving_continuous_over_microbatch", rnd, source,
+           serving.get("continuous_over_microbatch"))
+    scen = parsed.get("scenarios") or {}
+    for key in ("in_domain_accuracy", "da_mixture_accuracy", "nota_best_f1"):
+        _point(points, f"bench.{key}", rnd, source, scen.get(key))
+    return sum(len(v) for v in points.values()) - before
+
+
+def _roofline_points(points: dict, path: str, data: dict) -> int:
+    rnd, src = _round_of(path), os.path.basename(path)
+    before = sum(len(v) for v in points.values())
+    for key in ("step_bytes", "step_bytes_no_remat", "step_bytes_full_cs",
+                "lstm_residual_bytes", "floor_ms_nominal_v5e"):
+        _point(points, f"roofline.{key}", rnd, src, data.get(key))
+    return sum(len(v) for v in points.values()) - before
+
+
+def _comms_points(points: dict, path: str, data: dict) -> int:
+    rnd, src = _round_of(path), os.path.basename(path)
+    before = sum(len(v) for v in points.values())
+    flag = data.get("dp8_tokencache_lazy_flagship") or {}
+    _point(points, "comms.flagship_payload_bytes", rnd, src,
+           flag.get("total_bytes_per_step_per_device"))
+    _point(points, "comms.flagship_unattributed_bytes", rnd, src,
+           flag.get("unattributed_bytes"))
+    lazy = data.get("dp8_tokencache_lazy") or {}
+    _point(points, "comms.dp8_lazy_payload_bytes", rnd, src,
+           lazy.get("total_bytes_per_step_per_device"))
+    return sum(len(v) for v in points.values()) - before
+
+
+def _serve_points(points: dict, path: str, data: dict) -> int:
+    rnd, src = _round_of(path), os.path.basename(path)
+    before = sum(len(v) for v in points.values())
+    comp = data.get("comparison") or {}
+    _point(points, "serve.closed_qps_ratio", rnd, src,
+           comp.get("closed_qps_ratio"))
+    for arm in ("continuous", "microbatch"):
+        a = (data.get("arms") or {}).get(arm) or {}
+        _point(points, f"serve.closed_qps.{arm}", rnd, src,
+               (a.get("closed") or {}).get("qps"))
+        _point(points, f"serve.open_p99_ms.{arm}", rnd, src,
+               (a.get("open") or {}).get("p99_ms"))
+        drill = a.get("swap_drill") or {}
+        for k in ("dropped", "rejected"):
+            _point(points, f"serve.drill_{k}.{arm}", rnd, src,
+                   drill.get(k))
+    return sum(len(v) for v in points.values()) - before
+
+
+_EXTRACTORS = (
+    ("BENCH_r*.json", _bench_points),
+    ("ROOFLINE_r*.json", _roofline_points),
+    ("COMMS_r*.json", _comms_points),
+    ("SERVE_r*.json", _serve_points),
+)
+
+
+def build_trend(root: Path) -> tuple[dict, list[str]]:
+    """(trend dict, problems). A committed artifact contributing zero
+    points is a problem — the trajectory must never silently lose an
+    input. Output is DETERMINISTIC in the inputs (no timestamps), so
+    --check can demand committed-TREND == regenerated-TREND byte
+    equality."""
+    points: dict[str, list[dict]] = {}
+    inputs: list[str] = []
+    problems: list[str] = []
+    for pattern, extract in _EXTRACTORS:
+        for path in sorted(glob.glob(str(root / pattern))):
+            name = os.path.basename(path)
+            inputs.append(name)
+            try:
+                data = json.loads(Path(path).read_text())
+            except (json.JSONDecodeError, OSError) as e:
+                problems.append(f"{name}: unreadable ({e})")
+                continue
+            if not isinstance(data, dict):
+                problems.append(f"{name}: not a JSON object")
+                continue
+            if extract(points, path, data) == 0:
+                problems.append(
+                    f"{name}: contributed ZERO trajectory points — "
+                    f"extractor out of date with the artifact schema"
+                )
+    live_path = root / LIVE_NAME
+    live_rows = 0
+    if live_path.exists():
+        for lineno, line in enumerate(live_path.read_text().splitlines(), 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                problems.append(f"{LIVE_NAME}:{lineno}: not JSON")
+                continue
+            if not isinstance(row, dict):
+                problems.append(f"{LIVE_NAME}:{lineno}: not a JSON object")
+                continue
+            live_rows += 1
+            _bench_summary_points(
+                points, None, f"{LIVE_NAME}:{lineno}", row
+            )
+    series = {}
+    for name in sorted(points):
+        pts = points[name]
+        entry: dict = {"points": pts}
+        rule = _band_rule(name)
+        if rule is not None:
+            entry["band"] = {"rule": rule[0], "tol": rule[1]}
+        series[name] = entry
+    trend = {
+        "series": series,
+        "inputs": inputs,
+        "live_rows": live_rows,
+    }
+    return trend, problems
+
+
+def _strip_live(trend: dict) -> dict:
+    """The trend with TREND_INPUT.jsonl-derived points removed — the
+    ARTIFACT-ONLY view the staleness gate compares. Live rows are
+    machine-local by nature (every bench run appends one): holding the
+    committed TREND.json to byte-equality INCLUDING them would fail
+    tier-1 on any checkout that ever ran bench.py locally, and
+    committing a live-row-bearing TREND.json would fail every CLEAN
+    checkout in the other direction. The BAND gate uses the same view
+    (two local runs under different sandbox weather must not fail
+    tier-1 on one machine); live points are still folded into the
+    WRITTEN TREND.json for visibility, and gating a fresh run is the
+    --candidate path."""
+    series = {}
+    for name, entry in trend["series"].items():
+        pts = [
+            p for p in entry["points"]
+            if not str(p["source"]).startswith(LIVE_NAME)
+        ]
+        if pts:
+            series[name] = {**entry, "points": pts}
+    return {"series": series, "inputs": trend["inputs"]}
+
+
+# --- band checking --------------------------------------------------------
+
+def check_band(name: str, values: list[float], rule: str, tol: float,
+               candidate: float | None = None) -> str | None:
+    """Validate the newest value (or an explicit ``candidate``) against
+    the band its predecessors establish. Returns an error string or
+    None. Series with < 2 effective points (or < 1 prior for a
+    candidate) bind nothing."""
+    if candidate is not None:
+        prior, newest = values, candidate
+    else:
+        prior, newest = values[:-1], values[-1] if values else None
+    if newest is None:
+        return None
+    if rule == "zero":
+        return (None if newest == 0 else
+                f"{name}: {newest} must be 0 (zero-band)")
+    if rule == "floor":
+        return (None if newest >= tol else
+                f"{name}: {newest} below floor {tol}")
+    if not prior:
+        return None
+    if rule == "higher":
+        bar = max(prior) * (1.0 - tol)
+        if newest < bar:
+            return (f"{name}: {newest} out of band — below "
+                    f"{bar:.4g} ((1-{tol}) x best {max(prior):.4g})")
+        return None
+    if rule == "lower":
+        bar = min(prior) * (1.0 + tol)
+        if newest > bar:
+            return (f"{name}: {newest} out of band — above "
+                    f"{bar:.4g} ((1+{tol}) x best {min(prior):.4g})")
+        return None
+    return f"{name}: unknown band rule {rule!r}"
+
+
+def run_check(
+    root: Path, candidate_path: str | None = None
+) -> tuple[list[str], dict]:
+    """(--check failures as strings (empty = green), the built trend —
+    returned so main() can print counts without rebuilding)."""
+    trend, problems = build_trend(root)
+    errors = list(problems)
+    if not trend["series"]:
+        errors.append("trajectory is EMPTY: no artifacts matched")
+    committed = root / TREND_NAME
+    if not committed.exists():
+        errors.append(f"{TREND_NAME} not committed — run bench_trend.py")
+    else:
+        try:
+            on_disk = json.loads(committed.read_text())
+        except json.JSONDecodeError as e:
+            on_disk = None
+            errors.append(f"{TREND_NAME} unreadable: {e.msg}")
+        try:
+            stale = on_disk is not None and (
+                _strip_live(on_disk) != _strip_live(trend)
+            )
+        except (KeyError, TypeError, AttributeError):
+            stale = True    # hand-edited/malformed committed trend
+        if stale:
+            # Artifact-only comparison: uncommitted local bench runs
+            # (live rows in TREND_INPUT.jsonl) must not fail the gate —
+            # see _strip_live. New/changed *_r*.json artifacts DO.
+            errors.append(
+                f"{TREND_NAME} is STALE: regeneration differs (new or "
+                f"changed artifacts) — re-run tools/bench_trend.py and "
+                f"commit the result"
+            )
+    # Bands gate over COMMITTED artifacts only: live TREND_INPUT.jsonl
+    # rows are per-run and machine-local — two local bench runs under
+    # different sandbox weather must not fail tier-1 on that machine
+    # while CI stays green. Gating a fresh run is the --candidate path.
+    for name, entry in _strip_live(trend)["series"].items():
+        band = entry.get("band")
+        if band is None:
+            continue
+        values = [p["value"] for p in entry["points"]]
+        err = check_band(name, values, band["rule"], band["tol"])
+        if err:
+            errors.append(err)
+    if candidate_path is not None:
+        errors.extend(_check_candidate(trend, candidate_path))
+    return errors, trend
+
+
+def _check_candidate(trend: dict, candidate_path: str) -> list[str]:
+    """Validate a fresh bench summary (bench.py's stdout JSON object, or
+    a driver wrapper carrying it in ``parsed``) against committed bands."""
+    try:
+        data = json.loads(Path(candidate_path).read_text())
+    except (json.JSONDecodeError, OSError) as e:
+        return [f"candidate {candidate_path}: unreadable ({e})"]
+    if not isinstance(data, dict):
+        return [f"candidate {candidate_path}: not a JSON object"]
+    parsed = data.get("parsed", data)
+    if not isinstance(parsed, dict):
+        return [f"candidate {candidate_path}: 'parsed' is not an object"]
+    cand_points: dict[str, list[dict]] = {}
+    n = _bench_summary_points(cand_points, None, candidate_path, parsed)
+    if n == 0:
+        return [f"candidate {candidate_path}: no recognizable bench fields"]
+    errors = []
+    # Bands from COMMITTED artifacts only (same _strip_live view as the
+    # tier-1 gate): a lucky machine-local live row must not ratchet the
+    # bar a later run on the same machine is judged against.
+    artifact_series = _strip_live(trend)["series"]
+    for name, pts in cand_points.items():
+        rule = _band_rule(name)
+        if rule is None:
+            continue
+        committed = artifact_series.get(name)
+        prior = [p["value"] for p in committed["points"]] if committed else []
+        for p in pts:
+            err = check_band(name, prior, rule[0], rule[1],
+                             candidate=p["value"])
+            if err:
+                errors.append(f"candidate: {err}")
+    return errors
+
+
+# --- cli ------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fold committed perf artifacts into TREND.json and "
+                    "gate fresh legs against per-metric bands"
+    )
+    ap.add_argument("--root", default=str(_REPO),
+                    help="repo root holding the *_r*.json artifacts")
+    ap.add_argument("--check", action="store_true",
+                    help="validate only (coverage + staleness + bands); "
+                         "exit 1 on any failure; writes nothing")
+    ap.add_argument("--candidate",
+                    help="a fresh bench summary JSON to validate against "
+                         "the committed bands (with --check)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the trend as JSON to stdout")
+    args = ap.parse_args(argv)
+    root = Path(args.root)
+
+    if args.check or args.candidate:
+        errors, trend = run_check(root, args.candidate)
+        for e in errors:
+            print(f"trend check: {e}", file=sys.stderr)
+        n_pts = sum(
+            len(s["points"]) for s in trend["series"].values()
+        )
+        print(f"{'FAIL' if errors else 'OK'}: {len(trend['series'])} "
+              f"series, {n_pts} points, {len(errors)} failures")
+        return 1 if errors else 0
+
+    trend, problems = build_trend(root)
+    for p in problems:
+        print(f"trend: WARNING: {p}", file=sys.stderr)
+    out = root / TREND_NAME
+    out.write_text(json.dumps(trend, indent=1) + "\n")
+    n_pts = sum(len(s["points"]) for s in trend["series"].values())
+    print(f"wrote {out}: {len(trend['series'])} series, {n_pts} points "
+          f"from {len(trend['inputs'])} artifacts + {trend['live_rows']} "
+          f"live rows")
+    if args.as_json:
+        print(json.dumps(trend, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

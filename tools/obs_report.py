@@ -14,6 +14,9 @@ Modes:
                    measured latency), per-tenant SLO burn events, the
                    prediction-quality table + drift state (kind="quality",
                    ISSUE 10), scenario-harness legs (kind="scenario"),
+                   step-time decomposition + compile forensics
+                   (kind="perf"/"compile", ISSUE 11: segment fractions,
+                   tile check, out-of-band causes, compile phases),
                    health events, flight-recorder summary. Always
                    schema-checks first; a malformed stream is a finding,
                    not a crash.
@@ -41,6 +44,11 @@ if str(_REPO) not in sys.path:
     sys.path.insert(0, str(_REPO))
 
 from induction_network_on_fewrel_tpu.utils.metrics import KNOWN_KINDS  # noqa: E402
+# ONE home for the tiled-segment list (obs/perf.py): a segment added
+# there must be summed here, or tiles_ok_frac reports a false violation.
+from induction_network_on_fewrel_tpu.obs.perf import (  # noqa: E402
+    TILE_SEGMENTS as PERF_SEGMENTS,
+)
 
 
 # --- schema check ---------------------------------------------------------
@@ -369,6 +377,110 @@ def roofline_summary(recs: list[dict], run_dir: Path) -> dict | None:
             }
         except Exception as e:  # table is best-effort; headline stands
             out["components_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+
+
+def perf_summary(recs: list[dict]) -> dict | None:
+    """Step-time decomposition section (ISSUE 11, kind="perf"): per-window
+    segments that tile the measured window (obs/perf.py). Headlines: the
+    median segment fractions (where the wall time goes), the tile check
+    (fraction of windows whose segments sum to window_s within 5% — the
+    acceptance bar; by construction it should be 1.0), out-of-band window
+    count and the cause table, and the roofline-floor comparison when the
+    stream carries it."""
+    perf = [
+        r for r in recs
+        if r.get("kind") == "perf"
+        and isinstance(r.get("window_s"), (int, float))
+    ]
+    if not perf:
+        return None
+    out: dict = {"windows": len(perf)}
+
+    def med(key: str) -> float | None:
+        xs = [
+            float(r[key]) for r in perf
+            if isinstance(r.get(key), (int, float))
+        ]
+        return round(_percentile(xs, 50), 4) if xs else None
+
+    out["step_ms_p50"] = med("step_ms")
+    total_ms = sum(float(r["window_s"]) for r in perf) * 1e3
+    if total_ms > 0:
+        for seg in PERF_SEGMENTS:
+            seg_ms = sum(float(r.get(f"{seg}_ms", 0.0)) for r in perf)
+            out[f"{seg}_frac"] = round(seg_ms / total_ms, 4)
+    tiles_ok = sum(
+        1 for r in perf
+        if abs(
+            sum(float(r.get(f"{s}_ms", 0.0)) for s in PERF_SEGMENTS)
+            - float(r["window_s"]) * 1e3
+        ) <= 0.05 * float(r["window_s"]) * 1e3
+    )
+    out["tiles_ok_frac"] = round(tiles_ok / len(perf), 4)
+    compiles = sum(float(r.get("compiles", 0.0)) for r in perf)
+    if compiles:
+        out["window_compiles"] = int(compiles)
+        out["compile_ms_total"] = round(
+            sum(float(r.get("compile_ms", 0.0)) for r in perf), 3
+        )
+    gc_ms = sum(float(r.get("gc_ms", 0.0)) for r in perf)
+    if gc_ms:
+        out["gc_ms_total"] = round(gc_ms, 3)
+    oob = [r for r in perf if r.get("oob")]
+    out["oob_windows"] = len(oob)
+    if oob:
+        by_cause: dict[str, int] = {}
+        for r in oob:
+            c = str(r.get("cause"))
+            by_cause[c] = by_cause.get(c, 0) + 1
+        out["causes"] = by_cause
+    floor = med("floor_ms")
+    if floor is not None:
+        out["floor_ms"] = floor
+        out["device_over_floor_p50"] = med("device_over_floor")
+    return out
+
+
+def compile_summary(recs: list[dict]) -> dict | None:
+    """Compile-forensics section (ISSUE 11, kind="compile"): one record
+    per observed XLA compile (obs/compile.py). Headlines: counts by
+    phase (warmup / recompile / dup), total compile seconds, the
+    steady-state verdict (any post-arm gated recompile is the invariant
+    breach — surfaced via the recompile_burst health event), and the
+    slowest compiles with their triggers."""
+    comps = [r for r in recs if r.get("kind") == "compile"]
+    if not comps:
+        return None
+    by_phase: dict[str, int] = {}
+    for c in comps:
+        p = str(c.get("phase"))
+        by_phase[p] = by_phase.get(p, 0) + 1
+    out: dict = {"records": len(comps), "by_phase": by_phase}
+    elapsed = [
+        float(c["elapsed_ms"]) for c in comps
+        if isinstance(c.get("elapsed_ms"), (int, float))
+    ]
+    if elapsed:
+        out["compile_ms_total"] = round(sum(elapsed), 3)
+    bursts = [
+        r for r in recs
+        if r.get("kind") == "health" and r.get("event") == "recompile_burst"
+    ]
+    out["recompile_bursts"] = len(bursts)
+    slow = sorted(
+        (c for c in comps if isinstance(c.get("elapsed_ms"), (int, float))),
+        key=lambda c: -float(c["elapsed_ms"]),
+    )[:3]
+    if slow:
+        out["slowest"] = [
+            f"{c.get('fn')} {float(c['elapsed_ms']):.1f}ms "
+            f"step={c.get('step')} trigger={c.get('trigger')} "
+            f"phase={c.get('phase')}"
+            for c in slow
+        ]
     return out
 
 
@@ -706,8 +818,8 @@ def render(report: dict) -> str:
     lines.append(f"schema: {n} records, {len(errors)} errors")
     for e in errors[:10]:
         lines.append(f"  ! {e}")
-    for section in ("train", "mfu", "eval", "serve", "traces", "slo",
-                    "quality", "scenarios",
+    for section in ("train", "mfu", "eval", "perf", "compile", "serve",
+                    "traces", "slo", "quality", "scenarios",
                     "ckpt", "input_pipeline", "comms", "roofline", "health",
                     "flight_recorder", "overhead"):
         body = report.get(section)
@@ -771,6 +883,8 @@ def main(argv=None) -> int:
         "train": train,
         "mfu": mfu_summary(run_dir, train),
         "eval": eval_summary(recs),
+        "perf": perf_summary(recs),
+        "compile": compile_summary(recs),
         "serve": serve_summary(recs),
         "traces": trace_summary(recs),
         "slo": slo_summary(recs),

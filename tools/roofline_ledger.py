@@ -36,8 +36,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-NOMINAL_BW = 819e9      # v5e HBM GB/s (public spec)
-NOMINAL_MXU = 197e12    # v5e bf16 TFLOP/s (public spec)
+# One home (ISSUE 11): the nominal v5e constants moved next to the
+# component formulas so the online perf observer projects the same floor.
+from induction_network_on_fewrel_tpu.utils.roofline import (  # noqa: E402
+    NOMINAL_V5E_BW as NOMINAL_BW,
+    NOMINAL_V5E_MXU as NOMINAL_MXU,
+)
 
 
 def calibrate(jax):
@@ -171,17 +175,20 @@ def main() -> int:
     # is computed from THESE rows directly, not looked up in the ladder:
     # cross combinations (--remat off with a window, say) are not ladder
     # rungs and a rung lookup would stamp an inconsistent artifact.
+    from induction_network_on_fewrel_tpu.utils.roofline import (
+        projected_floor_ms,
+    )
+
     rows = ledger(cfg)
-    floor = sum(max(b / bw, f / mxu) * 1e3 for _, b, f in rows)
+    floor = projected_floor_ms(cfg, bw=bw, mxu=mxu)
     t5, t6, t8 = (totals[t] for t, _ in policies)
     print(f"\nbyte diet: {t5 / 1e6:.1f} -> {t6 / 1e6:.1f} -> {t8 / 1e6:.1f} "
           f"MB/step (round-5 -> attn remat -> + windowed-cs; "
           f"{t8 / t6:.1%} of round-6)")
 
-    # Production-silicon projection at nominal BW/MXU.
-    floor_prod = sum(
-        max(b / NOMINAL_BW, f / NOMINAL_MXU) * 1e3 for _, b, f in rows
-    )
+    # Production-silicon projection at nominal BW/MXU — the SAME helper
+    # the online perf observer stamps into kind="perf" (one spelling).
+    floor_prod = projected_floor_ms(cfg)
     eps_prod = cfg.batch_size / (floor_prod / 1e3)
     print(f"projected floor on nominal v5e (819 GB/s, 197 TF/s): "
           f"{floor_prod:.3f} ms/step -> {eps_prod:,.0f} eps/s/chip ceiling")
