@@ -502,7 +502,7 @@ def _serving_leg(jax, seconds: float = 1.5, tenants: int = 2,
         try:
             pools = _pools(register_tenants(engine, gen_args), cfg.k)
             engine.warmup()
-            by_tenant, _errs, wall = run_closed(
+            by_tenant, _errs, wall, _retries = run_closed(
                 engine, pools, concurrency, seconds,
                 np.random.default_rng(0),
             )

@@ -275,6 +275,17 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
                  "(comma-separable) — exercises the watchdog's feed_stall/"
                  "feed_poisoned detectors (RUNBOOK §10)",
         )
+        p.add_argument(
+            "--chaos", default="",
+            help="unified chaos-injection plan (obs/chaos.py, RUNBOOK "
+                 "§17): comma-separated POINT@AT[*COUNT][:ARG] "
+                 "directives over the named fault points — e.g. "
+                 "'ckpt.bitflip@1:ring_delta' corrupts the 2nd delta "
+                 "ring save. Deterministic; every fired fault emits a "
+                 "kind='fault' record; the containment layer "
+                 "(quarantine + ring-walk fallback) is what a drill "
+                 "asserts on. '' = off (zero-cost)",
+        )
     # device / parallelism
     p.add_argument("--device", default="tpu", choices=["tpu", "cpu"])
     p.add_argument(
@@ -460,6 +471,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         prefetch_depth=getattr(args, "prefetch_depth", 2),
         mixture=getattr(args, "mixture", ""),
         feed_fault=getattr(args, "feed_fault", ""),
+        chaos=getattr(args, "chaos", ""),
         adv=getattr(args, "adv", None) is not None,
         adv_lambda=getattr(args, "adv_lambda", 1.0),
         adv_dis_hidden=getattr(args, "adv_dis_hidden", 256),
@@ -1263,6 +1275,16 @@ def make_trainer(args, cfg: ExperimentConfig, only_test: bool = False):
     logger = MetricsLogger(
         run_dir, tensorboard_dir=getattr(args, "tensorboard", None)
     )
+    if cfg.chaos:
+        # Unified chaos injection (ISSUE 12, obs/chaos.py): one plan
+        # drives every layer's named fault points; fired faults emit
+        # kind="fault" records through this run's logger.
+        from induction_network_on_fewrel_tpu.obs.chaos import ChaosRegistry
+
+        reg = ChaosRegistry.parse(cfg.chaos, logger=logger)
+        if reg is not None:
+            reg.install()
+            print(f"chaos plan armed: {cfg.chaos}", file=sys.stderr)
     perf_obs = compile_watcher = None
     if cfg.perf:
         # Performance-attribution observability (ISSUE 11): the perf
@@ -1471,7 +1493,10 @@ def _run_train(args, trainer) -> int:
         src = args.load_ckpt or args.save_ckpt
         mngr = None
         try:
-            mngr = CheckpointManager(src, cfg)
+            # logger threaded: an integrity quarantine during the resume
+            # restore (corrupt slot -> ring-walk fallback) must land in
+            # the telemetry stream, not happen silently.
+            mngr = CheckpointManager(src, cfg, logger=trainer.logger)
             state, start_step = (
                 mngr.restore_latest(state) if args.resume else mngr.restore_best(state)
             )

@@ -290,6 +290,13 @@ class ExperimentConfig:
     # "stall:INDEX", "poison:INDEX", comma-separable. Debug-only drills
     # for the obs watchdog's feed_stall/feed_poisoned detectors. "" = off.
     feed_fault: str = ""
+    # Unified chaos-injection plan (obs/chaos.py, ISSUE 12): comma-
+    # separated POINT@AT[*COUNT][:ARG] directives over named fault points
+    # (ckpt.bitflip / ckpt.truncate / ckpt.restore_raise /
+    # publish.nan_params / publish.distill_raise / serve.execute_raise).
+    # Deterministic, drill-only; every fired fault emits a kind="fault"
+    # record. "" = off (zero-cost: one global check per fault point).
+    chaos: str = ""
 
     @property
     def total_q(self) -> int:

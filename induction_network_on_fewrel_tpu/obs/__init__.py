@@ -21,6 +21,10 @@ One shared model for what used to be three fragmented mechanisms:
                  with fn / shape signature / elapsed / trigger, with the
                  training twin of serving's zero-steady-state-recompile
                  gate.
+* ``chaos``    — unified chaos-injection registry (ISSUE 12): named
+                 fault points across layers (checkpoint corruption,
+                 publish poisoning, serving execute failures) driven by
+                 one deterministic ``--chaos`` spec; off = zero-cost.
 * ``recorder`` — flight recorder; dumps the last-N window on crash,
                  SIGTERM, or a watchdog trip.
 * ``export``   — counter/gauge/histogram registry + Prometheus text
@@ -32,6 +36,13 @@ flight_recorder.json) into a single run report — per-request trace
 waterfalls included — and schema-checks it.
 """
 
+from induction_network_on_fewrel_tpu.obs.chaos import (
+    ChaosError,
+    ChaosRegistry,
+    chaos_active,
+    chaos_fire,
+    corrupt_step_dir,
+)
 from induction_network_on_fewrel_tpu.obs.compile import (
     CompileWatcher,
     bind_health,
@@ -63,6 +74,11 @@ from induction_network_on_fewrel_tpu.obs.spans import (
 )
 
 __all__ = [
+    "ChaosError",
+    "ChaosRegistry",
+    "chaos_active",
+    "chaos_fire",
+    "corrupt_step_dir",
     "CompileWatcher",
     "CounterRegistry",
     "DiagnosticsCapture",

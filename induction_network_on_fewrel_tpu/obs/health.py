@@ -86,14 +86,21 @@ class HealthWatchdog:
         entropy_floor: float = 0.05,
         queue_stall_s: float = 5.0,
         on_event: Callable[[HealthEvent], None] | None = None,
+        capture: "DiagnosticsCapture | None" = None,
     ):
         """``throughput_drop``: trip when eps/s < drop * rolling median.
         ``throughput_warmup``: train records to observe before the baseline
         arms (the first windows include compile time and are not a
         baseline). ``logger``/``recorder`` are attached lazily so the
-        watchdog can be constructed before either exists."""
+        watchdog can be constructed before either exists. ``capture``: a
+        DiagnosticsCapture; when set, criticals capture through it (which
+        includes the flight dump) instead of a bare recorder dump — the
+        ISSUE 12 fault criticals (ckpt_corrupt / breaker_open /
+        publish_rollback) get the same evidence discipline as SLO burns
+        and drift."""
         self.logger = logger
         self.recorder = recorder
+        self.capture = capture
         self.throughput_drop = throughput_drop
         self.throughput_warmup = throughput_warmup
         self.entropy_floor = entropy_floor
@@ -147,8 +154,18 @@ class HealthWatchdog:
                 )
             finally:
                 self._in_emit = False
-        if ev.severity == CRITICAL and self.recorder is not None:
-            self.recorder.dump(reason=f"watchdog: {ev.event} ({ev.message})")
+        if ev.severity == CRITICAL:
+            # DiagnosticsCapture (when wired) already dumps the recorder
+            # as its first artifact — capturing AND dumping would write
+            # the flight window twice per incident.
+            if self.capture is not None:
+                self.capture.capture(
+                    reason=f"watchdog: {ev.event} ({ev.message})"
+                )
+            elif self.recorder is not None:
+                self.recorder.dump(
+                    reason=f"watchdog: {ev.event} ({ev.message})"
+                )
         if self.on_event is not None:
             self.on_event(ev)
 
@@ -167,6 +184,11 @@ class HealthWatchdog:
                     self._check_finite(int(rec.get("step", 0)), rec)
                 return
             step = int(rec.get("step", 0))
+            if kind == "fault":
+                # Fault-domain stream (ISSUE 12): containment actions
+                # become once-latched criticals; injections are context.
+                self._check_fault(step, rec)
+                return
             if kind in ("train", "val", "eval", "test", "serve",
                         "quality", "scenario", "perf", "compile"):
                 # quality/scenario carry model-score statistics — a NaN
@@ -182,6 +204,10 @@ class HealthWatchdog:
                 self._check_throughput(step, float(rec["episodes_per_s"]))
             if kind == "serve":
                 if rec.get("event") == "snapshot_swap":
+                    # A publish that COMMITTED re-arms the rollback
+                    # latch: the next failed publish is a new incident,
+                    # not a suppressed repeat of the last one.
+                    self._latched.discard("publish_rollback")
                     # Visibility, not a failure: every hot-swap publish
                     # lands in the health stream next to whatever it
                     # perturbed.
@@ -313,6 +339,84 @@ class HealthWatchdog:
             ))
         else:
             self._latched.discard("shed_load")
+
+    def _check_fault(self, step: int, rec: dict) -> None:
+        """Fault-domain criticals (ISSUE 12), each once-latched with an
+        explicit re-arm:
+
+        * ``ckpt_corrupt``     — a checkpoint slot quarantined. Latched
+          per SLOT (kind+step): one incident per corrupt slot, however
+          many roots/retries report it; a different slot is a new
+          incident by key.
+        * ``breaker_open``     — a tenant's circuit breaker opened.
+          Latched per tenant; the breaker's own ``to="closed"``
+          transition re-arms.
+        * ``publish_rollback`` — a publish transaction rolled back.
+          Single latch; a later COMMITTED publish (snapshot_swap serve
+          event) re-arms.
+
+        Injected faults (action="inject") are context, not failures —
+        the containment they provoke is what must (and does) trip.
+        """
+        action = rec.get("action")
+        if action == "ckpt_quarantine":
+            latch = (
+                f"ckpt_corrupt:{rec.get('ckpt_kind')}:{rec.get('ckpt_step')}"
+            )
+            if latch in self._latched:
+                return
+            self._latched.add(latch)
+            self._emit(HealthEvent(
+                event="ckpt_corrupt", severity=CRITICAL, step=step,
+                message=(
+                    f"checkpoint slot {rec.get('ckpt_kind')}/"
+                    f"{int(rec.get('ckpt_step', 0))} failed integrity "
+                    f"verification and was quarantined "
+                    f"({rec.get('reason')})"
+                ),
+                data={
+                    k: rec[k] for k in ("ckpt_kind", "ckpt_step", "reason")
+                    if k in rec
+                },
+            ))
+        elif action == "breaker":
+            tenant = rec.get("tenant")
+            latch = f"breaker_open:{tenant}"
+            if rec.get("to") == "open":
+                if latch in self._latched:
+                    return
+                self._latched.add(latch)
+                self._emit(HealthEvent(
+                    event="breaker_open", severity=CRITICAL, step=step,
+                    message=(
+                        f"circuit breaker OPEN for tenant {tenant!r} "
+                        f"after {int(rec.get('failures', 0))} consecutive "
+                        f"execute failures — shedding before it burns "
+                        f"device time"
+                    ),
+                    data={
+                        k: rec[k] for k in ("tenant", "from", "failures")
+                        if k in rec
+                    },
+                ))
+            elif rec.get("to") == "closed":
+                self._latched.discard(latch)
+        elif action == "publish_rollback":
+            if "publish_rollback" in self._latched:
+                return
+            self._latched.add("publish_rollback")
+            self._emit(HealthEvent(
+                event="publish_rollback", severity=CRITICAL, step=step,
+                message=(
+                    f"publish transaction rolled back — every tenant "
+                    f"stays on its pre-publish snapshot "
+                    f"({rec.get('reason')})"
+                ),
+                data={
+                    k: rec[k] for k in ("reason", "params_version")
+                    if k in rec
+                },
+            ))
 
     def observe_feed(
         self,

@@ -70,6 +70,27 @@ class DeadlineExceeded(TimeoutError):
     """The request's deadline expired before it reached the device."""
 
 
+class ExecuteError(RuntimeError):
+    """A launch failed on the device/host side: the batch's futures fail
+    with THIS (typed, retry-after-bearing) error and nothing else — the
+    worker survives, other tenants' batches are untouched (ISSUE 12
+    fault containment). ``retry_after_s`` tells an adaptive client when
+    resubmitting is worth trying (the breaker's open window when one is
+    armed, else the drain estimate — same convention as ``Saturated``);
+    ``cause`` carries the original exception."""
+
+    def __init__(self, tenant: str, retry_after_s: float,
+                 cause: BaseException | None = None):
+        super().__init__(
+            f"execution failed for tenant {tenant!r} "
+            f"({type(cause).__name__ if cause is not None else 'unknown'}: "
+            f"{cause}); retry after {retry_after_s:.3f}s"
+        )
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+        self.cause = cause
+
+
 @dataclasses.dataclass
 class Request:
     query: dict                 # [L]-leaf tokenized query dict

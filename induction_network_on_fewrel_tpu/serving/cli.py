@@ -117,6 +117,20 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--drift_band", type=float, default=4.0,
                    help="alert band width in standard errors of the "
                         "window mean (CRITICAL at 2x)")
+    p.add_argument("--breaker_threshold", type=int, default=0,
+                   help="per-tenant circuit breaker (serving/breaker.py): "
+                        "open after this many consecutive launch failures "
+                        "and shed that tenant's submits until a half-open "
+                        "probe succeeds (kind='fault' transitions; "
+                        "CRITICAL breaker_open once-latched). 0 = off")
+    p.add_argument("--breaker_open_s", type=float, default=5.0,
+                   help="seconds an open breaker sheds before admitting "
+                        "its half-open probe")
+    p.add_argument("--chaos", default="",
+                   help="chaos-injection plan (obs/chaos.py, RUNBOOK §17): "
+                        "POINT@AT[*COUNT][:ARG] directives, e.g. "
+                        "'serve.execute_raise@0*3:default'. Deterministic "
+                        "drills for the containment layer; '' = off")
     p.add_argument("--slo_profile", action="store_true",
                    help="also attempt a jax.profiler trace in the SLO "
                         "auto-capture (default off on this image — a "
@@ -127,8 +141,21 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _build_breaker(args):
+    if getattr(args, "breaker_threshold", 0) <= 0:
+        return None
+    from induction_network_on_fewrel_tpu.serving.breaker import (
+        CircuitBreaker,
+    )
+
+    return CircuitBreaker(
+        failure_threshold=args.breaker_threshold,
+        open_s=args.breaker_open_s,
+    )
+
+
 def _fresh_engine(args, buckets, logger=None, watchdog=None, slo=None,
-                  drift=None, trace_sample=0.0):
+                  drift=None, breaker=None, trace_sample=0.0):
     """Demo path: synthetic vocab + fresh-init induction weights (no
     checkpoint on disk). The serving machinery is identical; only the
     verdict quality is untrained."""
@@ -164,7 +191,8 @@ def _fresh_engine(args, buckets, logger=None, watchdog=None, slo=None,
         default_deadline_s=args.deadline_ms / 1e3,
         scheduler=args.scheduler, tenant_share=args.tenant_share,
         dp=args.dp, logger=logger, watchdog=watchdog,
-        slo=slo, drift=drift, trace_sample=trace_sample,
+        slo=slo, drift=drift, breaker=breaker,
+        trace_sample=trace_sample,
     )
 
 
@@ -246,6 +274,19 @@ def serve_main(argv=None) -> int:
             band_sigma=args.drift_band,
             logger=logger, recorder=recorder, capture=capture,
         )
+    if watchdog is not None and capture is not None:
+        # Fault criticals (ckpt_corrupt / breaker_open /
+        # publish_rollback) get the same auto-capture evidence as SLO
+        # burns and drift (ISSUE 12).
+        watchdog.capture = capture
+    breaker = _build_breaker(args)
+    if args.chaos:
+        from induction_network_on_fewrel_tpu.obs.chaos import ChaosRegistry
+
+        reg = ChaosRegistry.parse(args.chaos, logger=logger)
+        if reg is not None:
+            reg.install()
+            print(f"chaos plan armed: {args.chaos}", file=sys.stderr)
     if args.load_ckpt:
         engine = InferenceEngine.from_checkpoint(
             args.load_ckpt, device=args.device,
@@ -256,11 +297,13 @@ def serve_main(argv=None) -> int:
             default_deadline_s=args.deadline_ms / 1e3,
             scheduler=args.scheduler, tenant_share=args.tenant_share,
             dp=args.dp, logger=logger, watchdog=watchdog,
-            slo=slo, drift=drift, trace_sample=args.trace_sample,
+            slo=slo, drift=drift, breaker=breaker,
+            trace_sample=args.trace_sample,
         )
     else:
         engine = _fresh_engine(args, buckets, logger=logger,
                                watchdog=watchdog, slo=slo, drift=drift,
+                               breaker=breaker,
                                trace_sample=args.trace_sample)
 
     try:
@@ -327,14 +370,37 @@ def _demo(engine, ds, num_queries: int, seed: int = 0) -> None:
     ]
     if not pool:
         pool = [(rel, ds.instances[rel][0]) for rel in registered]
+    from induction_network_on_fewrel_tpu.serving.batcher import Saturated
+
     futures = []
+    shed = 0
     for i in rng.choice(len(pool), size=min(num_queries, len(pool)),
                         replace=False):
         rel, inst = pool[int(i)]
-        futures.append((rel, engine.submit(inst)))
-    hits = 0
+        try:
+            futures.append((rel, engine.submit(inst)))
+        except Saturated as e:
+            # A well-behaved client under backpressure/breaker shed: the
+            # demo reports it instead of dying on the typed error
+            # (containment drills run through this path — RUNBOOK §17).
+            shed += 1
+            print(json.dumps({"true": rel, "shed": True,
+                              "retry_after_s": e.retry_after_s}),
+                  flush=True)
+    hits = errors = 0
     for true_rel, fut in futures:
-        verdict = fut.result(timeout=30.0)
+        try:
+            verdict = fut.result(timeout=30.0)
+        except Exception as e:  # noqa: BLE001 — typed ExecuteError et al.
+            errors += 1
+            print(json.dumps({"true": true_rel,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+            continue
         hits += verdict["label"] == true_rel
         print(json.dumps({"true": true_rel, **verdict}), flush=True)
-    print(f"demo accuracy: {hits}/{len(futures)}", file=sys.stderr)
+    tail = "".join(
+        [f", {shed} shed" if shed else "",
+         f", {errors} errors" if errors else ""]
+    )
+    print(f"demo accuracy: {hits}/{len(futures)}{tail}", file=sys.stderr)

@@ -81,10 +81,17 @@ from induction_network_on_fewrel_tpu.obs.spans import (
     get_tracker,
     span,
 )
+from induction_network_on_fewrel_tpu.obs.chaos import (
+    ChaosError,
+    chaos_active,
+    chaos_fire,
+)
 from induction_network_on_fewrel_tpu.serving.batcher import (
     ContinuousBatcher,
     DynamicBatcher,
+    ExecuteError,
     Request,
+    Saturated,
 )
 from induction_network_on_fewrel_tpu.serving.buckets import (
     DEFAULT_BUCKETS,
@@ -121,6 +128,7 @@ class InferenceEngine:
         watchdog=None,
         slo=None,
         drift=None,
+        breaker=None,
         trace_sample: float = 0.0,
         start: bool = True,
     ):
@@ -173,6 +181,15 @@ class InferenceEngine:
         self.drift = drift
         if drift is not None and drift.logger is None:
             drift.logger = logger
+        # Per-tenant circuit breaker (ISSUE 12, serving/breaker.py): a
+        # repeatedly failing tenant sheds at submit (zero device time)
+        # until a half-open probe proves recovery. None (default) costs
+        # one `if` per submit. Transitions emit kind="fault" records —
+        # the watchdog latches CRITICAL breaker_open per tenant,
+        # re-armed by the close transition.
+        self.breaker = breaker
+        if breaker is not None and breaker.on_transition is None:
+            breaker.on_transition = self._on_breaker_transition
 
         self.stats = ServingStats(slo=slo)
         self.stats.bind_registry()
@@ -404,6 +421,19 @@ class InferenceEngine:
         backpressure (with ``.tenant`` set when the breach is this
         tenant's share — shed-load)."""
         self.registry.snapshot(tenant)   # raises for unknown tenants
+        if self.breaker is not None:
+            # Open breaker = shed at the door (ISSUE 12): a repeatedly
+            # failing tenant must not occupy launches other tenants
+            # could use. Deterministic half-open probes pass through.
+            retry = self.breaker.admit(tenant)
+            if retry is not None:
+                self.stats.record_breaker_shed(tenant)
+                if self.slo is not None:
+                    # Same discipline as the finally-tick below: a
+                    # fully-shed tenant is exactly the one whose SLO
+                    # windows must still evaluate.
+                    self.slo.maybe_evaluate()
+                raise Saturated(retry, tenant=tenant)
         trace = self._tracer.maybe_trace()   # None on the unsampled path
         if trace is None:
             t = self.tokenizer(self._as_instance(instance))
@@ -460,7 +490,10 @@ class InferenceEngine:
 
     def _execute_group(self, tenant: str, batch: list[Request]) -> None:
         """Continuous-scheduler callback: one tenant's batch."""
-        self._run_group(tenant, batch)
+        try:
+            self._run_group(tenant, batch)
+        except BaseException as e:  # noqa: BLE001 — contain, never wedge
+            self._contain_execute_failure(tenant, batch, e)
         self._maybe_emit()
 
     def _execute_batch(self, batch: list[Request]) -> None:
@@ -479,10 +512,36 @@ class InferenceEngine:
                 # One tenant's failure (dropped mid-flight, bad matrix)
                 # fails ITS futures only; the other tenants' sub-batches
                 # still execute.
-                for r in group:
-                    if not r.future.done():
-                        r.future.set_exception(e)
+                self._contain_execute_failure(tenant, group, e)
         self._maybe_emit()
+
+    def _contain_execute_failure(
+        self, tenant: str, batch: list[Request], exc: BaseException
+    ) -> None:
+        """Fault containment for one failed launch (ISSUE 12): the
+        batch's futures fail with a TYPED ``ExecuteError`` carrying a
+        retry-after hint — never the raw exception, never a wedged
+        worker, never another tenant's batch — the failure feeds the
+        tenant's circuit breaker, and one kind="fault" record attributes
+        it. Exceptions escaping THIS method would hit the batcher's
+        last-resort catch (worker still survives)."""
+        retry = (
+            self.breaker.open_s if self.breaker is not None
+            else 2.0 * self.stats.exec_estimate_s()
+        )
+        err = ExecuteError(tenant, retry_after_s=retry, cause=exc)
+        for r in batch:
+            if not r.future.done():
+                r.future.set_exception(err)
+        self.stats.record_execute_error(tenant, len(batch))
+        if self.breaker is not None:
+            self.breaker.record_failure(tenant)
+        if self._logger is not None:
+            self._logger.log(
+                self.stats.served, kind="fault", action="execute_error",
+                tenant=tenant, requests=float(len(batch)),
+                cause=f"{type(exc).__name__}: {exc}",
+            )
 
     def _run_group(self, tenant: str, batch: list[Request]) -> None:
         # Pinned snapshot: (params, matrix, names, threshold) captured
@@ -491,6 +550,27 @@ class InferenceEngine:
         # batch must score against the weights its matrix was distilled
         # with (registry.Snapshot doc).
         snap = self.registry.snapshot(tenant)
+        if snap.degraded:
+            # Fleet degraded mode (ISSUE 12): the tenant's snapshot is
+            # quarantined — serve open-set-floor NOTA verdicts flagged
+            # degraded=True instead of scoring against a suspect matrix.
+            # Zero device time; clients get an honest answer, not an
+            # error.
+            self._serve_degraded(tenant, batch, snap)
+            if self.breaker is not None:
+                # A degraded serve ANSWERS its requests — it must count
+                # as a breaker outcome, or a half-open probe routed here
+                # would report nothing and wedge the breaker in
+                # half_open (probes exhausted, no launch ever runs to
+                # close it), shedding the tenant forever.
+                self.breaker.record_success(tenant)
+            return
+        if chaos_active() and chaos_fire(
+            "serve.execute_raise", tenant=tenant, step=self.stats.served
+        ) is not None:
+            raise ChaosError(
+                f"injected execute failure for tenant {tenant!r} (chaos)"
+            )
         bucket = select_bucket(len(batch), self.batcher.buckets)
         # Fan-in: the sampled requests this launch serves. Their trace
         # ids link into the batch spans, and each gets a per-request
@@ -508,6 +588,10 @@ class InferenceEngine:
         t_exec_end = time.monotonic()
         exec_s = t_exec_end - t0
         self.stats.record_batch(len(batch), bucket, exec_s)
+        if self.breaker is not None:
+            # A completed launch: resets the failure streak; in
+            # half-open, the successful probe CLOSES the breaker.
+            self.breaker.record_success(tenant)
         # Two passes on purpose: the verdict BUILD (per-row argmax + an
         # N-class logits dict — the O(batch) host work after execute)
         # happens before ``now`` so the respond segment and latency_ms
@@ -563,6 +647,67 @@ class InferenceEngine:
                     "respond_ms": round(respond_ms, 3),
                     "total_ms": round((now - req.enqueued_at) * 1e3, 3),
                 })
+
+    def _serve_degraded(self, tenant: str, batch: list[Request],
+                        snap) -> None:
+        """Degraded-mode verdicts for a quarantined tenant: every request
+        resolves ``no_relation`` with ``degraded=True`` (the open-set
+        floor's honest "I cannot place this" answer), no device time, no
+        drift/quality observation (degraded traffic says nothing about
+        the model), one kind="fault" record per batch."""
+        now = time.monotonic()
+        for req in batch:
+            verdict = {
+                "label": NO_RELATION,
+                "class_index": -1,
+                "nota": True,
+                "degraded": True,
+                "margin": 0.0,
+                "entropy": 0.0,
+                "tenant": tenant,
+                "snapshot_version": snap.version,
+                "logits": {},
+                "latency_ms": round((now - req.enqueued_at) * 1e3, 3),
+            }
+            if req.trace is not None:
+                verdict["trace_id"] = req.trace.trace_id
+            # nota=None on purpose: degraded verdicts must not skew the
+            # tenant's quality stream or a drift baseline.
+            self.stats.record_done(
+                now - req.enqueued_at, tenant=tenant,
+                trace_id=(
+                    req.trace.trace_id if req.trace is not None else None
+                ),
+            )
+            req.future.set_result(verdict)
+        self.stats.record_degraded(tenant, len(batch))
+        if self._logger is not None:
+            self._logger.log(
+                self.stats.served, kind="fault",
+                action="degraded_verdicts", tenant=tenant,
+                served=float(len(batch)),
+            )
+
+    def _on_breaker_transition(self, tenant, frm, to, failures, now) -> None:
+        """Breaker transitions -> one kind="fault" record each; the
+        watchdog latches CRITICAL ``breaker_open`` on to="open" and
+        re-arms on to="closed"."""
+        if self._logger is not None:
+            self._logger.log(
+                self.stats.served, kind="fault", action="breaker",
+                tenant=tenant, **{"from": frm, "to": to},
+                failures=float(failures),
+            )
+
+    def quarantine_tenant(self, tenant: str, reason: str = "") -> None:
+        """Degrade one tenant (registry.quarantine_tenant): its traffic
+        gets open-set-floor NOTA verdicts flagged degraded=True until
+        unquarantine or the next successful publish."""
+        self.registry.quarantine_tenant(tenant, reason=reason)
+
+    def unquarantine_tenant(self, tenant: str, reason: str = "") -> None:
+        self.registry.unquarantine_tenant(tenant, reason=reason)
+        self._drift_rearm(tenant, f"unquarantine {reason}".strip())
 
     def _emit_trace(self, rec: dict) -> None:
         """One sampled request's segment record: retained for artifact

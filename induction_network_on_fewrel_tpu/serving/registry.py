@@ -63,6 +63,13 @@ from induction_network_on_fewrel_tpu.serving.buckets import QUERY_DTYPES
 DEFAULT_TENANT = "default"
 
 
+class PublishError(RuntimeError):
+    """A publish transaction was refused (validation gate) or failed
+    mid-flight and rolled back: the registry generation is UNCHANGED and
+    every tenant still serves its pre-publish snapshot. The caller's
+    artifact is bad, the fleet is fine."""
+
+
 @dataclasses.dataclass(frozen=True)
 class Snapshot:
     """One tenant's published serving state — immutable, so holding a
@@ -79,6 +86,13 @@ class Snapshot:
     params: Any             # the weights this snapshot scores against
     nota_threshold: float | None = None
     k: int = 5
+    # Degraded mode (ISSUE 12): a quarantined tenant's snapshot. The
+    # data plane serves open-set-floor NOTA verdicts flagged
+    # ``degraded=True`` instead of scoring against a suspect matrix —
+    # zero device time, honest answers. Cleared by unquarantine or by
+    # the next successful publish (a committed generation re-validates
+    # every vector).
+    degraded: bool = False
 
     @property
     def n_classes(self) -> int:
@@ -137,6 +151,11 @@ class TenantRegistry:
         # flowing during a republish's device time (ISSUE 11).
         self._publish_serial = threading.Lock()
         self._jax = jax
+        # Optional pre-swap canary (ISSUE 12): callable(new_params) that
+        # RAISES to veto a publish — callers wire the scenario-harness
+        # miniature quality floor here, so a candidate that passes
+        # finiteness but fails quality still rolls back.
+        self.publish_canary = None
         self.params_version = 0
         self._version = 0                 # monotonic snapshot stamp
         self._tenants: dict[str, Snapshot] = {}
@@ -270,6 +289,35 @@ class TenantRegistry:
                 self._gc_slots_locked()
             return snap
 
+    def quarantine_tenant(
+        self, tenant: str, reason: str = "", _degraded: bool = True,
+    ) -> Snapshot:
+        """Mark the tenant's snapshot DEGRADED (ISSUE 12): its resident
+        vectors are suspect (corrupt source checkpoint, operator call),
+        so the data plane stops scoring against them and serves
+        open-set-floor NOTA verdicts flagged ``degraded=True`` until an
+        unquarantine or the next successful publish. Pure CoW — the
+        matrix is kept (evidence, and unquarantine is free)."""
+        with self._lock:
+            s = self._require_locked(tenant)
+            self._version += 1
+            snap = dataclasses.replace(
+                s, version=self._version, degraded=_degraded
+            )
+            self._tenants[tenant] = snap
+        if self._logger is not None:
+            self._logger.log(
+                snap.version, kind="fault",
+                action=(
+                    "tenant_quarantine" if _degraded else "tenant_restore"
+                ),
+                tenant=tenant, reason=reason or "operator",
+            )
+        return snap
+
+    def unquarantine_tenant(self, tenant: str, reason: str = "") -> Snapshot:
+        return self.quarantine_tenant(tenant, reason=reason, _degraded=False)
+
     def set_nota_threshold(
         self, threshold: float | None, tenant: str = DEFAULT_TENANT
     ) -> Snapshot:
@@ -319,6 +367,15 @@ class TenantRegistry:
                 # The device pass — the whole point: NO lock held here.
                 with span("serve/distill", classes=len(missing)):
                     vecs = np.asarray(self._distill(params, sup))[0]
+                if not np.isfinite(vecs).all():
+                    # A non-finite vector must never become resident:
+                    # it would be interned by digest and shared into
+                    # every future publish (ISSUE 12 validation).
+                    raise ValueError(
+                        "registration refused: distilled class vectors "
+                        "are non-finite (corrupt weights or poisoned "
+                        "supports)"
+                    )
             with self._lock:
                 if self.params_version != pv:
                     continue    # a publish raced: re-distill on new weights
@@ -363,11 +420,88 @@ class TenantRegistry:
         the live set at swap time: slots a concurrent registration added
         mid-distill are re-distilled in another pass before the swap
         commits, so the published transaction covers EVERY slot live at
-        swap time (pinned in tests/test_serving_fleet.py)."""
+        swap time (pinned in tests/test_serving_fleet.py).
+
+        TRANSACTIONAL (ISSUE 12): a pre-swap validation gate (finite
+        params, finite distilled vectors, the optional ``publish_canary``
+        quality floor) plus a build-then-commit swap — every mutation of
+        registry state is staged and applied by plain assignments at the
+        very end, so ANY failure (validation veto, a raising distill, an
+        injected ``publish.nan_params``/``publish.distill_raise`` fault)
+        rolls back to the prior generation: params_version unchanged,
+        every tenant on its old snapshot, in-flight batches untouched.
+        Failures raise ``PublishError`` and emit one kind="fault"
+        record (action="publish_rollback"); the watchdog latches a
+        CRITICAL ``publish_rollback``, re-armed by the next committed
+        publish."""
         with self._publish_serial:
-            return self._publish_params_serialized(new_params)
+            version_before = self.params_version
+            try:
+                from induction_network_on_fewrel_tpu.obs.chaos import (
+                    chaos_fire,
+                )
+
+                if chaos_fire("publish.nan_params",
+                              step=version_before) is not None:
+                    from induction_network_on_fewrel_tpu.datapipe.faults \
+                        import poison_tree
+
+                    new_params = poison_tree(new_params)
+                return self._publish_params_serialized(new_params)
+            except BaseException as e:
+                if self.params_version != version_before:
+                    # The COMMIT happened — the exception came from the
+                    # post-commit telemetry (a raising logger hook, disk
+                    # full on the jsonl write). The publish is LIVE: do
+                    # not log a rollback, do not claim one. Re-raise the
+                    # real error.
+                    raise
+                # Nothing committed (build-then-commit): log the
+                # rollback and surface a typed error. The registry
+                # generation is unchanged.
+                if self._logger is not None:
+                    self._logger.log(
+                        version_before, kind="fault",
+                        action="publish_rollback",
+                        reason=f"{type(e).__name__}: {e}",
+                        params_version=float(version_before),
+                    )
+                if isinstance(e, PublishError):
+                    raise
+                raise PublishError(
+                    f"publish rolled back ({type(e).__name__}: {e}); "
+                    f"registry stays at params_version {version_before}"
+                ) from e
+
+    @staticmethod
+    def _first_nonfinite(tree) -> str | None:
+        """keystr of the first non-finite float leaf, or None."""
+        import jax
+
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            a = np.asarray(leaf)
+            if np.issubdtype(a.dtype, np.floating) and not np.isfinite(
+                a
+            ).all():
+                return jax.tree_util.keystr(path)
+        return None
 
     def _publish_params_serialized(self, new_params) -> int:
+        from induction_network_on_fewrel_tpu.obs.chaos import chaos_fire
+
+        # Pre-swap validation gate, part 1 — BEFORE burning device time
+        # on distills: a NaN'd artifact (bf16 blowup, corrupt restore)
+        # must never reach the shared [N, C] matrix every tenant scores
+        # against.
+        bad = self._first_nonfinite(new_params)
+        if bad is not None:
+            raise PublishError(
+                f"validation gate: non-finite params at {bad}"
+            )
+        if self.publish_canary is not None:
+            # Optional quality floor (scenario-harness miniature): runs
+            # outside every lock; a raise vetoes the publish.
+            self.publish_canary(new_params)
         new_version = self.params_version + 1
         # old slot id -> freshly distilled [C] vector (accumulated across
         # passes; slots never mutate in place, so a vector distilled in
@@ -397,6 +531,15 @@ class TenantRegistry:
                 )
                 groups.setdefault(sig, []).append(s)
             for slots_g in groups.values():
+                if chaos_fire("publish.distill_raise",
+                              step=new_version) is not None:
+                    from induction_network_on_fewrel_tpu.obs.chaos import (
+                        ChaosError,
+                    )
+
+                    raise ChaosError(
+                        "injected publish distill failure (chaos)"
+                    )
                 sup = self._stack_support([rows_of[s] for s in slots_g])
                 with span("serve/distill", classes=len(slots_g)):
                     vecs = np.asarray(self._distill(new_params, sup))[0]
@@ -405,9 +548,12 @@ class TenantRegistry:
             # Loop: a registration may have added live slots mid-distill;
             # the next pass picks up exactly the delta.
         with self._lock:
-            # Swap. Live set re-read ONCE more under the lock; a slot
-            # registered after the last pass above forces one more
-            # distill pass (rare — bounded by registration rate).
+            # Swap — BUILD-THEN-COMMIT (ISSUE 12): everything below
+            # stages into locals; registry state mutates only in the
+            # final commit block of plain assignments, so a failure
+            # anywhere before it (late distill, validation, device_put)
+            # leaves every tenant on its old snapshot and the
+            # generation unchanged.
             current = {
                 s for snap in self._tenants.values() for s in snap.slots
             }
@@ -422,34 +568,60 @@ class TenantRegistry:
                         vec_of[s] = np.asarray(
                             self._distill(new_params, sup)
                         )[0][0].astype(np.float32)
+            # Pre-swap validation gate, part 2: every distilled vector
+            # that would become resident must be finite — one NaN'd slot
+            # would poison every tenant sharing it.
+            for s in sorted(current):
+                if not np.isfinite(vec_of[s]).all():
+                    raise PublishError(
+                        f"validation gate: non-finite distilled class "
+                        f"vector for slot {s} "
+                        f"(digest {self._pool[s].digest[:12]})"
+                    )
+            staged_pool: dict[int, _Slot] = {}
             live_map: dict[int, int] = {}   # old slot -> new slot
             by_digest_new: dict[str, int] = {}
+            next_slot = self._next_slot
             for s in sorted(current):
                 digest = self._pool[s].digest
                 if digest in by_digest_new:
                     live_map[s] = by_digest_new[digest]
                     continue
-                slot = self._next_slot
-                self._next_slot += 1
-                self._pool[slot] = _Slot(
+                slot = next_slot
+                next_slot += 1
+                staged_pool[slot] = _Slot(
                     vec=vec_of[s], rows=self._pool[s].rows, digest=digest,
                 )
-                self._by_digest[(new_version, digest)] = slot
                 by_digest_new[digest] = slot
                 live_map[s] = slot
+            # Stage every tenant's new snapshot (device_put can raise —
+            # still pre-commit). Version stamps pre-assigned; committed
+            # as a block below.
+            version = self._version
+            staged_snaps: dict[str, Snapshot] = {}
+            for tenant, snap in self._tenants.items():
+                slots = [live_map[s] for s in snap.slots]
+                matrix = self._jax.device_put(
+                    np.stack([staged_pool[by_digest_new[
+                        self._pool[s].digest]].vec for s in snap.slots])
+                )
+                version += 1
+                staged_snaps[tenant] = Snapshot(
+                    tenant=tenant, version=version,
+                    params_version=new_version,
+                    names=snap.names, slots=tuple(slots), matrix=matrix,
+                    params=new_params,
+                    nota_threshold=snap.nota_threshold, k=self.k,
+                )
+            # COMMIT — plain assignments only; nothing below can raise.
+            self._pool.update(staged_pool)
+            for digest, slot in by_digest_new.items():
+                self._by_digest[(new_version, digest)] = slot
+            self._next_slot = next_slot
             self.params = new_params
             self.params_version = new_version
-            for tenant, snap in list(self._tenants.items()):
-                # gc=False: mid-loop GC would collect the freshly interned
-                # slots of tenants not yet republished; collect once after
-                # every tenant points at its new-version slots.
-                self._publish_locked(
-                    tenant,
-                    list(snap.names),
-                    [live_map[s] for s in snap.slots],
-                    nota_threshold=snap.nota_threshold,
-                    gc=False,
-                )
+            self._tenants.update(staged_snaps)
+            self._version = version
             self._gc_slots_locked()
             n_tenants, n_slots = len(self._tenants), len(live_map)
         if self._logger is not None:
@@ -534,6 +706,10 @@ class TenantRegistry:
             params_version=self.params_version,
             names=tuple(names), slots=tuple(slots), matrix=matrix,
             params=self.params, nota_threshold=nota_threshold, k=self.k,
+            # A registration on a quarantined tenant does not clear the
+            # quarantine — only unquarantine_tenant or a committed
+            # publish (which re-validates every vector) does.
+            degraded=prev.degraded if prev else False,
         )
         self._tenants[tenant] = snap
         # GC only when this publish actually DROPPED slot references —
@@ -588,6 +764,11 @@ class TenantRegistry:
             # re-distill cost shows up inside the publish trace.
             with span("serve/distill", classes=len(missing)):
                 vecs = np.asarray(self._distill(params, sup))[0]
+            if not np.isfinite(vecs).all():
+                raise ValueError(
+                    "registration refused: distilled class vectors are "
+                    "non-finite (corrupt weights or poisoned supports)"
+                )
             for i, vec in zip(missing, vecs):
                 slot = self._next_slot
                 self._next_slot += 1

@@ -106,13 +106,18 @@ class _TenantStats:
     like even when no detector is armed."""
 
     __slots__ = ("served", "rejected", "shed", "deadline_missed", "lat",
-                 "nota", "quality_n", "margin", "entropy")
+                 "nota", "quality_n", "margin", "entropy",
+                 "execute_errors", "breaker_shed", "degraded")
 
     def __init__(self, reservoir_cap: int):
         self.served = 0
         self.rejected = 0
         self.shed = 0
         self.deadline_missed = 0
+        self.execute_errors = 0   # requests failed by a launch failure
+        self.breaker_shed = 0     # submits shed by an open circuit breaker
+        self.degraded = 0         # open-set-floor NOTA verdicts served
+        #                           while the tenant was quarantined
         self.lat = _Reservoir(reservoir_cap)
         self.nota = 0
         self.quality_n = 0   # verdicts that CARRIED quality features —
@@ -150,6 +155,10 @@ class ServingStats:
         self.rejected = 0           # backpressure rejections at submit
         self.shed = 0               # per-tenant share breaches (shed-load)
         self.deadline_missed = 0    # expired before execution
+        self.execute_errors = 0     # requests failed by launch failures
+        #                             (typed ExecuteError — ISSUE 12)
+        self.breaker_shed = 0       # submits shed by open circuit breakers
+        self.degraded = 0           # degraded-mode NOTA verdicts served
         self.batches = 0            # bucket executions
         self.batch_rows = 0         # real (unpadded) rows executed
         self.batch_slots = 0        # bucket slots executed (incl. padding)
@@ -229,6 +238,45 @@ class ServingStats:
     def record_swap(self) -> None:
         with self._lock:
             self.swaps += 1
+
+    def record_execute_error(self, tenant: str | None, requests: int) -> None:
+        """A failed launch: ``requests`` futures of ONE tenant's batch
+        failed with a typed ExecuteError (the containment contract —
+        nothing else fails). Each counts as a bad outcome for the
+        tenant's SLO."""
+        with self._lock:
+            self.execute_errors += requests
+            ts = self._tenant(tenant)
+            if ts is not None:
+                ts.execute_errors += requests
+        if self._slo is not None and tenant is not None:
+            for _ in range(requests):
+                self._slo.record(tenant, error=True)
+
+    def record_breaker_shed(self, tenant: str) -> None:
+        """A submit shed by this tenant's OPEN circuit breaker: counted
+        apart from share-based shed-load so the watchdog's shed_load
+        signal keeps meaning 'over admission share' and breaker activity
+        reads from its own counter (and its own breaker_open critical)."""
+        with self._lock:
+            self.rejected += 1
+            self.breaker_shed += 1
+            ts = self._tenant(tenant)
+            ts.rejected += 1
+            ts.breaker_shed += 1
+        if self._slo is not None:
+            self._slo.record(tenant, error=True)
+
+    def record_degraded(self, tenant: str | None, requests: int) -> None:
+        """Degraded-mode NOTA verdicts served for a quarantined tenant.
+        Counted as SERVED for throughput/latency (record_done is called
+        per request as usual); this counter is the degraded-traffic
+        attribution on top."""
+        with self._lock:
+            self.degraded += requests
+            ts = self._tenant(tenant)
+            if ts is not None:
+                ts.degraded += requests
 
     def record_deadline_miss(self, tenant: str | None = None) -> None:
         with self._lock:
@@ -401,6 +449,9 @@ class ServingStats:
                 "rejected": self.rejected,
                 "shed": self.shed,
                 "deadline_missed": self.deadline_missed,
+                "execute_errors": self.execute_errors,
+                "breaker_shed": self.breaker_shed,
+                "degraded": self.degraded,
                 "batches": self.batches,
                 "batch_occupancy": round(occ, 4),
                 "p50_ms": round(p50, 3) if p50 is not None else 0.0,
@@ -425,6 +476,9 @@ class ServingStats:
                     "rejected": ts.rejected,
                     "shed": ts.shed,
                     "deadline_missed": ts.deadline_missed,
+                    "execute_errors": ts.execute_errors,
+                    "breaker_shed": ts.breaker_shed,
+                    "degraded": ts.degraded,
                     "p50_ms": round(p50, 3) if p50 is not None else 0.0,
                     "p99_ms": round(p99, 3) if p99 is not None else 0.0,
                 }

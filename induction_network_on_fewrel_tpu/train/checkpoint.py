@@ -29,6 +29,32 @@ the lazy-embed leaves:
 Best-checkpoint saves stay full: they are the durable artifact other
 tools (test.py, serving, convert_lazy_ckpt) consume. Non-lazy states
 (no emb leaves) keep full ring saves; ``ckpt_delta="off"`` forces them.
+
+**Integrity chain (ISSUE 12).** Every save (best, full ring, base,
+delta) writes an ``integrity_<step>.json`` sidecar next to its step dir:
+per-leaf sha256 digests of the exact host tree handed to orbax, plus a
+manifest digest. Restores verify the reassembled tree against the
+manifest; a mismatch — or a restore that raises on a slot whose data
+fails re-verification — is a **corrupt slot**:
+
+* the slot (step dir + its manifest, in staging AND the real dir) is
+  QUARANTINED: renamed aside with a ``.quarantined`` suffix, never
+  silently purged — the evidence survives for a post-mortem, and orbax
+  stops seeing the step so later saves at that number are accepted;
+* a ``kind="fault"`` record (action="ckpt_quarantine") is emitted; the
+  health watchdog latches a CRITICAL ``ckpt_corrupt`` per slot;
+* ``restore_latest``/``restore_best`` walk to the next-newest intact
+  slot — including quarantining a delta whose base died (the orphaned
+  delta cannot resolve) — and the cursor sidecar follows the surviving
+  step, so kill/corrupt/resume continues from the best surviving state
+  instead of crashing (tests/test_ckpt_integrity.py).
+
+Pre-integrity dirs (no manifest) keep the old behavior: restore errors
+raise, nothing is quarantined — a structural mismatch against an intact
+slot must stay a loud config error, which is also why a restore failure
+WITH a manifest first re-verifies the raw stored data before declaring
+corruption (intact data + failed restore = architecture mismatch, the
+original error re-raises).
 """
 
 from __future__ import annotations
@@ -311,6 +337,72 @@ def _sync_tree(src: Path, dst: Path, mirror_deletes: bool = True) -> None:
             continue
 
 
+# --- integrity chain (ISSUE 12) -------------------------------------------
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A slot failed integrity verification (digest mismatch, unreadable
+    payload with an intact-manifest claim, or an injected restore fault).
+    Carries the slot identity so the fallback walk can quarantine it."""
+
+    def __init__(self, kind: str, step: int, reason: str):
+        super().__init__(
+            f"checkpoint slot {kind}/{step} corrupt: {reason}"
+        )
+        self.kind = kind
+        self.step = step
+        self.reason = reason
+
+
+def _leaf_digest(leaf) -> str:
+    """sha256 of one host leaf. 0-dim leaves hash by ``repr(item())`` —
+    restore templates may legitimately re-type a scalar (np.int64 saved,
+    python int template), and a dtype-sensitive digest would quarantine
+    intact slots over a representation detail. Arrays hash dtype + shape
+    + bytes: a bit-flip ANYWHERE in the payload changes the digest."""
+    import hashlib
+
+    import numpy as np
+
+    a = np.asarray(leaf)
+    h = hashlib.sha256()
+    if a.ndim == 0:
+        h.update(repr(a.item()).encode())
+    else:
+        h.update(a.dtype.str.encode())
+        h.update(repr(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def tree_manifest(tree) -> dict:
+    """{leaves: {"00000": sha, ...}, manifest_sha} over the flat host
+    tree — the per-leaf + manifest checksum chain every save writes and
+    every restore verifies."""
+    import hashlib
+
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    d = {_leafkey(i): _leaf_digest(l) for i, l in enumerate(leaves)}
+    m = hashlib.sha256()
+    for k in sorted(d):
+        m.update(k.encode())
+        m.update(d[k].encode())
+    return {"leaves": d, "manifest_sha": m.hexdigest()}
+
+
+# Manager-kind -> subdirectory under a root (best lives at the root).
+_KIND_SUB = {
+    "best": "", "ring": "latest",
+    "ring_base": "ring_base", "ring_delta": "ring_delta",
+}
+
+
+def _integrity_name(step: int) -> str:
+    return f"integrity_{int(step):08d}.json"
+
+
 # --- delta-ring helpers ----------------------------------------------------
 #
 # Flat-leaf format: base/delta ring slots store ``{"leaves": {"00007":
@@ -358,7 +450,13 @@ def _tree_bytes(tree) -> int:
 
 class CheckpointManager:
     def __init__(self, ckpt_dir: str | Path, cfg: ExperimentConfig,
-                 max_to_keep: int = 3, stage: str | None = None):
+                 max_to_keep: int = 3, stage: str | None = None,
+                 logger=None):
+        # Telemetry sink for integrity events (kind="fault" quarantine
+        # records — the watchdog turns them into ckpt_corrupt criticals
+        # through its logger hook). None = silent quarantine on the
+        # stream side; the rename on disk still happens.
+        self._logger = logger
         self.dir = Path(ckpt_dir).absolute()
         self.dir.mkdir(parents=True, exist_ok=True)
         if stage is None:
@@ -617,6 +715,10 @@ class CheckpointManager:
                     "ring_base": self.ring_base_mngr,
                     "ring_delta": self.ring_delta_mngr,
                 }[kind]
+                # Integrity chain (module doc): per-leaf + manifest
+                # digests of the EXACT host tree handed to orbax, written
+                # as a sidecar the drain mirrors with its step.
+                manifest = tree_manifest(host)
                 if kind == "best":
                     mngr.save(
                         step,
@@ -625,6 +727,9 @@ class CheckpointManager:
                     )
                 else:
                     mngr.save(step, args=ocp.args.StandardSave(host))
+                self._write_manifest(kind, step, manifest)
+                self._prune_manifests(kind, mngr)
+                self._chaos_corrupt(kind, step, mngr)
                 if self._stage_root is not None:
                     # Drain staging -> real INLINE on this thread: the
                     # sync must see a quiescent staging tree, and a
@@ -764,6 +869,204 @@ class CheckpointManager:
             if path.exists():
                 return json.loads(path.read_text())
         return None
+
+    # --- integrity chain (ISSUE 12) ---------------------------------------
+
+    def _kind_dir(self, root: Path, kind: str) -> Path:
+        sub = _KIND_SUB[kind]
+        return root / sub if sub else root
+
+    def _write_manifest(self, kind: str, step: int, manifest: dict) -> None:
+        """Sidecar next to the step dir (at the managers' root — the
+        drain mirrors it to the real dir with its step). Atomic: a torn
+        manifest must never half-parse."""
+        import json
+
+        root = self._stage_root or self.dir
+        d = self._kind_dir(root, kind)
+        d.mkdir(parents=True, exist_ok=True)
+        path = d / _integrity_name(step)
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(json.dumps(
+            {"step": int(step), "kind": kind, **manifest}, sort_keys=True
+        ))
+        tmp.replace(path)
+
+    def _prune_manifests(self, kind: str, mngr) -> None:
+        """Drop manifests whose step the manager no longer retains
+        (orbax retention GC'd the dir) — saver-thread/quiescent only.
+        Quarantined manifests (``*.json.quarantined``) don't match the
+        glob and survive as evidence."""
+        retained = {int(s) for s in mngr.all_steps()}
+        for root in (self._stage_root, self.dir):
+            if root is None:
+                continue
+            for p in self._kind_dir(root, kind).glob("integrity_*.json"):
+                try:
+                    s = int(p.stem.split("_")[1])
+                except (IndexError, ValueError):
+                    continue
+                if s not in retained:
+                    p.unlink(missing_ok=True)
+
+    def _load_manifest(self, kind: str, step: int) -> dict | None:
+        import json
+
+        for root in (self._stage_root, self.dir):
+            if root is None:
+                continue
+            p = self._kind_dir(root, kind) / _integrity_name(step)
+            if p.exists():
+                try:
+                    return json.loads(p.read_text())
+                except (json.JSONDecodeError, OSError):
+                    return {"leaves": None}   # torn manifest: see _verify
+        return None
+
+    def _verify_tree(self, kind: str, step: int, tree: Any) -> None:
+        """Compare the restored tree's per-leaf digests to the manifest;
+        no manifest (pre-integrity dir) verifies nothing. Raises
+        CorruptCheckpointError on any mismatch."""
+        man = self._load_manifest(kind, step)
+        if man is None:
+            return
+        stored = man.get("leaves")
+        if not isinstance(stored, dict):
+            raise CorruptCheckpointError(kind, step, "unreadable manifest")
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(tree)
+        if len(stored) != len(leaves):
+            raise CorruptCheckpointError(
+                kind, step,
+                f"manifest records {len(stored)} leaves, restore "
+                f"produced {len(leaves)}",
+            )
+        for i, leaf in enumerate(leaves):
+            if stored.get(_leafkey(i)) != _leaf_digest(leaf):
+                raise CorruptCheckpointError(
+                    kind, step, f"leaf {_leafkey(i)} digest mismatch"
+                )
+
+    def _chaos_corrupt(self, kind: str, step: int, mngr) -> None:
+        """ckpt.bitflip / ckpt.truncate fault points (obs/chaos.py):
+        corrupt the just-written ring-family slot. Off = one module
+        global check; firing waits for the write to be durable first."""
+        from induction_network_on_fewrel_tpu.obs.chaos import (
+            chaos_active,
+            chaos_fire,
+            corrupt_step_dir,
+        )
+
+        if not chaos_active() or kind == "best":
+            return
+        for point, mode in (
+            ("ckpt.bitflip", "bitflip"), ("ckpt.truncate", "truncate"),
+        ):
+            if chaos_fire(point, kind=kind, step=int(step)) is not None:
+                mngr.wait_until_finished()
+                root = self._stage_root or self.dir
+                corrupt_step_dir(
+                    self._kind_dir(root, kind) / str(int(step)), mode
+                )
+
+    def _quarantine(self, kind: str, step: int, reason: str) -> None:
+        """Rename the corrupt slot aside (never delete): step dir +
+        manifest in staging AND the real dir get a ``.quarantined``
+        suffix, orbax managers reload so the step disappears from their
+        view (later saves at that number are accepted again), and —
+        when no other manager still holds the step — the cursor sidecar
+        follows, so a resumed stream can never pair the fallback state
+        with the corrupt slot's position. Emits one kind="fault"
+        record; the watchdog latches CRITICAL ``ckpt_corrupt``."""
+        renamed = 0
+        for root in (self._stage_root, self.dir):
+            if root is None:
+                continue
+            d = self._kind_dir(root, kind)
+            for name in (str(int(step)), _integrity_name(step)):
+                p = d / name
+                if not p.exists():
+                    continue
+                q = p.with_name(name + ".quarantined")
+                n = 1
+                while q.exists():
+                    q = p.with_name(f"{name}.quarantined{n}")
+                    n += 1
+                p.rename(q)
+                renamed += 1
+        for m in (self.mngr, self.latest_mngr,
+                  self.ring_base_mngr, self.ring_delta_mngr):
+            try:
+                m.reload()
+            except Exception:  # noqa: BLE001 — reload is best-effort
+                pass
+        if self._delta_base is not None and kind == "ring_base" \
+                and self._delta_base["step"] == int(step):
+            self._delta_base = None   # the diff reference died with it
+        still_held = any(
+            int(step) in {int(s) for s in m.all_steps()}
+            for m in (self.mngr, self.latest_mngr,
+                      self.ring_base_mngr, self.ring_delta_mngr)
+        )
+        if not still_held:
+            for root in (self._stage_root, self.dir):
+                if root is None:
+                    continue
+                c = root / self._cursor_name(step)
+                if c.exists():
+                    q = c.with_name(c.name + ".quarantined")
+                    if not q.exists():
+                        c.rename(q)
+        if self._logger is not None:
+            self._logger.log(
+                int(step), kind="fault", action="ckpt_quarantine",
+                ckpt_kind=kind, ckpt_step=float(step), reason=reason,
+                renamed=float(renamed),
+            )
+
+    def _restore_verified(self, mngr, kind: str, step: int, target: Any):
+        """Restore + integrity verification. A restore that RAISES on a
+        manifest-bearing slot re-verifies the raw stored data first:
+        intact data means the failure is structural (wrong target
+        architecture) and the original error re-raises; anything else is
+        corruption. The ``ckpt.restore_raise`` chaos point models a
+        flaky read and is contained exactly like corruption."""
+        from induction_network_on_fewrel_tpu.obs.chaos import chaos_fire
+
+        if chaos_fire("ckpt.restore_raise", kind=kind, step=int(step)):
+            raise CorruptCheckpointError(
+                kind, step, "injected restore fault (chaos)"
+            )
+        try:
+            out = self._restore(mngr, step, target)
+        except Exception as e:
+            self._reverify_or_corrupt(mngr, kind, step, e)
+        self._verify_tree(kind, step, out)
+        return out
+
+    def _reverify_or_corrupt(self, mngr, kind: str, step: int, exc) -> None:
+        """Classify a restore exception. Pre-integrity slots (no
+        manifest) re-raise — old behavior. With a manifest, the raw
+        stored data re-verifies: intact data means the failure is
+        STRUCTURAL (wrong target architecture — the original error
+        re-raises, nothing is quarantined); a digest mismatch or an
+        unreadable payload raises CorruptCheckpointError. Always
+        raises."""
+        if self._load_manifest(kind, step) is None:
+            raise exc
+        try:
+            raw = mngr.restore(step, args=ocp.args.StandardRestore())
+            self._verify_tree(kind, step, raw)
+        except CorruptCheckpointError as ce:
+            raise CorruptCheckpointError(
+                kind, step, f"{ce.reason} (restore also failed: {exc})"
+            ) from exc
+        except Exception as re_err:
+            raise CorruptCheckpointError(
+                kind, step, f"unreadable payload: {re_err}"
+            ) from exc
+        raise exc   # data verified intact -> structural mismatch
 
     def _ring_item(self, step: int, state: Any) -> tuple[str, Any, dict]:
         """Build the ring-save queue item: ("ring", full snapshot) for
@@ -953,61 +1256,112 @@ class CheckpointManager:
 
     def restore_best(self, target: Any) -> tuple[Any, int]:
         self.wait()  # a step mid-write is not restorable yet
-        step = self.mngr.best_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint in {self.dir}")
-        return self._restore(self.mngr, step, target), step
+        while True:
+            step = self.mngr.best_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoint in {self.dir}")
+            try:
+                return (
+                    self._restore_verified(self.mngr, "best", step, target),
+                    step,
+                )
+            except CorruptCheckpointError as e:
+                # Quarantine + fall back to the next-best retained step.
+                self._quarantine(e.kind, e.step, e.reason)
 
     def restore_latest(self, target: Any) -> tuple[Any, int]:
-        """Newest state across the best-tracked steps AND the recovery ring
-        (full slots, delta bases, and delta slots alike).
+        """Newest INTACT state across the best-tracked steps AND the
+        recovery ring (full slots, delta bases, and delta slots alike).
 
         Step number IS save order here: check_start_step (enforced at every
         training start) refuses runs whose numbering would collide with a
         dir's existing checkpoints, so within any dir this build writes,
         higher step == later save. The ring wins ties (it is written at
-        every val boundary; the best manager only on improvement)."""
+        every val boundary; the best manager only on improvement).
+
+        Integrity (ISSUE 12): each candidate verifies against its
+        manifest; a corrupt slot is quarantined (renamed aside, fault
+        record + CRITICAL ``ckpt_corrupt``) and the walk continues to the
+        next-newest slot — a delta whose base died quarantines as
+        orphaned and the walk re-resolves past it — so kill/corrupt/
+        resume recovers the best surviving state instead of crashing."""
         self.wait()  # a step mid-write is not restorable yet
-        best_side = self.mngr.latest_step()
-        ring_full = self.latest_mngr.latest_step()
-        ring_flat = max(
-            (
-                s for s in (
-                    self.ring_base_mngr.latest_step(),
-                    self.ring_delta_mngr.latest_step(),
-                ) if s is not None
-            ),
-            default=None,
-        )
-        ring_side = max(
-            (s for s in (ring_full, ring_flat) if s is not None),
-            default=None,
-        )
-        if best_side is None and ring_side is None:
-            raise FileNotFoundError(f"no checkpoint in {self.dir}")
-        if ring_side is not None and (best_side is None or ring_side >= best_side):
-            if ring_full is not None and ring_full >= ring_side:
+        while True:
+            best_side = self.mngr.latest_step()
+            ring_full = self.latest_mngr.latest_step()
+            ring_flat = max(
+                (
+                    s for s in (
+                        self.ring_base_mngr.latest_step(),
+                        self.ring_delta_mngr.latest_step(),
+                    ) if s is not None
+                ),
+                default=None,
+            )
+            ring_side = max(
+                (s for s in (ring_full, ring_flat) if s is not None),
+                default=None,
+            )
+            if best_side is None and ring_side is None:
+                raise FileNotFoundError(f"no checkpoint in {self.dir}")
+            try:
+                if ring_side is not None and (
+                    best_side is None or ring_side >= best_side
+                ):
+                    if ring_full is not None and ring_full >= ring_side:
+                        return (
+                            self._restore_verified(
+                                self.latest_mngr, "ring", ring_full, target
+                            ),
+                            ring_full,
+                        )
+                    return (
+                        self._restore_ring_flat(ring_side, target),
+                        ring_side,
+                    )
                 return (
-                    self._restore(self.latest_mngr, ring_full, target),
-                    ring_full,
+                    self._restore_verified(
+                        self.mngr, "best", best_side, target
+                    ),
+                    best_side,
                 )
-            return self._restore_ring_flat(ring_side, target), ring_side
-        return self._restore(self.mngr, best_side, target), best_side
+            except CorruptCheckpointError as e:
+                self._quarantine(e.kind, e.step, e.reason)
 
     def _restore_ring_flat(self, step: int, target: Any) -> Any:
         """Reassemble a delta-ring state: base leaves + (when ``step`` is a
         delta slot) the delta's non-embedding leaves and changed embedding
         rows scattered over the base's. Also re-arms the device-resident
         diff base so this manager's NEXT ring save deltas against the same
-        base the directory already holds."""
+        base the directory already holds.
+
+        Integrity (ISSUE 12): base AND delta payloads verify against
+        their manifests before assembly. A corrupt base raises with the
+        BASE's slot identity (the fallback walk quarantines it; the
+        surviving delta is then orphaned and quarantines on the next
+        pass); a delta referencing a stale/absent base is corruption-
+        class too when it carries a manifest — a pre-integrity dir keeps
+        the old loud errors."""
         import jax
         import jax.numpy as jnp
         import numpy as np
 
+        from induction_network_on_fewrel_tpu.obs.chaos import chaos_fire
+
         base_step = self.ring_base_mngr.latest_step()
         if base_step is None:
+            if self._load_manifest("ring_delta", step) is not None:
+                raise CorruptCheckpointError(
+                    "ring_delta", step,
+                    "orphaned delta: its base save is missing/quarantined",
+                )
             raise FileNotFoundError(
                 f"delta ring in {self.dir} has no base save"
+            )
+        if chaos_fire("ckpt.restore_raise", kind="ring_base",
+                      step=int(base_step)):
+            raise CorruptCheckpointError(
+                "ring_base", base_step, "injected restore fault (chaos)"
             )
         leaves_t, treedef = jax.tree_util.tree_flatten(target)
         n = len(leaves_t)
@@ -1026,9 +1380,15 @@ class CheckpointManager:
                 for i, l in enumerate(leaves_t)
             },
         }
-        raw_base = self.ring_base_mngr.restore(
-            base_step, args=ocp.args.StandardRestore(base_tpl)
-        )
+        try:
+            raw_base = self.ring_base_mngr.restore(
+                base_step, args=ocp.args.StandardRestore(base_tpl)
+            )
+        except Exception as e:
+            self._reverify_or_corrupt(
+                self.ring_base_mngr, "ring_base", base_step, e
+            )
+        self._verify_tree("ring_base", base_step, raw_base)
         if len(raw_base["leaves"]) != n:
             raise ValueError(
                 f"delta-ring base in {self.dir} holds "
@@ -1043,19 +1403,35 @@ class CheckpointManager:
                     "delta ring slot exists but the restore target has no "
                     "lazy-embed leaves (embed_optimizer mismatch?)"
                 )
-            raw_d = self.ring_delta_mngr.restore(
-                step, args=ocp.args.StandardRestore()
-            )
+            if chaos_fire("ckpt.restore_raise", kind="ring_delta",
+                          step=int(step)):
+                raise CorruptCheckpointError(
+                    "ring_delta", step, "injected restore fault (chaos)"
+                )
+            try:
+                raw_d = self.ring_delta_mngr.restore(
+                    step, args=ocp.args.StandardRestore()
+                )
+            except Exception as e:
+                self._reverify_or_corrupt(
+                    self.ring_delta_mngr, "ring_delta", step, e
+                )
+            self._verify_tree("ring_delta", step, raw_d)
             if (
                 int(raw_d["base_step"]) != int(base_step)
                 or int(raw_d["base_nonce"]) != int(raw_base["nonce"])
             ):
-                raise ValueError(
+                msg = (
                     f"delta ring slot {step} references base "
                     f"{int(raw_d['base_step'])}/"
                     f"{int(raw_d['base_nonce'])}, but {self.dir} holds "
                     f"{base_step}/{int(raw_base['nonce'])} — stale delta"
                 )
+                if self._load_manifest("ring_delta", step) is not None:
+                    # Its true base was quarantined/replaced: the delta
+                    # cannot resolve — corruption-class, walk past it.
+                    raise CorruptCheckpointError("ring_delta", step, msg)
+                raise ValueError(msg)
             slot_set = set(slots.values())
             for i in range(n):
                 if i not in slot_set:
@@ -1085,10 +1461,15 @@ class CheckpointManager:
         state would otherwise win every later --resume. Purging the base
         also drops the device diff reference, so the next ring save
         rebuilds a fresh base."""
-        for m in (self.latest_mngr, self.ring_delta_mngr, self.ring_base_mngr):
+        for kind, m in (("ring", self.latest_mngr),
+                        ("ring_delta", self.ring_delta_mngr),
+                        ("ring_base", self.ring_base_mngr)):
             for s in m.all_steps():
                 if s > best_step:
                     m.delete(s)
+            # Integrity sidecars of purged steps go with them (manifests
+            # for steps the manager no longer retains).
+            self._prune_manifests(kind, m)
         # Cursor sidecars newer than the restored best describe a stream
         # position the purged slots held — a later --resume must not
         # splice the post-collapse stream onto the restored state.

@@ -132,7 +132,13 @@ class FewShotTrainer:
         # Injectable steps so parallel/ can substitute mesh-sharded versions.
         self.train_step = train_step or make_train_step(model, cfg)
         self.eval_step = eval_step or make_eval_step(model, cfg)
-        self.ckpt = CheckpointManager(ckpt_dir, cfg) if ckpt_dir else None
+        # logger threaded so integrity quarantines (ISSUE 12) land in the
+        # telemetry stream — the watchdog hook turns them into CRITICAL
+        # ckpt_corrupt events.
+        self.ckpt = (
+            CheckpointManager(ckpt_dir, cfg, logger=self.logger)
+            if ckpt_dir else None
+        )
         self.best_val = -1.0
         # Divergence-guard arming threshold, CONFIG-RELATIVE (a hardcoded
         # 0.5 left the guard inert exactly where collapse risk is highest:

@@ -117,6 +117,25 @@ KNOWN_KINDS = frozenset({
     # obs/perf.CAUSES) on slow windows. obs_report's perf section reads
     # these (headline: segment fractions + the cause table).
     "perf",
+    # Fault-domain telemetry (ISSUE 12, obs/chaos.py + the containment
+    # layer): one record per INJECTED fault (action="inject" with point
+    # (str, an obs/chaos.KNOWN_POINTS name), seq, and the point's context
+    # fields — tenant on serving points, ckpt_kind on checkpoint points)
+    # and one per CONTAINMENT action:
+    # action="ckpt_quarantine" (ckpt_kind, ckpt_step, reason — a corrupt
+    # slot renamed aside, never silently purged), action="breaker"
+    # (tenant, from, to, failures — circuit-breaker transitions;
+    # to="open" trips the once-latched breaker_open CRITICAL),
+    # action="execute_error" (tenant, requests — a failed launch failing
+    # ONLY its batch's futures), action="publish_rollback" (reason,
+    # params_version — a refused/failed publish rolled back with every
+    # tenant on its old snapshot), action="tenant_quarantine" /
+    # "tenant_restore" (tenant, reason — degraded-mode routing), and
+    # action="degraded_verdicts" (tenant, served — open-set-floor NOTA
+    # verdicts served while quarantined). All scalar/str fields;
+    # obs_report's faults section renders injections and reactions side
+    # by side.
+    "fault",
     # XLA compile forensics (ISSUE 11, obs/compile.py): one record per
     # observed backend compile with fn (str, the jitted function), shapes
     # (str, the argument shape signature), elapsed_ms, trigger (str, the

@@ -1,0 +1,267 @@
+"""Checkpoint integrity chain (ISSUE 12, train/checkpoint.py).
+
+The contract under test:
+
+* every save writes per-leaf + manifest checksums; a clean restore
+  verifies silently (no quarantine, no fault records);
+* a corrupt slot is QUARANTINED (renamed aside, never deleted) with a
+  kind="fault" record and a once-latched CRITICAL ``ckpt_corrupt``, and
+  ``restore_latest`` walks to the newest INTACT slot with a bitwise-
+  correct restore — the delta-slot case falls back to its base, the
+  corrupt-BASE case orphans the delta and falls back further, the full-
+  ring case (``ckpt_delta=off``) falls back to the best save;
+* the cursor sidecar follows the surviving step;
+* the ``ckpt.restore_raise`` chaos point is contained exactly like
+  corruption (deterministic injection, off = zero cost).
+
+All states are captured with np.array COPIES: on the CPU backend
+``jax.device_get`` returns views of device buffers which later DONATING
+train steps reuse — comparing against a view would test allocator
+timing, not the restore.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    GloveTokenizer,
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.models.build import batch_to_model_inputs
+from induction_network_on_fewrel_tpu.obs.chaos import (
+    ChaosRegistry,
+    corrupt_step_dir,
+    install,
+)
+from induction_network_on_fewrel_tpu.obs.health import HealthWatchdog
+from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+from induction_network_on_fewrel_tpu.train.checkpoint import CheckpointManager
+from induction_network_on_fewrel_tpu.train.steps import init_state, make_train_step
+from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+VOCAB = 402
+CFG = ExperimentConfig(
+    encoder="cnn", n=3, k=2, q=2, batch_size=2, max_length=12,
+    vocab_size=VOCAB, hidden_size=16, induction_dim=16, ntn_slices=4,
+    lr=3e-3, weight_decay=0.0,
+    embed_optimizer="lazy", compute_dtype="float32", ckpt_stage="off",
+)
+
+
+@pytest.fixture(scope="module")
+def world():
+    vocab = make_synthetic_glove(vocab_size=VOCAB - 2)
+    ds = make_synthetic_fewrel(
+        num_relations=6, instances_per_relation=6, vocab_size=35
+    )
+    tok = GloveTokenizer(vocab, max_length=CFG.max_length)
+    sampler = EpisodeSampler(
+        ds, tok, CFG.n, CFG.k, CFG.q, CFG.batch_size, seed=3
+    )
+    batches = [
+        batch_to_model_inputs(sampler.sample_batch()) for _ in range(8)
+    ]
+    model = build_model(CFG, glove_init=vocab.vectors)
+    return model, batches
+
+
+def _copy(tree):
+    return jax.tree.map(lambda x: np.array(x), jax.device_get(tree))
+
+
+def _trees_equal(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(va), np.asarray(vb))
+        for (_, va), (_, vb) in zip(
+            jax.tree_util.tree_flatten_with_path(a)[0],
+            jax.tree_util.tree_flatten_with_path(b)[0],
+        )
+    )
+
+
+class _Capture:
+    """Logger + watchdog pair capturing fault records and health events."""
+
+    def __init__(self, tmp_path):
+        self.logger = MetricsLogger(tmp_path, quiet=True)
+        self.watchdog = HealthWatchdog(logger=self.logger)
+        self.logger.add_hook(self.watchdog.observe_record)
+        self.faults: list[dict] = []
+        self.logger.add_hook(
+            lambda rec: self.faults.append(rec)
+            if rec.get("kind") == "fault" else None
+        )
+
+
+def _train_and_save(model, batches, cfg, ckpt_dir, logger=None):
+    """2 steps -> ring save @2 (base in delta mode), 2 more -> save @4
+    (delta). Returns (mgr, state@2 copy, state@4 copy, save modes)."""
+    step_fn = make_train_step(model, cfg)
+    state = init_state(model, cfg, batches[0][0], batches[0][1])
+    mgr = CheckpointManager(ckpt_dir, cfg, logger=logger)
+    for sup, qry, lab in batches[:2]:
+        state, _ = step_fn(state, sup, qry, lab)
+    m2 = mgr.save_latest(2, state, cursor={"pos": 2})["mode"]
+    mgr.wait()
+    state2 = _copy(state)
+    for sup, qry, lab in batches[2:4]:
+        state, _ = step_fn(state, sup, qry, lab)
+    m4 = mgr.save_latest(4, state, cursor={"pos": 4})["mode"]
+    mgr.wait()
+    return mgr, state2, _copy(state), (m2, m4)
+
+
+def _template(model, batches, cfg):
+    return _copy(init_state(model, cfg, batches[0][0], batches[0][1]))
+
+
+def test_clean_restore_verifies_silently(world, tmp_path):
+    """Manifests are written with every save and a clean restore verifies
+    against them without quarantining anything."""
+    model, batches = world
+    cap = _Capture(tmp_path / "run")
+    mgr, _, state4, modes = _train_and_save(
+        model, batches, CFG, tmp_path / "ckpt", logger=cap.logger
+    )
+    assert modes == ("base", "delta")
+    assert (tmp_path / "ckpt/ring_base/integrity_00000002.json").exists()
+    assert (tmp_path / "ckpt/ring_delta/integrity_00000004.json").exists()
+    restored, step = mgr.restore_latest(_template(model, batches, CFG))
+    assert step == 4
+    assert _trees_equal(state4, restored)
+    assert cap.faults == []
+    assert not any(e.event == "ckpt_corrupt" for e in cap.watchdog.events)
+    mgr.close()
+
+
+def test_corrupt_delta_quarantines_and_falls_back_to_base(world, tmp_path):
+    """Bit-flipped delta slot: quarantined (renamed, never deleted; fault
+    record + ONE ckpt_corrupt CRITICAL), restore falls back to the base
+    bitwise, and the cursor sidecar follows the surviving step."""
+    model, batches = world
+    cap = _Capture(tmp_path / "run")
+    mgr, state2, _, _ = _train_and_save(
+        model, batches, CFG, tmp_path / "ckpt", logger=cap.logger
+    )
+    mgr.close()
+    assert corrupt_step_dir(tmp_path / "ckpt/ring_delta/4", "bitflip")
+
+    mgr2 = CheckpointManager(tmp_path / "ckpt", CFG, logger=cap.logger)
+    restored, step = mgr2.restore_latest(_template(model, batches, CFG))
+    assert step == 2
+    assert _trees_equal(state2, restored)
+    # Quarantined, not purged: the evidence survives on disk.
+    assert (tmp_path / "ckpt/ring_delta/4.quarantined").exists()
+    assert not (tmp_path / "ckpt/ring_delta/4").exists()
+    q = [f for f in cap.faults if f.get("action") == "ckpt_quarantine"]
+    assert len(q) == 1 and q[0]["ckpt_kind"] == "ring_delta"
+    crits = [e for e in cap.watchdog.events if e.event == "ckpt_corrupt"]
+    assert len(crits) == 1 and crits[0].severity == "critical"
+    # Cursor follows: the surviving step's sidecar loads, the corrupt
+    # slot's was renamed aside with it.
+    assert mgr2.load_cursor(2) == {"pos": 2}
+    assert mgr2.load_cursor(4) is None
+    # The dir stays WRITABLE at the freed step numbers (orbax would
+    # refuse saves <= its latest step had the slot not been renamed).
+    step_fn = make_train_step(model, CFG)
+    state = restored
+    for sup, qry, lab in batches[4:5]:
+        state, _ = step_fn(jax.device_put(state), sup, qry, lab)
+    assert mgr2.save_latest(3, state, force=True)["mode"] == "delta"
+    mgr2.wait()
+    mgr2.close()
+
+
+def test_corrupt_base_orphans_delta_falls_back_to_best(world, tmp_path):
+    """The delta-whose-base-died case: corrupting the BASE quarantines it,
+    the surviving delta is orphaned (quarantined too — it cannot
+    resolve), and the walk falls back to the best save."""
+    model, batches = world
+    cap = _Capture(tmp_path / "run")
+    step_fn = make_train_step(model, CFG)
+    state = init_state(model, CFG, batches[0][0], batches[0][1])
+    mgr = CheckpointManager(tmp_path / "ckpt", CFG, logger=cap.logger)
+    state, _ = step_fn(state, *batches[0])
+    mgr.save(1, state, val_accuracy=0.5, cursor={"pos": 1})   # best@1
+    mgr.wait()
+    state1 = _copy(state)
+    state, _ = step_fn(state, *batches[1])
+    assert mgr.save_latest(2, state, force=True)["mode"] == "base"
+    mgr.wait()
+    state, _ = step_fn(state, *batches[2])
+    assert mgr.save_latest(3, state, force=True)["mode"] == "delta"
+    mgr.wait()
+    mgr.close()
+    assert corrupt_step_dir(tmp_path / "ckpt/ring_base/2", "bitflip")
+
+    mgr2 = CheckpointManager(tmp_path / "ckpt", CFG, logger=cap.logger)
+    restored, step = mgr2.restore_latest(_template(model, batches, CFG))
+    assert step == 1
+    assert _trees_equal(state1, restored)
+    kinds = [
+        (f["ckpt_kind"], int(f["ckpt_step"])) for f in cap.faults
+        if f.get("action") == "ckpt_quarantine"
+    ]
+    assert ("ring_base", 2) in kinds and ("ring_delta", 3) in kinds
+    # Two slots, two incidents (latched per slot).
+    crits = [e for e in cap.watchdog.events if e.event == "ckpt_corrupt"]
+    assert len(crits) == 2
+    assert mgr2.load_cursor(1) == {"pos": 1}
+    mgr2.close()
+
+
+def test_truncated_full_ring_falls_back_to_best(world, tmp_path):
+    """ckpt_delta=off: a TRUNCATED full ring slot (the restore itself
+    raises) is classified corrupt via the manifest re-verify and the walk
+    falls back to the best save."""
+    model, batches = world
+    cfg = CFG.replace(ckpt_delta="off")
+    cap = _Capture(tmp_path / "run")
+    step_fn = make_train_step(model, cfg)
+    state = init_state(model, cfg, batches[0][0], batches[0][1])
+    mgr = CheckpointManager(tmp_path / "ckpt", cfg, logger=cap.logger)
+    state, _ = step_fn(state, *batches[0])
+    mgr.save(1, state, val_accuracy=0.5)
+    mgr.wait()
+    state1 = _copy(state)
+    state, _ = step_fn(state, *batches[1])
+    assert mgr.save_latest(2, state, force=True)["mode"] == "full"
+    mgr.wait()
+    mgr.close()
+    assert corrupt_step_dir(tmp_path / "ckpt/latest/2", "truncate")
+
+    mgr2 = CheckpointManager(tmp_path / "ckpt", cfg, logger=cap.logger)
+    restored, step = mgr2.restore_latest(_template(model, batches, cfg))
+    assert step == 1
+    assert _trees_equal(state1, restored)
+    assert (tmp_path / "ckpt/latest/2.quarantined").exists()
+    mgr2.close()
+
+
+def test_chaos_restore_raise_contained_like_corruption(world, tmp_path):
+    """The ckpt.restore_raise fault point: an injected restore failure on
+    the delta slot quarantines it and falls back to the base — the drill
+    path for flaky-read containment, deterministic by plan."""
+    model, batches = world
+    mgr, state2, _, _ = _train_and_save(
+        model, batches, CFG, tmp_path / "ckpt"
+    )
+    mgr.close()
+    reg = ChaosRegistry.parse("ckpt.restore_raise@0:ring_delta")
+    reg.install()
+    try:
+        mgr2 = CheckpointManager(tmp_path / "ckpt", CFG)
+        restored, step = mgr2.restore_latest(
+            _template(model, batches, CFG)
+        )
+        assert step == 2
+        assert _trees_equal(state2, restored)
+        assert (tmp_path / "ckpt/ring_delta/4.quarantined").exists()
+        assert reg.directives[0].fired == 1
+        mgr2.close()
+    finally:
+        install(None)

@@ -86,13 +86,15 @@ def test_snapshot_cow_isolation(world):
         mat0 = np.asarray(snap0.matrix).copy()
 
         # Mutate the tenant AND the registry around it.
+        # mask=1: a fully-masked row distills to NaN (masked_max -inf)
+        # and the ISSUE-12 registration validation rightly refuses it.
         eng.registry.register_tokens(
             "extra",
             [{k: np.asarray(v) for k, v in row.items()} for row in
              [dict(word=np.zeros(CFG.max_length, np.int32),
                    pos1=np.zeros(CFG.max_length, np.int16),
                    pos2=np.zeros(CFG.max_length, np.int16),
-                   mask=np.zeros(CFG.max_length, np.int8))]],
+                   mask=np.ones(CFG.max_length, np.int8))]],
             tenant="acme",
         )
         eng.register_dataset(ds_b, tenant="globex")

@@ -97,6 +97,15 @@ BANDS: dict[str, tuple[str, float]] = {
     "serve.drill_dropped.microbatch": ("zero", 0.0),
     "serve.drill_rejected.continuous": ("zero", 0.0),
     "serve.drill_rejected.microbatch": ("zero", 0.0),
+    # Chaos drill (ISSUE 12, CHAOS_r*.json): the containment invariants
+    # as zero-bands — a publish rollback must drop nothing and recompile
+    # nothing — plus pass/recovery floors. A containment regression
+    # fails --check the moment a new artifact records it.
+    "chaos.dropped_during_rollback": ("zero", 0.0),
+    "chaos.steady_recompiles": ("zero", 0.0),
+    "chaos.passed": ("floor", 1.0),
+    "chaos.ckpt_bitwise_recovery": ("floor", 1.0),
+    "chaos.breaker_open_criticals": ("floor", 1.0),
 }
 
 
@@ -208,11 +217,34 @@ def _serve_points(points: dict, path: str, data: dict) -> int:
     return sum(len(v) for v in points.values()) - before
 
 
+def _chaos_points(points: dict, path: str, data: dict) -> int:
+    """CHAOS_r*.json (tools/loadgen.py --chaos_drill): the containment
+    zero-bands plus the drill's pass/recovery record."""
+    rnd, src = _round_of(path), os.path.basename(path)
+    before = sum(len(v) for v in points.values())
+    zero = data.get("zero_bands") or {}
+    _point(points, "chaos.dropped_during_rollback", rnd, src,
+           zero.get("dropped_during_rollback"))
+    _point(points, "chaos.steady_recompiles", rnd, src,
+           zero.get("steady_recompiles"))
+    _point(points, "chaos.passed", rnd, src,
+           1.0 if data.get("passed") else 0.0)
+    drill = data.get("chaos_drill") or {}
+    ckpt = drill.get("ckpt") or {}
+    _point(points, "chaos.ckpt_bitwise_recovery", rnd, src,
+           1.0 if ckpt.get("bitwise_equal") else 0.0)
+    _point(points, "chaos.breaker_open_criticals", rnd, src,
+           drill.get("breaker_open_criticals"))
+    _point(points, "chaos.injected_faults", rnd, src, drill.get("injected"))
+    return sum(len(v) for v in points.values()) - before
+
+
 _EXTRACTORS = (
     ("BENCH_r*.json", _bench_points),
     ("ROOFLINE_r*.json", _roofline_points),
     ("COMMS_r*.json", _comms_points),
     ("SERVE_r*.json", _serve_points),
+    ("CHAOS_r*.json", _chaos_points),
 )
 
 

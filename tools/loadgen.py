@@ -138,6 +138,20 @@ def parse_args(argv=None):
                         "detector trips a once-latched CRITICAL with "
                         "captures and that a publish re-arms the baseline "
                         "(requires --run_dir)")
+    p.add_argument("--chaos_drill", action="store_true",
+                   help="fault-domain drill on its own engine (ISSUE 12): "
+                        "inject execute faults (circuit breaker must trip "
+                        "once-latched, the tenant must recover through a "
+                        "half-open probe), a poisoned publish (the "
+                        "transactional rollback must hold: registry "
+                        "generation unchanged, zero dropped in-flight "
+                        "requests, zero recompiles), and a corrupted ring "
+                        "slot (resume must quarantine it and continue "
+                        "bitwise from the newest intact slot); drift/SLO "
+                        "latches must re-arm after recovery (requires "
+                        "--run_dir)")
+    p.add_argument("--chaos_artifact", default=None, metavar="PATH",
+                   help="write the CHAOS_r*.json drill artifact here")
     p.add_argument("--slo_profile", action="store_true",
                    help="also attempt a jax.profiler trace in the SLO "
                         "auto-capture (default off: on this image a "
@@ -152,6 +166,8 @@ def parse_args(argv=None):
         p.error("--burn_drill needs --run_dir (captures land there)")
     if args.drift_drill and not args.run_dir:
         p.error("--drift_drill needs --run_dir (captures land there)")
+    if args.chaos_drill and not args.run_dir:
+        p.error("--chaos_drill needs --run_dir (captures land there)")
     return args
 
 
@@ -190,7 +206,7 @@ def make_synthetic_checkpoint(args, tmpdir: str) -> str:
 
 
 def build_engine(args, ckpt: str, scheduler: str, logger=None, slo=None,
-                 drift=None):
+                 drift=None, breaker=None):
     from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
 
     return InferenceEngine.from_checkpoint(
@@ -201,7 +217,7 @@ def build_engine(args, ckpt: str, scheduler: str, logger=None, slo=None,
         default_deadline_s=args.deadline_ms / 1e3,
         scheduler=scheduler, tenant_share=args.tenant_share,
         dp=args.serving_dp,
-        logger=logger, slo=slo, drift=drift,
+        logger=logger, slo=slo, drift=drift, breaker=breaker,
         trace_sample=args.trace_sample,
     )
 
@@ -295,9 +311,17 @@ def _pools(tenants: dict, k: int) -> dict:
 
 def run_closed(engine, pools, concurrency, duration, rng):
     """C synchronous workers round-robining tenants; returns per-tenant
-    latency lists + error count + wall."""
+    latency lists + error count + wall + per-tenant retry counts.
+
+    Backpressure discipline (ISSUE 12 satellite): a ``Saturated`` (or
+    typed ``ExecuteError``) carries ``retry_after_s`` — the worker
+    HONORS it with deterministic jittered backoff (the worker's own
+    seeded rng: hint x U[0.75, 1.25), capped at the remaining phase
+    time) instead of hot-spinning resubmits into a queue that just shed
+    it. Retries are counted per tenant and stamped into the artifact."""
     names = list(pools)
     lat = {t: [] for t in names}
+    retries = {t: 0 for t in names}
     errs = [0]
     stop = time.monotonic() + duration
     lock = threading.Lock()
@@ -307,6 +331,7 @@ def run_closed(engine, pools, concurrency, duration, rng):
 
         r = np.random.default_rng(seed)
         mine = {t: [] for t in names}
+        my_retries = {t: 0 for t in names}
         i = seed
         while time.monotonic() < stop:
             tenant = names[i % len(names)]
@@ -317,12 +342,20 @@ def run_closed(engine, pools, concurrency, duration, rng):
             try:
                 engine.classify(inst, tenant=tenant)
                 mine[tenant].append(time.monotonic() - t0)
-            except Exception:  # noqa: BLE001 — counted, load continues
+            except Exception as e:  # noqa: BLE001 — counted, load continues
                 with lock:
                     errs[0] += 1
+                hint = getattr(e, "retry_after_s", None)
+                if hint is not None:
+                    my_retries[tenant] += 1
+                    delay = float(hint) * (0.75 + 0.5 * float(r.random()))
+                    time.sleep(
+                        max(0.0, min(delay, stop - time.monotonic()))
+                    )
         with lock:
             for t in names:
                 lat[t].extend(mine[t])
+                retries[t] += my_retries[t]
 
     threads = [
         threading.Thread(target=worker, args=(i,)) for i in range(concurrency)
@@ -333,7 +366,7 @@ def run_closed(engine, pools, concurrency, duration, rng):
     for t in threads:
         t.join()
     wall = time.monotonic() - t0
-    return lat, errs[0], wall
+    return lat, errs[0], wall, retries
 
 
 def run_open(engine, pools, rate, duration, rng, swap_at=None, swap_fn=None,
@@ -473,7 +506,7 @@ def drive_one(engine, args, rng, swap_fn=None) -> dict:
     out["parity_ok"] = True
 
     if args.mode in ("closed", "both"):
-        lat, errs, wall = run_closed(
+        lat, errs, wall, retries = run_closed(
             engine, pools, args.concurrency, args.duration, rng
         )
         flat = _flat(lat)
@@ -483,6 +516,10 @@ def drive_one(engine, args, rng, swap_fn=None) -> dict:
             "p50_ms": pct_ms(flat, 50),
             "p99_ms": pct_ms(flat, 99),
             "errors": errs,
+            # Backoff honesty (ISSUE 12 satellite): how often each
+            # tenant's workers were told to retry-after and slept.
+            "retries": sum(retries.values()),
+            "retries_per_tenant": dict(sorted(retries.items())),
             "per_tenant": _per_tenant(lat),
         }
     if args.mode in ("open", "both"):
@@ -825,6 +862,299 @@ def run_drift_drill(args, ckpt, logger, recorder, capture) -> dict:
         engine.close()
 
 
+def _chaos_ckpt_leg(logger) -> dict:
+    """kill -> corrupt-newest-ring-slot -> resume, in-process: a tiny
+    lazy-embed run writes base + delta ring slots (with cursor sidecars),
+    the newest slot is corrupted on disk, and a fresh CheckpointManager —
+    exactly what ``--resume`` builds — must quarantine it and restore the
+    newest INTACT slot bitwise, with the cursor sidecar following."""
+    import jax
+    import numpy as np
+
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.data import (
+        GloveTokenizer,
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.models.build import (
+        batch_to_model_inputs,
+    )
+    from induction_network_on_fewrel_tpu.obs.chaos import corrupt_step_dir
+    from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+    from induction_network_on_fewrel_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+    from induction_network_on_fewrel_tpu.train.steps import (
+        init_state,
+        make_train_step,
+    )
+
+    cfg = ExperimentConfig(
+        encoder="cnn", n=3, k=2, q=2, batch_size=2, max_length=12,
+        vocab_size=202, hidden_size=16, embed_optimizer="lazy",
+        compute_dtype="float32", ckpt_stage="off", device="cpu",
+    )
+    vocab = make_synthetic_glove(vocab_size=cfg.vocab_size - 2)
+    ds = make_synthetic_fewrel(
+        num_relations=6, instances_per_relation=6, vocab_size=35
+    )
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    sampler = EpisodeSampler(
+        ds, tok, cfg.n, cfg.k, cfg.q, cfg.batch_size, seed=3
+    )
+    batches = [
+        batch_to_model_inputs(sampler.sample_batch()) for _ in range(6)
+    ]
+    model = build_model(cfg, glove_init=vocab.vectors)
+    step_fn = make_train_step(model, cfg)
+    state = init_state(model, cfg, batches[0][0], batches[0][1])
+    # np.array COPIES here too: on the CPU backend device_get returns
+    # views of device buffers, and the donating train steps below reuse
+    # that memory — a template whose leaves mutate under the restore
+    # would silently re-type it.
+    template = jax.tree.map(lambda x: np.array(x), jax.device_get(state))
+
+    from pathlib import Path
+
+    work = tempfile.mkdtemp(prefix="chaos_ckpt_")
+    mgr = CheckpointManager(work, cfg, logger=logger)
+    for sup, qry, lab in batches[:2]:
+        state, _ = step_fn(state, sup, qry, lab)
+    mode_base = mgr.save_latest(2, state, cursor={"pos": 2})["mode"]
+    mgr.wait()
+    # np.array COPIES: on the CPU backend device_get returns views of
+    # the device buffers, and the donating train steps below would reuse
+    # that memory — the "surviving state" must not mutate under us.
+    survivor = jax.tree.map(lambda x: np.array(x), jax.device_get(state))
+    for sup, qry, lab in batches[2:4]:
+        state, _ = step_fn(state, sup, qry, lab)
+    mode_delta = mgr.save_latest(4, state, cursor={"pos": 4})["mode"]
+    mgr.wait()
+    mgr.close()    # the "kill": the process owning the run is gone
+
+    corrupted = corrupt_step_dir(Path(work) / "ring_delta" / "4", "bitflip")
+    mgr2 = CheckpointManager(work, cfg, logger=logger)   # the "--resume"
+    restored, step = mgr2.restore_latest(template)
+    mismatched = []
+    for (pa, va), (_, vb) in zip(
+        jax.tree_util.tree_flatten_with_path(survivor)[0],
+        jax.tree_util.tree_flatten_with_path(restored)[0],
+    ):
+        if not np.array_equal(np.asarray(va), np.asarray(vb)):
+            mismatched.append(jax.tree_util.keystr(pa))
+    bitwise = not mismatched
+    cursor = mgr2.load_cursor(step)
+    quarantined = sorted(
+        str(p.relative_to(work))
+        for p in Path(work).rglob("*.quarantined*")
+    )
+    mgr2.close()
+    return {
+        "modes": [mode_base, mode_delta],
+        "corrupted_file": corrupted,
+        "fallback_step": step,
+        "bitwise_equal": bitwise,
+        # Which leaves diverged, when any did — a failing drill must name
+        # the evidence, not just say "False".
+        "mismatched_leaves": mismatched[:8],
+        "cursor_followed": bool(cursor) and cursor.get("pos") == step,
+        "quarantined": quarantined,
+    }
+
+
+def run_chaos_drill(args, ckpt, logger, recorder, capture) -> dict:
+    """The ISSUE 12 fault-domain drill, on its own engine:
+
+    1. execute faults — injected launch failures for tenant0 fail ONLY
+       that batch's futures (typed ExecuteError) and trip its circuit
+       breaker (once-latched CRITICAL breaker_open); the other tenant
+       keeps serving; after the open window a half-open probe recovers
+       the tenant (breaker closed, latch re-armed).
+    2. poisoned publish — an injected NaN publish is refused by the
+       pre-swap validation gate and ROLLS BACK: registry generation
+       unchanged, every tenant on its old snapshot, zero dropped
+       in-flight requests, zero steady-state recompiles; CRITICAL
+       publish_rollback once.
+    3. recovery — a clean publish commits (rollback latch re-arms,
+       drift baseline re-arms) and the tenant's SLO fast-burn latch
+       re-arms once clean traffic drains the window.
+    4. corrupted ring slot — kill/corrupt/resume recovers bitwise from
+       the newest intact slot (``_chaos_ckpt_leg``).
+    """
+    from induction_network_on_fewrel_tpu.obs import (
+        DriftDetector,
+        HealthWatchdog,
+        SLOEngine,
+        SLOObjective,
+    )
+    from induction_network_on_fewrel_tpu.obs.chaos import ChaosRegistry, install
+    from induction_network_on_fewrel_tpu.serving.batcher import (
+        ExecuteError,
+        Saturated,
+    )
+    from induction_network_on_fewrel_tpu.serving.breaker import CircuitBreaker
+    from induction_network_on_fewrel_tpu.serving.registry import PublishError
+
+    THRESHOLD, OPEN_S, FAST_S = 3, 0.6, 0.75
+    watchdog = HealthWatchdog(
+        logger=logger, recorder=recorder, capture=capture
+    )
+    if logger is not None:
+        logger.add_hook(watchdog.observe_record)
+    chaos = ChaosRegistry.parse(
+        f"serve.execute_raise@0*{THRESHOLD}:tenant0,publish.nan_params@0",
+        logger=logger,
+    )
+    chaos.install()
+    breaker = CircuitBreaker(failure_threshold=THRESHOLD, open_s=OPEN_S)
+    slo = SLOEngine(
+        SLOObjective(availability=args.slo_availability,
+                     latency_ms=args.slo_latency_ms),
+        fast_window_s=FAST_S, slow_window_s=10 * FAST_S,
+        logger=logger, recorder=recorder, capture=capture,
+    )
+    drift = DriftDetector(
+        window=16, baseline_n=8, min_count=8, eval_interval_s=0.0,
+        logger=logger, recorder=recorder, capture=capture,
+    )
+    engine = build_engine(args, ckpt, "continuous", logger=logger,
+                          slo=slo, drift=drift, breaker=breaker)
+    out: dict = {"threshold": THRESHOLD, "open_s": OPEN_S}
+    try:
+        tenants = register_tenants(engine, args)
+        engine.warmup()
+        pools = _pools(tenants, args.K)
+        t0 = "tenant0"
+        others = [t for t in pools if t != t0]
+
+        # 1. execute faults -> typed errors -> breaker opens -> shed.
+        exec_errors = shed = 0
+        for i in range(12):
+            try:
+                engine.classify(pools[t0][i % len(pools[t0])], tenant=t0)
+            except ExecuteError:
+                exec_errors += 1
+            except Saturated:
+                shed += 1
+        out["execute_errors"] = exec_errors
+        out["shed_while_open"] = shed
+        out["breaker_opened"] = breaker.state(t0) == "open"
+        other_served = 0
+        for t in others:
+            for i in range(4):
+                v = engine.classify(pools[t][i % len(pools[t])], tenant=t)
+                other_served += "label" in v and not v.get("degraded", False)
+        out["other_tenant_served"] = other_served
+        crits = [e for e in watchdog.events
+                 if e.event == "breaker_open" and e.severity == "critical"]
+        out["breaker_open_criticals"] = len(crits)
+
+        # Recovery: half-open probe after the window.
+        time.sleep(OPEN_S + 0.1)
+        v = engine.classify(pools[t0][0], tenant=t0)
+        out["probe_served"] = "label" in v
+        out["breaker_recovered"] = breaker.state(t0) == "closed"
+
+        # 2. poisoned publish under in-flight load.
+        pv0 = engine.registry.params_version
+        versions0 = {
+            t: engine.registry.snapshot(t).version
+            for t in engine.registry.tenants()
+        }
+        futs = []
+        for i in range(16):
+            t = list(pools)[i % len(pools)]
+            futs.append(engine.submit(
+                pools[t][i % len(pools[t])], tenant=t
+            ))
+        try:
+            engine.publish_params(engine.params)
+            poisoned_raised = False
+        except PublishError as e:
+            poisoned_raised = True
+            out["rollback_reason"] = str(e)[:160]
+        dropped = 0
+        for f in futs:
+            try:
+                f.result(timeout=30.0)
+            except Exception:  # noqa: BLE001 — any failure IS a drop here
+                dropped += 1
+        snap = engine.stats.snapshot()
+        out["rollback"] = {
+            "poisoned_publish_refused": poisoned_raised,
+            "params_version_before": pv0,
+            "params_version_after": engine.registry.params_version,
+            "tenant_snapshots_unchanged": versions0 == {
+                t: engine.registry.snapshot(t).version
+                for t in engine.registry.tenants()
+            },
+            "dropped_during_rollback": dropped,
+            "steady_recompiles": snap["steady_recompiles"],
+            "rollback_criticals": sum(
+                1 for e in watchdog.events
+                if e.event == "publish_rollback"
+            ),
+        }
+
+        # 3. clean publish commits: drift + rollback latch re-arm; SLO
+        # fast-burn latch re-arms once clean traffic drains the window.
+        rearms_before = drift.rearms
+        out["clean_publish_version"] = engine.publish_params(engine.params)
+        out["drift_rearmed"] = drift.rearms == rearms_before + 1
+        out["rollback_latch_rearmed"] = (
+            "publish_rollback" not in watchdog._latched
+        )
+        slo.evaluate()
+        out["slo_tripped_during_faults"] = slo.tripped
+        time.sleep(FAST_S + 0.2)
+        for i in range(15):
+            engine.classify(pools[t0][i % len(pools[t0])], tenant=t0)
+        slo.evaluate()
+        out["slo_rearmed"] = f"slo_burn:{t0}:fast" not in slo._latched
+        out["stats"] = engine.stats.snapshot(
+            queue_depth=engine.batcher.queue_depth
+        )
+    finally:
+        engine.close()
+        install(None)
+
+    # 4. kill -> corrupt -> resume (its own tiny training world).
+    out["ckpt"] = _chaos_ckpt_leg(logger)
+    out["ckpt_corrupt_criticals"] = sum(
+        1 for e in watchdog.events if e.event == "ckpt_corrupt"
+    )
+    out["injected"] = len(chaos.fired_log)
+    return out
+
+
+def check_chaos_drill(drill: dict) -> bool:
+    """The drill's acceptance: inject -> contain -> recover, all held."""
+    rb = drill.get("rollback", {})
+    return bool(
+        drill.get("breaker_opened")
+        and drill.get("breaker_open_criticals") == 1
+        and drill.get("execute_errors", 0) >= 1
+        and drill.get("other_tenant_served", 0) >= 1
+        and drill.get("probe_served")
+        and drill.get("breaker_recovered")
+        and rb.get("poisoned_publish_refused")
+        and rb.get("params_version_before") == rb.get("params_version_after")
+        and rb.get("tenant_snapshots_unchanged")
+        and rb.get("dropped_during_rollback") == 0
+        and rb.get("steady_recompiles") == 0
+        and rb.get("rollback_criticals") == 1
+        and drill.get("drift_rearmed")
+        and drill.get("rollback_latch_rearmed")
+        and drill.get("slo_rearmed")
+        and drill.get("ckpt", {}).get("bitwise_equal")
+        and drill.get("ckpt", {}).get("cursor_followed")
+        and drill.get("ckpt", {}).get("quarantined")
+        and drill.get("ckpt_corrupt_criticals", 0) >= 1
+    )
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     import numpy as np
@@ -965,6 +1295,50 @@ def main(argv=None) -> int:
                       "re-arm as required", file=sys.stderr)
                 rc = 1
 
+        chaos_drill_result = None
+        if args.chaos_drill:
+            drill = run_chaos_drill(args, ckpt, logger, recorder, capture)
+            chaos_drill_result = drill
+            ok = check_chaos_drill(drill)
+            rb = drill.get("rollback", {})
+            print(f"[chaos drill] breaker: opened={drill.get('breaker_opened')} "
+                  f"criticals={drill.get('breaker_open_criticals')} "
+                  f"recovered={drill.get('breaker_recovered')}; "
+                  f"rollback: refused={rb.get('poisoned_publish_refused')} "
+                  f"dropped={rb.get('dropped_during_rollback')} "
+                  f"recompiles={rb.get('steady_recompiles')}; "
+                  f"rearm: drift={drill.get('drift_rearmed')} "
+                  f"slo={drill.get('slo_rearmed')} "
+                  f"rollback_latch={drill.get('rollback_latch_rearmed')}; "
+                  f"ckpt: fallback_step={drill.get('ckpt', {}).get('fallback_step')} "
+                  f"bitwise={drill.get('ckpt', {}).get('bitwise_equal')}")
+            if not ok:
+                print("FAIL[chaos drill]: containment did not hold as "
+                      "required", file=sys.stderr)
+                rc = 1
+            if args.chaos_artifact:
+                artifact = {
+                    "config": {
+                        "tenants": args.tenants, "N": args.N, "K": args.K,
+                        "device": args.device, "seed": args.seed,
+                        "threshold": drill.get("threshold"),
+                        "open_s": drill.get("open_s"),
+                    },
+                    "chaos_drill": drill,
+                    "passed": ok,
+                    # The zero-bands tools/bench_trend.py folds: a
+                    # containment regression (a dropped request during
+                    # rollback, a steady-state recompile) fails --check.
+                    "zero_bands": {
+                        "dropped_during_rollback":
+                            rb.get("dropped_during_rollback"),
+                        "steady_recompiles": rb.get("steady_recompiles"),
+                    },
+                }
+                with open(args.chaos_artifact, "w") as f:
+                    json.dump(artifact, f, indent=1)
+                print(f"wrote {args.chaos_artifact}", file=sys.stderr)
+
         report = {
             "config": {
                 "tenants": args.tenants, "N": args.N, "K": args.K,
@@ -977,6 +1351,7 @@ def main(argv=None) -> int:
                 "trace_sample": args.trace_sample,
                 "burn_drill": bool(args.burn_drill),
                 "drift_drill": bool(args.drift_drill),
+                "chaos_drill": bool(args.chaos_drill),
                 "slo_latency_ms": args.slo_latency_ms,
                 "slo_availability": args.slo_availability,
             },
@@ -984,6 +1359,8 @@ def main(argv=None) -> int:
         }
         if drift_drill_result is not None:
             report["drift_drill"] = drift_drill_result
+        if chaos_drill_result is not None:
+            report["chaos_drill"] = chaos_drill_result
         if "continuous" in results and "microbatch" in results:
             c, m = results["continuous"], results["microbatch"]
             comparison = {}

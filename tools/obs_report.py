@@ -690,6 +690,62 @@ def scenario_summary(recs: list[dict]) -> dict | None:
     return out
 
 
+def fault_summary(recs: list[dict]) -> dict | None:
+    """Fault-domain section (ISSUE 12, kind="fault"): injections
+    (obs/chaos.py, action="inject") next to the containment they
+    provoked — checkpoint quarantines, circuit-breaker transitions
+    (with each tenant's LAST state), publish rollbacks, degraded-mode
+    verdicts. The fault criticals (ckpt_corrupt / breaker_open /
+    publish_rollback) appear in the health section; this section is the
+    action-level ledger."""
+    faults = [r for r in recs if r.get("kind") == "fault"]
+    if not faults:
+        return None
+    by_action: dict[str, int] = {}
+    for r in faults:
+        a = str(r.get("action"))
+        by_action[a] = by_action.get(a, 0) + 1
+    out: dict = {"records": len(faults), "by_action": by_action}
+    injected = [r for r in faults if r.get("action") == "inject"]
+    if injected:
+        by_point: dict[str, int] = {}
+        for r in injected:
+            p = str(r.get("point"))
+            by_point[p] = by_point.get(p, 0) + 1
+        out["injected_by_point"] = by_point
+    quarantines = [r for r in faults if r.get("action") == "ckpt_quarantine"]
+    if quarantines:
+        out["quarantined_slots"] = [
+            f"{q.get('ckpt_kind')}/{int(q.get('ckpt_step', 0))}: "
+            f"{q.get('reason')}"
+            for q in quarantines[-3:]
+        ]
+    transitions = [r for r in faults if r.get("action") == "breaker"]
+    if transitions:
+        last_state: dict[str, str] = {}
+        opens = 0
+        for r in transitions:
+            last_state[str(r.get("tenant"))] = str(r.get("to"))
+            opens += r.get("to") == "open"
+        out["breaker_opens"] = opens
+        out["breaker_last_state"] = dict(sorted(last_state.items()))
+    rollbacks = [r for r in faults if r.get("action") == "publish_rollback"]
+    if rollbacks:
+        out["publish_rollbacks"] = len(rollbacks)
+        out["last_rollback"] = str(rollbacks[-1].get("reason"))
+    exec_errs = [r for r in faults if r.get("action") == "execute_error"]
+    if exec_errs:
+        out["execute_error_requests"] = int(sum(
+            float(r.get("requests", 0)) for r in exec_errs
+        ))
+    degraded = [r for r in faults if r.get("action") == "degraded_verdicts"]
+    if degraded:
+        out["degraded_verdicts"] = int(sum(
+            float(r.get("served", 0)) for r in degraded
+        ))
+    return out
+
+
 def health_summary(recs: list[dict]) -> dict:
     events = [r for r in recs if r.get("kind") == "health"]
     by_event: dict[str, int] = {}
@@ -819,7 +875,7 @@ def render(report: dict) -> str:
     for e in errors[:10]:
         lines.append(f"  ! {e}")
     for section in ("train", "mfu", "eval", "perf", "compile", "serve",
-                    "traces", "slo", "quality", "scenarios",
+                    "faults", "traces", "slo", "quality", "scenarios",
                     "ckpt", "input_pipeline", "comms", "roofline", "health",
                     "flight_recorder", "overhead"):
         body = report.get(section)
@@ -886,6 +942,7 @@ def main(argv=None) -> int:
         "perf": perf_summary(recs),
         "compile": compile_summary(recs),
         "serve": serve_summary(recs),
+        "faults": fault_summary(recs),
         "traces": trace_summary(recs),
         "slo": slo_summary(recs),
         "quality": quality_summary(recs),
