@@ -1,0 +1,44 @@
+"""Fleet tier (ISSUE 13): multi-replica serving router + control plane.
+
+* ``placement``  — deterministic rendezvous tenant->replica placement
+  with replica health states (bounded remap on membership changes).
+* ``router``     — the submit front door: placement resolution, fleet-
+  level shed fairness, TraceContext propagation across the hop,
+  failover to degraded NOTA verdicts, per-replica circuit breaker as
+  the health feed. ``InProcessReplica`` is the tier-1/CPU transport.
+* ``control``    — tenant lifecycle routed to owners + the
+  all-or-nothing fan-out publish over the registry's two-phase
+  prepare/commit (any replica's refusal rolls the whole fleet back).
+* ``transport``  — the same ``ReplicaHandle`` interface over JSON-lines
+  sockets for real multi-process replicas.
+"""
+
+from induction_network_on_fewrel_tpu.fleet.control import (
+    FleetControl,
+    FleetPublishError,
+)
+from induction_network_on_fewrel_tpu.fleet.placement import (
+    DEAD,
+    DRAINING,
+    UP,
+    FleetPlacement,
+    placement_score,
+)
+from induction_network_on_fewrel_tpu.fleet.router import (
+    FleetRouter,
+    InProcessReplica,
+    ReplicaHandle,
+)
+
+__all__ = [
+    "DEAD",
+    "DRAINING",
+    "UP",
+    "FleetControl",
+    "FleetPlacement",
+    "FleetPublishError",
+    "FleetRouter",
+    "InProcessReplica",
+    "ReplicaHandle",
+    "placement_score",
+]

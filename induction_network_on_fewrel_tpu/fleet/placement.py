@@ -1,0 +1,153 @@
+"""Tenant -> replica placement: deterministic rendezvous hashing with
+replica health states (ISSUE 13 tentpole, piece a).
+
+**Rendezvous (highest-random-weight) hashing** — every (tenant, replica)
+pair hashes to a 64-bit score (blake2b of ``"tenant|replica"``; no RNG,
+no process state) and the tenant is owned by the LIVE replica with the
+highest score. Two properties make it the right placement primitive for
+a 10k-tenant fleet:
+
+* **Determinism** — placement is a pure function of (tenant id, live
+  replica set). Every router process, every restart, every test replays
+  the same map; there is no placement table to replicate or lose.
+* **Bounded remap** — adding a replica moves exactly the tenants whose
+  new scores win (expectation T/(R+1), the minimum any balanced scheme
+  can move); removing one moves exactly ITS tenants and nobody else's
+  (every surviving pair's score is unchanged, so every surviving argmax
+  is unchanged). Both bounds are test-pinned in tests/test_fleet.py.
+
+**Health states** — ``up`` (eligible), ``draining`` (operator-initiated:
+excluded from placement so its tenants remap away at the rendezvous
+bound, while the process keeps serving whatever is still in flight) and
+``dead`` (excluded; fed by the router's per-replica circuit breaker —
+the existing serving/breaker.CircuitBreaker keyed by replica id — or by
+the ``fleet.replica_kill`` chaos point). Dead/draining replicas stay in
+the table so a revive is one state flip with the same bounded remap.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+UP = "up"
+DRAINING = "draining"
+DEAD = "dead"
+
+_STATES = (UP, DRAINING, DEAD)
+
+
+def placement_score(tenant: str, replica: str) -> int:
+    """The rendezvous weight of one (tenant, replica) pair: a 64-bit
+    digest of the joined ids. Pure and process-independent — every
+    router, restart, and test computes the same score."""
+    h = hashlib.blake2b(
+        f"{tenant}|{replica}".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(h, "big")
+
+
+class FleetPlacement:
+    """The fleet's replica table + the rendezvous placement function.
+
+    Thread-safety: the router resolves placement on client threads while
+    breaker transitions / control-plane ops mutate states — one lock,
+    no I/O under it. ``place`` is two dict reads plus R hash calls (R =
+    replicas, single digits to low tens); at fleet scale the per-submit
+    cost is placement-table-free by design.
+    """
+
+    def __init__(self, replicas=()):
+        self._lock = threading.Lock()
+        self._states: dict[str, str] = {}
+        # Monotonic generation: bumped on every membership/state change,
+        # so callers (router owner cache, reports) can cheaply detect
+        # that placements may have moved.
+        self.generation = 0
+        for rid in replicas:
+            self._states[str(rid)] = UP
+
+    # --- membership / health ---------------------------------------------
+
+    def add_replica(self, replica: str, state: str = UP) -> None:
+        self._set(replica, state, must_exist=False)
+
+    def set_state(self, replica: str, state: str) -> None:
+        self._set(replica, state, must_exist=True)
+
+    def _set(self, replica: str, state: str, must_exist: bool) -> None:
+        if state not in _STATES:
+            raise ValueError(
+                f"unknown replica state {state!r} (one of {_STATES})"
+            )
+        with self._lock:
+            if must_exist and replica not in self._states:
+                raise ValueError(f"unknown replica {replica!r}")
+            if self._states.get(replica) == state:
+                return
+            self._states[replica] = state
+            self.generation += 1
+
+    def remove_replica(self, replica: str) -> None:
+        with self._lock:
+            if replica not in self._states:
+                raise ValueError(f"unknown replica {replica!r}")
+            del self._states[replica]
+            self.generation += 1
+
+    def state(self, replica: str) -> str | None:
+        with self._lock:
+            return self._states.get(replica)
+
+    def replicas(self) -> tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._states))
+
+    def live(self) -> tuple[str, ...]:
+        with self._lock:
+            return tuple(
+                sorted(r for r, s in self._states.items() if s == UP)
+            )
+
+    def states(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._states)
+
+    # --- placement --------------------------------------------------------
+
+    def place(self, tenant: str) -> str | None:
+        """The live replica owning ``tenant`` (highest rendezvous score),
+        or None when no replica is up. Ties (astronomically unlikely at
+        64 bits) break toward the lexically-smallest id so the map stays
+        a pure function of the inputs."""
+        with self._lock:
+            live = [r for r, s in self._states.items() if s == UP]
+        if not live:
+            return None
+        return max(
+            sorted(live), key=lambda r: placement_score(tenant, r)
+        )
+
+    def owners(self, tenants) -> dict[str, str | None]:
+        """Batch placement (one lock acquisition, one live-set)."""
+        with self._lock:
+            live = sorted(
+                r for r, s in self._states.items() if s == UP
+            )
+        if not live:
+            return {t: None for t in tenants}
+        return {
+            t: max(live, key=lambda r: placement_score(t, r))
+            for t in tenants
+        }
+
+    @staticmethod
+    def churn(before: dict[str, str | None],
+              after: dict[str, str | None]) -> int:
+        """Tenants whose owner changed between two placement maps — the
+        remap cost of a membership change (FLEET artifacts record it as
+        a fraction of tenants; the rendezvous bound is what the tests
+        pin)."""
+        return sum(
+            1 for t, r in before.items() if after.get(t) != r
+        )

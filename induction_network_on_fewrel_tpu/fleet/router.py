@@ -1,0 +1,603 @@
+"""Fleet router: one submit front door over N engine replicas (ISSUE 13
+tentpole, piece b).
+
+The router is the data plane of the fleet tier. Per submit it:
+
+1. resolves the tenant's owning replica through the deterministic
+   rendezvous placement (fleet/placement.py) — no placement table, no
+   coordination; every router instance computes the same owner;
+2. enforces **fleet-level shed-load fairness** on top of per-replica
+   backpressure: a tenant over its fleet-wide in-flight share sheds at
+   the router door (``Saturated(tenant=...)``) before touching any
+   replica queue — one hot tenant cannot monopolize the fleet's combined
+   admission capacity even when its owner replica still has room;
+3. propagates a ``TraceContext`` across the hop: the router's head
+   sampler mints the context, opens the ``fleet/route`` span, and hands
+   the SAME context to the replica's submit path — the replica-side
+   queue/pack/execute/respond segments join the router's trace id, so a
+   fleet waterfall reads end to end;
+4. **fails over**: a replica marked dead (its per-replica circuit
+   breaker — the existing serving/breaker.CircuitBreaker keyed by
+   replica id — opening on consecutive launch failures, or the
+   ``fleet.replica_kill`` chaos point) drops out of placement; its
+   tenants' traffic gets immediate degraded-mode NOTA verdicts (the
+   honest "I cannot place this" answer, zero device time) until the
+   control plane re-places them onto their new rendezvous owners
+   (fleet/control.FleetControl.replace_tenants).
+
+Replica transports: ``InProcessReplica`` wraps an ``InferenceEngine`` in
+this process (tier-1 / CPU drills); ``fleet/transport.py`` puts the SAME
+``ReplicaHandle`` interface over a JSON-lines socket for real
+multi-process runs. The router is transport-agnostic by construction.
+
+Telemetry: ``kind="fleet"`` records (utils/metrics.KNOWN_KINDS schema
+doc) — one aggregate record per emit, one per-replica record (``replica``
+str field) restating that replica's serving counters, and event records
+(``event="fanout_publish"`` / ``"replica_dead"`` / ``"replace"`` ...)
+for control-plane actions. Replica-death containment also emits
+``kind="fault"`` ``action="replica_dead"`` records, which the health
+watchdog latches as once-per-replica CRITICALs (re-armed by recovery).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+from induction_network_on_fewrel_tpu.obs.chaos import (
+    chaos_active,
+    chaos_fire,
+)
+from induction_network_on_fewrel_tpu.obs.spans import TraceSampler, get_tracker
+from induction_network_on_fewrel_tpu.fleet.placement import (
+    DEAD,
+    DRAINING,
+    UP,
+    FleetPlacement,
+)
+from induction_network_on_fewrel_tpu.serving.batcher import Saturated
+
+
+class ReplicaHandle:
+    """The transport-agnostic replica interface the router and control
+    plane speak. ``InProcessReplica`` (below) backs it with an engine in
+    this process; ``fleet/transport.SocketReplica`` backs it with a
+    JSON-lines socket to another process. Every method is synchronous
+    except ``submit``, which returns a Future."""
+
+    replica_id: str
+
+    # data plane
+    def submit(self, instance, deadline_s=None, tenant="default",
+               trace=None) -> Future:
+        raise NotImplementedError
+
+    # control plane
+    def register_dataset(self, dataset, tenant, max_classes=None):
+        raise NotImplementedError
+
+    def set_nota_threshold(self, threshold, tenant):
+        raise NotImplementedError
+
+    def quarantine_tenant(self, tenant, reason=""):
+        raise NotImplementedError
+
+    def unquarantine_tenant(self, tenant, reason=""):
+        raise NotImplementedError
+
+    def drop_tenant(self, tenant):
+        raise NotImplementedError
+
+    # two-phase publish (fleet fan-out)
+    def prepare_publish(self, params=None, ckpt_dir=None):
+        raise NotImplementedError
+
+    def commit_publish(self, txn) -> int:
+        raise NotImplementedError
+
+    def abort_publish(self, txn) -> None:
+        raise NotImplementedError
+
+    # observability / lifecycle
+    @property
+    def params_version(self) -> int:
+        raise NotImplementedError
+
+    def stats_snapshot(self) -> dict:
+        raise NotImplementedError
+
+    def warmup(self) -> int:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class InProcessReplica(ReplicaHandle):
+    """One engine replica in this process — the tier-1/CPU transport.
+    The engine keeps its own batcher worker, breaker, stats, and
+    registry; the handle only adapts the interface."""
+
+    def __init__(self, replica_id: str, engine):
+        self.replica_id = str(replica_id)
+        self.engine = engine
+
+    def submit(self, instance, deadline_s=None, tenant="default",
+               trace=None) -> Future:
+        return self.engine.submit(
+            instance, deadline_s, tenant=tenant, trace=trace
+        )
+
+    def register_dataset(self, dataset, tenant, max_classes=None):
+        return self.engine.register_dataset(
+            dataset, max_classes=max_classes, tenant=tenant
+        )
+
+    def set_nota_threshold(self, threshold, tenant):
+        self.engine.set_nota_threshold(threshold, tenant=tenant)
+
+    def quarantine_tenant(self, tenant, reason=""):
+        self.engine.quarantine_tenant(tenant, reason=reason)
+
+    def unquarantine_tenant(self, tenant, reason=""):
+        self.engine.unquarantine_tenant(tenant, reason=reason)
+
+    def drop_tenant(self, tenant):
+        self.engine.registry.drop_tenant(tenant)
+
+    def prepare_publish(self, params=None, ckpt_dir=None):
+        if params is None:
+            if ckpt_dir is None:
+                raise ValueError("prepare_publish needs params or ckpt_dir")
+            from induction_network_on_fewrel_tpu.serving.registry import (
+                load_params,
+            )
+
+            params = load_params(ckpt_dir)
+        return self.engine.prepare_publish(params)
+
+    def commit_publish(self, txn) -> int:
+        return self.engine.commit_publish(txn)
+
+    def abort_publish(self, txn) -> None:
+        txn.abort()
+
+    @property
+    def params_version(self) -> int:
+        return self.engine.registry.params_version
+
+    def stats_snapshot(self) -> dict:
+        return self.engine.stats.snapshot(
+            queue_depth=self.engine.batcher.queue_depth
+        )
+
+    def warmup(self) -> int:
+        return self.engine.warmup()
+
+    def close(self) -> None:
+        self.engine.close()
+
+
+class _TenantEntry:
+    """The router's per-tenant directory row: where the tenant is
+    REGISTERED (vs where placement currently points — a mismatch is a
+    pending re-placement served degraded), plus everything needed to
+    re-register it on a new owner after failover: the support source,
+    the NOTA threshold, the quarantine flag."""
+
+    __slots__ = ("owner", "source", "max_classes", "nota_threshold",
+                 "quarantined")
+
+    def __init__(self, owner, source, max_classes=None):
+        self.owner = owner
+        self.source = source
+        self.max_classes = max_classes
+        self.nota_threshold = None
+        self.quarantined = False
+
+
+class FleetRouter:
+    """Submit front door + replica health + the fleet tenant directory.
+
+    ``fleet_share`` bounds one tenant's fleet-wide IN-FLIGHT requests to
+    that fraction of the fleet's combined queue capacity (sum of replica
+    ``max_queue_depth``). Like the per-replica tenant share it binds
+    only once a second tenant has submitted — a single-tenant fleet
+    keeps full capacity.
+    """
+
+    def __init__(
+        self,
+        replicas: dict[str, ReplicaHandle],
+        logger=None,
+        breaker=None,
+        fleet_share: float = 0.5,
+        trace_sample: float = 0.0,
+        queue_capacity_per_replica: int = 64,
+    ):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.replicas: dict[str, ReplicaHandle] = dict(replicas)
+        self.placement = FleetPlacement(self.replicas)
+        self._logger = logger
+        self._tracer = TraceSampler(trace_sample)
+        self.fleet_share = fleet_share
+        self._capacity_per_replica = queue_capacity_per_replica
+        # Per-replica circuit breaker: serving/breaker.CircuitBreaker
+        # keyed by REPLICA id — consecutive forwarded-launch failures
+        # open it, the open transition marks the replica dead in
+        # placement (the ISSUE 13 health feed), a later closed
+        # transition marks it back up.
+        self.breaker = breaker
+        if breaker is not None:
+            breaker.on_transition = self._on_breaker_transition
+        self._lock = threading.Lock()
+        self.directory: dict[str, _TenantEntry] = {}
+        self._inflight: dict[str, int] = {}
+        self._seen: set[str] = set()
+        # Counters (all under _lock).
+        self.submitted = 0
+        self.routed: dict[str, int] = {r: 0 for r in self.replicas}
+        self.degraded_served = 0      # failover NOTA verdicts from HERE
+        self.shed = 0                 # fleet-share sheds at the door
+        self.replica_deaths = 0
+        self.replaced = 0             # tenants re-registered after a
+        #                               membership/health change (churn)
+        self._emit_step = 0
+
+    # --- capacity / fairness ----------------------------------------------
+
+    def _fleet_capacity(self) -> int:
+        n_live = max(1, len(self.placement.live()))
+        return n_live * self._capacity_per_replica
+
+    def _tenant_cap(self) -> int:
+        return max(1, int(self._fleet_capacity() * self.fleet_share))
+
+    # --- data plane -------------------------------------------------------
+
+    def submit(self, instance, deadline_s=None, tenant="default") -> Future:
+        """Route one query to its owning replica. Raises ``ValueError``
+        for unregistered tenants, ``Saturated`` at the fleet-share bound
+        or with no live replica; returns the replica's Future (or an
+        immediately-resolved degraded verdict during failover)."""
+        entry = self.directory.get(tenant)
+        if entry is None:
+            raise ValueError(
+                f"unknown tenant {tenant!r} — register it through the "
+                f"fleet control plane first"
+            )
+        if chaos_active():
+            owner_now = entry.owner
+            if owner_now is not None and chaos_fire(
+                "fleet.replica_kill", replica=owner_now,
+                step=self.submitted,
+            ) is not None:
+                self.mark_replica_dead(owner_now, reason="chaos")
+        target = self.placement.place(tenant)
+        if target is None:
+            raise Saturated(1.0)   # no live replica: back off, retry
+        with self._lock:
+            self.submitted += 1
+            self._seen.add(tenant)
+            if (len(self._seen) > 1
+                    and self._inflight.get(tenant, 0) >= self._tenant_cap()):
+                self.shed += 1
+                raise Saturated(0.05, tenant=tenant)
+            # RESERVE the in-flight slot under the SAME lock as the cap
+            # check — check-then-act across two lock sections would let
+            # N concurrent submitters all pass the check at cap-1 and
+            # overshoot the share by the caller concurrency. Every exit
+            # below that does not hand back a replica future releases
+            # the reservation.
+            self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+        reserved = True
+        probe = False
+        try:
+            if entry.owner != target:
+                if self._admit_recovery_probe(entry.owner):
+                    probe = True
+                    # The owner's breaker OPEN window elapsed: route THIS
+                    # request to it as the half-open recovery probe instead
+                    # of a degraded verdict. Success closes the breaker,
+                    # whose closed transition revives the replica in
+                    # placement — a transient-failure replica heals itself
+                    # without operator re-placement; failure re-opens the
+                    # window and the next requests go back to degraded.
+                    target = entry.owner
+                elif (entry.owner in self.replicas
+                      and self.placement.state(entry.owner)
+                      not in (None, DEAD)):
+                    # A MEMBERSHIP change (replica add / drain) moved
+                    # the tenant's rendezvous placement while its
+                    # registered owner is still alive and holds the
+                    # support set: keep serving CORRECT verdicts from
+                    # the registration until control.replace_tenants()
+                    # moves it. Degraded NOTA is reserved for a dead
+                    # owner — the case with nothing left to ask.
+                    target = entry.owner
+                else:
+                    # Pending re-placement with the owner DEAD (or
+                    # removed): honest degraded NOTA, zero device time,
+                    # until control.replace_tenants() re-registers.
+                    return self._degraded_future(tenant)
+            trace = self._tracer.maybe_trace()
+            handle = self.replicas[target]
+            try:
+                if trace is not None:
+                    tracker = get_tracker()
+                    with tracker.trace(trace):
+                        with tracker.span("fleet/route", xplane=False,
+                                          tenant=tenant, replica=target):
+                            fut = handle.submit(
+                                instance, deadline_s, tenant=tenant,
+                                trace=trace,
+                            )
+                else:
+                    fut = handle.submit(instance, deadline_s, tenant=tenant)
+            except Saturated:
+                # Per-replica backpressure re-raises as-is — EXCEPT on
+                # an admitted recovery probe, whose slot MUST record an
+                # outcome or the breaker wedges half-open with no path
+                # back. A saturated replica answered, but "queue full"
+                # is not probe success: record failure (re-opens the
+                # window; the next window probes again).
+                if probe and self.breaker is not None:
+                    self.breaker.record_failure(target)
+                raise
+            except BaseException:
+                # A transport/submit failure (socket down, closed batcher)
+                # counts against the replica's breaker — enough of them
+                # opens it and placement routes around. Same owner guard
+                # as _on_done: a straggler that read the OLD owner just
+                # before replace_tenants() flipped it (the replica then
+                # refuses the dropped tenant synchronously) must not
+                # count against the healthy replica — except a probe,
+                # whose consumed slot must always record.
+                if self.breaker is not None and (
+                        probe or entry.owner == target):
+                    self.breaker.record_failure(target)
+                raise
+            with self._lock:
+                self.routed[target] = self.routed.get(target, 0) + 1
+            # Hand the reservation to the done callback BEFORE attaching
+            # it — an already-resolved future fires the callback
+            # synchronously, and the finally below must not release a
+            # second time.
+            reserved = False
+            fut.add_done_callback(
+                lambda f, t=tenant, r=target, p=probe:
+                    self._on_done(f, t, r, probe=p)
+            )
+            return fut
+        finally:
+            if reserved:
+                self._release_inflight(tenant)
+
+    def classify(self, instance, deadline_s=None, tenant="default") -> dict:
+        fut = self.submit(instance, deadline_s, tenant=tenant)
+        return fut.result(timeout=(deadline_s or 30.0) + 30.0)
+
+    def _release_inflight(self, tenant: str) -> None:
+        with self._lock:
+            n = self._inflight.get(tenant, 1) - 1
+            if n <= 0:
+                self._inflight.pop(tenant, None)
+            else:
+                self._inflight[tenant] = n
+
+    def _on_done(self, fut: Future, tenant: str, replica: str,
+                 probe: bool = False) -> None:
+        self._release_inflight(tenant)
+        if self.breaker is None:
+            return
+        exc = fut.exception()
+        if exc is None:
+            self.breaker.record_success(replica)
+            return
+        if probe:
+            # An admitted half-open probe consumed the breaker's single
+            # probe slot — EVERY failed outcome (deadline miss included)
+            # must be recorded, else the breaker wedges half-open
+            # forever and the replica can never be probed again.
+            self.breaker.record_failure(replica)
+            return
+        from induction_network_on_fewrel_tpu.serving.batcher import (
+            ExecuteError,
+        )
+
+        # ExecuteError = the replica's launch failed; OSError (incl.
+        # ConnectionError from a dead SocketReplica process — raised
+        # in the transport's pool thread, so it surfaces HERE via
+        # the future, never via submit's synchronous except) = the
+        # replica itself is unreachable. Both count. Deadline
+        # misses and Saturated do not — they are load, not health.
+        if isinstance(exc, (ExecuteError, OSError)):
+            # Attribute the failure only while ``replica`` is still
+            # the tenant's REGISTERED owner: after replace_tenants()
+            # flips the registration, requests still queued on the
+            # old (healthy) replica fail typed-retryable when its
+            # tenant state is dropped — those stragglers must not
+            # open the old replica's breaker and cascade a false
+            # replica death.
+            entry = self.directory.get(tenant)
+            if entry is not None and entry.owner == replica:
+                self.breaker.record_failure(replica)
+
+    def _degraded_future(self, tenant: str) -> Future:
+        """An immediately-resolved degraded NOTA verdict — the fleet's
+        failover answer while the tenant awaits re-placement. The shape
+        is serving/engine.degraded_verdict (ONE home with the engine's
+        quarantine path); ``failover=True`` lets clients (and the
+        quality stream, which excludes degraded verdicts) tell
+        router-side failover from a replica-side quarantine."""
+        from induction_network_on_fewrel_tpu.serving.engine import (
+            degraded_verdict,
+        )
+
+        fut: Future = Future()
+        fut.set_result(degraded_verdict(tenant, failover=True))
+        with self._lock:
+            self.degraded_served += 1
+        return fut
+
+    # --- replica health ---------------------------------------------------
+
+    def _admit_recovery_probe(self, replica: str | None) -> bool:
+        """True when ``replica`` is a breaker-opened DEAD replica whose
+        open window has elapsed and the breaker admits a half-open
+        probe. Chaos/operator-killed replicas (breaker still closed)
+        never probe — their recovery path is revive + re-placement, and
+        auto-routing traffic back would defeat the kill drill's
+        semantics."""
+        if (self.breaker is None or replica is None
+                or replica not in self.replicas
+                or self.placement.state(replica) != DEAD):
+            return False
+        from induction_network_on_fewrel_tpu.serving.breaker import (
+            CLOSED as BRK_CLOSED,
+        )
+
+        if self.breaker.state(replica) == BRK_CLOSED:
+            return False
+        return self.breaker.admit(replica) is None
+
+    def _on_breaker_transition(self, replica, frm, to, failures, now):
+        """The per-replica breaker IS the health feed: open -> dead
+        (placement routes around, tenants fail over), closed -> up.
+        Also mirrored as kind='fault' action='breaker' records so the
+        existing watchdog latch (breaker_open, keyed by the 'tenant'
+        field = replica id here) applies unchanged."""
+        if self._logger is not None:
+            self._logger.log(
+                self.submitted, kind="fault", action="breaker",
+                tenant=f"replica:{replica}", **{"from": frm, "to": to},
+                failures=float(failures),
+            )
+        if to == "open":
+            self.mark_replica_dead(
+                replica, reason=f"breaker open after {failures} failures"
+            )
+        elif to == "closed" and self.placement.state(replica) == DEAD:
+            self.revive_replica(replica, reason="breaker closed")
+
+    def mark_replica_dead(self, replica: str, reason: str = "") -> None:
+        if self.placement.state(replica) == DEAD:
+            return
+        self.placement.set_state(replica, DEAD)
+        with self._lock:
+            self.replica_deaths += 1
+            affected = sum(
+                1 for e in self.directory.values() if e.owner == replica
+            )
+        if self._logger is not None:
+            self._logger.log(
+                self.submitted, kind="fault", action="replica_dead",
+                replica=replica, reason=reason or "operator",
+                tenants=float(affected),
+            )
+
+    def revive_replica(self, replica: str, reason: str = "") -> None:
+        if self.placement.state(replica) == UP:
+            return
+        self.placement.set_state(replica, UP)
+        # Stale-params check: a replica that missed a fan-out publish
+        # while dead (control._publish_targets excludes DEAD replicas)
+        # re-enters placement at an OLD generation — surfaced LOUDLY
+        # here at revive time, not silently discovered at the next
+        # fan-out's version-skew record. The control plane holds no
+        # params to auto-re-publish with (RUNBOOK §18: revive →
+        # re-publish is the operator recipe); this record is the
+        # enforcement hook.
+        if self._logger is not None:
+            try:
+                mine = self.replicas[replica].params_version
+                peers = [
+                    h.params_version
+                    for rid, h in self.replicas.items()
+                    if rid != replica and self.placement.state(rid) == UP
+                ]
+            except Exception:  # noqa: BLE001 — an unreachable peer
+                mine, peers = None, []
+            if mine is not None and peers and mine < max(peers):
+                self._logger.log(
+                    self.submitted, kind="fault",
+                    action="replica_stale_params", replica=replica,
+                    params_version=float(mine),
+                    fleet_version=float(max(peers)),
+                )
+            self._logger.log(
+                self.submitted, kind="fault", action="replica_recover",
+                replica=replica, reason=reason or "operator",
+            )
+
+    def drain_replica(self, replica: str) -> None:
+        self.placement.set_state(replica, DRAINING)
+
+    def pending_failover(self) -> tuple[str, ...]:
+        """Tenants whose registered owner differs from their current
+        placement — the set ``control.replace_tenants()`` will move."""
+        # Snapshot under _lock: the control plane inserts directory
+        # entries (register_tenant) from other threads, and a CPython
+        # dict raises mid-iteration when it grows underneath us.
+        with self._lock:
+            entries = list(self.directory.items())
+        owners = self.placement.owners([t for t, _ in entries])
+        return tuple(sorted(
+            t for t, e in entries
+            if owners.get(t) is not None and owners[t] != e.owner
+        ))
+
+    # --- observability ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        states = self.placement.states()
+        pending = len(self.pending_failover())
+        with self._lock:
+            return {
+                "replicas": len(self.replicas),
+                "live": sum(1 for s in states.values() if s == UP),
+                "dead": sum(1 for s in states.values() if s == DEAD),
+                "tenants": len(self.directory),
+                "submitted": self.submitted,
+                "shed": self.shed,
+                "degraded_served": self.degraded_served,
+                "replica_deaths": self.replica_deaths,
+                "replaced": self.replaced,
+                "pending_failover": pending,
+                "inflight": sum(self._inflight.values()),
+            }
+
+    def emit_stats(self, step: int | None = None) -> None:
+        """One aggregate ``kind="fleet"`` record + one per-replica record
+        (``replica`` field) restating that replica's serving counters —
+        the fleet section of tools/obs_report.py splits on the field."""
+        if self._logger is None:
+            return
+        step = self.submitted if step is None else step
+        self._logger.log(step, kind="fleet", **self.snapshot())
+        states = self.placement.states()
+        for rid in sorted(self.replicas):
+            try:
+                snap = self.replicas[rid].stats_snapshot()
+            except Exception:  # noqa: BLE001 — a dead replica has no stats
+                snap = {}
+            self._logger.log(
+                step, kind="fleet", replica=rid,
+                state=states.get(rid, "removed"),
+                routed=float(self.routed.get(rid, 0)),
+                **{
+                    k: snap[k] for k in (
+                        "served", "p50_ms", "p99_ms", "batch_occupancy",
+                        "steady_recompiles", "queue_depth", "degraded",
+                    ) if k in snap
+                },
+            )
+
+    def close(self) -> None:
+        for handle in self.replicas.values():
+            try:
+                handle.close()
+            except Exception:  # noqa: BLE001 — close every replica anyway
+                pass

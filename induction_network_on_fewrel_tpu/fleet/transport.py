@@ -1,0 +1,417 @@
+"""Socket transport: the ``ReplicaHandle`` interface over JSON-lines TCP
+(ISSUE 13 tentpole, piece b — the multi-process spelling).
+
+The router is transport-agnostic: tier-1 and CPU drills run
+``InProcessReplica`` handles, and THIS module puts the exact same
+interface over a localhost socket so a real deployment can run one
+engine replica per process (or per host) behind the same router code.
+One JSON object per line each way:
+
+    request:  {"op": <name>, ...operands}
+    response: {"ok": true, ...result} | {"ok": false, "error": <type>,
+               "message": str, ...typed-error fields}
+
+Typed serving errors cross the wire by name: ``Saturated`` (with
+``retry_after_s``/``tenant``) and ``ExecuteError`` (with ``tenant``/
+``retry_after_s``) are re-raised as the SAME types client-side, so the
+router's breaker/backpressure logic cannot tell the transports apart —
+which is the point.
+
+Publish fan-out over the wire ships the CHECKPOINT DIRECTORY, not a
+params tree: replicas of a real multi-process fleet share the training
+run's artifact store, and ``publish_prepare`` restores + prepares
+locally (phase 1), holding the transaction server-side under a token
+until ``publish_commit``/``publish_abort`` (phase 2) — the same
+two-phase contract the in-process handle provides, so the fleet control
+plane's all-or-nothing fan-out works unchanged across processes.
+
+Scope: the wire format favors clarity over throughput (tokens travel as
+JSON); it is the correctness-faithful IPC arm the slow-lane tests
+exercise, not a tuned RPC stack. TraceContext crosses as ``trace_id``
+(the id string is the cross-process identity; span stitching by id is
+exactly how the in-process hop works too).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from induction_network_on_fewrel_tpu.fleet.router import ReplicaHandle
+from induction_network_on_fewrel_tpu.serving.batcher import (
+    ExecuteError,
+    Saturated,
+)
+
+
+def _inst_to_wire(inst) -> dict:
+    return {
+        "tokens": list(inst.tokens),
+        "head_pos": list(inst.head_pos),
+        "tail_pos": list(inst.tail_pos),
+    }
+
+
+def _inst_from_wire(d: dict):
+    from induction_network_on_fewrel_tpu.data.fewrel import Instance
+
+    return Instance(
+        tokens=tuple(d["tokens"]),
+        head_pos=tuple(int(p) for p in d["head_pos"]),
+        tail_pos=tuple(int(p) for p in d["tail_pos"]),
+    )
+
+
+def _dataset_to_wire(dataset) -> dict:
+    return {
+        rel: [_inst_to_wire(i) for i in dataset.instances[rel]]
+        for rel in dataset.rel_names
+    }
+
+
+def _dataset_from_wire(d: dict):
+    from induction_network_on_fewrel_tpu.data.fewrel import FewRelDataset
+
+    return FewRelDataset({
+        rel: [_inst_from_wire(i) for i in insts]
+        for rel, insts in d.items()
+    })
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        server: ReplicaServer = self.server.replica_server  # type: ignore
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            req = None
+            try:
+                req = json.loads(line)
+                resp = server.dispatch(req)
+            except Exception as e:  # noqa: BLE001 — typed errors -> wire
+                resp = _error_response(e)
+            self.wfile.write(
+                (json.dumps(resp) + "\n").encode()
+            )
+            self.wfile.flush()
+            if isinstance(req, dict) and req.get("op") == "bye":
+                return
+
+
+def _error_response(e: BaseException) -> dict:
+    resp = {
+        "ok": False, "error": type(e).__name__, "message": str(e),
+    }
+    for field in ("retry_after_s", "tenant"):
+        v = getattr(e, field, None)
+        if isinstance(v, (int, float, str)):
+            resp[field] = v
+    return resp
+
+
+class ReplicaServer:
+    """One engine replica served over a JSON-lines socket. Construct
+    with a live ``InferenceEngine``; ``start()`` binds (port 0 = pick a
+    free one) and serves on daemon threads; ``address`` is what a
+    ``SocketReplica`` connects to."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
+        self.engine = engine
+        self._txns: dict[int, object] = {}
+        self._txn_seq = 0
+        self._txn_lock = threading.Lock()
+        srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True
+        )
+        srv.daemon_threads = True
+        srv.replica_server = self  # type: ignore[attr-defined]
+        self._srv = srv
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._srv.server_address[:2]
+
+    def start(self) -> "ReplicaServer":
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name="replica-server",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        with self._txn_lock:
+            txns, self._txns = dict(self._txns), {}
+        for txn in txns.values():
+            try:
+                txn.abort()
+            except Exception:  # noqa: BLE001 — release every serial lock
+                pass
+
+    # --- op dispatch ------------------------------------------------------
+
+    def dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        eng = self.engine
+        if op in ("ping", "bye"):
+            return {"ok": True}
+        if op == "classify":
+            from induction_network_on_fewrel_tpu.obs.spans import (
+                TraceContext,
+            )
+
+            trace = (
+                TraceContext(str(req["trace_id"]))
+                if req.get("trace_id") else None
+            )
+            inst = req["instance"]
+            if (isinstance(inst, dict)
+                    and {"tokens", "head_pos", "tail_pos"} <= set(inst)):
+                inst = _inst_from_wire(inst)
+            # Any other dict shape (raw FewRel records, token dicts with
+            # defaulted positions) passes through VERBATIM to
+            # engine._as_instance — transport parity: every instance
+            # shape the in-process handle accepts works over the wire.
+            fut = eng.submit(
+                inst,
+                req.get("deadline_s"), tenant=req.get("tenant", "default"),
+                trace=trace,
+            )
+            timeout = (req.get("deadline_s") or eng.default_deadline_s) + 30.0
+            return {"ok": True, "verdict": fut.result(timeout=timeout)}
+        if op == "register":
+            names = eng.register_dataset(
+                _dataset_from_wire(req["dataset"]),
+                max_classes=req.get("max_classes"),
+                tenant=req.get("tenant", "default"),
+            )
+            return {"ok": True, "classes": list(names)}
+        if op == "set_nota_threshold":
+            eng.set_nota_threshold(
+                req.get("threshold"), tenant=req.get("tenant", "default")
+            )
+            return {"ok": True}
+        if op == "quarantine":
+            eng.quarantine_tenant(req["tenant"], reason=req.get("reason", ""))
+            return {"ok": True}
+        if op == "unquarantine":
+            eng.unquarantine_tenant(
+                req["tenant"], reason=req.get("reason", "")
+            )
+            return {"ok": True}
+        if op == "drop_tenant":
+            eng.registry.drop_tenant(req["tenant"])
+            return {"ok": True}
+        if op == "publish_prepare":
+            from induction_network_on_fewrel_tpu.serving.registry import (
+                load_params,
+            )
+
+            txn = eng.prepare_publish(load_params(req["ckpt_dir"]))
+            with self._txn_lock:
+                self._txn_seq += 1
+                token = self._txn_seq
+                self._txns[token] = txn
+            return {"ok": True, "txn": token}
+        if op == "publish_commit":
+            txn = self._take_txn(req["txn"])
+            return {"ok": True, "version": eng.commit_publish(txn)}
+        if op == "publish_abort":
+            self._take_txn(req["txn"]).abort()
+            return {"ok": True}
+        if op == "stats":
+            return {"ok": True, "stats": eng.stats.snapshot(
+                queue_depth=eng.batcher.queue_depth
+            )}
+        if op == "params_version":
+            return {"ok": True, "version": eng.registry.params_version}
+        if op == "warmup":
+            return {"ok": True, "compiled": eng.warmup()}
+        raise ValueError(f"unknown op {op!r}")
+
+    def _take_txn(self, token):
+        with self._txn_lock:
+            txn = self._txns.pop(int(token), None)
+        if txn is None:
+            raise ValueError(f"unknown publish transaction {token!r}")
+        return txn
+
+
+class SocketReplica(ReplicaHandle):
+    """Client half: the ``ReplicaHandle`` interface over per-thread
+    connections. Each calling thread lazily dials its OWN connection
+    (the server is a ThreadingTCPServer — one handler per connection),
+    so the ``pool_size`` submit workers drive up to that many classifies
+    concurrently and the replica's batcher can actually batch across
+    them; ``submit`` runs the blocking classify on the pool so the
+    router still gets a Future. Requests on one connection are strictly
+    request/response, so no per-connection lock is needed — a
+    connection is only ever used by the thread that dialed it."""
+
+    def __init__(self, replica_id: str, address: tuple[str, int],
+                 pool_size: int = 8, timeout_s: float = 120.0):
+        self.replica_id = str(replica_id)
+        self._address = address
+        self._timeout_s = timeout_s
+        self._tls = threading.local()
+        self._conns: list[tuple[socket.socket, object]] = []
+        self._conns_lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=pool_size,
+            thread_name_prefix=f"replica-{replica_id}",
+        )
+        self._closed = False
+        self._connect()   # dial eagerly: fail fast on a bad address
+
+    def _connect(self) -> tuple[socket.socket, object]:
+        sock = socket.create_connection(
+            self._address, timeout=self._timeout_s
+        )
+        conn = (sock, sock.makefile("rb"))
+        self._tls.conn = conn
+        with self._conns_lock:
+            self._conns.append(conn)
+        return conn
+
+    def _drop_conn(self, conn) -> None:
+        """Invalidate this thread's cached connection: after any
+        transport error (broken pipe, timeout mid-response) the socket
+        is dead or DESYNCED (a late response line would be read as the
+        next request's reply) — the next call from this thread must
+        re-dial, which is also what lets a half-open recovery probe
+        succeed once a restarted replica process is back."""
+        if getattr(self._tls, "conn", None) is conn:
+            self._tls.conn = None
+        with self._conns_lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+        sock, rfile = conn
+        for closer in (rfile.close, sock.close):
+            try:
+                closer()
+            except OSError:
+                pass
+
+    def _call(self, **req) -> dict:
+        if self._closed:
+            raise ConnectionError(f"replica {self.replica_id}: closed")
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            conn = self._connect()
+        sock, rfile = conn
+        try:
+            sock.sendall((json.dumps(req) + "\n").encode())
+            line = rfile.readline()
+        except OSError:
+            self._drop_conn(conn)
+            raise
+        if not line:
+            self._drop_conn(conn)
+            raise ConnectionError(
+                f"replica {self.replica_id}: connection closed"
+            )
+        resp = json.loads(line)
+        if resp.get("ok"):
+            return resp
+        err, msg = resp.get("error"), resp.get("message", "")
+        if err == "Saturated":
+            raise Saturated(
+                float(resp.get("retry_after_s", 0.05)),
+                tenant=resp.get("tenant"),
+            )
+        if err == "ExecuteError":
+            raise ExecuteError(
+                str(resp.get("tenant", "?")),
+                retry_after_s=float(resp.get("retry_after_s", 0.05)),
+                cause=RuntimeError(msg),
+            )
+        if err == "DeadlineExceeded":
+            from induction_network_on_fewrel_tpu.serving.batcher import (
+                DeadlineExceeded,
+            )
+
+            raise DeadlineExceeded(msg)
+        raise RuntimeError(f"replica {self.replica_id}: {err}: {msg}")
+
+    # --- ReplicaHandle ----------------------------------------------------
+
+    def submit(self, instance, deadline_s=None, tenant="default",
+               trace=None) -> Future:
+        wire = _inst_to_wire(instance) if hasattr(instance, "tokens") \
+            else instance
+        return self._pool.submit(
+            lambda: self._call(
+                op="classify", instance=wire, deadline_s=deadline_s,
+                tenant=tenant,
+                trace_id=trace.trace_id if trace is not None else None,
+            )["verdict"]
+        )
+
+    def register_dataset(self, dataset, tenant, max_classes=None):
+        return self._call(
+            op="register", dataset=_dataset_to_wire(dataset),
+            tenant=tenant, max_classes=max_classes,
+        )["classes"]
+
+    def set_nota_threshold(self, threshold, tenant):
+        self._call(op="set_nota_threshold", threshold=threshold,
+                   tenant=tenant)
+
+    def quarantine_tenant(self, tenant, reason=""):
+        self._call(op="quarantine", tenant=tenant, reason=reason)
+
+    def unquarantine_tenant(self, tenant, reason=""):
+        self._call(op="unquarantine", tenant=tenant, reason=reason)
+
+    def drop_tenant(self, tenant):
+        self._call(op="drop_tenant", tenant=tenant)
+
+    def prepare_publish(self, params=None, ckpt_dir=None):
+        if ckpt_dir is None:
+            raise ValueError(
+                "socket replicas publish from a shared checkpoint "
+                "directory (pass ckpt_dir; a raw params tree does not "
+                "cross the wire)"
+            )
+        return self._call(op="publish_prepare", ckpt_dir=str(ckpt_dir))["txn"]
+
+    def commit_publish(self, txn) -> int:
+        return int(self._call(op="publish_commit", txn=txn)["version"])
+
+    def abort_publish(self, txn) -> None:
+        self._call(op="publish_abort", txn=txn)
+
+    @property
+    def params_version(self) -> int:
+        return int(self._call(op="params_version")["version"])
+
+    def stats_snapshot(self) -> dict:
+        return self._call(op="stats")["stats"]
+
+    def warmup(self) -> int:
+        return int(self._call(op="warmup")["compiled"])
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        try:
+            self._call(op="bye")   # best-effort, this thread's conn
+        except Exception:  # noqa: BLE001 — closing a dead socket is fine
+            pass
+        self._closed = True
+        self._pool.shutdown(wait=False)
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), []
+        for sock, rfile in conns:
+            for closer in (rfile.close, sock.close):
+                try:
+                    closer()
+                except OSError:
+                    pass

@@ -92,6 +92,13 @@ KNOWN_POINTS: dict[str, str] = {
         "ExecuteError) and feed the tenant's circuit breaker. ARG "
         "filters the tenant."
     ),
+    "fleet.replica_kill": (
+        "at a fleet-router submit arrival: mark the request's owning "
+        "replica DEAD (simulated process death, ISSUE 13) — the router "
+        "must fail over: degraded NOTA verdicts for the replica's "
+        "tenants until re-placement, then normal service from the new "
+        "owners. ARG filters the replica id."
+    ),
 }
 
 
@@ -163,7 +170,9 @@ class ChaosRegistry:
         (the site then applies the fault), else None. ``ctx`` carries the
         ARG-filter key (``tenant`` on serving points, ``kind`` on
         checkpoint points) plus telemetry fields."""
-        ctx_arg = ctx.get("tenant") or ctx.get("kind")
+        # ARG-filter key by point family: tenant on serving points, ring
+        # kind on checkpoint points, replica id on fleet points.
+        ctx_arg = ctx.get("tenant") or ctx.get("kind") or ctx.get("replica")
         fired = None
         with self._lock:
             for d in self.directives:
@@ -267,14 +276,28 @@ def corrupt_step_dir(step_dir: str | Path, mode: str = "bitflip") -> str | None:
     if size == 0:
         return None
     if mode == "bitflip":
-        # Offset from the name hash: stable across runs, never offset 0
-        # of an empty file.
-        off = (sum(target.name.encode()) * 2654435761) % size
+        # One flipped byte per stripe, offsets jittered by the name
+        # hash. A SINGLE flip proved flaky: orbax/tensorstore chunk
+        # file names are run-unique, so the name-hash offset lands at
+        # a different byte every run — and a 65 KB ocdbt chunk holds
+        # framing/slack bytes that never materialize into any verified
+        # leaf, so occasionally the corruption was invisible to the
+        # integrity chain and the quarantine tests flaked. Striping 8
+        # flips across the file keeps the "silent corruption" shape
+        # (size unchanged, superficially parseable) while making a
+        # miss require EVERY stripe to land in slack.
+        jitter = sum(target.name.encode()) * 2654435761
+        stripes = min(8, size)
         with open(target, "r+b") as f:
-            f.seek(off)
-            b = f.read(1)
-            f.seek(off)
-            f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+            for i in range(stripes):
+                off = (i * size) // stripes + jitter % max(
+                    size // stripes, 1
+                )
+                off %= size
+                f.seek(off)
+                b = f.read(1)
+                f.seek(off)
+                f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
     elif mode == "truncate":
         with open(target, "r+b") as f:
             f.truncate(max(size // 2, 1))

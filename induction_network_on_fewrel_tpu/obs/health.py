@@ -354,6 +354,8 @@ class HealthWatchdog:
         * ``publish_rollback`` — a publish transaction rolled back.
           Single latch; a later COMMITTED publish (snapshot_swap serve
           event) re-arms.
+        * ``replica_dead``     — a fleet replica marked dead (ISSUE 13).
+          Latched per replica; ``action="replica_recover"`` re-arms.
 
         Injected faults (action="inject") are context, not failures —
         the containment they provoke is what must (and does) trip.
@@ -401,6 +403,32 @@ class HealthWatchdog:
                 ))
             elif rec.get("to") == "closed":
                 self._latched.discard(latch)
+        elif action == "replica_dead":
+            # Fleet tier (ISSUE 13): a replica marked dead (breaker open
+            # on forwarded failures, or the fleet.replica_kill chaos
+            # point). Latched per replica; action="replica_recover"
+            # re-arms — a flapping replica is one incident per down
+            # transition, not one per routed-around request.
+            replica = rec.get("replica")
+            latch = f"replica_dead:{replica}"
+            if latch in self._latched:
+                return
+            self._latched.add(latch)
+            self._emit(HealthEvent(
+                event="replica_dead", severity=CRITICAL, step=step,
+                message=(
+                    f"fleet replica {replica!r} marked DEAD "
+                    f"({rec.get('reason')}) — "
+                    f"{int(rec.get('tenants', 0))} tenant(s) failing "
+                    f"over to degraded NOTA until re-placement"
+                ),
+                data={
+                    k: rec[k] for k in ("replica", "reason", "tenants")
+                    if k in rec
+                },
+            ))
+        elif action == "replica_recover":
+            self._latched.discard(f"replica_dead:{rec.get('replica')}")
         elif action == "publish_rollback":
             if "publish_rollback" in self._latched:
                 return

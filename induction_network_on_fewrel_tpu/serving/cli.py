@@ -11,7 +11,9 @@ carry trace_id); ``--slo_latency_ms`` arms the per-tenant SLO burn-rate
 engine, whose fast-window CRITICAL auto-captures diagnostics to
 ``--run_dir`` (RUNBOOK §14); ``--drift`` arms the online prediction-drift
 detector (per-tenant NOTA rate / margin / entropy vs a calibration
-baseline, re-armed on every publish — RUNBOOK §15).
+baseline, re-armed on every publish — RUNBOOK §15); ``--replicas N``
+runs N engine replicas behind the fleet router (rendezvous placement,
+fleet-share fairness, breaker-fed failover — RUNBOOK §18).
 """
 
 from __future__ import annotations
@@ -131,6 +133,17 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
                         "POINT@AT[*COUNT][:ARG] directives, e.g. "
                         "'serve.execute_raise@0*3:default'. Deterministic "
                         "drills for the containment layer; '' = off")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="fleet mode (ISSUE 13, fleet/): run this many "
+                        "in-process engine replicas behind the fleet "
+                        "router — rendezvous tenant placement, fleet-"
+                        "level shed fairness, replica breaker/failover, "
+                        "fan-out publish. 1 (default) = the single-"
+                        "engine path")
+    p.add_argument("--router", action="store_true",
+                   help="route through the fleet router even with "
+                        "--replicas 1 (exercises the fleet front door "
+                        "on a single-replica deployment)")
     p.add_argument("--slo_profile", action="store_true",
                    help="also attempt a jax.profiler trace in the SLO "
                         "auto-capture (default off on this image — a "
@@ -152,6 +165,34 @@ def _build_breaker(args):
         failure_threshold=args.breaker_threshold,
         open_s=args.breaker_open_s,
     )
+
+
+def _build_engine(args, buckets, logger=None, watchdog=None, slo=None,
+                  drift=None, breaker=None, trace_sample=0.0):
+    """ONE home for CLI engine construction — the from_checkpoint /
+    fresh-init fork plus every shared kwarg — used by the single-engine
+    path AND each fleet replica (which passes trace_sample=0.0: the
+    ROUTER head-samples and hands the context across the hop)."""
+    from induction_network_on_fewrel_tpu.serving.engine import (
+        InferenceEngine,
+    )
+
+    if args.load_ckpt:
+        return InferenceEngine.from_checkpoint(
+            args.load_ckpt, device=args.device,
+            glove=args.glove, glove_mat=args.glove_mat,
+            k=args.K, buckets=buckets,
+            max_queue_depth=args.queue_depth,
+            batch_window_s=args.batch_window_ms / 1e3,
+            default_deadline_s=args.deadline_ms / 1e3,
+            scheduler=args.scheduler, tenant_share=args.tenant_share,
+            dp=args.dp, logger=logger, watchdog=watchdog,
+            slo=slo, drift=drift, breaker=breaker,
+            trace_sample=trace_sample,
+        )
+    return _fresh_engine(args, buckets, logger=logger, watchdog=watchdog,
+                         slo=slo, drift=drift, breaker=breaker,
+                         trace_sample=trace_sample)
 
 
 def _fresh_engine(args, buckets, logger=None, watchdog=None, slo=None,
@@ -193,6 +234,22 @@ def _fresh_engine(args, buckets, logger=None, watchdog=None, slo=None,
         dp=args.dp, logger=logger, watchdog=watchdog,
         slo=slo, drift=drift, breaker=breaker,
         trace_sample=trace_sample,
+    )
+
+
+def _write_prometheus(run_dir) -> None:
+    """Prometheus text exposition of the shared counter registry
+    (obs/export.py) — the scrape-format twin of the final kind="serve"
+    record; an HTTP server would serve this string. Call BEFORE
+    engine/router close: close unbinds the stats callbacks from the
+    registry (fleet mode binds several replicas under one prefix; the
+    exposition reflects the latest bind — documented latest-wins
+    behavior of the shared registry)."""
+    from induction_network_on_fewrel_tpu.obs import get_registry
+    from pathlib import Path
+
+    Path(run_dir, "metrics.prom").write_text(
+        get_registry().to_prometheus()
     )
 
 
@@ -287,24 +344,13 @@ def serve_main(argv=None) -> int:
         if reg is not None:
             reg.install()
             print(f"chaos plan armed: {args.chaos}", file=sys.stderr)
-    if args.load_ckpt:
-        engine = InferenceEngine.from_checkpoint(
-            args.load_ckpt, device=args.device,
-            glove=args.glove, glove_mat=args.glove_mat,
-            k=args.K, buckets=buckets,
-            max_queue_depth=args.queue_depth,
-            batch_window_s=args.batch_window_ms / 1e3,
-            default_deadline_s=args.deadline_ms / 1e3,
-            scheduler=args.scheduler, tenant_share=args.tenant_share,
-            dp=args.dp, logger=logger, watchdog=watchdog,
-            slo=slo, drift=drift, breaker=breaker,
-            trace_sample=args.trace_sample,
-        )
-    else:
-        engine = _fresh_engine(args, buckets, logger=logger,
-                               watchdog=watchdog, slo=slo, drift=drift,
-                               breaker=breaker,
-                               trace_sample=args.trace_sample)
+    if args.replicas > 1 or args.router:
+        return _serve_fleet(args, buckets, logger=logger,
+                            watchdog=watchdog, slo=slo, drift=drift)
+    engine = _build_engine(args, buckets, logger=logger,
+                           watchdog=watchdog, slo=slo, drift=drift,
+                           breaker=breaker,
+                           trace_sample=args.trace_sample)
 
     try:
         ds = _support_dataset(args, engine.registry.k, seed=args.seed)
@@ -331,38 +377,123 @@ def serve_main(argv=None) -> int:
                 if stream is not sys.stdin:
                     stream.close()
         else:
-            _demo(engine, ds, args.demo_queries, seed=args.seed)
+            _demo(engine.submit, ds, list(engine.class_names),
+                  engine.registry.k, args.demo_queries, seed=args.seed)
 
         snap = engine.stats.snapshot(queue_depth=engine.batcher.queue_depth)
         print("serve stats: " + json.dumps(snap), file=sys.stderr)
         return 0
     finally:
         if args.run_dir:
-            # Prometheus text exposition of the shared counter registry
-            # (obs/export.py) — the scrape-format twin of the final
-            # kind="serve" record; an HTTP server would serve this string.
-            # Rendered BEFORE close: engine.close() unbinds the stats
-            # callbacks from the registry.
-            from induction_network_on_fewrel_tpu.obs import get_registry
-            from pathlib import Path
-
-            Path(args.run_dir, "metrics.prom").write_text(
-                get_registry().to_prometheus()
-            )
+            _write_prometheus(args.run_dir)
         engine.close()
         if logger is not None:
             logger.close()
 
 
-def _demo(engine, ds, num_queries: int, seed: int = 0) -> None:
+def _serve_fleet(args, buckets, logger=None, watchdog=None, slo=None,
+                 drift=None) -> int:
+    """Fleet-mode serving (ISSUE 13): ``--replicas`` in-process engine
+    replicas behind the fleet router. The support corpus registers as
+    the ``default`` tenant on its rendezvous owner through the control
+    plane; queries (``--input`` or the demo batch) route through the
+    router front door — placement resolution, fleet-share fairness,
+    breaker-fed failover — exactly the path a multi-process deployment
+    takes (fleet/transport.py swaps the replica handles, nothing else).
+    Shared obs objects (slo/drift/watchdog) are per-tenant keyed, so
+    every replica feeding them is by design."""
+    from induction_network_on_fewrel_tpu.fleet import (
+        FleetControl,
+        FleetRouter,
+        InProcessReplica,
+    )
+
+    def mk_engine():
+        return _build_engine(
+            args, buckets, logger=logger, watchdog=watchdog, slo=slo,
+            drift=drift, breaker=_build_breaker(args),
+        )
+
+    from induction_network_on_fewrel_tpu.serving.breaker import (
+        CircuitBreaker,
+    )
+
+    n = max(args.replicas, 1)
+    replicas = {
+        f"r{i:02d}": InProcessReplica(f"r{i:02d}", mk_engine())
+        for i in range(n)
+    }
+    router = FleetRouter(
+        replicas, logger=logger,
+        breaker=CircuitBreaker(failure_threshold=3,
+                               open_s=args.breaker_open_s),
+        queue_capacity_per_replica=args.queue_depth,
+        trace_sample=args.trace_sample,
+    )
+    control = FleetControl(router)
+    try:
+        first = replicas[sorted(replicas)[0]].engine
+        ds = _support_dataset(args, first.registry.k, seed=args.seed)
+        owner = control.register_tenant(
+            "default", ds, max_classes=args.max_classes,
+            nota_threshold=args.nota_threshold,
+        )
+        compiled = sum(h.warmup() for h in router.replicas.values())
+        print(f"fleet: {n} replica(s), default tenant placed on {owner}, "
+              f"{compiled} bucket programs compiled", file=sys.stderr)
+
+        def answer(instance) -> dict:
+            return router.classify(
+                instance, args.deadline_ms / 1e3, tenant="default"
+            )
+
+        if args.input:
+            stream = sys.stdin if args.input == "-" else open(args.input)
+            try:
+                for line in stream:
+                    line = line.strip()
+                    if line:
+                        print(json.dumps(
+                            answer(json.loads(line))
+                        ), flush=True)
+            finally:
+                if stream is not sys.stdin:
+                    stream.close()
+        else:
+            names = list(ds.rel_names)
+            if args.max_classes is not None:
+                names = names[: args.max_classes]
+            _demo(
+                lambda inst: router.submit(
+                    inst, args.deadline_ms / 1e3, tenant="default"
+                ),
+                ds, names, first.registry.k, args.demo_queries,
+                seed=args.seed,
+            )
+
+        router.emit_stats()
+        print("fleet stats: " + json.dumps(router.snapshot()),
+              file=sys.stderr)
+        return 0
+    finally:
+        if args.run_dir:
+            _write_prometheus(args.run_dir)
+        router.close()
+        if logger is not None:
+            logger.close()
+
+
+def _demo(submit, ds, names, k: int, num_queries: int,
+          seed: int = 0) -> None:
     """Self-contained demo: classify held-out instances of the registered
     corpus (instances AFTER the K supports, so the engine has not seen
-    them) and print one verdict line each."""
+    them) and print one verdict line each. ``submit`` is any
+    Future-returning entry — the engine's submit or the fleet router's
+    (one demo, both transports)."""
     import numpy as np
 
     rng = np.random.default_rng(seed)
-    k = engine.registry.k
-    registered = set(engine.class_names)
+    registered = set(names)
     pool = [
         (rel, inst)
         for rel in ds.rel_names if rel in registered
@@ -378,7 +509,7 @@ def _demo(engine, ds, num_queries: int, seed: int = 0) -> None:
                         replace=False):
         rel, inst = pool[int(i)]
         try:
-            futures.append((rel, engine.submit(inst)))
+            futures.append((rel, submit(inst)))
         except Saturated as e:
             # A well-behaved client under backpressure/breaker shed: the
             # demo reports it instead of dying on the typed error

@@ -109,6 +109,33 @@ from induction_network_on_fewrel_tpu.serving.stats import ServingStats
 NO_RELATION = "no_relation"
 
 
+def degraded_verdict(tenant: str, *, snapshot_version: int = -1,
+                     latency_ms: float = 0.0,
+                     failover: bool = False) -> dict:
+    """The degraded-mode NOTA verdict — ONE shape home shared by the
+    engine's quarantine path (``_serve_degraded``) and the fleet
+    router's failover path (``fleet/router._degraded_future``), so the
+    two spellings of "I cannot place this" can never drift apart.
+    ``failover=True`` marks the router-side variant (clients and the
+    quality stream tell router failover from replica quarantine by
+    the flag)."""
+    verdict = {
+        "label": NO_RELATION,
+        "class_index": -1,
+        "nota": True,
+        "degraded": True,
+        "margin": 0.0,
+        "entropy": 0.0,
+        "tenant": tenant,
+        "snapshot_version": snapshot_version,
+        "logits": {},
+        "latency_ms": latency_ms,
+    }
+    if failover:
+        verdict["failover"] = True
+    return verdict
+
+
 class InferenceEngine:
     def __init__(
         self,
@@ -410,16 +437,37 @@ class InferenceEngine:
             source=ckpt_dir,
         )
 
+    # Two-phase publish (fleet fan-out, ISSUE 13): the control plane
+    # prepares EVERY replica before committing ANY (fleet/control.py).
+    # Commit runs through _traced_publish so a fan-out publish gets the
+    # same span, swap counter, and drift re-arm a local publish gets.
+
+    def prepare_publish(self, new_params):
+        """Phase 1 on this replica: validation gate + full re-distill,
+        nothing visible to the data plane yet. Returns the registry's
+        ``PublishTransaction``; the caller must ``commit_publish`` or
+        abort it (same thread)."""
+        return self.registry.prepare_publish(new_params)
+
+    def commit_publish(self, txn) -> int:
+        """Phase 2: commit a prepared transaction with the engine-side
+        publish bookkeeping (trace span, stats.record_swap, drift
+        re-arm) a plain ``publish_params`` performs."""
+        return self._traced_publish(txn.commit)
+
     # --- query path ------------------------------------------------------
 
     def submit(
         self, instance, deadline_s: float | None = None,
-        tenant: str = DEFAULT_TENANT,
+        tenant: str = DEFAULT_TENANT, trace=None,
     ):
         """Tokenize one query and enqueue it for ``tenant``; returns a
         Future resolving to the verdict dict. Raises ``Saturated`` under
         backpressure (with ``.tenant`` set when the breach is this
-        tenant's share — shed-load)."""
+        tenant's share — shed-load). ``trace`` adopts a TraceContext a
+        caller already minted (the fleet router's front door, ISSUE 13)
+        instead of head-sampling here — the request's segments then join
+        the router's trace id across the hop."""
         self.registry.snapshot(tenant)   # raises for unknown tenants
         if self.breaker is not None:
             # Open breaker = shed at the door (ISSUE 12): a repeatedly
@@ -434,7 +482,8 @@ class InferenceEngine:
                     # windows must still evaluate.
                     self.slo.maybe_evaluate()
                 raise Saturated(retry, tenant=tenant)
-        trace = self._tracer.maybe_trace()   # None on the unsampled path
+        if trace is None:
+            trace = self._tracer.maybe_trace()   # None when unsampled
         if trace is None:
             t = self.tokenizer(self._as_instance(instance))
         else:
@@ -657,18 +706,10 @@ class InferenceEngine:
         the model), one kind="fault" record per batch."""
         now = time.monotonic()
         for req in batch:
-            verdict = {
-                "label": NO_RELATION,
-                "class_index": -1,
-                "nota": True,
-                "degraded": True,
-                "margin": 0.0,
-                "entropy": 0.0,
-                "tenant": tenant,
-                "snapshot_version": snap.version,
-                "logits": {},
-                "latency_ms": round((now - req.enqueued_at) * 1e3, 3),
-            }
+            verdict = degraded_verdict(
+                tenant, snapshot_version=snap.version,
+                latency_ms=round((now - req.enqueued_at) * 1e3, 3),
+            )
             if req.trace is not None:
                 verdict["trace_id"] = req.trace.trace_id
             # nota=None on purpose: degraded verdicts must not skew the

@@ -434,44 +434,44 @@ class TenantRegistry:
         record (action="publish_rollback"); the watchdog latches a
         CRITICAL ``publish_rollback``, re-armed by the next committed
         publish."""
-        with self._publish_serial:
-            version_before = self.params_version
-            try:
-                from induction_network_on_fewrel_tpu.obs.chaos import (
-                    chaos_fire,
+        txn = None
+        try:
+            # Literally prepare+commit: ONE home for the chaos point,
+            # the serial-lock acquisition, and the staging logic —
+            # fleet fan-outs and single-replica publishes cannot drift.
+            txn = self.prepare_publish(new_params)
+            return txn.commit()
+        except BaseException as e:
+            if txn is not None and txn.committed:
+                # The COMMIT happened — the exception came from the
+                # post-commit telemetry (a raising logger hook, disk
+                # full on the jsonl write). The publish is LIVE: do
+                # not log a rollback, do not claim one. Re-raise the
+                # real error.
+                raise
+            # Nothing committed (build-then-commit): log the
+            # rollback and surface a typed error. The registry
+            # generation is unchanged. The version reported is the one
+            # captured UNDER the serial lock (txn.version_before) —
+            # a pre-lock read could be stale by a concurrent
+            # publisher's commit; when prepare itself failed (txn
+            # None) the lock has been released, so the live counter
+            # is the honest answer.
+            version_before = (txn.version_before if txn is not None
+                              else self.params_version)
+            if self._logger is not None:
+                self._logger.log(
+                    version_before, kind="fault",
+                    action="publish_rollback",
+                    reason=f"{type(e).__name__}: {e}",
+                    params_version=float(version_before),
                 )
-
-                if chaos_fire("publish.nan_params",
-                              step=version_before) is not None:
-                    from induction_network_on_fewrel_tpu.datapipe.faults \
-                        import poison_tree
-
-                    new_params = poison_tree(new_params)
-                return self._publish_params_serialized(new_params)
-            except BaseException as e:
-                if self.params_version != version_before:
-                    # The COMMIT happened — the exception came from the
-                    # post-commit telemetry (a raising logger hook, disk
-                    # full on the jsonl write). The publish is LIVE: do
-                    # not log a rollback, do not claim one. Re-raise the
-                    # real error.
-                    raise
-                # Nothing committed (build-then-commit): log the
-                # rollback and surface a typed error. The registry
-                # generation is unchanged.
-                if self._logger is not None:
-                    self._logger.log(
-                        version_before, kind="fault",
-                        action="publish_rollback",
-                        reason=f"{type(e).__name__}: {e}",
-                        params_version=float(version_before),
-                    )
-                if isinstance(e, PublishError):
-                    raise
-                raise PublishError(
-                    f"publish rolled back ({type(e).__name__}: {e}); "
-                    f"registry stays at params_version {version_before}"
-                ) from e
+            if isinstance(e, PublishError):
+                raise
+            raise PublishError(
+                f"publish rolled back ({type(e).__name__}: {e}); "
+                f"registry stays at params_version {version_before}"
+            ) from e
 
     @staticmethod
     def _first_nonfinite(tree) -> str | None:
@@ -486,7 +486,51 @@ class TenantRegistry:
                 return jax.tree_util.keystr(path)
         return None
 
-    def _publish_params_serialized(self, new_params) -> int:
+    # --- two-phase publish (fleet fan-out, ISSUE 13) ----------------------
+
+    def prepare_publish(self, new_params) -> "PublishTransaction":
+        """Phase 1 of a two-phase publish: acquire the publish-serial
+        lock (HELD until ``commit()``/``abort()`` on the returned
+        transaction), run the validation gate and every re-distill pass,
+        and return the fully-staged transaction. On ANY failure the lock
+        is released and the registry is untouched — nothing was staged
+        into live state, so an abort-after-prepare-failure is a no-op by
+        construction.
+
+        This is the primitive the fleet control plane composes into an
+        all-or-nothing fan-out (fleet/control.py): prepare on EVERY
+        replica first, then commit everywhere only once every prepare
+        succeeded — one replica's validation failure aborts the others
+        before any of them moved, so params_version stays uniform across
+        the fleet. Single-replica callers keep using ``publish_params``,
+        which is now literally prepare+commit in one call.
+
+        The commit phase re-distills only late-registered stragglers
+        (bounded: the delta since prepare) and re-validates them; plain
+        assignments then publish the generation. The serial lock is a
+        plain (ownerless) mutex, so a transaction prepared on one
+        thread may be committed/aborted from another — the socket
+        transport's server prepares on one connection-handler thread
+        and commits/aborts on whichever handler thread the phase-2 op
+        arrives on (fleet/transport.py)."""
+        self._publish_serial.acquire()
+        try:
+            from induction_network_on_fewrel_tpu.obs.chaos import chaos_fire
+
+            if chaos_fire("publish.nan_params",
+                          step=self.params_version) is not None:
+                from induction_network_on_fewrel_tpu.datapipe.faults import (
+                    poison_tree,
+                )
+
+                new_params = poison_tree(new_params)
+            staged = self._prepare_serialized(new_params)
+        except BaseException:
+            self._publish_serial.release()
+            raise
+        return PublishTransaction(self, staged)
+
+    def _prepare_serialized(self, new_params) -> dict:
         from induction_network_on_fewrel_tpu.obs.chaos import chaos_fire
 
         # Pre-swap validation gate, part 1 — BEFORE burning device time
@@ -547,6 +591,16 @@ class TenantRegistry:
                     vec_of[s] = vec.astype(np.float32)
             # Loop: a registration may have added live slots mid-distill;
             # the next pass picks up exactly the delta.
+        return {
+            "new_params": new_params,
+            "new_version": new_version,
+            "vec_of": vec_of,
+        }
+
+    def _commit_prepared(self, staged: dict) -> int:
+        new_params = staged["new_params"]
+        new_version = staged["new_version"]
+        vec_of = staged["vec_of"]
         with self._lock:
             # Swap — BUILD-THEN-COMMIT (ISSUE 12): everything below
             # stages into locals; registry state mutates only in the
@@ -801,6 +855,68 @@ class TenantRegistry:
                 dtype=dt,
             )[None]
         return sup
+
+
+class PublishTransaction:
+    """A prepared (phase-1-complete) publish: validation passed, every
+    live slot is re-distilled against the new weights, and the owning
+    registry's publish-serial lock is held. Exactly one of ``commit()``
+    or ``abort()`` must follow — from any thread; the serial mutex is
+    ownerless precisely so phase 2 can arrive on a different thread
+    than phase 1 (the socket transport's connection handlers).
+
+    ``commit`` publishes the staged generation (the build-then-commit
+    swap — it can still refuse on a late-registered straggler whose
+    re-distill fails validation, in which case the registry is unchanged
+    and the transaction counts as aborted). ``abort`` releases the
+    serial lock and discards the staged vectors; the registry never
+    learned the transaction existed. Either way the lock is released
+    exactly once."""
+
+    __slots__ = ("_registry", "_staged", "version_before", "_done",
+                 "committed")
+
+    def __init__(self, registry: TenantRegistry, staged: dict):
+        self._registry = registry
+        self._staged = staged
+        self.version_before = registry.params_version
+        self._done = False
+        # True once the swap's plain-assignment block has run — the
+        # exact "is the publish LIVE?" bit error handlers need (a
+        # post-commit telemetry exception must never read as a
+        # rollback, and a concurrent publisher moving params_version
+        # must never make a prepare failure read as a commit).
+        self.committed = False
+
+    @property
+    def new_version(self) -> int:
+        return self._staged["new_version"]
+
+    def commit(self) -> int:
+        if self._done:
+            raise RuntimeError("publish transaction already finished")
+        try:
+            version = self._registry._commit_prepared(self._staged)
+            self.committed = True
+            return version
+        except BaseException:
+            # _commit_prepared emits telemetry AFTER its plain-
+            # assignment swap: if params_version reached our staged
+            # version the swap IS live and only the telemetry raised.
+            # Safe to read here — the serial lock is still held, so no
+            # other publisher can have produced this version.
+            if self._registry.params_version == self._staged["new_version"]:
+                self.committed = True
+            raise
+        finally:
+            self._done = True
+            self._registry._publish_serial.release()
+
+    def abort(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._registry._publish_serial.release()
 
 
 def load_params(ckpt_dir: str, model=None):

@@ -136,6 +136,24 @@ KNOWN_KINDS = frozenset({
     # obs_report's faults section renders injections and reactions side
     # by side.
     "fault",
+    # Fleet-tier telemetry (ISSUE 13, fleet/router.py + fleet/control.py,
+    # three record shapes, all scalar/str): (a) the AGGREGATE router
+    # record (no ``replica``/``event`` field) with replicas / live /
+    # dead / tenants / submitted / shed (fleet-share door sheds) /
+    # degraded_served (failover NOTA verdicts served at the router) /
+    # replica_deaths / replaced (tenants re-registered after membership
+    # or health changes — cumulative placement churn) /
+    # pending_failover / inflight; (b) one PER-REPLICA record per emit
+    # carrying ``replica`` (str) and ``state`` (up/draining/dead) with
+    # that replica's routed count and serving counters (served / p50_ms
+    # / p99_ms / batch_occupancy / steady_recompiles / queue_depth);
+    # (c) EVENT records: event="fanout_publish" (publish_s, replicas,
+    # params_version — the all-or-nothing fleet publish),
+    # event="replica_add" and event="replace" (moved, tenants —
+    # re-placement churn). Replica-death containment emits kind="fault"
+    # action="replica_dead"/"replica_recover" next to these.
+    # tools/obs_report.py's fleet section splits on replica/event.
+    "fleet",
     # XLA compile forensics (ISSUE 11, obs/compile.py): one record per
     # observed backend compile with fn (str, the jitted function), shapes
     # (str, the argument shape signature), elapsed_ms, trigger (str, the

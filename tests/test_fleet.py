@@ -1,0 +1,797 @@
+"""Fleet-tier tier-1 tests (ISSUE 13): rendezvous placement invariants,
+router failover/shed-fairness/trace propagation, the all-or-nothing
+fan-out publish, kind="fleet" telemetry, and the miniature 3-replica
+drill replayed against the committed FLEET_r*.json band (the
+tests/test_scenarios.py artifact discipline). The socket transport and
+the 10k-tenant routing soak ride the slow lane.
+"""
+
+import glob
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import Future
+
+import jax
+import numpy as np
+import pytest
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.data.tokenizer import GloveTokenizer
+from induction_network_on_fewrel_tpu.fleet import (
+    DEAD,
+    DRAINING,
+    UP,
+    FleetControl,
+    FleetPlacement,
+    FleetPublishError,
+    FleetRouter,
+    InProcessReplica,
+    ReplicaHandle,
+)
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.obs.chaos import ChaosRegistry, install
+from induction_network_on_fewrel_tpu.obs.health import HealthWatchdog
+from induction_network_on_fewrel_tpu.serving.batcher import (
+    ExecuteError,
+    Saturated,
+)
+from induction_network_on_fewrel_tpu.serving.breaker import CircuitBreaker
+from induction_network_on_fewrel_tpu.serving.buckets import zero_batch
+from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
+from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import loadgen  # noqa: E402
+import obs_report  # noqa: E402
+
+CFG = ExperimentConfig(
+    model="induction", encoder="cnn", hidden_size=16,
+    vocab_size=122, word_dim=8, pos_dim=2, max_length=16,
+    induction_dim=8, ntn_slices=4, routing_iters=2,
+    n=3, train_n=3, k=2, q=2, device="cpu",
+)
+
+
+@pytest.fixture(scope="module")
+def world():
+    vocab = make_synthetic_glove(vocab_size=CFG.vocab_size - 2,
+                                 word_dim=CFG.word_dim)
+    tok = GloveTokenizer(vocab, max_length=CFG.max_length)
+    model = build_model(CFG, glove_init=vocab.vectors)
+    params = model.init(
+        jax.random.key(0),
+        zero_batch(CFG.max_length, (1, CFG.n, CFG.k)),
+        zero_batch(CFG.max_length, (1, 2)),
+    )
+    datasets = [
+        make_synthetic_fewrel(
+            num_relations=3, instances_per_relation=8,
+            vocab_size=CFG.vocab_size - 2, seed=s,
+        )
+        for s in range(3)
+    ]
+    return tok, model, params, datasets
+
+
+def _fleet(world, n_replicas=3, logger=None, breaker=None, **router_kw):
+    tok, model, params, _ = world
+    replicas = {
+        f"r{i}": InProcessReplica(
+            f"r{i}",
+            InferenceEngine(
+                model, params, CFG, tok, k=CFG.k, buckets=(1, 2, 4),
+                logger=logger,
+            ),
+        )
+        for i in range(n_replicas)
+    }
+    router = FleetRouter(replicas, logger=logger, breaker=breaker,
+                         **router_kw)
+    return router, FleetControl(router)
+
+
+def _pools(datasets, k=CFG.k):
+    return [
+        [i for r in ds.rel_names for i in ds.instances[r][k:]]
+        for ds in datasets
+    ]
+
+
+# --- placement invariants ---------------------------------------------------
+
+
+def test_placement_deterministic_and_consistent():
+    """Same tenant -> same live replica, across calls AND across
+    placement instances (no table, no process state)."""
+    tenants = [f"t{i:04d}" for i in range(500)]
+    a = FleetPlacement([f"r{i}" for i in range(4)])
+    b = FleetPlacement([f"r{i}" for i in range(4)])
+    first = a.owners(tenants)
+    assert first == b.owners(tenants)
+    for t in tenants[:50]:
+        assert a.place(t) == first[t] == a.place(t)
+    # Balanced enough: no replica owns more than twice its fair share.
+    from collections import Counter
+
+    dist = Counter(first.values())
+    assert set(dist) == {f"r{i}" for i in range(4)}
+    assert max(dist.values()) <= 2 * (len(tenants) / 4)
+
+
+def test_placement_add_remap_bound():
+    """Adding a replica moves ~T/(R+1) tenants (test-pinned at 1.5x the
+    expectation) and every moved tenant moves TO the newcomer — the
+    rendezvous property: surviving pairs' scores are unchanged, so an
+    owner can only change when the new replica wins."""
+    tenants = [f"t{i:05d}" for i in range(1000)]
+    pl = FleetPlacement([f"r{i}" for i in range(4)])
+    before = pl.owners(tenants)
+    pl.add_replica("r4")
+    after = pl.owners(tenants)
+    moved = [t for t in tenants if after[t] != before[t]]
+    assert 0 < len(moved) <= 1.5 / 5 * len(tenants)
+    assert all(after[t] == "r4" for t in moved)
+    assert FleetPlacement.churn(before, after) == len(moved)
+
+
+def test_placement_remove_moves_only_victims():
+    """Removing (or killing) a replica moves exactly ITS tenants; every
+    other tenant keeps its owner."""
+    tenants = [f"t{i:05d}" for i in range(1000)]
+    pl = FleetPlacement(["r0", "r1", "r2"])
+    before = pl.owners(tenants)
+    pl.set_state("r1", DEAD)
+    after = pl.owners(tenants)
+    for t in tenants:
+        if before[t] == "r1":
+            assert after[t] in ("r0", "r2")
+        else:
+            assert after[t] == before[t]
+    # Revive restores the EXACT original map (pure function of ids).
+    pl.set_state("r1", UP)
+    assert pl.owners(tenants) == before
+
+
+def test_placement_states_and_empty():
+    pl = FleetPlacement(["r0", "r1"])
+    pl.set_state("r0", DRAINING)
+    assert pl.live() == ("r1",)
+    assert pl.place("anyone") == "r1"
+    pl.set_state("r1", DEAD)
+    assert pl.place("anyone") is None
+    with pytest.raises(ValueError):
+        pl.set_state("nope", UP)
+    with pytest.raises(ValueError):
+        pl.set_state("r0", "sideways")
+
+
+# --- router over stub replicas (routing mechanics at zero engine cost) ------
+
+
+class _StubReplica(ReplicaHandle):
+    """Transport-shaped stub: immediate verdicts stamped with the
+    replica id (so routing is directly observable), optional unresolved
+    futures (fleet-share accounting) and injected launch failures
+    (breaker feed)."""
+
+    def __init__(self, rid, hold=False, fail=False, dead_socket=False):
+        self.replica_id = rid
+        self.hold = hold
+        self.fail = fail
+        self.dead_socket = dead_socket
+        self.held: list[Future] = []
+        self.submits = 0
+        self.version = 0
+
+    def submit(self, instance, deadline_s=None, tenant="default",
+               trace=None):
+        self.submits += 1
+        f: Future = Future()
+        if self.hold:
+            self.held.append(f)
+        elif self.dead_socket:
+            # SocketReplica resolves the pool future with the transport
+            # error when the peer process dies — it never raises from
+            # submit() itself.
+            f.set_exception(ConnectionError("connection closed"))
+        elif self.fail:
+            f.set_exception(ExecuteError(tenant, retry_after_s=0.01))
+        else:
+            f.set_result({
+                "label": "rel0", "tenant": tenant,
+                "replica": self.replica_id,
+                "trace_id": trace.trace_id if trace is not None else None,
+            })
+        return f
+
+    def register_dataset(self, dataset, tenant, max_classes=None):
+        return []
+
+    def set_nota_threshold(self, threshold, tenant):
+        pass
+
+    def quarantine_tenant(self, tenant, reason=""):
+        pass
+
+    def unquarantine_tenant(self, tenant, reason=""):
+        pass
+
+    def drop_tenant(self, tenant):
+        pass
+
+    def prepare_publish(self, params=None, ckpt_dir=None):
+        return object()
+
+    def commit_publish(self, txn):
+        self.version += 1
+        return self.version
+
+    def abort_publish(self, txn):
+        pass
+
+    @property
+    def params_version(self):
+        return self.version
+
+    def stats_snapshot(self):
+        return {"served": self.submits, "p50_ms": 0.0, "p99_ms": 0.0,
+                "batch_occupancy": 1.0, "steady_recompiles": 0,
+                "queue_depth": len(self.held)}
+
+    def warmup(self):
+        return 0
+
+    def close(self):
+        pass
+
+
+def _stub_fleet(n=3, logger=None, breaker=None, **kw):
+    replicas = {f"r{i}": _StubReplica(f"r{i}") for i in range(n)}
+    router = FleetRouter(replicas, logger=logger, breaker=breaker, **kw)
+    control = FleetControl(router)
+    ds = object()
+    for i in range(24):
+        control.register_tenant(f"t{i:02d}", ds)
+    return router, control, replicas
+
+
+def test_router_routes_to_rendezvous_owner():
+    router, control, replicas = _stub_fleet()
+    try:
+        for t, entry in router.directory.items():
+            v = router.classify("q", tenant=t)
+            assert v["replica"] == entry.owner == router.placement.place(t)
+        assert sum(r.submits for r in replicas.values()) == 24
+        with pytest.raises(ValueError):
+            router.submit("q", tenant="never-registered")
+    finally:
+        router.close()
+
+
+def test_fleet_share_shed_fairness():
+    """A tenant over its fleet-wide in-flight share sheds AT THE DOOR
+    (Saturated with the tenant set) while other tenants keep admitting —
+    and the bound only binds once a second tenant exists."""
+    replicas = {f"r{i}": _StubReplica(f"r{i}", hold=True) for i in range(2)}
+    router = FleetRouter(replicas, fleet_share=0.5,
+                         queue_capacity_per_replica=4)
+    control = FleetControl(router)
+    try:
+        control.register_tenant("hog", object())
+        control.register_tenant("mouse", object())
+        cap = router._tenant_cap()   # 2 live * 4 * 0.5 = 4
+        assert cap == 4
+        hog_owner = router.directory["hog"].owner
+        # The share binds only once a SECOND tenant has submitted (the
+        # per-replica tenant_share discipline) — seed it first.
+        router.submit("q", tenant="mouse")
+        for _ in range(cap):
+            router.submit("q", tenant="hog")
+        with pytest.raises(Saturated) as exc:
+            router.submit("q", tenant="hog")
+        assert exc.value.tenant == "hog"
+        # The other tenant still admits — fleet-level fairness.
+        router.submit("q", tenant="mouse")
+        # Draining the hog's futures frees its share.
+        for f in replicas[hog_owner].held:
+            if not f.done():
+                f.set_result({"label": "rel0", "tenant": "hog",
+                              "replica": hog_owner})
+        router.submit("q", tenant="hog")
+    finally:
+        router.close()
+
+
+def test_breaker_opens_marks_dead_and_fails_over():
+    """Consecutive forwarded-launch failures open the per-replica
+    breaker; the open transition marks the replica DEAD in placement
+    (the health feed), its tenants fail over to degraded NOTA, and the
+    watchdog latches ONE replica_dead critical (re-armed by revive)."""
+    logger = MetricsLogger(None, quiet=True)
+    watchdog = HealthWatchdog(logger=logger)
+    logger.add_hook(watchdog.observe_record)
+    breaker = CircuitBreaker(failure_threshold=3, open_s=60.0)
+    replicas = {f"r{i}": _StubReplica(f"r{i}") for i in range(3)}
+    router = FleetRouter(replicas, logger=logger, breaker=breaker)
+    control = FleetControl(router)
+    try:
+        for i in range(24):
+            control.register_tenant(f"t{i:02d}", object())
+        tenant = "t00"
+        victim = router.directory[tenant].owner
+        replicas[victim].fail = True
+        for _ in range(3):
+            fut = router.submit("q", tenant=tenant)
+            with pytest.raises(ExecuteError):
+                fut.result(timeout=5.0)
+        assert breaker.state(victim) == "open"
+        assert router.placement.state(victim) == DEAD
+        crits = [e for e in watchdog.events if e.event == "replica_dead"]
+        assert len(crits) == 1
+        # Failover: the tenant now resolves to a LIVE replica but is
+        # still registered on the dead one -> degraded NOTA.
+        v = router.classify("q", tenant=tenant)
+        assert v["degraded"] and v["failover"] and v["nota"]
+        assert v["label"] == "no_relation"
+        # Re-placement recovers; only the victim's tenants moved.
+        owners_before = {
+            t: e.owner for t, e in router.directory.items()
+        }
+        moved = control.replace_tenants()
+        assert moved == sum(
+            1 for o in owners_before.values() if o == victim
+        )
+        v = router.classify("q", tenant=tenant)
+        assert "degraded" not in v or not v.get("degraded")
+        # Revive re-arms the latch.
+        router.revive_replica(victim)
+        assert f"replica_dead:{victim}" not in watchdog._latched
+    finally:
+        router.close()
+        logger.close()
+
+
+def test_breaker_opens_on_dead_socket_transport():
+    """A dead replica PROCESS surfaces as ConnectionError on the routed
+    future (SocketReplica resolves the pool future with the transport
+    error — submit() itself never raises), and that must feed the
+    per-replica breaker exactly like an ExecuteError: the replica goes
+    DEAD and its tenants fail over to degraded NOTA instead of raw
+    ConnectionErrors forever."""
+    breaker = CircuitBreaker(failure_threshold=3, open_s=60.0)
+    replicas = {f"r{i}": _StubReplica(f"r{i}") for i in range(3)}
+    router = FleetRouter(replicas, breaker=breaker)
+    control = FleetControl(router)
+    try:
+        for i in range(24):
+            control.register_tenant(f"t{i:02d}", object())
+        tenant = "t00"
+        victim = router.directory[tenant].owner
+        replicas[victim].dead_socket = True
+        for _ in range(3):
+            fut = router.submit("q", tenant=tenant)
+            with pytest.raises(ConnectionError):
+                fut.result(timeout=5.0)
+        assert breaker.state(victim) == "open"
+        assert router.placement.state(victim) == DEAD
+        v = router.classify("q", tenant=tenant)
+        assert v["degraded"] and v["failover"] and v["nota"]
+    finally:
+        router.close()
+
+
+def test_breaker_half_open_probe_auto_revives():
+    """After the open window a displaced tenant's request routes to the
+    dead replica as the half-open RECOVERY PROBE: success closes the
+    breaker, the closed transition revives the replica in placement,
+    and service resumes on the original owner with no operator
+    re-placement. A chaos/operator-killed replica (breaker still
+    closed) never probes — its path stays revive + replace."""
+    breaker = CircuitBreaker(failure_threshold=2, open_s=0.2)
+    replicas = {f"r{i}": _StubReplica(f"r{i}") for i in range(3)}
+    router = FleetRouter(replicas, breaker=breaker)
+    control = FleetControl(router)
+    try:
+        for i in range(12):
+            control.register_tenant(f"t{i:02d}", object())
+        tenant = "t00"
+        victim = router.directory[tenant].owner
+        replicas[victim].fail = True
+        for _ in range(2):
+            with pytest.raises(ExecuteError):
+                router.submit("q", tenant=tenant).result(timeout=5.0)
+        assert router.placement.state(victim) == DEAD
+        # Still inside the open window: degraded, no probe.
+        assert router.classify("q", tenant=tenant)["degraded"]
+        # Window elapses and the replica is healthy again: the next
+        # request IS the probe — served by the original owner, breaker
+        # closed, replica revived.
+        replicas[victim].fail = False
+        time.sleep(0.25)
+        v = router.classify("q", tenant=tenant)
+        assert v["replica"] == victim and not v.get("degraded")
+        assert breaker.state(victim) == "closed"
+        assert router.placement.state(victim) == UP
+        # Chaos-kill (breaker untouched) never auto-probes.
+        router.mark_replica_dead(victim, reason="drill")
+        time.sleep(0.25)
+        assert router.classify("q", tenant=tenant)["degraded"]
+    finally:
+        router.close()
+
+
+def test_10k_tenant_placement_scale():
+    """Placement at the ROADMAP scale: 10k tenants over 8 replicas —
+    balanced, deterministic, and the add-remap bound holds. Pure
+    hashing: this is the cheap half of the 10k soak (the traffic half
+    rides the slow lane)."""
+    tenants = [f"t{i:05d}" for i in range(10_000)]
+    pl = FleetPlacement([f"r{i}" for i in range(8)])
+    from collections import Counter
+
+    dist = Counter(pl.owners(tenants).values())
+    assert len(dist) == 8
+    assert max(dist.values()) < 1.25 * 10_000 / 8
+    assert min(dist.values()) > 0.75 * 10_000 / 8
+    before = pl.owners(tenants)
+    pl.add_replica("r8")
+    moved = FleetPlacement.churn(before, pl.owners(tenants))
+    assert 0 < moved <= 1.35 / 9 * 10_000
+
+
+# --- engine-backed fleet behavior -------------------------------------------
+
+
+def test_fanout_publish_atomicity(world):
+    """One replica's injected ``publish.nan_params`` (the MIDDLE one, so
+    an already-prepared replica must abort) rolls the WHOLE fleet back:
+    every replica on its old params_version, every tenant snapshot
+    unchanged, in-flight batches untouched — then a clean fan-out
+    commits uniformly with zero recompiles."""
+    _, _, params, datasets = world
+    router, control = _fleet(world)
+    try:
+        pools = _pools(datasets)
+        for i in range(6):
+            control.register_tenant(f"t{i}", datasets[i % 3])
+        for h in router.replicas.values():
+            h.warmup()
+        versions0 = {
+            r: h.params_version for r, h in router.replicas.items()
+        }
+        snaps0 = {
+            r: {t: h.engine.registry.snapshot(t).version
+                for t in h.engine.registry.tenants()}
+            for r, h in router.replicas.items()
+        }
+        futs = [
+            router.submit(pools[i % 3][0], 10.0, tenant=f"t{i}")
+            for i in range(6)
+        ]
+        install(ChaosRegistry.parse("publish.nan_params@1"))
+        try:
+            with pytest.raises(FleetPublishError) as exc:
+                control.publish_params(params)
+        finally:
+            install(None)
+        assert exc.value.replica == sorted(router.replicas)[1]
+        assert versions0 == {
+            r: h.params_version for r, h in router.replicas.items()
+        }
+        assert snaps0 == {
+            r: {t: h.engine.registry.snapshot(t).version
+                for t in h.engine.registry.tenants()}
+            for r, h in router.replicas.items()
+        }
+        for f in futs:
+            assert "label" in f.result(timeout=30.0)
+        # Clean fan-out: uniform new version, zero recompiles.
+        version = control.publish_params(params)
+        assert {
+            h.params_version for h in router.replicas.values()
+        } == {version}
+        assert all(
+            h.stats_snapshot()["steady_recompiles"] == 0
+            for h in router.replicas.values()
+        )
+    finally:
+        router.close()
+
+
+def test_replica_kill_chaos_failover_recover(world):
+    """The fleet.replica_kill chaos point mid-traffic: the owning
+    replica dies, its tenants serve degraded NOTA (zero drops), and
+    re-placement recovers them on surviving replicas — per-tenant NOTA
+    thresholds surviving the move."""
+    _, _, _, datasets = world
+    logger = MetricsLogger(None, quiet=True)
+    router, control = _fleet(world, logger=logger)
+    try:
+        pools = _pools(datasets)
+        for i in range(9):
+            control.register_tenant(f"t{i}", datasets[i % 3])
+        tenant = "t0"
+        control.set_nota_threshold(tenant, 123.0)   # open-set floor:
+        #                            everything verdicts NOTA — a marker
+        #                            that must survive re-placement
+        victim = router.directory[tenant].owner
+        install(ChaosRegistry.parse(f"fleet.replica_kill@0:{victim}"))
+        try:
+            v = router.classify(pools[0][0], 10.0, tenant=tenant)
+        finally:
+            install(None)
+        assert v["degraded"] and v["failover"]
+        assert router.placement.state(victim) == DEAD
+        moved = control.replace_tenants()
+        assert moved >= 1 and not router.pending_failover()
+        v = router.classify(pools[0][0], 10.0, tenant=tenant)
+        assert not v.get("degraded")
+        # The threshold moved with the tenant: still all-NOTA.
+        assert v["nota"] and v["label"] == "no_relation"
+        new_owner = router.directory[tenant].owner
+        assert new_owner != victim
+        assert router.replicas[new_owner].engine.registry.snapshot(
+            tenant
+        ).nota_threshold == 123.0
+    finally:
+        router.close()
+        logger.close()
+
+
+def test_trace_context_propagates_across_hop(world):
+    """A router-minted TraceContext crosses the hop: the verdict's
+    trace_id is the router's id, and the ring holds both the router's
+    fleet/route span and the replica-side serve spans under that id."""
+    from induction_network_on_fewrel_tpu.obs.spans import (
+        SpanTracker,
+        set_tracker,
+    )
+
+    _, _, _, datasets = world
+    tracker = SpanTracker(capacity=512)
+    prev = set_tracker(tracker)
+    router, control = _fleet(world, n_replicas=2, trace_sample=1.0)
+    try:
+        control.register_tenant("t0", datasets[0])
+        v = router.classify(_pools(datasets)[0][0], 10.0, tenant="t0")
+        assert v.get("trace_id")
+        spans = tracker.snapshot()
+        route = [s for s in spans if s["name"] == "fleet/route"]
+        assert route and route[0]["trace_id"] == v["trace_id"]
+        execute = [
+            s for s in spans
+            if s["name"] == "serve/execute"
+            and v["trace_id"] in tuple(s.get("links", ()))
+        ]
+        assert execute, [s["name"] for s in spans]
+    finally:
+        set_tracker(prev)
+        router.close()
+
+
+def test_fleet_telemetry_schema_and_report(world, tmp_path):
+    """kind='fleet' records are schema-clean and the obs_report fleet
+    section renders the per-replica table, churn, and fan-out row."""
+    _, _, params, datasets = world
+    logger = MetricsLogger(tmp_path, quiet=True)
+    router, control = _fleet(world, n_replicas=2, logger=logger)
+    try:
+        for i in range(4):
+            control.register_tenant(f"t{i}", datasets[i % 3])
+        pools = _pools(datasets)
+        for i in range(4):
+            router.classify(pools[i % 3][0], 10.0, tenant=f"t{i}")
+        control.publish_params(params)
+        victim = router.directory["t0"].owner
+        router.mark_replica_dead(victim, reason="test")
+        router.classify(pools[0][0], 10.0, tenant="t0")   # degraded
+        control.replace_tenants()
+        router.emit_stats()
+    finally:
+        router.close()
+        logger.close()
+    n, errors = obs_report.check_schema(tmp_path / "metrics.jsonl")
+    assert errors == [], errors
+    recs = obs_report.load_records(tmp_path / "metrics.jsonl")
+    fleet = obs_report.fleet_summary(recs)
+    assert fleet["replicas"] == 2 and fleet["tenants"] == 4
+    assert set(fleet["replica_table"]) == set(router.replicas)
+    assert fleet["last_fanout"]["params_version"] == 1.0
+    assert fleet["degraded_served"] >= 1
+    assert fleet["replica_dead_faults"] == 1
+    assert fleet["replace_events"] == 1
+
+
+# --- the tier-1 regression gate (FLEET artifact band) -----------------------
+
+
+def _latest_fleet_artifact() -> dict:
+    paths = sorted(glob.glob(os.path.join(_REPO, "FLEET_r*.json")))
+    assert paths, "no FLEET_r*.json artifact in the repo root"
+    with open(paths[-1]) as f:
+        return json.load(f)
+
+
+def test_fleet_artifact_complete():
+    """Acceptance shape: the committed soak artifact carries the
+    per-replica table, placement churn vs bound, the fan-out publish
+    row, the replica-kill drill, the zero-bands, and the tier1 block
+    the gate below replays."""
+    art = _latest_fleet_artifact()
+    assert art["passed"] and art["placement_consistent"]
+    assert art["tenants"] >= 1000          # the CPU-honest soak scale
+    assert len(art["per_replica"]) >= 4
+    for row in art["per_replica"].values():
+        assert row["steady_recompiles"] == 0
+        assert isinstance(row["qps"], (int, float))
+    pl = art["placement"]
+    assert pl["add_churn_frac"] <= pl["add_churn_bound"]
+    fp = art["fanout_publish"]
+    assert fp["uniform"] and fp["dropped"] == 0
+    assert fp["steady_recompiles"] == 0
+    assert isinstance(fp["publish_s"], (int, float))
+    rk = art["replica_kill"]
+    assert rk["criticals"] == 1 and rk["once_latched"]
+    assert rk["recovered"] and rk["dropped_during_failover"] == 0
+    assert art["zero_bands"] == {
+        "dropped_during_failover": 0, "steady_recompiles": 0,
+    }
+    t1 = art["tier1"]
+    assert {"replicas", "tenants", "seed", "add_churn_frac", "band",
+            "placement_distribution", "replica_kill"} <= set(t1)
+
+
+def test_fleet_tier1_regression_gate(tmp_path):
+    """Replay the committed artifact's miniature 3-replica drill
+    in-process: consistent placement under mixed traffic, the poisoned
+    fan-out rolling back atomically and the clean one committing with
+    zero recompiles and zero drops, bounded add-churn (EXACT — placement
+    is a pure function of the ids), and replica-kill failover serving
+    degraded NOTA then recovering after re-placement."""
+    art = _latest_fleet_artifact()
+    t1 = art["tier1"]
+    logger = MetricsLogger(tmp_path, quiet=True)
+    try:
+        res = loadgen.fleet_tier1_drill(seed=int(t1["seed"]), logger=logger)
+    finally:
+        logger.close()
+    assert res["passed"], res
+    band = t1["band"]["churn_frac_abs"]
+    assert abs(res["add_churn_frac"] - t1["add_churn_frac"]) <= band, (
+        "placement churn moved vs the committed artifact — a placement/"
+        "hash change must re-emit FLEET_r*.json (tools/loadgen.py "
+        "--fleet ... --fleet_artifact)"
+    )
+    assert res["placement_distribution"] == t1["placement_distribution"]
+    assert res["replica_kill"]["victim"] == t1["replica_kill"]["victim"]
+    for key in ("degraded_verdict", "criticals", "once_latched",
+                "recovered", "latch_rearmed_on_revive"):
+        assert res["replica_kill"][key] == t1["replica_kill"][key], key
+    assert res["steady_recompiles"] == 0
+    # Telemetry from the replay is schema-clean (fleet kind included).
+    n, errors = obs_report.check_schema(tmp_path / "metrics.jsonl")
+    assert errors == [], errors
+
+
+# --- slow lane: socket transport + scaled soak ------------------------------
+
+
+@pytest.mark.slow
+def test_socket_transport_fleet(world, tmp_path):
+    """The same router/control stack over the JSON-lines socket
+    transport: registration, routed traffic, typed backpressure, and a
+    checkpoint fan-out publish — behind the SAME ReplicaHandle
+    interface (the multi-process arm of ISSUE 13)."""
+    from induction_network_on_fewrel_tpu.fleet.transport import (
+        ReplicaServer,
+        SocketReplica,
+    )
+    from induction_network_on_fewrel_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+    from induction_network_on_fewrel_tpu.train.steps import init_state
+
+    tok, model, params, datasets = world
+    # A real checkpoint: socket replicas publish from the shared
+    # artifact store, not a wire-serialized params tree.
+    state = init_state(
+        model, CFG,
+        zero_batch(CFG.max_length, (1, CFG.n, CFG.k)),
+        zero_batch(CFG.max_length, (1, CFG.total_q)),
+    )
+    ckpt = str(tmp_path / "ckpt")
+    mngr = CheckpointManager(ckpt, CFG, stage="off")
+    try:
+        mngr.save(0, state, val_accuracy=0.0)
+        mngr.wait()
+    finally:
+        mngr.close()
+
+    engines = [
+        InferenceEngine(model, params, CFG, tok, k=CFG.k, buckets=(1, 2))
+        for _ in range(2)
+    ]
+    servers = [ReplicaServer(e).start() for e in engines]
+    clients = {}
+    router = None
+    try:
+        clients = {
+            f"r{i}": SocketReplica(f"r{i}", srv.address)
+            for i, srv in enumerate(servers)
+        }
+        assert all(c.params_version == 0 for c in clients.values())
+        router = FleetRouter(dict(clients))
+        control = FleetControl(router)
+        for i in range(6):
+            control.register_tenant(f"t{i}", datasets[i % 3])
+        for rid, c in clients.items():
+            c.warmup()
+        pools = _pools(datasets)
+        for i in range(6):
+            v = router.classify(pools[i % 3][0], 15.0, tenant=f"t{i}")
+            assert v["tenant"] == f"t{i}" and "label" in v
+        # Fan-out publish from the checkpoint dir: both processes'
+        # registries commit the same new version.
+        version = control.publish_checkpoint(ckpt)
+        assert version == 1
+        assert all(c.params_version == 1 for c in clients.values())
+        # Typed errors cross the wire: unknown tenant on the replica.
+        with pytest.raises(RuntimeError):
+            clients["r0"].submit(
+                pools[0][0], tenant="not-there"
+            ).result(timeout=10.0)
+    finally:
+        if router is not None:
+            router.close()       # closes the SocketReplica clients
+        else:
+            for c in clients.values():
+                c.close()
+        for srv in servers:
+            srv.stop()
+        for e in engines:
+            e.close()
+
+
+@pytest.mark.slow
+def test_fleet_soak_10k_tenants(world):
+    """The ROADMAP-scale control plane through the REAL loadgen path:
+    10,000 tenants onboarded onto 4 replicas, mixed traffic, a fan-out
+    publish under load, bounded add-churn, and the replica-kill
+    failover arc — the full soak, slow lane (~1 min CPU; the committed
+    FLEET_r01.json is the 1k in-session twin)."""
+    import argparse
+
+    args = argparse.Namespace(
+        fleet=4, tenants=10_000, N=3, K=2, na_rate=0, buckets="1,2,4",
+        queue_depth=64, tenant_share=0.5, deadline_ms=10000.0,
+        batch_window_ms=2.0, serving_dp=None, device="cpu",
+        concurrency=4, duration=2.5, seed=1, trace_sample=0.0,
+    )
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="fleet_soak_") as tmp:
+        ckpt = loadgen.make_synthetic_checkpoint(args, tmp)
+        out = loadgen.run_fleet_soak(args, ckpt, None, None, None)
+    assert out["passed"], out
+    assert out["tenants"] == 10_000
+    # The rendezvous bound holds at the full scale too.
+    pl = out["placement"]
+    assert pl["add_churn_frac"] <= pl["add_churn_bound"]
+    assert out["zero_bands"] == {
+        "dropped_during_failover": 0, "steady_recompiles": 0,
+    }
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

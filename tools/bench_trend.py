@@ -106,6 +106,16 @@ BANDS: dict[str, tuple[str, float]] = {
     "chaos.passed": ("floor", 1.0),
     "chaos.ckpt_bitwise_recovery": ("floor", 1.0),
     "chaos.breaker_open_criticals": ("floor", 1.0),
+    # Fleet soak (ISSUE 13, FLEET_r*.json): the router-tier containment
+    # invariants as zero-bands — failover must drop nothing (degraded
+    # verdicts are answers, not drops) and steady-state traffic across
+    # every replica must compile nothing — plus the drill pass/recovery
+    # floors. Absolute qps/p99 are recorded unbanded (documented-unstable
+    # sandbox, same policy as serve.*).
+    "fleet.dropped_during_failover": ("zero", 0.0),
+    "fleet.steady_recompiles": ("zero", 0.0),
+    "fleet.passed": ("floor", 1.0),
+    "fleet.kill_recovered": ("floor", 1.0),
 }
 
 
@@ -239,12 +249,46 @@ def _chaos_points(points: dict, path: str, data: dict) -> int:
     return sum(len(v) for v in points.values()) - before
 
 
+def _fleet_points(points: dict, path: str, data: dict) -> int:
+    """FLEET_r*.json (tools/loadgen.py --fleet): the router-tier soak —
+    zero-bands, drill pass/recovery, placement churn, fan-out publish
+    wall time, and per-replica qps (recorded, unbanded)."""
+    rnd, src = _round_of(path), os.path.basename(path)
+    before = sum(len(v) for v in points.values())
+    zero = data.get("zero_bands") or {}
+    _point(points, "fleet.dropped_during_failover", rnd, src,
+           zero.get("dropped_during_failover"))
+    _point(points, "fleet.steady_recompiles", rnd, src,
+           zero.get("steady_recompiles"))
+    _point(points, "fleet.passed", rnd, src,
+           1.0 if data.get("passed") else 0.0)
+    placement = data.get("placement") or {}
+    _point(points, "fleet.tenants", rnd, src, placement.get("tenants"))
+    _point(points, "fleet.add_churn_frac", rnd, src,
+           placement.get("add_churn_frac"))
+    fanout = data.get("fanout_publish") or {}
+    _point(points, "fleet.fanout_publish_s", rnd, src,
+           fanout.get("publish_s"))
+    kill = data.get("replica_kill") or {}
+    _point(points, "fleet.kill_recovered", rnd, src,
+           1.0 if kill.get("recovered") else 0.0)
+    traffic = data.get("traffic") or {}
+    _point(points, "fleet.qps", rnd, src, traffic.get("qps"))
+    _point(points, "fleet.p99_ms", rnd, src, traffic.get("p99_ms"))
+    for rid, row in sorted((data.get("per_replica") or {}).items()):
+        if isinstance(row, dict):
+            _point(points, f"fleet.replica_qps.{rid}", rnd, src,
+                   row.get("qps"))
+    return sum(len(v) for v in points.values()) - before
+
+
 _EXTRACTORS = (
     ("BENCH_r*.json", _bench_points),
     ("ROOFLINE_r*.json", _roofline_points),
     ("COMMS_r*.json", _comms_points),
     ("SERVE_r*.json", _serve_points),
     ("CHAOS_r*.json", _chaos_points),
+    ("FLEET_r*.json", _fleet_points),
 )
 
 

@@ -152,6 +152,19 @@ def parse_args(argv=None):
                         "--run_dir)")
     p.add_argument("--chaos_artifact", default=None, metavar="PATH",
                    help="write the CHAOS_r*.json drill artifact here")
+    p.add_argument("--fleet", type=int, default=0, metavar="R",
+                   help="fleet soak mode (ISSUE 13): build R in-process "
+                        "engine replicas behind the fleet router, spread "
+                        "--tenants tenants across them by rendezvous "
+                        "placement, drive mixed closed-loop traffic, "
+                        "fan-out one all-or-nothing publish mid-load, "
+                        "measure placement churn on a replica add, and "
+                        "run the fleet.replica_kill failover drill "
+                        "(degraded NOTA -> re-place -> recover). "
+                        "Standalone mode: the scheduler arms are skipped. "
+                        "0 = off")
+    p.add_argument("--fleet_artifact", default=None, metavar="PATH",
+                   help="write the FLEET_r*.json soak artifact here")
     p.add_argument("--slo_profile", action="store_true",
                    help="also attempt a jax.profiler trace in the SLO "
                         "auto-capture (default off: on this image a "
@@ -1155,6 +1168,552 @@ def check_chaos_drill(drill: dict) -> bool:
     )
 
 
+# --- fleet soak (ISSUE 13) --------------------------------------------------
+
+
+def _fleet_datasets(args, count: int) -> list:
+    """``count`` distinct synthetic relation corpora. Tenants cycle over
+    them: distinct-enough supports for a real multi-tenant workload,
+    while the registry's digest dedup keeps the distill cost bounded at
+    1k/10k-tenant scale (CPU-honest — the per-tenant snapshots, routing,
+    and placement work are all still per-tenant)."""
+    from induction_network_on_fewrel_tpu.data import make_synthetic_fewrel
+
+    return [
+        make_synthetic_fewrel(
+            num_relations=args.N, instances_per_relation=args.K + 10,
+            vocab_size=2000, seed=args.seed + 101 * d,
+        )
+        for d in range(count)
+    ]
+
+
+def _run_fleet_closed(router, pools, tenant_names, concurrency, duration,
+                      seed, deadline_s=10.0):
+    """Closed-loop workers striding across ``tenant_names`` through the
+    ROUTER. Returns aggregate latency percentiles + the three outcome
+    counters the fleet invariants gate on: ``shed`` (fleet-share or
+    replica backpressure — back off and retry, same discipline as
+    run_closed), ``degraded`` (failover NOTA verdicts — answers, not
+    errors), ``errors`` (everything else — the dropped_during_failover
+    zero-band)."""
+    import numpy as np
+
+    from induction_network_on_fewrel_tpu.serving.batcher import Saturated
+
+    lat: list[float] = []
+    counters = {"shed": 0, "degraded": 0, "errors": 0}
+    lock = threading.Lock()
+    stop = time.monotonic() + duration
+
+    def worker(wi: int):
+        r = np.random.default_rng(seed + wi)
+        mine, me = [], {"shed": 0, "degraded": 0, "errors": 0}
+        i = wi
+        while time.monotonic() < stop:
+            tenant = tenant_names[i % len(tenant_names)]
+            i += concurrency
+            pool = pools[tenant]
+            inst = pool[int(r.integers(len(pool)))]
+            t0 = time.monotonic()
+            try:
+                v = router.classify(inst, deadline_s, tenant=tenant)
+                mine.append(time.monotonic() - t0)
+                if v.get("degraded"):
+                    me["degraded"] += 1
+            except Saturated as e:
+                me["shed"] += 1
+                delay = e.retry_after_s * (0.75 + 0.5 * float(r.random()))
+                time.sleep(max(0.0, min(delay, stop - time.monotonic())))
+            except Exception:  # noqa: BLE001 — counted: the zero-band
+                me["errors"] += 1
+        with lock:
+            lat.extend(mine)
+            for k in counters:
+                counters[k] += me[k]
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(concurrency)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    return {
+        "served": len(lat),
+        "qps": round(len(lat) / wall, 1),
+        "p50_ms": pct_ms(lat, 50),
+        "p99_ms": pct_ms(lat, 99),
+        "wall": wall,
+        **counters,
+    }
+
+
+def run_fleet_soak(args, ckpt, logger, recorder, capture) -> dict:
+    """The ISSUE 13 fleet soak: R in-process replicas behind the router,
+    T tenants rendezvous-placed across them, then:
+
+    1. onboarding — T tenants registered through the control plane
+       (owners recorded, placement re-resolution consistent);
+    2. mixed closed-loop traffic with ONE all-or-nothing fan-out publish
+       fired mid-load from a side thread: zero dropped requests, zero
+       steady-state recompiles on every replica, params_version uniform;
+    3. replica add — placement churn measured against the rendezvous
+       bound (~1/(R+1)), displaced tenants re-registered and re-served;
+    4. ``fleet.replica_kill`` drill — an injected replica death mid-
+       traffic: failover serves degraded NOTA (zero drops), the
+       watchdog latches ONE replica_dead CRITICAL, re-placement
+       recovers the tenants, and a revive re-arms the latch.
+    """
+    from collections import Counter
+
+    from induction_network_on_fewrel_tpu.fleet import (
+        FleetControl,
+        FleetPlacement,
+        FleetRouter,
+        InProcessReplica,
+    )
+    from induction_network_on_fewrel_tpu.obs import HealthWatchdog
+    from induction_network_on_fewrel_tpu.obs.chaos import (
+        ChaosRegistry,
+        install,
+    )
+    from induction_network_on_fewrel_tpu.serving.breaker import CircuitBreaker
+
+    from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+    R, T = args.fleet, max(args.tenants, 1)
+    # The kill drill's criticals flow through logger HOOKS (watchdog):
+    # with no run dir, a pathless logger still carries the record stream.
+    own_logger = logger is None
+    if own_logger:
+        logger = MetricsLogger(None, quiet=True)
+    watchdog = HealthWatchdog(
+        logger=logger, recorder=recorder, capture=capture
+    )
+    logger.add_hook(watchdog.observe_record)
+
+    def mk():
+        return build_engine(args, ckpt, "continuous", logger=logger)
+
+    replicas = {
+        f"r{i:02d}": InProcessReplica(f"r{i:02d}", mk()) for i in range(R)
+    }
+    router = FleetRouter(
+        replicas, logger=logger,
+        breaker=CircuitBreaker(failure_threshold=3, open_s=1.0),
+        queue_capacity_per_replica=args.queue_depth,
+    )
+    control = FleetControl(router)
+    out: dict = {"replicas": R, "tenants": T}
+    try:
+        # 1. onboarding.
+        datasets = _fleet_datasets(args, min(8, T))
+        names = [f"t{i:04d}" for i in range(T)]
+        t0 = time.monotonic()
+        for i, tenant in enumerate(names):
+            control.register_tenant(tenant, datasets[i % len(datasets)])
+        out["register_s"] = round(time.monotonic() - t0, 3)
+        out["warmup_compiles"] = sum(
+            h.warmup() for h in router.replicas.values()
+        )
+        dist = Counter(e.owner for e in router.directory.values())
+        out["placement_distribution"] = dict(sorted(dist.items()))
+        owners = router.placement.owners(names)
+        out["placement_consistent"] = all(
+            owners[t] == router.directory[t].owner for t in names
+        )
+        pools = {
+            t: [
+                inst
+                for rel in datasets[i % len(datasets)].rel_names
+                for inst in datasets[i % len(datasets)].instances[rel][args.K:]
+            ]
+            for i, t in enumerate(names)
+        }
+
+        # 2. mixed traffic + mid-load fan-out publish.
+        served0 = {
+            rid: h.stats_snapshot()["served"]
+            for rid, h in router.replicas.items()
+        }
+        pub: dict = {}
+
+        def _publish():
+            p0 = time.monotonic()
+            try:
+                pub["params_version"] = control.publish_params(
+                    router.replicas[sorted(router.replicas)[0]].engine.params
+                )
+            except Exception as e:  # noqa: BLE001 — report, never die
+                pub["error"] = repr(e)
+            pub["publish_s"] = round(time.monotonic() - p0, 4)
+
+        timer = threading.Timer(max(args.duration / 2, 0.5), _publish)
+        timer.start()
+        traffic = _run_fleet_closed(
+            router, pools, names, args.concurrency, args.duration,
+            args.seed,
+        )
+        timer.join(timeout=120.0)
+        wall = traffic.pop("wall")
+        out["traffic"] = traffic
+        per_replica = {}
+        for rid, h in sorted(router.replicas.items()):
+            s = h.stats_snapshot()
+            per_replica[rid] = {
+                "qps": round((s["served"] - served0[rid]) / wall, 1),
+                "served": s["served"],
+                "p50_ms": s["p50_ms"],
+                "p99_ms": s["p99_ms"],
+                "occupancy": s["batch_occupancy"],
+                "steady_recompiles": s["steady_recompiles"],
+            }
+        out["per_replica"] = per_replica
+        versions = {
+            rid: h.params_version for rid, h in router.replicas.items()
+        }
+        out["fanout_publish"] = {
+            **pub,
+            "replicas": len(versions),
+            "uniform": len(set(versions.values())) == 1,
+            "dropped": traffic["errors"],
+            "steady_recompiles": sum(
+                r["steady_recompiles"] for r in per_replica.values()
+            ),
+        }
+
+        # 3. replica add: churn against the rendezvous bound.
+        before = router.placement.owners(names)
+        new_rid = f"r{R:02d}"
+        control.add_replica(InProcessReplica(new_rid, mk()))
+        after = router.placement.owners(names)
+        moved = FleetPlacement.churn(before, after)
+        replaced = control.replace_tenants()
+        router.replicas[new_rid].warmup()
+        moved_tenants = [t for t in names if after[t] != before[t]]
+        out["placement"] = {
+            "tenants": T,
+            "replicas": R,
+            "add_churn_frac": round(moved / T, 4),
+            # 1/(R+1) expectation + slack — the bound tests pin.
+            "add_churn_bound": round(1.5 / (R + 1), 4),
+            # The 1.5x slack is a LARGE-T concentration bound: churn is
+            # binomial with mean T/(R+1), and a handful of tenants can
+            # legitimately all move. Gate only in the statistical
+            # regime; tiny fleets record the number unbanded.
+            "churn_ok": T < 100 or moved / T <= 1.5 / (R + 1),
+            "moved": moved,
+            "replaced": replaced,
+            # Vacuously true when nothing moved (legitimate at tiny T).
+            "moved_tenants_served": all(
+                not router.classify(
+                    pools[t][0], 10.0, tenant=t
+                ).get("degraded")
+                for t in moved_tenants[:5]
+            ),
+        }
+
+        # 4. replica-kill failover drill.
+        victim = router.directory[names[0]].owner
+        affected = [
+            t for t, e in router.directory.items() if e.owner == victim
+        ]
+        install(ChaosRegistry.parse(
+            f"fleet.replica_kill@0:{victim}", logger=logger
+        ))
+        kill_traffic = _run_fleet_closed(
+            router, pools, names[: min(T, 128)], 2,
+            max(1.5, args.duration / 3), args.seed + 7,
+        )
+        install(None)
+        crits = [e for e in watchdog.events if e.event == "replica_dead"]
+        replaced_kill = control.replace_tenants()
+        recovered = all(
+            not router.classify(pools[t][0], 10.0, tenant=t).get("degraded")
+            for t in affected[:5]
+        )
+        router.revive_replica(victim, reason="drill recovery")
+        latch_rearmed = (
+            f"replica_dead:{victim}" not in watchdog._latched
+        )
+        moved_back = control.replace_tenants()
+        out["replica_kill"] = {
+            "victim": victim,
+            "affected_tenants": len(affected),
+            "degraded_served": kill_traffic["degraded"],
+            "dropped_during_failover": kill_traffic["errors"],
+            "criticals": len(crits),
+            "once_latched": len(crits) == 1,
+            "replaced": replaced_kill,
+            "recovered": recovered,
+            "latch_rearmed_on_revive": latch_rearmed,
+            "moved_back_on_revive": moved_back,
+        }
+        router.emit_stats()
+        final_recompiles = sum(
+            h.stats_snapshot()["steady_recompiles"]
+            for h in router.replicas.values()
+        )
+        out["zero_bands"] = {
+            "dropped_during_failover": kill_traffic["errors"],
+            "steady_recompiles": final_recompiles,
+        }
+        out["passed"] = check_fleet_soak(out)
+        return out
+    finally:
+        install(None)
+        router.close()
+        # Unhook the soak's watchdog: a later drill on the SAME logger
+        # (the tier-1 miniature in main's fleet branch) must not emit
+        # every fault critical twice.
+        if watchdog.observe_record in logger.hooks:
+            logger.hooks.remove(watchdog.observe_record)
+        if own_logger:
+            logger.close()
+
+
+def check_fleet_soak(out: dict) -> bool:
+    """The soak's acceptance: consistent placement, an atomic fan-out
+    publish under load (uniform version, zero drops, zero recompiles),
+    bounded add-churn with displaced tenants re-served, and the kill
+    drill's full inject -> degrade -> re-place -> recover arc."""
+    fp = out.get("fanout_publish", {})
+    pl = out.get("placement", {})
+    rk = out.get("replica_kill", {})
+    zb = out.get("zero_bands", {})
+    return bool(
+        out.get("placement_consistent")
+        and fp.get("params_version") is not None
+        and fp.get("uniform")
+        and fp.get("dropped") == 0
+        and fp.get("steady_recompiles") == 0
+        and isinstance(pl.get("add_churn_frac"), float)
+        and pl.get("churn_ok")
+        and pl.get("moved_tenants_served")
+        and rk.get("degraded_served", 0) >= 1
+        and rk.get("criticals") == 1
+        and rk.get("once_latched")
+        and rk.get("recovered")
+        and rk.get("latch_rearmed_on_revive")
+        and rk.get("dropped_during_failover") == 0
+        and zb.get("steady_recompiles") == 0
+    )
+
+
+def fleet_tier1_drill(seed: int = 0, logger=None) -> dict:
+    """The miniature 3-replica fleet leg the tier-1 gate replays
+    (tests/test_fleet.py — the tests/test_scenarios.py artifact
+    discipline): a tiny self-contained world, every fleet invariant in
+    one pass. Deterministic in ``seed``: the placement numbers are pure
+    functions of the tenant/replica ids, so the committed FLEET artifact
+    can pin them EXACTLY and a hash/placement change fails tier-1 until
+    the artifact is re-emitted."""
+    import jax
+    from collections import Counter
+
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.data import (
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.data.tokenizer import GloveTokenizer
+    from induction_network_on_fewrel_tpu.fleet import (
+        FleetControl,
+        FleetPlacement,
+        FleetPublishError,
+        FleetRouter,
+        InProcessReplica,
+    )
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.obs import HealthWatchdog
+    from induction_network_on_fewrel_tpu.obs.chaos import (
+        ChaosRegistry,
+        install,
+    )
+    from induction_network_on_fewrel_tpu.serving.breaker import CircuitBreaker
+    from induction_network_on_fewrel_tpu.serving.buckets import zero_batch
+    from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
+    from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+    R, T = 3, 48
+    cfg = ExperimentConfig(
+        model="induction", encoder="cnn", hidden_size=16,
+        vocab_size=122, word_dim=8, pos_dim=2, max_length=16,
+        induction_dim=8, ntn_slices=4, routing_iters=2,
+        n=3, train_n=3, k=2, q=2, device="cpu", seed=seed,
+    )
+    vocab = make_synthetic_glove(
+        vocab_size=cfg.vocab_size - 2, word_dim=cfg.word_dim
+    )
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    model = build_model(cfg, glove_init=vocab.vectors)
+    params = model.init(
+        jax.random.key(seed),
+        zero_batch(cfg.max_length, (1, cfg.n, cfg.k)),
+        zero_batch(cfg.max_length, (1, 2)),
+    )
+    # A hook-bearing logger even with no run dir: the watchdog's latch
+    # assertions need the record stream, not the jsonl file.
+    own_logger = logger if logger is not None else MetricsLogger(
+        None, quiet=True
+    )
+    watchdog = HealthWatchdog(logger=own_logger)
+    own_logger.add_hook(watchdog.observe_record)
+
+    def mk():
+        return InferenceEngine(
+            model, params, cfg, tok, k=cfg.k, buckets=(1, 2, 4),
+            logger=own_logger,
+        )
+
+    replicas = {
+        f"r{i:02d}": InProcessReplica(f"r{i:02d}", mk()) for i in range(R)
+    }
+    router = FleetRouter(
+        replicas, logger=own_logger,
+        breaker=CircuitBreaker(failure_threshold=3, open_s=1.0),
+        queue_capacity_per_replica=64,
+    )
+    control = FleetControl(router)
+    out: dict = {"replicas": R, "tenants": T, "seed": seed}
+    try:
+        datasets = [
+            make_synthetic_fewrel(
+                num_relations=cfg.n, instances_per_relation=cfg.k + 6,
+                vocab_size=cfg.vocab_size - 2, seed=seed + 101 * d,
+            )
+            for d in range(4)
+        ]
+        names = [f"t{i:02d}" for i in range(T)]
+        for i, tenant in enumerate(names):
+            control.register_tenant(tenant, datasets[i % 4])
+        for h in router.replicas.values():
+            h.warmup()
+        dist = Counter(e.owner for e in router.directory.values())
+        out["placement_distribution"] = dict(sorted(dist.items()))
+        owners = router.placement.owners(names)
+        out["placement_consistent"] = all(
+            owners[t] == router.directory[t].owner for t in names
+        )
+        pools = {
+            t: [
+                inst for rel in datasets[i % 4].rel_names
+                for inst in datasets[i % 4].instances[rel][cfg.k:]
+            ]
+            for i, t in enumerate(names)
+        }
+        # Mixed traffic: one verdict per tenant through the router.
+        verdicts = [
+            router.classify(pools[t][0], 10.0, tenant=t) for t in names
+        ]
+        out["traffic_ok"] = all(
+            v["tenant"] == t and not v.get("degraded")
+            for v, t in zip(verdicts, names)
+        )
+
+        # Poisoned fan-out: the MIDDLE replica's prepare is injected
+        # (publish.nan_params@1) — atomicity means the whole fleet rolls
+        # back with in-flight batches untouched.
+        versions0 = {
+            rid: h.params_version for rid, h in router.replicas.items()
+        }
+        futs = [
+            router.submit(pools[t][1], 10.0, tenant=t) for t in names[:8]
+        ]
+        install(ChaosRegistry.parse("publish.nan_params@1",
+                                    logger=own_logger))
+        try:
+            control.publish_params(params)
+            rolled_back = False
+        except FleetPublishError:
+            rolled_back = True
+        install(None)
+        inflight_ok = all(
+            "label" in f.result(timeout=30.0) for f in futs
+        )
+        out["poisoned_fanout"] = {
+            "rolled_back": rolled_back,
+            "versions_unchanged": versions0 == {
+                rid: h.params_version
+                for rid, h in router.replicas.items()
+            },
+            "inflight_untouched": inflight_ok,
+        }
+        # Clean fan-out commits uniformly.
+        version = control.publish_params(params)
+        out["fanout_publish"] = {
+            "params_version": version,
+            "uniform": len({
+                h.params_version for h in router.replicas.values()
+            }) == 1,
+        }
+
+        # Replica add: churn at the rendezvous bound.
+        before = router.placement.owners(names)
+        control.add_replica(InProcessReplica(f"r{R:02d}", mk()))
+        after = router.placement.owners(names)
+        moved = FleetPlacement.churn(before, after)
+        control.replace_tenants()
+        router.replicas[f"r{R:02d}"].warmup()
+        out["add_churn_frac"] = round(moved / T, 4)
+        out["add_churn_bound"] = round(1.5 / (R + 1), 4)
+
+        # Replica-kill failover: degraded -> re-place -> recover.
+        victim = router.directory[names[0]].owner
+        install(ChaosRegistry.parse(f"fleet.replica_kill@0:{victim}",
+                                    logger=own_logger))
+        v_deg = router.classify(pools[names[0]][0], 10.0, tenant=names[0])
+        install(None)
+        crits = [e for e in watchdog.events if e.event == "replica_dead"]
+        # Once-latch: more traffic to displaced tenants adds nothing.
+        router.classify(pools[names[0]][0], 10.0, tenant=names[0])
+        crits2 = [e for e in watchdog.events if e.event == "replica_dead"]
+        control.replace_tenants()
+        v_rec = router.classify(pools[names[0]][0], 10.0, tenant=names[0])
+        router.revive_replica(victim, reason="drill")
+        out["replica_kill"] = {
+            "victim": victim,
+            "degraded_verdict": bool(
+                v_deg.get("degraded") and v_deg.get("failover")
+            ),
+            "criticals": len(crits),
+            "once_latched": len(crits2) == 1,
+            "recovered": not v_rec.get("degraded"),
+            "latch_rearmed_on_revive": (
+                f"replica_dead:{victim}" not in watchdog._latched
+            ),
+        }
+        out["steady_recompiles"] = sum(
+            h.stats_snapshot()["steady_recompiles"]
+            for h in router.replicas.values()
+        )
+        out["passed"] = bool(
+            out["placement_consistent"]
+            and out["traffic_ok"]
+            and all(out["poisoned_fanout"].values())
+            and out["fanout_publish"]["uniform"]
+            and out["add_churn_frac"] <= out["add_churn_bound"]
+            and out["replica_kill"]["degraded_verdict"]
+            and out["replica_kill"]["criticals"] == 1
+            and out["replica_kill"]["once_latched"]
+            and out["replica_kill"]["recovered"]
+            and out["replica_kill"]["latch_rearmed_on_revive"]
+            and out["steady_recompiles"] == 0
+        )
+        return out
+    finally:
+        install(None)
+        router.close()
+        if watchdog.observe_record in own_logger.hooks:
+            own_logger.hooks.remove(watchdog.observe_record)
+        if logger is None:
+            own_logger.close()
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     import numpy as np
@@ -1197,6 +1756,69 @@ def main(argv=None) -> int:
     results = {}
     rc = 0
     try:
+        if args.fleet > 0:
+            # Fleet soak mode (ISSUE 13): standalone — the router tier
+            # is the system under test, not the scheduler arms.
+            soak = run_fleet_soak(args, ckpt, logger, recorder, capture)
+            ok = soak.get("passed", False)
+            pl, fp, rk = (soak.get("placement", {}),
+                          soak.get("fanout_publish", {}),
+                          soak.get("replica_kill", {}))
+            print(f"[fleet soak] R={args.fleet} T={soak['tenants']} "
+                  f"qps={soak.get('traffic', {}).get('qps')} "
+                  f"publish_s={fp.get('publish_s')} "
+                  f"uniform={fp.get('uniform')} "
+                  f"dropped={fp.get('dropped')} "
+                  f"recompiles={soak.get('zero_bands', {}).get('steady_recompiles')}; "
+                  f"add churn {pl.get('add_churn_frac')} "
+                  f"(bound {pl.get('add_churn_bound')}); "
+                  f"kill: degraded={rk.get('degraded_served')} "
+                  f"criticals={rk.get('criticals')} "
+                  f"recovered={rk.get('recovered')}")
+            if not ok:
+                print("FAIL[fleet soak]: invariants did not hold",
+                      file=sys.stderr)
+                rc = 1
+            # The miniature tier-1 leg (the band tests/test_fleet.py
+            # replays) rides in the artifact — same world, same seed.
+            tier1 = fleet_tier1_drill(seed=args.seed, logger=logger)
+            if not tier1.get("passed"):
+                print("FAIL[fleet tier1]: miniature drill failed",
+                      file=sys.stderr)
+                rc = 1
+            report = {
+                "config": {
+                    "fleet": args.fleet, "tenants": args.tenants,
+                    "N": args.N, "K": args.K, "buckets": args.buckets,
+                    "queue_depth": args.queue_depth,
+                    "concurrency": args.concurrency,
+                    "duration": args.duration, "device": args.device,
+                    "seed": args.seed,
+                },
+                **soak,
+                "tier1": {
+                    **tier1,
+                    # Placement is a pure function of the ids: the gate
+                    # pins the miniature numbers EXACTLY (a placement/
+                    # hash change must re-emit the artifact).
+                    "band": {"churn_frac_abs": 0.0},
+                },
+            }
+            print(json.dumps({
+                k: report[k] for k in
+                ("config", "traffic", "per_replica", "placement",
+                 "fanout_publish", "replica_kill", "zero_bands", "passed")
+                if k in report
+            }))
+            if args.fleet_artifact:
+                with open(args.fleet_artifact, "w") as f:
+                    json.dump(report, f, indent=1)
+                print(f"wrote {args.fleet_artifact}", file=sys.stderr)
+            if args.run_dir:
+                print(f"telemetry in {args.run_dir} — render with "
+                      f"'python tools/obs_report.py {args.run_dir}'",
+                      file=sys.stderr)
+            return rc
         for arm in arms:
             rng = np.random.default_rng(args.seed)  # same arrivals per arm
             engine = build_engine(

@@ -746,6 +746,72 @@ def fault_summary(recs: list[dict]) -> dict | None:
     return out
 
 
+def fleet_summary(recs: list[dict]) -> dict | None:
+    """Fleet-tier section (ISSUE 13, kind="fleet"): the router's
+    aggregate counters, a per-replica table (state + routed + serving
+    percentiles + the per-replica zero-recompile counter), placement
+    churn (cumulative ``replaced`` + replace events), and the fan-out
+    publish row (publish_s / replicas / params_version of the last
+    all-or-nothing fleet publish). Splits the three record shapes on
+    the ``replica`` and ``event`` fields — the serve-section
+    discipline."""
+    fleet = [r for r in recs if r.get("kind") == "fleet"]
+    if not fleet:
+        return None
+    events = [r for r in fleet if isinstance(r.get("event"), str)]
+    replica_recs = [
+        r for r in fleet
+        if isinstance(r.get("replica"), str)
+        and not isinstance(r.get("event"), str)
+    ]
+    aggregate = [
+        r for r in fleet
+        if not isinstance(r.get("replica"), str)
+        and not isinstance(r.get("event"), str)
+    ]
+    out: dict = {"records": len(fleet)}
+    if aggregate:
+        last = aggregate[-1]
+        out.update({
+            k: last[k] for k in (
+                "replicas", "live", "dead", "tenants", "submitted",
+                "shed", "degraded_served", "replica_deaths", "replaced",
+                "pending_failover",
+            ) if k in last
+        })
+    if replica_recs:
+        by_replica: dict[str, dict] = {}
+        for r in replica_recs:   # last record per replica wins
+            by_replica[r["replica"]] = {
+                k: r[k] for k in (
+                    "state", "routed", "served", "p50_ms", "p99_ms",
+                    "batch_occupancy", "steady_recompiles", "queue_depth",
+                ) if k in r
+            }
+        out["replica_table"] = {
+            rid: by_replica[rid] for rid in sorted(by_replica)
+        }
+    publishes = [e for e in events if e.get("event") == "fanout_publish"]
+    if publishes:
+        last = publishes[-1]
+        out["fanout_publishes"] = len(publishes)
+        out["last_fanout"] = {
+            k: last[k] for k in ("publish_s", "replicas", "params_version")
+            if k in last
+        }
+    replaces = [e for e in events if e.get("event") == "replace"]
+    if replaces:
+        out["replace_events"] = len(replaces)
+        out["last_replace_moved"] = replaces[-1].get("moved")
+    deaths = [
+        r for r in recs
+        if r.get("kind") == "fault" and r.get("action") == "replica_dead"
+    ]
+    if deaths:
+        out["replica_dead_faults"] = len(deaths)
+    return out
+
+
 def health_summary(recs: list[dict]) -> dict:
     events = [r for r in recs if r.get("kind") == "health"]
     by_event: dict[str, int] = {}
@@ -875,9 +941,9 @@ def render(report: dict) -> str:
     for e in errors[:10]:
         lines.append(f"  ! {e}")
     for section in ("train", "mfu", "eval", "perf", "compile", "serve",
-                    "faults", "traces", "slo", "quality", "scenarios",
-                    "ckpt", "input_pipeline", "comms", "roofline", "health",
-                    "flight_recorder", "overhead"):
+                    "fleet", "faults", "traces", "slo", "quality",
+                    "scenarios", "ckpt", "input_pipeline", "comms",
+                    "roofline", "health", "flight_recorder", "overhead"):
         body = report.get(section)
         if body is None:
             continue
@@ -942,6 +1008,7 @@ def main(argv=None) -> int:
         "perf": perf_summary(recs),
         "compile": compile_summary(recs),
         "serve": serve_summary(recs),
+        "fleet": fleet_summary(recs),
         "faults": fault_summary(recs),
         "traces": trace_summary(recs),
         "slo": slo_summary(recs),
