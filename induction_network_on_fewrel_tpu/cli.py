@@ -13,7 +13,11 @@ import argparse
 import os
 import sys
 
-from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.config import (
+    ADAPT_KNOBS,
+    ExperimentConfig,
+    resolve_adapt_policy,
+)
 
 
 def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
@@ -286,6 +290,41 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
                  "(quarantine + ring-walk fallback) is what a drill "
                  "asserts on. '' = off (zero-cost)",
         )
+        # Self-healing adaptation policy (obs/adapt.py, ISSUE 14,
+        # RUNBOOK §19): resolved in ONE home
+        # (config.resolve_adapt_policy, shared with serve.py). A train
+        # run stamps the policy into the checkpoint's config.json, so a
+        # serving controller fine-tuning FROM this artifact inherits it
+        # without re-spelling the knobs.
+        p.add_argument(
+            "--adapt", action="store_true",
+            help="stamp a self-healing adaptation policy into this "
+                 "run's checkpoints: a serving-side controller "
+                 "(serve.py --adapt) fine-tuning from the artifact "
+                 "inherits the budgets below (RUNBOOK §19)",
+        )
+        p.add_argument("--adapt_retries", type=int, default=None,
+                       help="adaptation flap damper: failed loops "
+                            "before the permanent adapt_exhausted "
+                            "CRITICAL + tenant quarantine")
+        p.add_argument("--adapt_backoff_s", type=float, default=None,
+                       help="base retry backoff seconds (doubles per "
+                            "failed attempt)")
+        p.add_argument("--adapt_cooldown_s", type=float, default=None,
+                       help="post-success trigger suppression seconds")
+        p.add_argument("--adapt_step_budget", type=int, default=None,
+                       help="fine-tune optimizer-step budget")
+        p.add_argument("--adapt_wall_s", type=float, default=None,
+                       help="fine-tune wall-clock budget seconds "
+                            "(breach = timeout-kill + checkpoint "
+                            "cleanup)")
+        p.add_argument("--adapt_verify_s", type=float, default=None,
+                       help="post-publish verification window seconds "
+                            "(drift re-trip inside it rolls back)")
+        p.add_argument("--adapt_canary", default=None,
+                       help="pre-publish canary plan: 'leg:floor[,leg:"
+                            "floor...]' accuracy bars "
+                            "(tools/scenarios.run_canary) or 'off'")
     # device / parallelism
     p.add_argument("--device", default="tpu", choices=["tpu", "cpu"])
     p.add_argument(
@@ -413,7 +452,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
     train_iter = getattr(args, "train_iter", 0)
     val_iter = getattr(args, "val_iter", 1000)
     val_step = getattr(args, "val_step", 0)
-    return ExperimentConfig(
+    cfg = ExperimentConfig(
         train_n=args.trainN or args.N,
         n=args.N, k=args.K, q=args.Q, na_rate=args.na_rate,
         nota_head=args.nota_head,
@@ -472,11 +511,23 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         mixture=getattr(args, "mixture", ""),
         feed_fault=getattr(args, "feed_fault", ""),
         chaos=getattr(args, "chaos", ""),
+        adapt=getattr(args, "adapt", False),
+        # Adapt knobs left unset keep the dataclass defaults; the whole
+        # policy is validated in ONE home (config.resolve_adapt_policy)
+        # right below, so a bad knob fails at run start, not when the
+        # first drift CRITICAL tries to use it.
+        **{
+            k: v for k, v in (
+                (k, getattr(args, k, None)) for k in ADAPT_KNOBS
+            ) if v is not None
+        },
         adv=getattr(args, "adv", None) is not None,
         adv_lambda=getattr(args, "adv_lambda", 1.0),
         adv_dis_hidden=getattr(args, "adv_dis_hidden", 256),
         adv_batch=getattr(args, "adv_batch", 32),
     )
+    resolve_adapt_policy(cfg)   # fail-fast knob validation (no-op when off)
+    return cfg
 
 
 def select_device(cfg: ExperimentConfig, compile_cache: str = "auto") -> None:

@@ -297,6 +297,23 @@ class ExperimentConfig:
     # Deterministic, drill-only; every fired fault emits a kind="fault"
     # record. "" = off (zero-cost: one global check per fault point).
     chaos: str = ""
+    # Self-healing adaptation loop (obs/adapt.py, ISSUE 14): a drift
+    # CRITICAL kicks off a supervised mixture-ramp fine-tune from the
+    # live checkpoint, gated by the scenario-harness canary floors
+    # before any publish. The knobs below are resolved in ONE home
+    # (resolve_adapt_policy, the resolve_runtime_backends discipline)
+    # shared by serve.py and train.py; train runs stamp them into the
+    # checkpoint's config.json so a serving controller fine-tuning FROM
+    # that artifact inherits the policy.
+    adapt: bool = False
+    adapt_retries: int = 3        # flap damper: failed loops before the
+                                  # permanent adapt_exhausted CRITICAL
+    adapt_backoff_s: float = 2.0  # base retry backoff (doubles per fail)
+    adapt_cooldown_s: float = 60.0   # post-success trigger suppression
+    adapt_step_budget: int = 200     # fine-tune optimizer-step budget
+    adapt_wall_s: float = 300.0      # fine-tune wall-clock budget
+    adapt_verify_s: float = 30.0     # post-publish verification window
+    adapt_canary: str = "in_domain:0.3"  # leg:floor[,leg:floor...] | off
 
     @property
     def total_q(self) -> int:
@@ -362,3 +379,95 @@ class ExperimentConfig:
     @classmethod
     def from_json(cls, s: str) -> "ExperimentConfig":
         return cls(**json.loads(s))
+
+
+# --- self-healing adaptation knob resolution (ISSUE 14) --------------------
+
+# The controller-facing knob names, in the order serve.py/train.py expose
+# them. Each maps 1:1 onto an ExperimentConfig ``adapt_*`` field.
+ADAPT_KNOBS = (
+    "adapt_retries", "adapt_backoff_s", "adapt_cooldown_s",
+    "adapt_step_budget", "adapt_wall_s", "adapt_verify_s", "adapt_canary",
+)
+
+
+def parse_canary_plan(spec: str) -> dict[str, float]:
+    """``"leg:floor[,leg:floor...]"`` -> {leg: floor}; "off" -> {} (the
+    canary gate disabled — every candidate publishes). Floors are hard
+    go/no-go accuracy bars for tools/scenarios.run_canary; legs name the
+    evaluation datasets the CLI wires (``in_domain`` = the serving
+    support corpus, ``target`` = the remediation corpus)."""
+    spec = (spec or "").strip()
+    if not spec or spec == "off":
+        return {}
+    floors: dict[str, float] = {}
+    for part in spec.split(","):
+        leg, sep, floor_s = part.strip().partition(":")
+        if not sep or not leg:
+            raise ValueError(
+                f"canary plan entry {part!r} must be 'leg:floor' "
+                f"(e.g. 'in_domain:0.3,target:0.25') or 'off'"
+            )
+        floor = float(floor_s)
+        if not 0.0 <= floor <= 1.0:
+            raise ValueError(
+                f"canary floor for {leg!r} must be in [0, 1], got {floor}"
+            )
+        if leg in floors:
+            raise ValueError(f"canary plan names leg {leg!r} twice")
+        floors[leg] = floor
+    return floors
+
+
+def resolve_adapt_policy(knobs: Any, base: "ExperimentConfig | None" = None):
+    """ONE home for the --adapt knob resolution (the
+    models/build.resolve_runtime_backends discipline), shared by
+    serve.py, train.py, and the drills. ``knobs`` is any object with
+    ``adapt`` + the ADAPT_KNOBS attributes — an ExperimentConfig or an
+    argparse namespace; an attribute that is missing or None falls back
+    to ``base`` (e.g. the served checkpoint's stored config — train runs
+    stamp the policy into config.json exactly so a serving controller
+    inherits it), then to the ExperimentConfig default. Returns the
+    validated policy dict (controller kwargs + the parsed canary plan),
+    or None when adaptation is off."""
+    fields = {f.name: f.default for f in dataclasses.fields(ExperimentConfig)}
+
+    def knob(name):
+        v = getattr(knobs, name, None)
+        if v is None and base is not None:
+            v = getattr(base, name, None)
+        return fields[name] if v is None else v
+
+    enabled = getattr(knobs, "adapt", None)
+    if enabled is None and base is not None:
+        enabled = getattr(base, "adapt", False)
+    if not enabled:
+        return None
+    retries = int(knob("adapt_retries"))
+    backoff_s = float(knob("adapt_backoff_s"))
+    cooldown_s = float(knob("adapt_cooldown_s"))
+    step_budget = int(knob("adapt_step_budget"))
+    wall_s = float(knob("adapt_wall_s"))
+    verify_s = float(knob("adapt_verify_s"))
+    if retries < 1:
+        raise ValueError(f"adapt_retries must be >= 1, got {retries}")
+    if backoff_s <= 0 or wall_s <= 0 or verify_s <= 0:
+        raise ValueError(
+            f"adapt_backoff_s/adapt_wall_s/adapt_verify_s must be > 0 "
+            f"(got {backoff_s}/{wall_s}/{verify_s})"
+        )
+    if cooldown_s < 0:
+        raise ValueError(f"adapt_cooldown_s must be >= 0, got {cooldown_s}")
+    if step_budget < 1:
+        raise ValueError(
+            f"adapt_step_budget must be >= 1, got {step_budget}"
+        )
+    return {
+        "retry_budget": retries,
+        "backoff_s": backoff_s,
+        "cooldown_s": cooldown_s,
+        "step_budget": step_budget,
+        "wall_budget_s": wall_s,
+        "verify_window_s": verify_s,
+        "canary_floors": parse_canary_plan(str(knob("adapt_canary"))),
+    }

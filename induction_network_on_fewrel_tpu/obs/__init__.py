@@ -21,6 +21,11 @@ One shared model for what used to be three fragmented mechanisms:
                  with fn / shape signature / elapsed / trigger, with the
                  training twin of serving's zero-steady-state-recompile
                  gate.
+* ``adapt``    — drift-triggered adaptation controller (ISSUE 14): a
+                 drift CRITICAL kicks off a supervised, bounded,
+                 canary-gated mixture-ramp fine-tune published into the
+                 live fleet, with automatic rollback and a retry-budget
+                 flap damper.
 * ``chaos``    — unified chaos-injection registry (ISSUE 12): named
                  fault points across layers (checkpoint corruption,
                  publish poisoning, serving execute failures) driven by
@@ -36,6 +41,7 @@ flight_recorder.json) into a single run report — per-request trace
 waterfalls included — and schema-checks it.
 """
 
+from induction_network_on_fewrel_tpu.obs.adapt import AdaptationController
 from induction_network_on_fewrel_tpu.obs.chaos import (
     ChaosError,
     ChaosRegistry,
@@ -74,6 +80,7 @@ from induction_network_on_fewrel_tpu.obs.spans import (
 )
 
 __all__ = [
+    "AdaptationController",
     "ChaosError",
     "ChaosRegistry",
     "chaos_active",

@@ -99,6 +99,23 @@ KNOWN_POINTS: dict[str, str] = {
         "tenants until re-placement, then normal service from the new "
         "owners. ARG filters the replica id."
     ),
+    "adapt.train_raise": (
+        "at an adaptation fine-tune launch (obs/adapt.py, ISSUE 14): "
+        "raise ChaosError instead of training — the controller must "
+        "count the attempt failed, honor its backoff, and exhaust after "
+        "the retry budget. ARG filters the tenant."
+    ),
+    "adapt.canary_fail": (
+        "at the adaptation canary gate: force a failed verdict — the "
+        "candidate must be DISCARDED (checkpoint cleanup, zero "
+        "publishes), never reach the fleet. ARG filters the tenant."
+    ),
+    "adapt.publish_raise": (
+        "at the adaptation publish step, after the canary passed: raise "
+        "ChaosError before the fan-out — the controller must count the "
+        "attempt failed with the fleet untouched. ARG filters the "
+        "tenant."
+    ),
 }
 
 

@@ -190,13 +190,16 @@ class HealthWatchdog:
                 self._check_fault(step, rec)
                 return
             if kind in ("train", "val", "eval", "test", "serve",
-                        "quality", "scenario", "perf", "compile"):
+                        "quality", "scenario", "perf", "compile",
+                        "adapt"):
                 # quality/scenario carry model-score statistics — a NaN
                 # margin/entropy/accuracy means NaN logits upstream, the
                 # exact silent failure the non-finite check exists for.
                 # perf/compile carry timing decompositions (ISSUE 11) — a
                 # non-finite segment or elapsed means broken clocks or a
                 # division by a zero window, equally silent upstream.
+                # adapt carries the loop's recover/publish timings and
+                # the verification band numbers (ISSUE 14) — same class.
                 self._check_finite(step, rec)
             if kind in ("train", "val", "eval"):
                 self._check_entropy(step, rec)

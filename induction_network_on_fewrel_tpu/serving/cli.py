@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -150,6 +151,42 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
                         "profiler session concurrent with the serving "
                         "worker corrupts the heap at exit, RUNBOOK §14; "
                         "span snapshot + flight dump always capture)")
+    # Self-healing adaptation (obs/adapt.py, ISSUE 14, RUNBOOK §19):
+    # knobs resolved in ONE home (config.resolve_adapt_policy, shared
+    # with train.py); values left unset fall back to the served
+    # checkpoint's stamped policy, then the config defaults.
+    p.add_argument("--adapt", action="store_true",
+                   help="arm the drift-triggered adaptation controller: "
+                        "a drift CRITICAL kicks off a bounded mixture-"
+                        "ramp fine-tune from the served checkpoint, "
+                        "canary-gated on the scenario-harness floors, "
+                        "published through the (fan-out) hot-swap with "
+                        "automatic rollback; requires --drift")
+    p.add_argument("--adapt_mixture", default=None, metavar="FILE",
+                   help="FewRel-schema JSON of the remediation (target-"
+                        "domain) corpus the fine-tune ramps in; required "
+                        "with --adapt + --support_file (the demo path "
+                        "falls back to a synthetic shifted twin)")
+    p.add_argument("--adapt_retries", type=int, default=None,
+                   help="flap damper: failed adaptation loops before the "
+                        "permanent adapt_exhausted CRITICAL + quarantine")
+    p.add_argument("--adapt_backoff_s", type=float, default=None,
+                   help="base retry backoff seconds (doubles per fail)")
+    p.add_argument("--adapt_cooldown_s", type=float, default=None,
+                   help="post-success trigger suppression seconds")
+    p.add_argument("--adapt_step_budget", type=int, default=None,
+                   help="fine-tune optimizer-step budget")
+    p.add_argument("--adapt_wall_s", type=float, default=None,
+                   help="fine-tune wall-clock budget (breach = timeout-"
+                        "kill + candidate checkpoint cleanup)")
+    p.add_argument("--adapt_verify_s", type=float, default=None,
+                   help="post-publish verification window (drift "
+                        "re-trip inside it rolls back to the prior "
+                        "artifact)")
+    p.add_argument("--adapt_canary", default=None,
+                   help="pre-publish canary plan 'leg:floor[,...]' over "
+                        "legs in_domain/target (tools/scenarios."
+                        "run_canary floors), or 'off'")
     p.add_argument("--seed", type=int, default=0)
     return p
 
@@ -237,6 +274,153 @@ def _fresh_engine(args, buckets, logger=None, watchdog=None, slo=None,
     )
 
 
+def _adapt_target_dataset(args, k: int):
+    """The remediation (target-domain) corpus the adaptation fine-tune
+    ramps in: --adapt_mixture when given; the demo path (synthetic
+    supports) falls back to the synthetic shifted twin — the same
+    relations with the trigger signal moved to a disjoint vocab block
+    (data/synthetic.make_domain_shifted_fewrel, the wiki -> pubmed shift
+    in miniature). A real --support_file without --adapt_mixture is
+    refused: the CLI must not invent a target corpus."""
+    if args.adapt_mixture:
+        from induction_network_on_fewrel_tpu.data import load_fewrel_json
+
+        return load_fewrel_json(args.adapt_mixture)
+    if args.support_file:
+        raise SystemExit(
+            "--adapt with --support_file needs --adapt_mixture (the "
+            "target-domain corpus the mixture-ramp fine-tune adapts "
+            "toward); only the synthetic demo path can derive one"
+        )
+    from induction_network_on_fewrel_tpu.data import (
+        make_domain_shifted_fewrel,
+    )
+
+    return make_domain_shifted_fewrel(
+        num_relations=10, instances_per_relation=max(k + 10, 20),
+        vocab_size=2000, shift=1.0, seed=args.seed,
+    )
+
+
+def _save_base_checkpoint(engine, out_dir: str) -> str:
+    """Demo path: the fresh-init weights saved through the real
+    CheckpointManager, so the adaptation loop has a live artifact to
+    fine-tune from and roll back to (a --load_ckpt deployment uses the
+    served directory itself). The directory is derived state (fresh-init
+    weights) — a restart with the same --run_dir rebuilds it rather than
+    colliding with the previous run's step-0 save (orbax refuses step
+    re-saves)."""
+    import shutil
+
+    import jax
+
+    from induction_network_on_fewrel_tpu.serving.buckets import zero_batch
+    from induction_network_on_fewrel_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+    from induction_network_on_fewrel_tpu.train.steps import init_state
+
+    cfg = engine.cfg
+    state = init_state(
+        engine.model, cfg,
+        zero_batch(cfg.max_length, (1, cfg.n, cfg.k)),
+        zero_batch(cfg.max_length, (1, cfg.total_q)),
+        rng=jax.random.key(cfg.seed),
+    )
+    state = state.replace(params=engine.registry.params)
+    shutil.rmtree(out_dir, ignore_errors=True)
+    mngr = CheckpointManager(out_dir, cfg, stage="off")
+    try:
+        mngr.save(0, state, val_accuracy=0.0)
+        mngr.wait()
+    finally:
+        mngr.close()
+    return out_dir
+
+
+def _build_adapt(args, policy, *, drift, model, cfg, tok, src_ds, tgt_ds,
+                 base_ckpt, publish_fn, quarantine_fn, logger=None,
+                 recorder=None, capture=None):
+    """Assemble the AdaptationController from the serving context: the
+    fine-tune reads the live artifact + the two corpora, the canary is
+    tools/scenarios.run_canary over {in_domain, target} legs at the
+    resolved floors, publish goes through the caller's (fan-out)
+    publish, and rollback republishes whatever was live before."""
+    import tempfile
+
+    from induction_network_on_fewrel_tpu.obs.adapt import (
+        AdaptationController,
+        make_checkpoint_loop,
+    )
+
+    work = tempfile.mkdtemp(prefix="adapt_candidates_")
+
+    def finetune(src_ckpt, out, seq, attempt, step_budget, wall_budget_s):
+        from induction_network_on_fewrel_tpu.train.finetune import (
+            mixture_finetune,
+        )
+
+        return mixture_finetune(
+            src_ckpt, out, src_ds, tgt_ds, tok,
+            steps=step_budget, wall_budget_s=wall_budget_s,
+            seed=args.seed + seq, logger=logger,
+        )
+
+    train_fn, publish, cleanup, current_fn = make_checkpoint_loop(
+        base_ckpt, work, finetune, publish_fn,
+    )
+
+    canary_fn = None
+    floors = policy["canary_floors"]
+    if floors:
+        # Startup-time fail-fast, both halves: the canary entrypoint
+        # import (an unresolvable tools/ must not be silently converted
+        # into N failed canaries + a permanent quarantine) AND the plan's
+        # leg names (a floor naming a leg this deployment doesn't wire
+        # would fail every candidate at the first drift CRITICAL — the
+        # same quarantine-by-typo outcome).
+        legs = {"in_domain": src_ds, "target": tgt_ds}
+        unknown = sorted(set(floors) - set(legs))
+        if unknown:
+            raise SystemExit(
+                f"--adapt_canary names unknown leg(s) {unknown}: this "
+                f"deployment wires legs {sorted(legs)}"
+            )
+        # Evaluate ONLY the legs the plan floors: a floorless leg is
+        # recorded-not-judged by canary_verdict, so evaluating it would
+        # burn publish-critical device time with zero verdict effect.
+        legs = {k: v for k, v in legs.items() if k in floors}
+        _repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        )))
+        if _repo not in sys.path:
+            sys.path.insert(0, _repo)
+        from tools.scenarios import run_canary
+
+        def canary_fn(candidate):
+            from induction_network_on_fewrel_tpu.serving.registry import (
+                load_params,
+            )
+
+            return run_canary(
+                model, load_params(candidate), cfg, tok,
+                legs=legs, floors=floors, seed=args.seed,
+            )
+
+    return AdaptationController(
+        train_fn, canary_fn, publish,
+        drift=drift, current_fn=current_fn,
+        cleanup_fn=cleanup, quarantine_fn=quarantine_fn,
+        retry_budget=policy["retry_budget"],
+        backoff_s=policy["backoff_s"],
+        cooldown_s=policy["cooldown_s"],
+        verify_window_s=policy["verify_window_s"],
+        step_budget=policy["step_budget"],
+        wall_budget_s=policy["wall_budget_s"],
+        logger=logger, recorder=recorder, capture=capture,
+    )
+
+
 def _write_prometheus(run_dir) -> None:
     """Prometheus text exposition of the shared counter registry
     (obs/export.py) — the scrape-format twin of the final kind="serve"
@@ -268,7 +452,11 @@ def _support_dataset(args, cfg_k: int, seed: int = 0):
 
 
 def serve_main(argv=None) -> int:
-    args = build_serve_arg_parser().parse_args(argv)
+    parser = build_serve_arg_parser()
+    args = parser.parse_args(argv)
+    if args.adapt and not args.drift:
+        parser.error("--adapt needs --drift (the controller subscribes "
+                     "to the drift detector's CRITICALs)")
     buckets = tuple(int(b) for b in args.buckets.split(","))
 
     # Device selection must happen before any jax backend init — reuse the
@@ -346,12 +534,14 @@ def serve_main(argv=None) -> int:
             print(f"chaos plan armed: {args.chaos}", file=sys.stderr)
     if args.replicas > 1 or args.router:
         return _serve_fleet(args, buckets, logger=logger,
-                            watchdog=watchdog, slo=slo, drift=drift)
+                            watchdog=watchdog, slo=slo, drift=drift,
+                            recorder=recorder, capture=capture)
     engine = _build_engine(args, buckets, logger=logger,
                            watchdog=watchdog, slo=slo, drift=drift,
                            breaker=breaker,
                            trace_sample=args.trace_sample)
 
+    adapt = None
     try:
         ds = _support_dataset(args, engine.registry.k, seed=args.seed)
         names = engine.register_dataset(ds, max_classes=args.max_classes)
@@ -363,6 +553,43 @@ def serve_main(argv=None) -> int:
         compiled = engine.warmup()
         print(f"warmup: {compiled} bucket programs compiled "
               f"(buckets={list(engine.batcher.buckets)})", file=sys.stderr)
+
+        if args.adapt:
+            from induction_network_on_fewrel_tpu.config import (
+                resolve_adapt_policy,
+            )
+            from induction_network_on_fewrel_tpu.train.checkpoint import (
+                CheckpointManager,
+            )
+
+            # Knob resolution base: the served checkpoint's stamped
+            # policy (train.py --adapt rides in config.json), then the
+            # config defaults — ONE home, config.resolve_adapt_policy.
+            base_cfg = (
+                CheckpointManager.load_config(args.load_ckpt)
+                if args.load_ckpt else engine.cfg
+            )
+            policy = resolve_adapt_policy(args, base=base_cfg)
+            tgt_ds = _adapt_target_dataset(args, engine.registry.k)
+            base_ckpt = args.load_ckpt or _save_base_checkpoint(
+                engine,
+                os.path.join(args.run_dir or ".", "adapt_base_ckpt"),
+            )
+            adapt = _build_adapt(
+                args, policy, drift=drift, model=engine.model,
+                cfg=engine.cfg, tok=engine.tokenizer, src_ds=ds,
+                tgt_ds=tgt_ds, base_ckpt=base_ckpt,
+                publish_fn=engine.publish_checkpoint,
+                quarantine_fn=lambda t, reason="": (
+                    engine.quarantine_tenant(t, reason=reason)
+                ),
+                logger=logger, recorder=recorder, capture=capture,
+            )
+            adapt.start()
+            print("adaptation controller armed "
+                  f"(retries={policy['retry_budget']}, "
+                  f"step_budget={policy['step_budget']})",
+                  file=sys.stderr)
 
         if args.input:
             stream = sys.stdin if args.input == "-" else open(args.input)
@@ -386,13 +613,15 @@ def serve_main(argv=None) -> int:
     finally:
         if args.run_dir:
             _write_prometheus(args.run_dir)
+        if adapt is not None:
+            adapt.close()
         engine.close()
         if logger is not None:
             logger.close()
 
 
 def _serve_fleet(args, buckets, logger=None, watchdog=None, slo=None,
-                 drift=None) -> int:
+                 drift=None, recorder=None, capture=None) -> int:
     """Fleet-mode serving (ISSUE 13): ``--replicas`` in-process engine
     replicas behind the fleet router. The support corpus registers as
     the ``default`` tenant on its rendezvous owner through the control
@@ -431,6 +660,7 @@ def _serve_fleet(args, buckets, logger=None, watchdog=None, slo=None,
         trace_sample=args.trace_sample,
     )
     control = FleetControl(router)
+    adapt = None
     try:
         first = replicas[sorted(replicas)[0]].engine
         ds = _support_dataset(args, first.registry.k, seed=args.seed)
@@ -441,6 +671,41 @@ def _serve_fleet(args, buckets, logger=None, watchdog=None, slo=None,
         compiled = sum(h.warmup() for h in router.replicas.values())
         print(f"fleet: {n} replica(s), default tenant placed on {owner}, "
               f"{compiled} bucket programs compiled", file=sys.stderr)
+
+        if args.adapt:
+            from induction_network_on_fewrel_tpu.config import (
+                resolve_adapt_policy,
+            )
+            from induction_network_on_fewrel_tpu.train.checkpoint import (
+                CheckpointManager,
+            )
+
+            base_cfg = (
+                CheckpointManager.load_config(args.load_ckpt)
+                if args.load_ckpt else first.cfg
+            )
+            policy = resolve_adapt_policy(args, base=base_cfg)
+            tgt_ds = _adapt_target_dataset(args, first.registry.k)
+            base_ckpt = args.load_ckpt or _save_base_checkpoint(
+                first,
+                os.path.join(args.run_dir or ".", "adapt_base_ckpt"),
+            )
+            adapt = _build_adapt(
+                args, policy, drift=drift, model=first.model,
+                cfg=first.cfg, tok=first.tokenizer, src_ds=ds,
+                tgt_ds=tgt_ds, base_ckpt=base_ckpt,
+                # Survivors publish into the LIVE FLEET through the
+                # existing all-or-nothing fan-out: any replica's refusal
+                # rolls every replica back before anything moved.
+                publish_fn=control.publish_checkpoint,
+                quarantine_fn=lambda t, reason="": (
+                    control.quarantine_tenant(t, reason=reason)
+                ),
+                logger=logger, recorder=recorder, capture=capture,
+            )
+            adapt.start()
+            print("adaptation controller armed over the fleet fan-out "
+                  f"(retries={policy['retry_budget']})", file=sys.stderr)
 
         def answer(instance) -> dict:
             return router.classify(
@@ -478,6 +743,8 @@ def _serve_fleet(args, buckets, logger=None, watchdog=None, slo=None,
     finally:
         if args.run_dir:
             _write_prometheus(args.run_dir)
+        if adapt is not None:
+            adapt.close()
         router.close()
         if logger is not None:
             logger.close()

@@ -154,6 +154,26 @@ KNOWN_KINDS = frozenset({
     # action="replica_dead"/"replica_recover" next to these.
     # tools/obs_report.py's fleet section splits on replica/event.
     "fleet",
+    # Self-healing adaptation telemetry (ISSUE 14, obs/adapt.py): one
+    # record per controller action, all scalar/str with ``action`` (str),
+    # ``tenant`` (str), ``state`` (the machine state after the action),
+    # ``attempt`` (1-based within the current adaptation loop):
+    # action="trigger" (feature, reason — a drift CRITICAL armed the
+    # loop), action="train" (ok 0/1, steps, train_s — the mixture-ramp
+    # fine-tune; ok=0 carries error), action="canary" (passed 0/1,
+    # failures — the scenario-harness quality floors as a hard
+    # pre-publish gate; failed candidates are discarded, never
+    # published), action="publish" (params_version, publish_s — the
+    # committed hot-swap/fan-out), action="verified" (recover_s —
+    # trigger-to-back-in-band wall time, the section headline; nota_base
+    # / nota_healthy / nota_band restate the in-band check),
+    # action="rollback" (reason, params_version — post-publish drift
+    # re-tripped inside the verification window; the prior artifact was
+    # republished), and action="exhausted" (attempts — the flap damper:
+    # the retry budget burned out, the tenant is quarantined and the
+    # permanent adapt_exhausted CRITICAL latched). obs_report's adapt
+    # section renders the loop outcome table from these.
+    "adapt",
     # XLA compile forensics (ISSUE 11, obs/compile.py): one record per
     # observed backend compile with fn (str, the jitted function), shapes
     # (str, the argument shape signature), elapsed_ms, trigger (str, the

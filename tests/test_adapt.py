@@ -1,0 +1,580 @@
+"""Self-healing adaptation controller tier-1 gates (ISSUE 14).
+
+Two layers, the tests/test_chaos.py discipline:
+
+* Pure state-machine pins on ``obs/adapt.AdaptationController`` with
+  stub train/canary/publish functions and an injected clock — every arm
+  of armed -> triggered -> training -> canary -> publishing -> verifying
+  -> cooldown/exhausted, the exponential backoff, the flap damper, the
+  rollback paths, and the one-home knob resolution
+  (``config.resolve_adapt_policy`` / ``parse_canary_plan``) plus the
+  library canary verdict math (``tools/scenarios.canary_verdict``).
+* The miniature IN-PROCESS drill (``tools/loadgen.adapt_tier1_drill``,
+  the same world ``--adapt_drill`` stamps into the committed
+  ``ADAPT_r*.json``): the success arm must run inject-shift -> drift
+  CRITICAL -> mixture-ramp fine-tune -> canary pass -> fan-out publish
+  (0 dropped, 0 steady recompiles, params_version uniform) -> NOTA rate
+  back in band -> detector re-armed, and the failure arm (chaos
+  ``adapt.canary_fail``) must discard the candidate with ZERO publishes,
+  honor the backoff, and latch ``adapt_exhausted`` after the retry
+  budget — gated structurally against the committed artifact.
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from induction_network_on_fewrel_tpu.config import (
+    ExperimentConfig,
+    parse_canary_plan,
+    resolve_adapt_policy,
+)
+from induction_network_on_fewrel_tpu.datapipe.mixture import MixtureSchedule
+from induction_network_on_fewrel_tpu.obs.adapt import (
+    ARMED,
+    COOLDOWN,
+    EXHAUSTED,
+    TRIGGERED,
+    VERIFYING,
+    AdaptationController,
+)
+from induction_network_on_fewrel_tpu.obs.chaos import ChaosRegistry, install
+from induction_network_on_fewrel_tpu.obs.health import CRITICAL, HealthEvent
+from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import loadgen  # noqa: E402
+import obs_report  # noqa: E402
+import scenarios  # noqa: E402
+
+
+# --- state-machine harness --------------------------------------------------
+
+
+class _Stub:
+    """Programmable train/canary/publish fns with call accounting."""
+
+    def __init__(self, train_ok=True, canary_ok=True, publish_ok=True):
+        self.train_ok = train_ok
+        self.canary_ok = canary_ok
+        self.publish_ok = publish_ok
+        self.trained = 0
+        self.canaried = 0
+        self.published = []
+        self.cleaned = []
+        self.quarantined = []
+        self.version = 0
+
+    def train(self, tenant, attempt, step_budget, wall_budget_s):
+        self.trained += 1
+        if not self.train_ok:
+            raise RuntimeError("stub fine-tune failure")
+        return f"cand_{self.trained}"
+
+    def canary(self, candidate):
+        self.canaried += 1
+        ok = self.canary_ok
+        return {"passed": ok,
+                "failures": [] if ok else ["in_domain: below floor"]}
+
+    def publish(self, candidate):
+        if not self.publish_ok:
+            raise RuntimeError("stub publish refusal")
+        self.version += 1
+        self.published.append(candidate)
+        return self.version
+
+    def cleanup(self, candidate):
+        self.cleaned.append(candidate)
+
+    def quarantine(self, tenant, reason=""):
+        self.quarantined.append(tenant)
+
+
+def _controller(stub, **kw):
+    kw.setdefault("retry_budget", 2)
+    kw.setdefault("backoff_s", 10.0)
+    kw.setdefault("cooldown_s", 100.0)
+    kw.setdefault("verify_window_s", 50.0)
+    return AdaptationController(
+        stub.train, stub.canary, stub.publish,
+        cleanup_fn=stub.cleanup, quarantine_fn=stub.quarantine, **kw,
+    )
+
+
+def test_success_loop_verifies_and_cools_down():
+    """No detector wired: publish implies verified on the next tick;
+    cooldown suppresses triggers until it expires, then re-arms."""
+    stub = _Stub()
+    c = _controller(stub)
+    assert c.state_of("t") == ARMED
+    assert c.trigger("t", feature="nota_rate", now=0.0)
+    assert c.state_of("t") == TRIGGERED
+    assert c.run_once(now=1.0) == "t"
+    assert c.state_of("t") == VERIFYING
+    assert stub.published == ["cand_1"]
+    c.tick(now=2.0)
+    assert c.state_of("t") == COOLDOWN
+    info = c.loop_info("t")
+    assert info["loops"] == 1 and info["attempts"] == 0
+    assert not c.trigger("t", now=50.0)          # cooldown absorbs
+    assert c.trigger("t", now=2.0 + 100.0 + 1)   # expired: re-arms
+    actions = [r["action"] for r in c.records]
+    assert actions[:4] == ["trigger", "train", "canary", "publish"]
+    assert "verified" in actions
+
+
+def test_canary_failure_discards_and_never_publishes():
+    stub = _Stub(canary_ok=False)
+    c = _controller(stub)
+    c.trigger("t", now=0.0)
+    c.run_once(now=1.0)
+    assert stub.published == []
+    assert stub.cleaned == ["cand_1"]
+    assert c.state_of("t") == TRIGGERED       # backing off for retry
+    assert c.loop_info("t")["attempts"] == 1
+    canary = [r for r in c.records if r["action"] == "canary"]
+    assert canary and canary[0]["passed"] == 0.0
+    assert "first_failure" in canary[0]
+
+
+def test_backoff_is_exponential_and_honored():
+    """attempt N's retry waits backoff_s * 2**(N-1); an early run_once
+    is a no-op."""
+    stub = _Stub(canary_ok=False)
+    c = _controller(stub, retry_budget=5, backoff_s=10.0)
+    c.trigger("t", now=0.0)
+    assert c.run_once(now=0.0) == "t"             # attempt 1 fails
+    assert c.run_once(now=9.9) is None            # < 10s: honored
+    assert c.run_once(now=10.1) == "t"            # attempt 2 fails
+    assert c.run_once(now=10.1 + 19.9) is None    # < 20s after fail 2
+    assert c.loop_info("t")["not_before"] == pytest.approx(10.1 + 20.0)
+    assert c.run_once(now=10.1 + 20.1) == "t"     # attempt 3
+    assert stub.trained == 3
+
+
+def test_retry_budget_exhausts_quarantines_and_latches_once():
+    stub = _Stub(train_ok=False)
+    c = _controller(stub, retry_budget=2, backoff_s=1.0)
+    c.trigger("t", now=0.0)
+    c.run_once(now=0.0)
+    assert c.state_of("t") == TRIGGERED
+    c.run_once(now=5.0)
+    assert c.state_of("t") == EXHAUSTED
+    assert stub.quarantined == ["t"]
+    events = [e for e in c.events if e.event == "adapt_exhausted"]
+    assert len(events) == 1 and events[0].data["tenant"] == "t"
+    # Permanent: triggers absorbed, nothing ever runs again.
+    assert not c.trigger("t", now=100.0)
+    assert c.run_once(now=100.0) is None
+    assert stub.trained == 2
+    # Operator escape hatch.
+    c.unquarantine("t")
+    assert c.state_of("t") == ARMED and c.loop_info("t")["attempts"] == 0
+
+
+def test_publish_refusal_counts_failed_with_cleanup():
+    stub = _Stub(publish_ok=False)
+    c = _controller(stub)
+    c.trigger("t", now=0.0)
+    c.run_once(now=0.0)
+    assert stub.published == []
+    assert stub.cleaned == ["cand_1"]
+    assert c.loop_info("t")["attempts"] == 1
+    pub = [r for r in c.records if r["action"] == "publish"]
+    assert pub and pub[0]["ok"] == 0.0 and "error" in pub[0]
+
+
+def test_retrip_during_verification_rolls_back_to_prior():
+    """A drift CRITICAL inside the verification window republishes the
+    prior artifact and counts the attempt failed."""
+    stub = _Stub()
+    live = {"artifact": "base"}
+    orig = stub.publish
+
+    def publish(candidate):
+        v = orig(candidate)
+        live["artifact"] = candidate
+        return v
+
+    c = AdaptationController(
+        stub.train, stub.canary, publish,
+        current_fn=lambda: live["artifact"], cleanup_fn=stub.cleanup,
+        retry_budget=3, backoff_s=1.0, verify_window_s=50.0,
+    )
+    c.trigger("t", now=0.0)
+    c.run_once(now=0.0)
+    assert c.state_of("t") == VERIFYING
+    assert stub.published == ["cand_1"]
+    assert not c.trigger("t", now=5.0)    # re-trip: flips the verdict bit
+    c.tick(now=5.0)
+    assert stub.published == ["cand_1", "base"]   # prior republished
+    assert stub.cleaned == ["cand_1"]
+    assert c.state_of("t") == TRIGGERED
+    assert c.loop_info("t")["attempts"] == 1
+    rb = [r for r in c.records if r["action"] == "rollback"]
+    assert rb and "re-trip" in rb[0]["reason"]
+
+
+class _NeverArms:
+    """Detector stub that never re-arms (verification can only expire)."""
+
+    band_sigma, baseline_n, nota_rate_floor = 4.0, 16, 0.05
+    on_event = None
+
+    def armed(self, tenant):
+        return False
+
+    def baseline_for(self, tenant):
+        return None
+
+
+def test_verify_window_expiry_rolls_back():
+    """With a detector wired but never re-arming, the window expiring
+    un-verified is a failure, not a silent success."""
+    stub = _Stub()
+    c = AdaptationController(
+        stub.train, stub.canary, stub.publish, drift=_NeverArms(),
+        cleanup_fn=stub.cleanup, retry_budget=3, backoff_s=1.0,
+        verify_window_s=50.0,
+    )
+    c.trigger("t", now=0.0)
+    c.run_once(now=0.0)
+    c.tick(now=49.0)
+    assert c.state_of("t") == VERIFYING   # window still open
+    c.tick(now=51.0)
+    assert c.state_of("t") == TRIGGERED
+    assert c.loop_info("t")["attempts"] == 1
+    rb = [r for r in c.records if r["action"] == "rollback"]
+    assert rb and "expired" in rb[0]["reason"]
+
+
+def test_verify_deadline_anchored_at_publish_not_trigger():
+    """A wall-clock-long fine-tune must not consume the verification
+    window: the deadline is anchored at PUBLISH completion (the
+    attempt's real elapsed wall is added to the injected clock), so a
+    slow attempt still leaves the full window for post-publish traffic
+    to re-baseline the detector."""
+    stub = _Stub()
+    orig = stub.train
+
+    def slow_train(*a):
+        time.sleep(1.0)
+        return orig(*a)
+
+    c = AdaptationController(
+        slow_train, stub.canary, stub.publish, drift=_NeverArms(),
+        cleanup_fn=stub.cleanup, retry_budget=3, backoff_s=1.0,
+        verify_window_s=0.5,
+    )
+    c.trigger("t", now=0.0)
+    c.run_once(now=0.0)
+    # Past trigger + window, but publish completed ~1.0 s of wall later:
+    # the window is still open (the buggy anchoring would roll back).
+    c.tick(now=0.6)
+    assert c.state_of("t") == VERIFYING
+    c.tick(now=2.5)   # now genuinely past publish + window
+    assert c.state_of("t") == TRIGGERED
+    rb = [r for r in c.records if r["action"] == "rollback"]
+    assert rb and "expired" in rb[0]["reason"]
+
+
+def test_bind_is_idempotent_and_chains_prev_subscriber():
+    """Re-binding the same detector is a no-op: the guard compares the
+    INSTALLED fanout closure, so a second bind can never chain the
+    fanout to itself (infinite recursion on the first drift event). The
+    detector's pre-existing subscriber keeps firing exactly once."""
+
+    class _Drift:
+        on_event = None
+
+        def baseline_for(self, tenant):
+            return None
+
+    stub = _Stub()
+    d = _Drift()
+    seen = []
+    d.on_event = seen.append
+    c = _controller(stub)
+    c.bind(d)
+    c.bind(d)   # second bind: must be absorbed by the guard
+    ev = HealthEvent(
+        event="prediction_drift", severity=CRITICAL, step=1,
+        message="drift", data={"tenant": "t", "feature": "nota_rate"},
+    )
+    d.on_event(ev)   # would RecursionError with a self-referential chain
+    assert seen == [ev]                  # prior subscriber fired once
+    assert c.state_of("t") == TRIGGERED  # and the controller triggered
+
+
+def test_failed_rollback_publish_keeps_live_candidate():
+    """If the rollback republish refuses, the fleet is still SERVING
+    the candidate — it must NOT be deleted (it backs the live
+    params_version and every later fine-tune reads it)."""
+    stub = _Stub()
+    live = {"artifact": "base"}
+    calls = {"n": 0}
+
+    def publish(candidate):
+        calls["n"] += 1
+        if calls["n"] == 2:     # the rollback republish refuses
+            raise RuntimeError("fan-out refusal")
+        stub.version += 1
+        live["artifact"] = candidate
+        return stub.version
+
+    c = AdaptationController(
+        stub.train, stub.canary, publish, drift=_NeverArms(),
+        current_fn=lambda: live["artifact"], cleanup_fn=stub.cleanup,
+        retry_budget=3, backoff_s=1.0, verify_window_s=0.5,
+    )
+    c.trigger("t", now=0.0)
+    c.run_once(now=0.0)
+    c.tick(now=10.0)    # window expired -> rollback; republish fails
+    assert stub.cleaned == []                  # still live: kept
+    assert live["artifact"] == "cand_1"
+    assert c.loop_info("t")["attempts"] == 1
+    rb = [r for r in c.records if r["action"] == "rollback"]
+    assert rb and "FAILED" in rb[0]["reason"]
+
+
+def test_raising_telemetry_does_not_wedge_tenant():
+    """A raising jsonl write between the guarded stages must not strand
+    the tenant in a state neither run_once nor tick can schedule: the
+    attempt counts failed (state repaired BEFORE telemetry), the error
+    surfaces, and the retry works once the logger heals."""
+
+    class _BadLogger:
+        def __init__(self):
+            self.fail = True
+
+        def log(self, step, **kw):
+            if (self.fail and kw.get("kind") == "adapt"
+                    and kw.get("action") == "train"):
+                self.fail = False
+                raise OSError("disk full")
+
+    stub = _Stub()
+    c = AdaptationController(
+        stub.train, stub.canary, stub.publish, cleanup_fn=stub.cleanup,
+        retry_budget=3, backoff_s=1.0, verify_window_s=50.0,
+        logger=_BadLogger(),
+    )
+    c.trigger("t", now=0.0)
+    with pytest.raises(OSError):
+        c.run_once(now=0.0)
+    assert c.state_of("t") == TRIGGERED        # schedulable, not wedged
+    assert c.loop_info("t")["attempts"] == 1
+    assert c.run_once(now=5.0) == "t"          # retry past the backoff
+    assert c.state_of("t") == VERIFYING
+
+
+def test_one_finetune_at_a_time_fleetwide():
+    """Two triggered tenants: one run_once serves one tenant; the other
+    waits its turn (the fine-tune owns the device)."""
+    stub = _Stub()
+    c = _controller(stub)
+    c.trigger("a", now=0.0)
+    c.trigger("b", now=0.0)
+    assert c.run_once(now=0.0) == "a"
+    assert c.state_of("b") == TRIGGERED
+    assert c.run_once(now=0.0) == "b"
+    assert stub.trained == 2
+
+
+def test_chaos_train_raise_counts_failed_attempt():
+    stub = _Stub()
+    c = _controller(stub, retry_budget=2, backoff_s=1.0)
+    install(ChaosRegistry.parse("adapt.train_raise@0:t"))
+    try:
+        c.trigger("t", now=0.0)
+        c.run_once(now=0.0)
+    finally:
+        install(None)
+    assert stub.trained == 0              # never reached the real fn
+    assert c.loop_info("t")["attempts"] == 1
+    train = [r for r in c.records if r["action"] == "train"]
+    assert train and train[0]["ok"] == 0.0
+
+
+# --- knob resolution / canary math ------------------------------------------
+
+
+def test_parse_canary_plan():
+    assert parse_canary_plan("off") == {}
+    assert parse_canary_plan("") == {}
+    assert parse_canary_plan("in_domain:0.3,target:0.25") == {
+        "in_domain": 0.3, "target": 0.25,
+    }
+    with pytest.raises(ValueError, match="must be 'leg:floor'"):
+        parse_canary_plan("in_domain")
+    with pytest.raises(ValueError, match="in \\[0, 1\\]"):
+        parse_canary_plan("in_domain:1.5")
+    with pytest.raises(ValueError, match="twice"):
+        parse_canary_plan("a:0.1,a:0.2")
+
+
+def test_resolve_adapt_policy_one_home():
+    assert resolve_adapt_policy(ExperimentConfig()) is None   # off
+    cfg = ExperimentConfig(adapt=True, adapt_retries=5,
+                           adapt_canary="in_domain:0.4")
+    policy = resolve_adapt_policy(cfg)
+    assert policy["retry_budget"] == 5
+    assert policy["canary_floors"] == {"in_domain": 0.4}
+    assert policy["step_budget"] == ExperimentConfig().adapt_step_budget
+
+    class _Args:   # argparse-namespace shape: unset knobs are None
+        adapt = True
+        adapt_retries = None
+        adapt_backoff_s = None
+        adapt_cooldown_s = None
+        adapt_step_budget = 7
+        adapt_wall_s = None
+        adapt_verify_s = None
+        adapt_canary = None
+
+    # Unset CLI knobs fall back to the checkpoint's stamped policy.
+    merged = resolve_adapt_policy(_Args(), base=cfg)
+    assert merged["retry_budget"] == 5        # from the stamped config
+    assert merged["step_budget"] == 7         # CLI override wins
+    with pytest.raises(ValueError, match="adapt_retries"):
+        resolve_adapt_policy(ExperimentConfig(adapt=True, adapt_retries=0))
+    with pytest.raises(ValueError, match="adapt_step_budget"):
+        resolve_adapt_policy(
+            ExperimentConfig(adapt=True, adapt_step_budget=0)
+        )
+
+
+def test_canary_verdict_math():
+    floors = {"in_domain": 0.6, "target": 0.5}
+    ok = scenarios.canary_verdict(
+        {"in_domain": {"accuracy": 0.7}, "target": {"accuracy": 0.5}},
+        floors,
+    )
+    assert ok["passed"] and ok["failures"] == []
+    bad = scenarios.canary_verdict(
+        {"in_domain": {"accuracy": 0.59}, "target": {"accuracy": 0.9}},
+        floors,
+    )
+    assert not bad["passed"]
+    assert "in_domain" in bad["failures"][0]
+    # A floor with no evaluated leg FAILS — the gate never silently
+    # skips a bar.
+    missing = scenarios.canary_verdict(
+        {"in_domain": {"accuracy": 0.9}}, floors,
+    )
+    assert not missing["passed"]
+    assert any("no evaluated leg" in f for f in missing["failures"])
+    # Extra legs without floors are recorded, not judged.
+    extra = scenarios.canary_verdict(
+        {"in_domain": {"accuracy": 0.9}, "adversarial": {"accuracy": 0.1}},
+        {"in_domain": 0.6},
+    )
+    assert extra["passed"] and "ok" not in extra["legs"]["adversarial"]
+
+
+def test_floors_from_headline_applies_tier1_band():
+    head = {"in_domain_accuracy": 0.9, "cross_domain_accuracy": 0.4,
+            "da_mixture_accuracy": 0.8}
+    floors = scenarios.floors_from_headline(head)
+    tol = scenarios.TIER1_BAND["accuracy_abs"]
+    assert floors["in_domain_accuracy"] == pytest.approx(0.9 - tol)
+    assert set(floors) == {"in_domain_accuracy", "cross_domain_accuracy",
+                           "da_mixture_accuracy"}
+
+
+def test_mixture_ramp_spelling():
+    sched = MixtureSchedule.ramp(start_weight=0.2, parity_at=100)
+    assert sched.names == ("src", "tgt")
+    w0 = dict(zip(sched.names, sched.weights_at(0)))
+    w_mid = dict(zip(sched.names, sched.weights_at(50)))
+    w_end = dict(zip(sched.names, sched.weights_at(100)))
+    assert w0["src"] == 1.0 and w0["tgt"] == pytest.approx(0.2)
+    assert 0.2 < w_mid["tgt"] < 1.0
+    assert w_end["tgt"] == pytest.approx(1.0)
+    with pytest.raises(ValueError, match="parity_at"):
+        MixtureSchedule.ramp(parity_at=0)
+
+
+# --- the miniature in-process drill (the ISSUE 14 acceptance gate) ----------
+
+
+def _latest_adapt_artifact() -> dict:
+    paths = sorted(glob.glob(os.path.join(_REPO, "ADAPT_r*.json")))
+    assert paths, "no ADAPT_r*.json artifact in the repo root"
+    with open(paths[-1]) as f:
+        return json.load(f)
+
+
+def test_adapt_drill_both_arms(tmp_path):
+    """The committed drill replayed in-process: every structural flag on
+    both arms must hold (wall times excepted — sandbox-unstable), the
+    zero-bands must be exactly zero, and the emitted kind="adapt"
+    telemetry must pass obs_report's schema gate and render the
+    adaptation section with the time-to-recover headline."""
+    committed = _latest_adapt_artifact()
+    assert committed["passed"], "committed ADAPT artifact is red"
+
+    logger = MetricsLogger(tmp_path, quiet=True)
+    try:
+        drill = loadgen.adapt_tier1_drill(
+            seed=committed["seed"], logger=logger
+        )
+    finally:
+        logger.close()
+    assert drill["passed"], (
+        "adapt drill red: success="
+        f"{drill['success']} failure={drill['canary_failure']}"
+    )
+
+    s, f = drill["success"], drill["canary_failure"]
+    # Success arm: inject shift -> trip -> fine-tune -> canary pass ->
+    # fan-out publish -> back in band -> re-armed.
+    assert s["baseline_armed"] and s["tripped"]
+    assert s["canary_passed"] and s["published"]
+    assert s["versions_uniform"]
+    assert s["dropped_during_publish"] == 0
+    assert s["steady_recompiles"] == 0
+    assert s["inflight_at_publish"] > 0       # the zero-drop proof rode
+    assert s["rearmed"] and s["verified"]     # inside the publish
+    assert s["nota_shifted"] >= 0.5           # the collapse was real
+    assert s["loops"] == 1
+    # Failure arm: discarded, zero publishes, backoff honored,
+    # exhausted + quarantined after the budget.
+    assert f["tripped"] and f["attempt1_failed"]
+    assert f["backoff_honored"]
+    assert f["exhausted"] and f["exhausted_criticals"] == 1
+    assert f["quarantined"] and f["retrigger_absorbed"]
+    assert f["candidates_cleaned"]
+    assert f["unexpected_publishes"] == 0
+    assert f["canary_fail_records"] == f["retry_budget"]
+    # The committed artifact's structural view must match the replay
+    # (the scenarios-artifact discipline: re-emitting via --adapt_drill
+    # is the one sanctioned way to move it).
+    assert committed["zero_bands"] == {
+        "dropped_during_publish": s["dropped_during_publish"],
+        "steady_recompiles": s["steady_recompiles"],
+        "unexpected_publishes": f["unexpected_publishes"],
+    }
+    assert committed["canary_failure"]["retry_budget"] == f["retry_budget"]
+
+    # Telemetry gate: schema-clean, adapt section renders with the
+    # loop-outcome table + recover headline.
+    n, errors = obs_report.check_schema(tmp_path / "metrics.jsonl")
+    assert errors == [] and n > 0
+    recs = obs_report.load_records(tmp_path / "metrics.jsonl")
+    adapt = obs_report.adapt_summary(recs)
+    assert adapt is not None
+    assert adapt["verified_loops"] >= 1
+    assert adapt["time_to_recover_s"] is not None
+    row = adapt["loops"]["tenant0"]
+    assert row["verified"] >= 1 and row["exhausted"] == 1
+    assert row["canary_fail"] == f["retry_budget"]

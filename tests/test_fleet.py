@@ -37,6 +37,7 @@ from induction_network_on_fewrel_tpu.fleet import (
 )
 from induction_network_on_fewrel_tpu.models import build_model
 from induction_network_on_fewrel_tpu.obs.chaos import ChaosRegistry, install
+from induction_network_on_fewrel_tpu.obs.drift import DriftDetector
 from induction_network_on_fewrel_tpu.obs.health import HealthWatchdog
 from induction_network_on_fewrel_tpu.serving.batcher import (
     ExecuteError,
@@ -505,6 +506,60 @@ def test_fanout_publish_atomicity(world):
             h.stats_snapshot()["steady_recompiles"] == 0
             for h in router.replicas.values()
         )
+    finally:
+        router.close()
+
+
+def test_fanout_commit_rearms_drift_once_abort_rearms_nothing(world):
+    """Drift re-arm semantics across a fleet fan-out commit (ISSUE 14):
+    every replica's detector re-arms EXACTLY once per COMMITTED publish
+    (the engine's commit hook — post-publish drift is judged against
+    the new normal), and an aborted fan-out re-arms NOTHING — no
+    replica moved, so the old baselines are still the right comparison
+    basis and must survive untouched, latches included."""
+    tok, model, params, datasets = world
+    drifts = {}
+    replicas = {}
+    for i in range(3):
+        d = DriftDetector(eval_interval_s=0.0)
+        drifts[f"r{i}"] = d
+        replicas[f"r{i}"] = InProcessReplica(
+            f"r{i}",
+            InferenceEngine(model, params, CFG, tok, k=CFG.k,
+                            buckets=(1, 2, 4), drift=d),
+        )
+    router = FleetRouter(replicas)
+    control = FleetControl(router)
+    BASE = {"nota_rate": (0.0, 0.0), "margin": (1.0, 0.1),
+            "entropy": (0.1, 0.05)}
+    try:
+        for i in range(3):
+            control.register_tenant(f"t{i}", datasets[i % 3])
+        # Seed every replica's detector with calibration state (the
+        # registrations above are quiet rearm no-ops — no state yet).
+        for d in drifts.values():
+            d.set_baseline("t0", BASE)
+        assert all(d.rearms == 0 for d in drifts.values())
+        # Aborted fan-out: the poisoned MIDDLE replica refuses at
+        # prepare, every prepared txn aborts before anything moved.
+        install(ChaosRegistry.parse("publish.nan_params@1"))
+        try:
+            with pytest.raises(FleetPublishError):
+                control.publish_params(params)
+        finally:
+            install(None)
+        assert all(d.rearms == 0 for d in drifts.values())
+        assert all(d.armed("t0") for d in drifts.values())
+        # Committed fan-out: exactly one re-arm per replica, baselines
+        # dropped for re-capture from post-publish traffic.
+        control.publish_params(params)
+        assert [d.rearms for d in drifts.values()] == [1, 1, 1]
+        assert not any(d.armed("t0") for d in drifts.values())
+        # Exactly once PER committed publish, not once ever.
+        for d in drifts.values():
+            d.set_baseline("t0", BASE)
+        control.publish_params(params)
+        assert [d.rearms for d in drifts.values()] == [2, 2, 2]
     finally:
         router.close()
 

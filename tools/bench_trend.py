@@ -116,6 +116,20 @@ BANDS: dict[str, tuple[str, float]] = {
     "fleet.steady_recompiles": ("zero", 0.0),
     "fleet.passed": ("floor", 1.0),
     "fleet.kill_recovered": ("floor", 1.0),
+    # Adaptation drill (ISSUE 14, ADAPT_r*.json): the self-healing loop's
+    # containment invariants as zero-bands — the fan-out publish of a
+    # canary-passed candidate drops nothing and recompiles nothing, and
+    # the FAILURE arm (forced canary fail) publishes NOTHING — plus the
+    # recovery floor (the success arm must end with the tenant's NOTA
+    # rate back in band and the detector re-armed). Wall times
+    # (finetune_s / publish_s / recover_s) are recorded unbanded
+    # (documented-unstable sandbox, same policy as serve.*).
+    "adapt.dropped_during_publish": ("zero", 0.0),
+    "adapt.steady_recompiles": ("zero", 0.0),
+    "adapt.unexpected_publishes": ("zero", 0.0),
+    "adapt.passed": ("floor", 1.0),
+    "adapt.recovered": ("floor", 1.0),
+    "adapt.exhausted_latched": ("floor", 1.0),
 }
 
 
@@ -282,6 +296,32 @@ def _fleet_points(points: dict, path: str, data: dict) -> int:
     return sum(len(v) for v in points.values()) - before
 
 
+def _adapt_points(points: dict, path: str, data: dict) -> int:
+    """ADAPT_r*.json (tools/loadgen.py --adapt_drill): the self-healing
+    loop's zero-bands (nothing dropped or recompiled by the adaptation
+    publish; the forced-canary-failure arm publishes nothing), the
+    recovery/exhaustion floors, and the recorded (unbanded) wall
+    times."""
+    rnd, src = _round_of(path), os.path.basename(path)
+    before = sum(len(v) for v in points.values())
+    zero = data.get("zero_bands") or {}
+    for key in ("dropped_during_publish", "steady_recompiles",
+                "unexpected_publishes"):
+        _point(points, f"adapt.{key}", rnd, src, zero.get(key))
+    _point(points, "adapt.passed", rnd, src,
+           1.0 if data.get("passed") else 0.0)
+    success = data.get("success") or {}
+    _point(points, "adapt.recovered", rnd, src,
+           1.0 if success.get("verified") else 0.0)
+    _point(points, "adapt.recover_s", rnd, src, success.get("recover_s"))
+    _point(points, "adapt.finetune_s", rnd, src, success.get("finetune_s"))
+    _point(points, "adapt.publish_s", rnd, src, success.get("publish_s"))
+    failure = data.get("canary_failure") or {}
+    _point(points, "adapt.exhausted_latched", rnd, src,
+           1.0 if failure.get("exhausted") else 0.0)
+    return sum(len(v) for v in points.values()) - before
+
+
 _EXTRACTORS = (
     ("BENCH_r*.json", _bench_points),
     ("ROOFLINE_r*.json", _roofline_points),
@@ -289,6 +329,7 @@ _EXTRACTORS = (
     ("SERVE_r*.json", _serve_points),
     ("CHAOS_r*.json", _chaos_points),
     ("FLEET_r*.json", _fleet_points),
+    ("ADAPT_r*.json", _adapt_points),
 )
 
 

@@ -62,6 +62,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import sys
 import tempfile
 import threading
@@ -152,6 +153,23 @@ def parse_args(argv=None):
                         "--run_dir)")
     p.add_argument("--chaos_artifact", default=None, metavar="PATH",
                    help="write the CHAOS_r*.json drill artifact here")
+    p.add_argument("--adapt_drill", action="store_true",
+                   help="self-healing adaptation drill (ISSUE 14), "
+                        "standalone mode on its own miniature world: "
+                        "SUCCESS arm — inject an OOV domain shift, the "
+                        "drift CRITICAL triggers the controller, a "
+                        "mixture-ramp fine-tune passes the scenario-"
+                        "harness canary and fan-out-publishes into a "
+                        "3-replica fleet (0 dropped, 0 recompiles, "
+                        "params_version uniform), the tenant's NOTA "
+                        "rate returns to band and the detector re-arms; "
+                        "FAILURE arm — chaos adapt.canary_fail discards "
+                        "every candidate (zero publishes), backoff is "
+                        "honored, and the retry budget exhausts into a "
+                        "latched adapt_exhausted CRITICAL + quarantine "
+                        "(requires --run_dir)")
+    p.add_argument("--adapt_artifact", default=None, metavar="PATH",
+                   help="write the ADAPT_r*.json drill artifact here")
     p.add_argument("--fleet", type=int, default=0, metavar="R",
                    help="fleet soak mode (ISSUE 13): build R in-process "
                         "engine replicas behind the fleet router, spread "
@@ -181,6 +199,8 @@ def parse_args(argv=None):
         p.error("--drift_drill needs --run_dir (captures land there)")
     if args.chaos_drill and not args.run_dir:
         p.error("--chaos_drill needs --run_dir (captures land there)")
+    if args.adapt_drill and not args.run_dir:
+        p.error("--adapt_drill needs --run_dir (captures land there)")
     return args
 
 
@@ -1168,6 +1188,520 @@ def check_chaos_drill(drill: dict) -> bool:
     )
 
 
+# --- self-healing adaptation drill (ISSUE 14) -------------------------------
+
+# The miniature adaptation world: the smallest config where the
+# SCENARIOS_r01 story reproduces end to end on CPU in seconds — a
+# source-trained model collapses to the all-NOTA basin on the shifted
+# twin (tgt traffic ~0.9 NOTA through the serving engine), and a
+# mixture-ramp fine-tune recovers it (tgt NOTA back to the in-domain
+# 0.0). CE loss + seed 1 per the scenarios TIER1 rationale.
+ADAPT_WORLD = dict(
+    num_relations=5, instances_per_relation=20,
+    train_iters=140, finetune_steps=100,
+    canary_floors={"in_domain": 0.6, "target": 0.5},
+    canary_episodes=48,
+    drift=dict(window=32, baseline_n=24, min_count=16),
+    cfg=dict(
+        model="induction", encoder="cnn", hidden_size=64,
+        induction_dim=32, ntn_slices=32, routing_iters=2,
+        train_n=2, n=2, k=2, q=2, na_rate=1, batch_size=4,
+        max_length=16, vocab_size=302, word_dim=50,
+        compute_dtype="float32", loss="ce", lr=5e-3,
+        weight_decay=0.0, val_step=0, device="cpu", seed=1,
+    ),
+)
+
+
+def _adapt_world(seed: int, tmpdir: str):
+    """(cfg, tok, model, src, tgt, ckpt_dir): the source-trained live
+    artifact plus the two corpora. The tgt twin is the same relations
+    with the trigger signal moved to a disjoint vocab block
+    (make_domain_shifted_fewrel — wiki -> pubmed in miniature)."""
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.data import (
+        GloveTokenizer,
+        make_domain_shifted_fewrel,
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+    from induction_network_on_fewrel_tpu.train import FewShotTrainer
+    from induction_network_on_fewrel_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+    from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+    plan = ADAPT_WORLD
+    cfg = ExperimentConfig(**plan["cfg"])
+    vocab = make_synthetic_glove(vocab_size=cfg.vocab_size - 2,
+                                 word_dim=cfg.word_dim)
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    src = make_synthetic_fewrel(
+        num_relations=plan["num_relations"],
+        instances_per_relation=plan["instances_per_relation"],
+        vocab_size=cfg.vocab_size - 2, seed=seed,
+    )
+    tgt = make_domain_shifted_fewrel(
+        num_relations=plan["num_relations"],
+        instances_per_relation=plan["instances_per_relation"],
+        vocab_size=cfg.vocab_size - 2, shift=1.0, seed=seed,
+    )
+    model = build_model(cfg, glove_init=vocab.vectors)
+    trainer = FewShotTrainer(
+        model, cfg,
+        EpisodeSampler(src, tok, n=cfg.n, k=cfg.k, q=cfg.q,
+                       batch_size=cfg.batch_size, na_rate=cfg.na_rate,
+                       seed=seed + 1),
+        logger=MetricsLogger(quiet=True),
+    )
+    state = trainer.train(num_iters=plan["train_iters"])
+    ckpt = os.path.join(tmpdir, "live_ckpt")
+    mngr = CheckpointManager(ckpt, cfg, stage="off")
+    try:
+        mngr.save(plan["train_iters"], state, val_accuracy=0.0)
+        mngr.wait()
+    finally:
+        mngr.close()
+    trainer.close()
+    return cfg, tok, model, src, tgt, ckpt
+
+
+def _adapt_pools(src, tgt, k: int):
+    """Held-out (post-support) query pools per domain."""
+    return (
+        [i for r in src.rel_names for i in src.instances[r][k:]],
+        [i for r in tgt.rel_names for i in tgt.instances[r][k:]],
+    )
+
+
+def _build_adapt_controller(model, cfg, tok, src, tgt, ckpt, drift,
+                            publish_fn, quarantine_fn, tmpdir, *,
+                            steps, logger=None, recorder=None,
+                            capture=None, **kw):
+    """The drill's controller: real mixture fine-tune, real scenario-
+    harness canary, the caller's (fan-out) publish. Mirrors the serve.py
+    wiring (serving/cli._build_adapt) at drill scale."""
+    from induction_network_on_fewrel_tpu.obs.adapt import (
+        AdaptationController,
+        make_checkpoint_loop,
+    )
+    from induction_network_on_fewrel_tpu.serving.registry import load_params
+    from induction_network_on_fewrel_tpu.train.finetune import (
+        mixture_finetune,
+    )
+    from scenarios import run_canary
+
+    # Per-controller candidate dir: the two arms share one world (and
+    # one tmpdir), and a failure-arm candidate must never collide with
+    # the success arm's published one (orbax refuses step re-saves).
+    work = tempfile.mkdtemp(dir=tmpdir, prefix="candidates_")
+
+    def finetune(src_ckpt, out, seq, attempt, step_budget, wall_budget_s):
+        return mixture_finetune(
+            src_ckpt, out, src, tgt, tok, steps=step_budget,
+            wall_budget_s=wall_budget_s, seed=cfg.seed + 100 + seq,
+        )
+
+    # The shared closure wiring (live-artifact holder, candidate
+    # naming, publish/cleanup) is ONE home with serve.py's builder.
+    train_fn, publish, cleanup, current_fn = make_checkpoint_loop(
+        ckpt, work, finetune, publish_fn, prefix="cand_",
+    )
+
+    def canary_fn(candidate):
+        return run_canary(
+            model, load_params(candidate), cfg, tok,
+            legs={"in_domain": src, "target": tgt},
+            floors=dict(ADAPT_WORLD["canary_floors"]),
+            episodes=ADAPT_WORLD["canary_episodes"], seed=cfg.seed + 7,
+        )
+
+    controller = AdaptationController(
+        train_fn, canary_fn, publish, drift=drift,
+        current_fn=current_fn, cleanup_fn=cleanup,
+        quarantine_fn=quarantine_fn, step_budget=steps,
+        logger=logger, recorder=recorder, capture=capture, **kw,
+    )
+    return controller, work
+
+
+def _drive_until(classify, pool, *, stop, cap, count_nota=False):
+    """Classify pool instances round-robin until ``stop()`` or ``cap``
+    calls; returns (calls, nota_count)."""
+    nota = 0
+    for i in range(cap):
+        if stop():
+            return i, nota
+        v = classify(pool[i % len(pool)])
+        nota += bool(v.get("nota")) if count_nota else 0
+    return cap, nota
+
+
+def run_adapt_success_arm(cfg, tok, model, src, tgt, ckpt, tmpdir,
+                          logger=None, recorder=None, capture=None,
+                          replicas: int = 3) -> dict:
+    """Inject shift -> drift CRITICAL -> mixture fine-tune -> canary
+    pass -> all-or-nothing fan-out publish (0 dropped, 0 steady
+    recompiles, params_version uniform) -> NOTA rate back in band ->
+    detector re-armed -> controller verified."""
+    from induction_network_on_fewrel_tpu.fleet import (
+        FleetControl,
+        FleetRouter,
+        InProcessReplica,
+    )
+    from induction_network_on_fewrel_tpu.obs import DriftDetector
+    from induction_network_on_fewrel_tpu.obs.adapt import (
+        COOLDOWN,
+        TRIGGERED,
+        VERIFYING,
+    )
+    from induction_network_on_fewrel_tpu.serving.engine import (
+        InferenceEngine,
+    )
+    from induction_network_on_fewrel_tpu.serving.registry import load_params
+
+    tenant = "tenant0"
+    dknobs = ADAPT_WORLD["drift"]
+    # ONE detector shared by every replica (per-tenant keyed): the
+    # owner replica's verdicts feed it, and a committed fan-out re-arms
+    # it exactly once (the first replica's commit hook drops the state;
+    # the rest are quiet no-ops — pinned in tests/test_fleet.py).
+    drift = DriftDetector(
+        eval_interval_s=0.0, logger=logger, recorder=recorder,
+        capture=capture, **dknobs,
+    )
+    params = load_params(ckpt)
+    handles = {
+        f"r{i}": InProcessReplica(
+            f"r{i}",
+            InferenceEngine(model, params, cfg, tok, k=cfg.k,
+                            buckets=(1, 2, 4), logger=logger,
+                            drift=drift),
+        )
+        for i in range(replicas)
+    }
+    router = FleetRouter(handles, logger=logger)
+    control = FleetControl(router)
+    out: dict = {"replicas": replicas}
+    src_pool, tgt_pool = _adapt_pools(src, tgt, cfg.k)
+    # The zero-drop proof rides INSIDE the publish: the wrapper submits
+    # a burst of clean queries immediately before the fan-out, so the
+    # hot-swap commits with requests genuinely in flight (the PR 7
+    # pattern — serving load concurrent with TRAINING dispatch is a
+    # separate, image-unsafe pattern: two threads driving jit on this
+    # CPU build corrupt the heap, the round-6/round-10 ENV finding).
+    inflight: dict = {"futures": [], "submitted": 0}
+
+    def publish_with_inflight_load(candidate):
+        futs = [
+            router.submit(src_pool[i % len(src_pool)], 30.0,
+                          tenant=tenant)
+            for i in range(16)
+        ]
+        inflight["futures"].extend(futs)
+        inflight["submitted"] += len(futs)
+        return control.publish_checkpoint(candidate)
+
+    controller, work = _build_adapt_controller(
+        model, cfg, tok, src, tgt, ckpt, drift,
+        publish_with_inflight_load,
+        lambda t, reason="": control.quarantine_tenant(t, reason=reason),
+        tmpdir, steps=ADAPT_WORLD["finetune_steps"],
+        logger=logger, recorder=recorder, capture=capture,
+        retry_budget=3, backoff_s=0.5, cooldown_s=5.0,
+        verify_window_s=120.0, wall_budget_s=120.0,
+    )
+    try:
+        control.register_tenant(tenant, src)
+        for h in router.replicas.values():
+            h.warmup()
+
+        def classify(inst):
+            return router.classify(inst, 30.0, tenant=tenant)
+
+        # 1. Calibration baseline from clean in-domain traffic.
+        n_base = dknobs["baseline_n"] + dknobs["min_count"] + 8
+        _drive_until(classify, src_pool, stop=lambda: False, cap=n_base)
+        out["baseline_armed"] = drift.armed(tenant)
+        healthy = drift.baseline_for(tenant)
+        out["nota_healthy"] = healthy["nota_rate"][0] if healthy else None
+
+        # 2. Inject the domain shift: target-twin traffic. The NOTA
+        # collapse must trip a CRITICAL which triggers the controller
+        # (drift.on_event -> controller.trigger). A FIXED window of
+        # shifted queries (not stop-at-trigger): the trip usually lands
+        # within a few queries — margin/entropy move first — and the
+        # recorded shifted NOTA rate must measure the collapse itself,
+        # not the trip latency; extra triggers are absorbed.
+        calls, nota_shift = _drive_until(
+            classify, tgt_pool, stop=lambda: False,
+            cap=2 * dknobs["window"], count_nota=True,
+        )
+        out["tripped"] = controller.state_of(tenant) == TRIGGERED
+        out["shift_queries"] = calls
+        out["nota_shifted"] = round(nota_shift / max(calls, 1), 4)
+        trigger_recs = [r for r in controller.records
+                        if r["action"] == "trigger"]
+        out["trigger_feature"] = (
+            trigger_recs[0].get("feature") if trigger_recs else None
+        )
+        if not out["tripped"]:
+            out["verified"] = False
+            return out
+
+        # 3. The adaptation attempt — fine-tune + canary + fan-out
+        # publish with the in-flight burst (the publish wrapper above):
+        # zero dropped, zero steady recompiles, params_version uniform.
+        versions0 = {
+            rid: h.engine.registry.params_version
+            for rid, h in handles.items()
+        }
+        t0 = time.monotonic()
+        processed = controller.run_once()
+        out["adapt_wall_s"] = round(time.monotonic() - t0, 3)
+        out["processed"] = processed
+        out["state_after_publish"] = controller.state_of(tenant)
+        out["published"] = controller.state_of(tenant) == VERIFYING
+        recs = {r["action"]: r for r in controller.records}
+        out["finetune_s"] = recs.get("train", {}).get("train_s")
+        out["canary_passed"] = recs.get("canary", {}).get("passed") == 1.0
+        out["publish_s"] = recs.get("publish", {}).get("publish_s")
+        dropped = 0
+        for fut in inflight["futures"]:
+            try:
+                fut.result(timeout=30.0)
+            except Exception:  # noqa: BLE001 — any failure IS a drop
+                dropped += 1
+        out["inflight_at_publish"] = inflight["submitted"]
+        out["dropped_during_publish"] = dropped
+        versions1 = {
+            rid: h.engine.registry.params_version
+            for rid, h in handles.items()
+        }
+        out["params_version_before"] = sorted(versions0.values())[0]
+        out["params_versions_after"] = sorted(set(versions1.values()))
+        out["versions_uniform"] = (
+            len(set(versions1.values())) == 1
+            and all(versions1[r] == versions0[r] + 1 for r in versions1)
+        )
+        out["steady_recompiles"] = sum(
+            h.engine.stats.snapshot()["steady_recompiles"]
+            for h in handles.values()
+        )
+
+        # 4. Post-publish verification: the shifted domain IS the new
+        # normal — adapted, its traffic must re-baseline the re-armed
+        # detector with the NOTA rate back in band of the healthy
+        # baseline, and the controller declares success. EXACTLY
+        # baseline_n queries: the recaptured baseline is the verify
+        # check's input, and stopping short of min_count further window
+        # fill keeps clean-pool composition oscillation (a real margin-
+        # window effect on an 80-instance pool) from judging anything
+        # mid-verification.
+        rearms_at_publish = drift.rearms
+        _drive_until(classify, tgt_pool, stop=lambda: False,
+                     cap=dknobs["baseline_n"])
+        post_base = drift.baseline_for(tenant)
+        out["nota_post"] = (
+            post_base["nota_rate"][0] if post_base else None
+        )
+        out["rearmed"] = drift.armed(tenant) and rearms_at_publish >= 1
+        controller.tick()
+        out["verified"] = controller.state_of(tenant) == COOLDOWN
+        ver = [r for r in controller.records if r["action"] == "verified"]
+        if ver:
+            out["recover_s"] = ver[-1].get("recover_s")
+            out["nota_band"] = ver[-1].get("nota_band")
+        out["loops"] = controller.loop_info(tenant)["loops"]
+        return out
+    finally:
+        controller.close()
+        router.close()
+
+
+def run_adapt_failure_arm(cfg, tok, model, src, tgt, ckpt, tmpdir,
+                          logger=None, recorder=None,
+                          capture=None) -> dict:
+    """Forced canary failure (chaos ``adapt.canary_fail``): the
+    candidate is discarded — ZERO publishes — retries honor exponential
+    backoff, and the retry budget exhausts into a permanent
+    ``adapt_exhausted`` CRITICAL + tenant quarantine."""
+    from induction_network_on_fewrel_tpu.obs import DriftDetector
+    from induction_network_on_fewrel_tpu.obs.adapt import (
+        EXHAUSTED,
+        TRIGGERED,
+    )
+    from induction_network_on_fewrel_tpu.obs.chaos import (
+        ChaosRegistry,
+        install,
+    )
+    from induction_network_on_fewrel_tpu.serving.engine import (
+        InferenceEngine,
+    )
+    from induction_network_on_fewrel_tpu.serving.registry import load_params
+
+    tenant = "tenant0"
+    RETRIES, BACKOFF = 2, 30.0
+    dknobs = ADAPT_WORLD["drift"]
+    drift = DriftDetector(
+        eval_interval_s=0.0, logger=logger, recorder=recorder,
+        capture=capture, **dknobs,
+    )
+    engine = InferenceEngine(
+        model, load_params(ckpt), cfg, tok, k=cfg.k, buckets=(1, 2, 4),
+        logger=logger, drift=drift,
+    )
+    chaos = ChaosRegistry.parse(
+        f"adapt.canary_fail@0*{RETRIES}:{tenant}", logger=logger
+    )
+    chaos.install()
+    out: dict = {"retry_budget": RETRIES, "backoff_s": BACKOFF}
+    # Tiny fine-tunes: the chaos point fails the canary regardless, so
+    # the arm drills the RETRY/backoff/exhaustion machinery, not model
+    # quality.
+    controller, work = _build_adapt_controller(
+        model, cfg, tok, src, tgt, ckpt, drift,
+        engine.publish_checkpoint,
+        lambda t, reason="": engine.quarantine_tenant(t, reason=reason),
+        tmpdir, steps=8, logger=logger, recorder=recorder,
+        capture=capture, retry_budget=RETRIES, backoff_s=BACKOFF,
+        verify_window_s=60.0, wall_budget_s=60.0,
+    )
+    try:
+        engine.register_dataset(src, tenant=tenant)
+        engine.warmup()
+        src_pool, tgt_pool = _adapt_pools(src, tgt, cfg.k)
+
+        def classify(inst):
+            return engine.classify(inst, tenant=tenant)
+
+        n_base = dknobs["baseline_n"] + dknobs["min_count"] + 8
+        _drive_until(classify, src_pool, stop=lambda: False, cap=n_base)
+        _drive_until(
+            classify, tgt_pool,
+            stop=lambda: controller.state_of(tenant) == TRIGGERED,
+            cap=2 * dknobs["window"],
+        )
+        out["tripped"] = controller.state_of(tenant) == TRIGGERED
+        if not out["tripped"]:
+            return out
+        pv0 = engine.registry.params_version
+        swaps0 = engine.stats.snapshot()["swaps"]
+
+        # Attempt 1: train runs (tiny), canary chaos-fails, candidate
+        # discarded, backoff scheduled.
+        now = 1000.0
+        out["attempt1_ran"] = controller.run_once(now=now) == tenant
+        info = controller.loop_info(tenant)
+        out["attempt1_failed"] = (
+            info["state"] == TRIGGERED and info["attempts"] == 1
+        )
+        # Backoff honored: a retry before not_before does NOT run.
+        out["backoff_honored"] = (
+            controller.run_once(now=now + 0.5 * BACKOFF) is None
+        )
+        # Attempt 2 (past the backoff): chaos-fails again -> the retry
+        # budget is burned -> EXHAUSTED + quarantine, permanently.
+        out["attempt2_ran"] = (
+            controller.run_once(now=now + BACKOFF + 1.0) == tenant
+        )
+        out["exhausted"] = controller.state_of(tenant) == EXHAUSTED
+        exhausted_events = [
+            e for e in controller.events if e.event == "adapt_exhausted"
+        ]
+        out["exhausted_criticals"] = len(exhausted_events)
+        out["quarantined"] = engine.registry.snapshot(tenant).degraded
+        # Permanent: another trigger is absorbed, nothing runs.
+        out["retrigger_absorbed"] = not controller.trigger(
+            tenant, now=now + 500.0
+        )
+        out["candidates_cleaned"] = not any(
+            p.startswith("cand_") for p in os.listdir(work)
+        )
+        snap = engine.stats.snapshot()
+        out["unexpected_publishes"] = (
+            engine.registry.params_version - pv0
+            + snap["swaps"] - swaps0
+        )
+        out["canary_fail_records"] = sum(
+            1 for r in controller.records
+            if r["action"] == "canary" and r.get("passed") == 0.0
+        )
+        out["injected"] = len(chaos.fired_log)
+        return out
+    finally:
+        controller.close()
+        install(None)
+        engine.close()
+
+
+def adapt_tier1_drill(seed: int = 1, logger=None, recorder=None,
+                      capture=None) -> dict:
+    """Both arms of the ISSUE 14 drill in one world (what
+    tests/test_adapt.py gates in tier-1 and --adapt_drill stamps into
+    ADAPT_r*.json). Deterministic under a fixed seed on a fixed stack
+    (wall times excepted)."""
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="adapt_drill_") as tmpdir:
+        cfg, tok, model, src, tgt, ckpt = _adapt_world(seed, tmpdir)
+        out = {
+            "seed": seed,
+            "config": dict(ADAPT_WORLD["cfg"]),
+            "world": {
+                k: ADAPT_WORLD[k] for k in
+                ("num_relations", "instances_per_relation",
+                 "train_iters", "finetune_steps", "canary_floors")
+            },
+            "success": run_adapt_success_arm(
+                cfg, tok, model, src, tgt, ckpt, tmpdir,
+                logger=logger, recorder=recorder, capture=capture,
+            ),
+            "canary_failure": run_adapt_failure_arm(
+                cfg, tok, model, src, tgt, ckpt, tmpdir,
+                logger=logger, recorder=recorder, capture=capture,
+            ),
+        }
+        out["wall_s"] = round(time.monotonic() - t0, 1)
+        out["passed"] = check_adapt_drill(out)
+        return out
+
+
+def check_adapt_drill(drill: dict) -> bool:
+    """The drill's acceptance: detect -> adapt -> gate -> publish ->
+    verify on the success arm; discard -> backoff -> exhaust -> contain
+    on the failure arm."""
+    s = drill.get("success", {})
+    f = drill.get("canary_failure", {})
+    return bool(
+        s.get("baseline_armed")
+        and s.get("tripped")
+        and s.get("canary_passed")
+        and s.get("published")
+        and s.get("versions_uniform")
+        and s.get("dropped_during_publish") == 0
+        and s.get("steady_recompiles") == 0
+        and s.get("rearmed")
+        and s.get("verified")
+        # The quality story in numbers: healthy ~0, collapsed high,
+        # recovered back under the healthy+band bar.
+        and s.get("nota_shifted", 0) >= 0.5
+        and abs(s.get("nota_post", 1.0) - s.get("nota_healthy", 0.0))
+        <= max(s.get("nota_band") or 0.05, 0.05) + 1e-9
+        and f.get("tripped")
+        and f.get("attempt1_failed")
+        and f.get("backoff_honored")
+        and f.get("exhausted")
+        and f.get("exhausted_criticals") == 1
+        and f.get("quarantined")
+        and f.get("retrigger_absorbed")
+        and f.get("candidates_cleaned")
+        and f.get("unexpected_publishes") == 0
+        and f.get("canary_fail_records") == f.get("retry_budget")
+    )
+
+
 # --- fleet soak (ISSUE 13) --------------------------------------------------
 
 
@@ -1721,11 +2255,23 @@ def main(argv=None) -> int:
     from induction_network_on_fewrel_tpu.cli import select_device
     from induction_network_on_fewrel_tpu.config import ExperimentConfig
 
-    select_device(ExperimentConfig(device=args.device), "auto")
+    # ENV FINDING (round 15): the persistent XLA compile cache corrupts
+    # the glibc heap on this image when one process both SERVES (live
+    # engine programs) and TRAINS (the adaptation fine-tune) — the drill
+    # segfaulted in the fine-tune's train dispatch with the cache on,
+    # reproducibly, and is clean with it off (same class as the round-6
+    # CLI --resume and round-10 profiler teardown crashes; BASELINE
+    # round 15). serve.py --adapt deployments on this image should pass
+    # --compile_cache off likewise (RUNBOOK §19).
+    select_device(ExperimentConfig(device=args.device),
+                  "off" if args.adapt_drill else "auto")
 
     tmp = None
     ckpt = args.ckpt
-    if ckpt is None:
+    if ckpt is None and not args.adapt_drill:
+        # --adapt_drill trains its own miniature world (the default
+        # synthetic checkpoint would be dead weight — and one more
+        # orbax world in the process for no reason).
         tmp = tempfile.TemporaryDirectory(prefix="loadgen_")
         print("building synthetic-data checkpoint...", file=sys.stderr)
         ckpt = make_synthetic_checkpoint(args, tmp.name)
@@ -1814,6 +2360,66 @@ def main(argv=None) -> int:
                 with open(args.fleet_artifact, "w") as f:
                     json.dump(report, f, indent=1)
                 print(f"wrote {args.fleet_artifact}", file=sys.stderr)
+            if args.run_dir:
+                print(f"telemetry in {args.run_dir} — render with "
+                      f"'python tools/obs_report.py {args.run_dir}'",
+                      file=sys.stderr)
+            return rc
+        if args.adapt_drill:
+            # Standalone mode (like --fleet): the adaptation loop is the
+            # system under test, on its own miniature world — the
+            # scheduler arms are skipped.
+            drill = adapt_tier1_drill(
+                seed=args.seed, logger=logger, recorder=recorder,
+                capture=capture,
+            )
+            s, f = drill["success"], drill["canary_failure"]
+            print(f"[adapt drill/success] tripped={s.get('tripped')} "
+                  f"({s.get('trigger_feature')}) "
+                  f"nota {s.get('nota_healthy')} -> "
+                  f"{s.get('nota_shifted')} -> {s.get('nota_post')}; "
+                  f"finetune {s.get('finetune_s')}s canary="
+                  f"{s.get('canary_passed')} publish "
+                  f"{s.get('publish_s')}s uniform="
+                  f"{s.get('versions_uniform')} "
+                  f"dropped={s.get('dropped_during_publish')} "
+                  f"recompiles={s.get('steady_recompiles')} "
+                  f"verified={s.get('verified')} "
+                  f"recover {s.get('recover_s')}s")
+            print(f"[adapt drill/canary-failure] tripped={f.get('tripped')} "
+                  f"backoff_honored={f.get('backoff_honored')} "
+                  f"exhausted={f.get('exhausted')} "
+                  f"criticals={f.get('exhausted_criticals')} "
+                  f"quarantined={f.get('quarantined')} "
+                  f"publishes={f.get('unexpected_publishes')} "
+                  f"cleaned={f.get('candidates_cleaned')}")
+            if not drill["passed"]:
+                print("FAIL[adapt drill]: the loop did not detect/adapt/"
+                      "gate/verify (or contain) as required",
+                      file=sys.stderr)
+                rc = 1
+            report = {
+                "round": 1,
+                "generated_by": "tools/loadgen.py --adapt_drill",
+                **drill,
+                # The zero-bands tools/bench_trend.py folds: the
+                # adaptation publish must drop nothing and recompile
+                # nothing, and the failure arm must publish NOTHING.
+                "zero_bands": {
+                    "dropped_during_publish":
+                        s.get("dropped_during_publish"),
+                    "steady_recompiles": s.get("steady_recompiles"),
+                    "unexpected_publishes": f.get("unexpected_publishes"),
+                },
+            }
+            print(json.dumps({
+                k: report[k] for k in
+                ("world", "zero_bands", "passed") if k in report
+            }))
+            if args.adapt_artifact:
+                with open(args.adapt_artifact, "w") as fh:
+                    json.dump(report, fh, indent=1)
+                print(f"wrote {args.adapt_artifact}", file=sys.stderr)
             if args.run_dir:
                 print(f"telemetry in {args.run_dir} — render with "
                       f"'python tools/obs_report.py {args.run_dir}'",

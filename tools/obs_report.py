@@ -746,6 +746,63 @@ def fault_summary(recs: list[dict]) -> dict | None:
     return out
 
 
+def adapt_summary(recs: list[dict]) -> dict | None:
+    """Self-healing adaptation section (ISSUE 14, kind="adapt"): the
+    loop outcome table — per tenant: triggers, fine-tunes (ok/failed),
+    canary passes/fails, publishes, rollbacks, verified loops, and
+    whether the tenant exhausted its retry budget — with the
+    time-to-recover headline (the last verified loop's trigger-to-
+    back-in-band wall time) and fine-tune/publish costs."""
+    adapt = [r for r in recs if r.get("kind") == "adapt"]
+    if not adapt:
+        return None
+    out: dict = {"records": len(adapt)}
+    verified = [r for r in adapt if r.get("action") == "verified"]
+    if verified:
+        out["time_to_recover_s"] = verified[-1].get("recover_s")
+        out["verified_loops"] = len(verified)
+    trains_ok = [r for r in adapt
+                 if r.get("action") == "train" and r.get("ok") == 1.0]
+    if trains_ok:
+        out["finetune_s_last"] = trains_ok[-1].get("train_s")
+    publishes = [r for r in adapt
+                 if r.get("action") == "publish" and r.get("ok") == 1.0]
+    if publishes:
+        out["publish_s_last"] = publishes[-1].get("publish_s")
+        out["last_params_version"] = publishes[-1].get("params_version")
+    by_tenant: dict[str, dict] = {}
+    for r in adapt:
+        t = str(r.get("tenant"))
+        row = by_tenant.setdefault(t, {
+            "triggers": 0, "train_ok": 0, "train_fail": 0,
+            "canary_pass": 0, "canary_fail": 0, "publishes": 0,
+            "rollbacks": 0, "verified": 0, "exhausted": 0,
+        })
+        a = r.get("action")
+        if a == "trigger":
+            row["triggers"] += 1
+        elif a == "train":
+            row["train_ok" if r.get("ok") == 1.0 else "train_fail"] += 1
+        elif a == "canary":
+            row["canary_pass" if r.get("passed") == 1.0
+                else "canary_fail"] += 1
+        elif a == "publish" and r.get("ok") == 1.0:
+            row["publishes"] += 1
+        elif a == "rollback":
+            row["rollbacks"] += 1
+        elif a == "verified":
+            row["verified"] += 1
+        elif a == "exhausted":
+            row["exhausted"] += 1
+    out["loops"] = {t: by_tenant[t] for t in sorted(by_tenant)}
+    exhausted = [r for r in adapt if r.get("action") == "exhausted"]
+    if exhausted:
+        out["exhausted_tenants"] = sorted(
+            {str(r.get("tenant")) for r in exhausted}
+        )
+    return out
+
+
 def fleet_summary(recs: list[dict]) -> dict | None:
     """Fleet-tier section (ISSUE 13, kind="fleet"): the router's
     aggregate counters, a per-replica table (state + routed + serving
@@ -941,7 +998,7 @@ def render(report: dict) -> str:
     for e in errors[:10]:
         lines.append(f"  ! {e}")
     for section in ("train", "mfu", "eval", "perf", "compile", "serve",
-                    "fleet", "faults", "traces", "slo", "quality",
+                    "fleet", "adapt", "faults", "traces", "slo", "quality",
                     "scenarios", "ckpt", "input_pipeline", "comms",
                     "roofline", "health", "flight_recorder", "overhead"):
         body = report.get(section)
@@ -1009,6 +1066,7 @@ def main(argv=None) -> int:
         "compile": compile_summary(recs),
         "serve": serve_summary(recs),
         "fleet": fleet_summary(recs),
+        "adapt": adapt_summary(recs),
         "faults": fault_summary(recs),
         "traces": trace_summary(recs),
         "slo": slo_summary(recs),

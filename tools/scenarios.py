@@ -278,6 +278,83 @@ def nota_calibration(model, params, cfg, ds, tok, episodes, na_rate,
     }
 
 
+# --- library-level canary gate (ISSUE 14 satellite) ------------------------
+#
+# The quality floors as a plan-in/verdict-out ENTRYPOINT — no argv, no
+# main() coupling — so the adaptation controller's pre-publish canary
+# (obs/adapt.py) and this CLI share ONE home for what "good enough to
+# ship" means. A candidate that fails any floor is discarded by the
+# controller, never published.
+
+
+def floors_from_headline(headline: dict,
+                         band: dict | None = None) -> dict[str, float]:
+    """Turn a recorded tier1 headline (``tier1_headline``'s shape, e.g.
+    the committed SCENARIOS artifact's ``tier1`` block) into canary
+    floors: each accuracy minus the tier-1 band — the SAME one-sided
+    bars tests/test_scenarios.py gates on."""
+    tol = (band or TIER1_BAND)["accuracy_abs"]
+    floors = {}
+    for key in ("in_domain_accuracy", "cross_domain_accuracy",
+                "da_mixture_accuracy"):
+        if isinstance(headline.get(key), (int, float)):
+            floors[key] = round(max(headline[key] - tol, 0.0), 4)
+    return floors
+
+
+def canary_verdict(legs: dict, floors: dict[str, float]) -> dict:
+    """Hold evaluated legs to their floors. ``legs``: {name: {"accuracy":
+    ...}} (extra legs without a floor are recorded, not judged; a floor
+    without a matching leg FAILS — a gate that silently skips a bar is
+    worse than no gate). Verdict: {"passed", "legs", "failures"}."""
+    failures = []
+    out_legs = {}
+    for name, leg in legs.items():
+        acc = leg.get("accuracy")
+        floor = floors.get(name)
+        row = {"accuracy": acc}
+        if floor is not None:
+            row["floor"] = floor
+            row["ok"] = bool(acc is not None and acc >= floor)
+            if not row["ok"]:
+                failures.append(
+                    f"{name}: accuracy {acc} below floor {floor}"
+                )
+        out_legs[name] = row
+    for name in sorted(set(floors) - set(legs)):
+        failures.append(f"{name}: floor {floors[name]} has no evaluated leg")
+    return {"passed": not failures, "legs": out_legs, "failures": failures}
+
+
+def run_canary(model, params, cfg, tok, legs: dict, floors: dict,
+               episodes: int = 48, seed: int = 0) -> dict:
+    """Evaluate candidate ``params`` on each leg's dataset and hold it
+    to the floors. ``legs``: {name: FewRel-schema dataset} (episode
+    geometry from ``cfg``); ``floors``: {name: min accuracy}. Returns
+    the ``canary_verdict`` dict with per-leg accuracy/acc_ci95."""
+    from induction_network_on_fewrel_tpu.train import FewShotTrainer
+    from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+    if not legs:
+        raise ValueError("canary needs at least one evaluation leg")
+    first = next(iter(legs.values()))
+    trainer = FewShotTrainer(
+        model, cfg, _sampler(first, tok, cfg, seed=seed),
+        logger=MetricsLogger(quiet=True),
+    )
+    try:
+        evaluated = {
+            name: _eval_leg(
+                trainer, params,
+                _sampler(ds, tok, cfg, seed=seed + 17 + i), episodes,
+            )
+            for i, (name, ds) in enumerate(sorted(legs.items()))
+        }
+    finally:
+        trainer.close()
+    return canary_verdict(evaluated, floors)
+
+
 # --- the harness ----------------------------------------------------------
 
 
