@@ -10,12 +10,25 @@
   all-or-nothing fan-out publish over the registry's two-phase
   prepare/commit (any replica's refusal rolls the whole fleet back).
 * ``transport``  — the same ``ReplicaHandle`` interface over JSON-lines
-  sockets for real multi-process replicas.
+  sockets for real multi-process replicas (per-call deadlines, bounded
+  idempotent retry, net.* chaos points — ISSUE 15).
+* ``journal``    — the write-ahead log of every control-plane op
+  (ISSUE 15): CRC-framed records, torn-tail truncation, snapshot
+  compaction, deterministic replay; ``FleetRouter.recover(journal)``
+  rebuilds the directory bitwise after a crash.
+* ``supervisor`` — replica supervision: health probes, restart with
+  exponential backoff + deterministic jitter, bounded budget degrading
+  to permanent-dead, re-registration + params catch-up on restart.
 """
 
 from induction_network_on_fewrel_tpu.fleet.control import (
     FleetControl,
     FleetPublishError,
+)
+from induction_network_on_fewrel_tpu.fleet.journal import (
+    FleetJournal,
+    JournalError,
+    JournalState,
 )
 from induction_network_on_fewrel_tpu.fleet.placement import (
     DEAD,
@@ -29,16 +42,23 @@ from induction_network_on_fewrel_tpu.fleet.router import (
     InProcessReplica,
     ReplicaHandle,
 )
+from induction_network_on_fewrel_tpu.fleet.supervisor import (
+    ReplicaSupervisor,
+)
 
 __all__ = [
     "DEAD",
     "DRAINING",
     "UP",
     "FleetControl",
+    "FleetJournal",
     "FleetPlacement",
     "FleetPublishError",
     "FleetRouter",
     "InProcessReplica",
+    "JournalError",
+    "JournalState",
     "ReplicaHandle",
+    "ReplicaSupervisor",
     "placement_score",
 ]

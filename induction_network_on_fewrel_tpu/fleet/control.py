@@ -38,6 +38,7 @@ from induction_network_on_fewrel_tpu.fleet.router import (
     InProcessReplica,
     ReplicaHandle,
     _TenantEntry,
+    drive_tenant_state,
 )
 
 
@@ -75,11 +76,38 @@ class FleetPublishError(RuntimeError):
 
 
 class FleetControl:
-    """Control-plane operations over a ``FleetRouter``'s replicas."""
+    """Control-plane operations over a ``FleetRouter``'s replicas.
 
-    def __init__(self, router: FleetRouter, logger=None):
+    With a ``journal`` (fleet/journal.FleetJournal, ISSUE 15) every
+    control-plane op is write-ahead-logged AFTER it succeeds on the
+    replicas: tenant register/threshold/quarantine, replica
+    add/drain/revive, and committed publishes (params_version + the
+    checkpoint path a catch-up can re-drive). A crashed router then
+    rebuilds everything through ``FleetRouter.recover(journal)``.
+    Placement is never journaled — it stays a pure rendezvous function
+    of (tenant id, live replica set)."""
+
+    def __init__(self, router: FleetRouter, logger=None, journal=None):
         self.router = router
         self._logger = logger if logger is not None else router._logger
+        self.journal = journal
+
+    def _journal(self, op: str, **fields) -> None:
+        if self.journal is not None:
+            self.journal.append(op, **fields)
+
+    @staticmethod
+    def _source_wire(dataset):
+        """The journal-ready form of a support source (None for
+        non-dataset sources — e.g. routing-only stubs; such tenants
+        recover their directory row but cannot be re-registered)."""
+        if dataset is None or not hasattr(dataset, "rel_names"):
+            return None
+        from induction_network_on_fewrel_tpu.fleet.transport import (
+            _dataset_to_wire,
+        )
+
+        return _dataset_to_wire(dataset)
 
     # --- tenant lifecycle -------------------------------------------------
 
@@ -105,6 +133,11 @@ class FleetControl:
         # mid-failover iteration.
         with self.router._lock:
             self.router.directory[tenant] = entry
+        self._journal(
+            "tenant_register", tenant=tenant,
+            source=self._source_wire(dataset), max_classes=max_classes,
+            nota_threshold=nota_threshold,
+        )
         return owner
 
     def set_nota_threshold(self, tenant: str, threshold) -> None:
@@ -113,11 +146,14 @@ class FleetControl:
             threshold, tenant
         )
         entry.nota_threshold = threshold
+        self._journal("tenant_threshold", tenant=tenant,
+                      threshold=threshold)
 
     def quarantine_tenant(self, tenant: str, reason: str = "") -> None:
         entry = self._entry(tenant)
         self.router.replicas[entry.owner].quarantine_tenant(tenant, reason)
         entry.quarantined = True
+        self._journal("tenant_quarantine", tenant=tenant, reason=reason)
 
     def unquarantine_tenant(self, tenant: str, reason: str = "") -> None:
         entry = self._entry(tenant)
@@ -125,6 +161,7 @@ class FleetControl:
             tenant, reason
         )
         entry.quarantined = False
+        self._journal("tenant_unquarantine", tenant=tenant, reason=reason)
 
     def _entry(self, tenant: str) -> _TenantEntry:
         entry = self.router.directory.get(tenant)
@@ -142,11 +179,24 @@ class FleetControl:
         self.router.replicas[rid] = handle
         self.router.routed.setdefault(rid, 0)
         self.router.placement.add_replica(rid)
+        self._journal("replica_add", replica=rid)
         if self._logger is not None:
             self._logger.log(
                 self.router.submitted, kind="fleet", event="replica_add",
                 replica=rid, replicas=float(len(self.router.replicas)),
             )
+
+    def drain_replica(self, replica: str) -> None:
+        """Operator drain, journaled: the replica leaves placement (its
+        tenants remap at the rendezvous bound) but keeps serving what is
+        in flight — and a recovered router replays the drain instead of
+        routing fresh traffic back."""
+        self.router.drain_replica(replica)
+        self._journal("replica_drain", replica=replica)
+
+    def revive_replica(self, replica: str, reason: str = "") -> None:
+        self.router.revive_replica(replica, reason=reason)
+        self._journal("replica_revive", replica=replica)
 
     def replace_tenants(self) -> int:
         """Re-register every displaced tenant (registered owner !=
@@ -167,14 +217,10 @@ class FleetControl:
             target = self.router.placement.place(tenant)
             if target is None:
                 continue
-            handle = self.router.replicas[target]
-            handle.register_dataset(
-                entry.source, tenant, max_classes=entry.max_classes
+            drive_tenant_state(
+                self.router.replicas[target], tenant, entry,
+                reason="carried over",
             )
-            if entry.nota_threshold is not None:
-                handle.set_nota_threshold(entry.nota_threshold, tenant)
-            if entry.quarantined:
-                handle.quarantine_tenant(tenant, reason="carried over")
             old = entry.owner
             entry.owner = target
             moved += 1
@@ -309,6 +355,14 @@ class FleetControl:
             raise FleetPublishError(rid, cause, committed=committed) \
                 from cause
         version = max(versions.values())
+        # Write-ahead the COMMITTED generation (the publish is live on
+        # every replica at this point): the params_version plus the
+        # checkpoint path recovery re-drives a stale replica's catch-up
+        # from. Journaled before the telemetry/skew records so a raising
+        # logger hook can never lose a live commit. fsync="commit"
+        # syncs exactly this append.
+        self._journal("publish_commit", params_version=int(version),
+                      ckpt_dir=str(ckpt_dir) if ckpt_dir else None)
         if len(set(versions.values())) != 1 and self._logger is not None:
             # The fleet is LIVE on the new weights everywhere (commits
             # landed) but the version COUNTERS disagree — a replica with

@@ -1,0 +1,404 @@
+"""Durable control plane, layer 1 (ISSUE 15): a write-ahead log of every
+fleet control-plane op, so a router crash loses NOTHING.
+
+Every piece of fleet state the router holds in memory — the tenant
+directory (support sources, NOTA thresholds, quarantine flags), replica
+membership/drain states, the committed params_version + checkpoint path,
+adaptation exhaustion latches — is exactly the state Geng 2019's
+per-relation class vectors and Gao 2019's per-tenant NOTA/DA knobs hang
+off, and before this module it lived only in process memory. The journal
+makes it an append-only on-disk log with:
+
+* **Per-record framing**: ``[u32 length][u32 crc32][payload]`` per
+  record, payload = canonical JSON (sorted keys, no timestamps). A torn
+  tail — a crash mid-write, a truncated disk flush, the injected
+  ``journal.torn_write`` chaos point — fails the length or CRC check at
+  exactly one record, and replay TRUNCATES there: everything before the
+  tear is recovered, nothing after it can poison the directory
+  (``kind="fault"`` ``action="journal_truncated"`` tells the operator).
+* **An fsync policy knob** (``fsync=``): ``"always"`` fsyncs every
+  append (maximum durability, one disk sync per control-plane op),
+  ``"commit"`` (default) fsyncs only generation-changing ops
+  (``publish_commit``) and compactions — tenant churn rides the OS page
+  cache, the committed generation never does — and ``"off"`` leaves
+  syncing to the OS (drills/tests). The tradeoff is RUNBOOK §20's.
+* **Snapshot compaction**: ``compact()`` folds the materialized state
+  into ``snapshot.json`` (atomic tmp+rename) and truncates the WAL;
+  replay = snapshot + remaining WAL ops, proven equivalent to the full
+  log (test-pinned). ``compact_every=N`` auto-compacts when the WAL
+  exceeds N records, bounding replay time and disk growth.
+* **Deterministic replay**: ``materialize()`` is a pure function of the
+  recorded op sequence — no clocks, no RNG, no process state — so every
+  router restart, every test, and every compacted/uncompacted pair
+  rebuilds the SAME state, and placement stays the pure rendezvous
+  function it already was (placements are never journaled; they are
+  recomputed from tenant ids + the replayed replica states).
+
+The journal never imports the router/transport layers: callers hand it
+JSON-ready payloads (``fleet/control.py`` converts datasets to their
+wire form before journaling) so this module has no import cycle and no
+serialization opinions of its own.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+from pathlib import Path
+
+from induction_network_on_fewrel_tpu.obs.chaos import chaos_fire
+
+WAL_NAME = "wal.log"
+SNAPSHOT_NAME = "snapshot.json"
+
+_HEADER = struct.Struct("<II")   # (payload length, crc32(payload))
+
+FSYNC_POLICIES = ("always", "commit", "off")
+
+# Ops whose loss a crash must never cause — under fsync="commit" these
+# (and compactions) are the only syncs: the committed generation, and
+# the PERMANENT adaptation-exhaustion latch (rare by construction — one
+# append per burned retry budget — and the whole point of journaling it
+# is surviving exactly the crash class an unsynced page cache loses).
+_COMMIT_OPS = frozenset({"publish_commit", "adapt_exhausted"})
+
+# The full control-plane op vocabulary. An op outside this set is a
+# programming error at append time (the FeedFaults rule: refusing loudly
+# beats replaying garbage later).
+KNOWN_OPS = frozenset({
+    "tenant_register",      # tenant, source (wire dict), max_classes,
+    #                         nota_threshold (optional)
+    "tenant_threshold",     # tenant, threshold
+    "tenant_quarantine",    # tenant, reason
+    "tenant_unquarantine",  # tenant, reason
+    "tenant_drop",          # tenant
+    "replica_add",          # replica, meta (optional address dict)
+    "replica_drain",        # replica
+    "replica_revive",       # replica
+    "publish_commit",       # params_version, ckpt_dir (nullable)
+    "adapt_exhausted",      # tenant, attempts (the permanent latch)
+})
+
+
+class JournalError(RuntimeError):
+    """A journal-layer refusal: unknown op, bad knob, replaying an
+    inconsistent prefix (which a CRC-clean journal cannot produce), or
+    appending to a journal whose tail was torn by the injected
+    ``journal.torn_write`` fault (the simulated crash ends this
+    process's writes; recovery reopens the directory)."""
+
+
+class JournalState:
+    """The materialized control-plane state: a pure fold of the op
+    sequence. Canonical (``to_dict`` sorts everything), so two replays
+    of the same ops compare byte-identical through ``json.dumps``."""
+
+    def __init__(self):
+        # tenant -> {source, max_classes, nota_threshold, quarantined}
+        self.tenants: dict[str, dict] = {}
+        self.replicas: dict[str, str] = {}   # replica -> up|draining
+        # The last committed publish: params_version + the checkpoint
+        # path a catch-up can re-drive it from (None for params-only
+        # publishes — version reconciliation still works, re-driving
+        # does not; recovery surfaces that as replica_stale_params).
+        self.committed: dict = {"params_version": 0, "ckpt_dir": None}
+        self.adapt_exhausted: dict[str, float] = {}   # tenant -> attempts
+        self.applied = 0    # ops folded in (snapshot base + WAL)
+
+    def apply(self, rec: dict) -> None:
+        op = rec.get("op")
+        t = rec.get("tenant")
+        if op == "tenant_register":
+            self.tenants[t] = {
+                "source": rec.get("source"),
+                "max_classes": rec.get("max_classes"),
+                "nota_threshold": rec.get("nota_threshold"),
+                "quarantined": False,
+            }
+        elif op == "tenant_threshold":
+            self._tenant(rec)["nota_threshold"] = rec.get("threshold")
+        elif op == "tenant_quarantine":
+            self._tenant(rec)["quarantined"] = True
+        elif op == "tenant_unquarantine":
+            self._tenant(rec)["quarantined"] = False
+        elif op == "tenant_drop":
+            self.tenants.pop(t, None)
+        elif op == "replica_add":
+            self.replicas[str(rec.get("replica"))] = "up"
+        elif op == "replica_drain":
+            self.replicas[str(rec.get("replica"))] = "draining"
+        elif op == "replica_revive":
+            self.replicas[str(rec.get("replica"))] = "up"
+        elif op == "publish_commit":
+            self.committed = {
+                "params_version": int(rec["params_version"]),
+                "ckpt_dir": rec.get("ckpt_dir"),
+            }
+        elif op == "adapt_exhausted":
+            self.adapt_exhausted[t] = float(rec.get("attempts", 0))
+        else:
+            raise JournalError(f"unknown journal op {op!r} in replay")
+        self.applied += 1
+
+    def _tenant(self, rec: dict) -> dict:
+        entry = self.tenants.get(rec.get("tenant"))
+        if entry is None:
+            # Unreachable through the framing: truncation only removes a
+            # TAIL, so every CRC-clean prefix is self-consistent.
+            raise JournalError(
+                f"journal op {rec.get('op')!r} for unregistered tenant "
+                f"{rec.get('tenant')!r}"
+            )
+        return entry
+
+    def to_dict(self) -> dict:
+        return {
+            "tenants": {t: dict(self.tenants[t])
+                        for t in sorted(self.tenants)},
+            "replicas": {r: self.replicas[r]
+                         for r in sorted(self.replicas)},
+            "committed": dict(self.committed),
+            "adapt_exhausted": {t: self.adapt_exhausted[t]
+                                for t in sorted(self.adapt_exhausted)},
+            "applied": self.applied,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JournalState":
+        st = cls()
+        st.tenants = {t: dict(v) for t, v in d.get("tenants", {}).items()}
+        st.replicas = dict(d.get("replicas", {}))
+        st.committed = dict(
+            d.get("committed", {"params_version": 0, "ckpt_dir": None})
+        )
+        st.adapt_exhausted = dict(d.get("adapt_exhausted", {}))
+        st.applied = int(d.get("applied", 0))
+        return st
+
+
+class FleetJournal:
+    """One journal directory: ``wal.log`` (framed records) +
+    ``snapshot.json`` (the compaction base). Thread-safe — control-plane
+    ops journal from client threads, the supervisor from its loop."""
+
+    def __init__(self, out_dir: str | Path, fsync: str = "commit",
+                 compact_every: int = 0, logger=None):
+        if fsync not in FSYNC_POLICIES:
+            raise JournalError(
+                f"unknown fsync policy {fsync!r} (one of {FSYNC_POLICIES})"
+            )
+        if compact_every < 0:
+            raise JournalError("compact_every must be >= 0 (0 = manual)")
+        self.dir = Path(out_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.fsync = fsync
+        self.compact_every = compact_every
+        self._logger = logger
+        self._lock = threading.RLock()
+        self._fh = None
+        self._torn = False   # set by the injected torn write: the
+        #                      "process" died mid-append; reopen to heal
+        self.snapshot_seq = 0     # ops folded into snapshot.json
+        self._wal_records = 0
+        snap = self.dir / SNAPSHOT_NAME
+        if snap.exists():
+            self.snapshot_seq = int(
+                json.loads(snap.read_text()).get("applied", 0)
+            )
+        # Opening IS recovery: a torn tail from a previous crash is
+        # truncated now, so appends land on a clean frame boundary.
+        self._recover_tail()
+
+    # --- write side -------------------------------------------------------
+
+    @property
+    def records(self) -> int:
+        """WAL records on disk (excludes ops folded into the snapshot)."""
+        return self._wal_records
+
+    @property
+    def seq(self) -> int:
+        """Total ops this journal holds (snapshot base + WAL)."""
+        return self.snapshot_seq + self._wal_records
+
+    def append(self, op: str, **fields) -> int:
+        """Append one op; returns its 0-based sequence number. Fields
+        must be JSON-ready (callers serialize datasets to wire form
+        first) and must not carry timestamps — replay is deterministic
+        by contract."""
+        if op not in KNOWN_OPS:
+            raise JournalError(
+                f"unknown journal op {op!r} (known: "
+                f"{', '.join(sorted(KNOWN_OPS))})"
+            )
+        with self._lock:
+            if self._torn:
+                raise JournalError(
+                    "journal tail is torn (injected journal.torn_write): "
+                    "the writing process is 'dead' — reopen the journal "
+                    "directory to truncate and recover"
+                )
+            seq = self.seq
+            payload = json.dumps(
+                {"op": op, "seq": seq, **fields}, sort_keys=True
+            ).encode()
+            header = _HEADER.pack(len(payload), zlib.crc32(payload))
+            fired = chaos_fire("journal.torn_write", op=op, step=seq)
+            fh = self._open()
+            if fired is not None:
+                # The simulated crash: the header claims the full record
+                # but only half the payload reaches disk. This journal
+                # object refuses further writes (the process died);
+                # recovery = reopen, which truncates the tear.
+                fh.write(header + payload[: max(len(payload) // 2, 1)])
+                fh.flush()
+                self._torn = True
+                return seq
+            fh.write(header + payload)
+            fh.flush()
+            if self.fsync == "always" or (
+                self.fsync == "commit" and op in _COMMIT_OPS
+            ):
+                os.fsync(fh.fileno())
+            self._wal_records += 1
+            if self.compact_every and self._wal_records >= self.compact_every:
+                self._compact_locked()
+            return seq
+
+    def sync(self) -> None:
+        """Force an fsync regardless of policy (operator barrier)."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+
+    def _open(self):
+        if self._fh is None or self._fh.closed:
+            self._fh = open(self.dir / WAL_NAME, "ab")
+        return self._fh
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None and not self._fh.closed:
+                self._fh.close()
+
+    # --- read side --------------------------------------------------------
+
+    def _scan(self, repair: bool) -> tuple[list[dict], int]:
+        """Parse the WAL: (records, bytes of clean prefix). A short or
+        CRC-failing record is a TEAR: everything from its frame start is
+        dropped; with ``repair`` the file is truncated there (and the
+        truncation is told as a kind='fault' record)."""
+        path = self.dir / WAL_NAME
+        if not path.exists():
+            return [], 0
+        blob = path.read_bytes()
+        records: list[dict] = []
+        off = 0
+        clean = 0
+        reason = None
+        while off + _HEADER.size <= len(blob):
+            length, crc = _HEADER.unpack_from(blob, off)
+            start, end = off + _HEADER.size, off + _HEADER.size + length
+            if end > len(blob):
+                reason = "short payload (torn write)"
+                break
+            payload = blob[start:end]
+            if zlib.crc32(payload) != crc:
+                reason = "crc mismatch (corrupt record)"
+                break
+            try:
+                rec = json.loads(payload)
+            except json.JSONDecodeError:
+                reason = "unparseable payload"
+                break
+            records.append(rec)
+            off = end
+            clean = off
+        else:
+            if off < len(blob):
+                reason = "trailing partial header"
+        if clean < len(blob) and repair:
+            dropped = len(blob) - clean
+            with open(path, "r+b") as f:
+                f.truncate(clean)
+            if self._logger is not None:
+                self._logger.log(
+                    len(records), kind="fault", action="journal_truncated",
+                    reason=reason or "torn tail",
+                    bytes_dropped=float(dropped),
+                    records_kept=float(len(records)),
+                )
+        return records, clean
+
+    def _recover_tail(self) -> None:
+        records, _ = self._scan(repair=True)
+        self._wal_records = len(records)
+        self._torn = False
+
+    def replay(self) -> list[dict]:
+        """The WAL records (clean prefix only; repairs a torn tail in
+        place, exactly like construction does)."""
+        with self._lock:
+            if self._fh is not None and not self._fh.closed:
+                self._fh.flush()
+            records, _ = self._scan(repair=True)
+            self._wal_records = len(records)
+            return records
+
+    def materialize(self) -> JournalState:
+        """Snapshot base + WAL ops folded into one ``JournalState`` —
+        the pure, deterministic replay every recovery path runs."""
+        with self._lock:
+            snap_path = self.dir / SNAPSHOT_NAME
+            if snap_path.exists():
+                state = JournalState.from_dict(
+                    json.loads(snap_path.read_text())
+                )
+            else:
+                state = JournalState()
+            for rec in self.replay():
+                state.apply(rec)
+            return state
+
+    # --- compaction -------------------------------------------------------
+
+    def compact(self) -> JournalState:
+        """Fold the full log into ``snapshot.json`` and truncate the
+        WAL. Crash-safe: the snapshot lands by atomic rename BEFORE the
+        WAL truncates, so a crash between the two replays snapshot + the
+        (re-applied, idempotent-by-construction) WAL ops — every op
+        apply is a plain overwrite, so double-application of a suffix
+        cannot diverge the state."""
+        with self._lock:
+            return self._compact_locked()
+
+    def _compact_locked(self) -> JournalState:
+        state = self.materialize()
+        tmp = self.dir / (SNAPSHOT_NAME + ".tmp")
+        snap = json.dumps(state.to_dict(), sort_keys=True, indent=1)
+        with open(tmp, "w") as f:
+            f.write(snap + "\n")
+            f.flush()
+            if self.fsync != "off":
+                os.fsync(f.fileno())
+        os.replace(tmp, self.dir / SNAPSHOT_NAME)
+        if self._fh is not None and not self._fh.closed:
+            self._fh.close()
+            self._fh = None
+        with open(self.dir / WAL_NAME, "wb") as f:
+            if self.fsync != "off":
+                f.flush()
+                os.fsync(f.fileno())
+        self.snapshot_seq = state.applied
+        self._wal_records = 0
+        if self._logger is not None:
+            self._logger.log(
+                state.applied, kind="fleet", event="journal_compact",
+                snapshot_seq=float(state.applied),
+                tenants=float(len(state.tenants)),
+            )
+        return state

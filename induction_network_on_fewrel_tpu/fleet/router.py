@@ -41,6 +41,7 @@ watchdog latches as once-per-replica CRITICALs (re-armed by recovery).
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from concurrent.futures import Future
@@ -77,6 +78,9 @@ class ReplicaHandle:
     def register_dataset(self, dataset, tenant, max_classes=None):
         raise NotImplementedError
 
+    def has_tenant(self, tenant) -> bool:
+        raise NotImplementedError
+
     def set_nota_threshold(self, threshold, tenant):
         raise NotImplementedError
 
@@ -89,8 +93,10 @@ class ReplicaHandle:
     def drop_tenant(self, tenant):
         raise NotImplementedError
 
-    # two-phase publish (fleet fan-out)
-    def prepare_publish(self, params=None, ckpt_dir=None):
+    # two-phase publish (fleet fan-out; target_version = the recovery
+    # catch-up spelling, pinning the generation the commit lands at)
+    def prepare_publish(self, params=None, ckpt_dir=None,
+                        target_version=None):
         raise NotImplementedError
 
     def commit_publish(self, txn) -> int:
@@ -100,6 +106,13 @@ class ReplicaHandle:
         raise NotImplementedError
 
     # observability / lifecycle
+    def ping(self) -> bool:
+        """Cheap liveness probe (the supervisor's health loop). The
+        transport raises (ConnectionError/TransportTimeout) when the
+        peer is gone or wedged; an in-process replica is alive by
+        construction."""
+        return True
+
     @property
     def params_version(self) -> int:
         raise NotImplementedError
@@ -134,6 +147,9 @@ class InProcessReplica(ReplicaHandle):
             dataset, max_classes=max_classes, tenant=tenant
         )
 
+    def has_tenant(self, tenant) -> bool:
+        return self.engine.registry.has_tenant(tenant)
+
     def set_nota_threshold(self, threshold, tenant):
         self.engine.set_nota_threshold(threshold, tenant=tenant)
 
@@ -146,7 +162,8 @@ class InProcessReplica(ReplicaHandle):
     def drop_tenant(self, tenant):
         self.engine.registry.drop_tenant(tenant)
 
-    def prepare_publish(self, params=None, ckpt_dir=None):
+    def prepare_publish(self, params=None, ckpt_dir=None,
+                        target_version=None):
         if params is None:
             if ckpt_dir is None:
                 raise ValueError("prepare_publish needs params or ckpt_dir")
@@ -155,7 +172,9 @@ class InProcessReplica(ReplicaHandle):
             )
 
             params = load_params(ckpt_dir)
-        return self.engine.prepare_publish(params)
+        return self.engine.prepare_publish(
+            params, target_version=target_version
+        )
 
     def commit_publish(self, txn) -> int:
         return self.engine.commit_publish(txn)
@@ -177,6 +196,23 @@ class InProcessReplica(ReplicaHandle):
 
     def close(self) -> None:
         self.engine.close()
+
+
+def drive_tenant_state(handle, tenant: str, entry: "_TenantEntry",
+                       reason: str) -> None:
+    """ONE home for making a replica serve-ready for one directory
+    tenant: register the support source, then carry the NOTA threshold
+    and quarantine flag. Shared by failover re-placement
+    (control.replace_tenants), cold-start recovery (router.recover),
+    and supervised restart (supervisor._adopt) — three hand-mirrored
+    copies of this block had already started to drift."""
+    handle.register_dataset(
+        entry.source, tenant, max_classes=entry.max_classes
+    )
+    if entry.nota_threshold is not None:
+        handle.set_nota_threshold(entry.nota_threshold, tenant)
+    if entry.quarantined:
+        handle.quarantine_tenant(tenant, reason=reason)
 
 
 class _TenantEntry:
@@ -404,7 +440,9 @@ class FleetRouter:
             self.breaker.record_failure(replica)
             return
         from induction_network_on_fewrel_tpu.serving.batcher import (
+            DeadlineExceeded,
             ExecuteError,
+            TransportTimeout,
         )
 
         # ExecuteError = the replica's launch failed; OSError (incl.
@@ -413,6 +451,15 @@ class FleetRouter:
         # the future, never via submit's synchronous except) = the
         # replica itself is unreachable. Both count. Deadline
         # misses and Saturated do not — they are load, not health.
+        # DeadlineExceeded needs saying EXPLICITLY: TimeoutError IS an
+        # OSError subclass, so without the carve-out a loaded replica
+        # expiring requests would read as replica death and cascade a
+        # false failover (ISSUE 15). TransportTimeout is the one
+        # deadline that DOES count — a wedged peer answering nothing
+        # within the per-call deadline is health, not load.
+        if isinstance(exc, DeadlineExceeded) \
+                and not isinstance(exc, TransportTimeout):
+            return
         if isinstance(exc, (ExecuteError, OSError)):
             # Attribute the failure only while ``replica`` is still
             # the tenant's REGISTERED owner: after replace_tenants()
@@ -548,6 +595,240 @@ class FleetRouter:
             t for t, e in entries
             if owners.get(t) is not None and owners[t] != e.owner
         ))
+
+    # --- cold-start recovery (ISSUE 15) -----------------------------------
+
+    def recover(self, journal, catch_up: bool = True,
+                state=None) -> dict:
+        """Rebuild the fleet's control-plane state from a
+        ``fleet/journal.FleetJournal`` after a router crash/restart.
+
+        Deterministic by construction: the journal's materialized state
+        is a pure fold of the op sequence and placement is a pure
+        rendezvous function, so the rebuilt directory is BITWISE the
+        pre-crash directory (owner, support source, NOTA threshold,
+        quarantine flag per tenant — ``directory_view()`` is the
+        canonical comparison form). Three repairs happen along the way:
+
+        * journaled replica DRAIN states re-apply to placement;
+        * a tenant whose owning replica lost its registry (a restarted
+          replica process answering ``has_tenant`` False) is
+          RE-REGISTERED there — source, threshold, and quarantine flag
+          re-driven from the journal;
+        * with ``catch_up``, every live replica answering at a stale
+          params_version is caught up by re-driving the journaled
+          publish at the committed generation
+          (``catch_up_replica``) — ``replica_stale_params`` turned
+          from a warning into a repair.
+
+        Emits one ``kind="fault"`` ``action="recovered"`` summary
+        record; returns the summary dict (tenants / reregistered /
+        caught_up / journal_records / snapshot_seq)."""
+        from induction_network_on_fewrel_tpu.fleet.transport import (
+            _dataset_from_wire,
+        )
+
+        # ``state`` lets a caller that already materialized the journal
+        # (serve.py startup reads the adapt latches from the same
+        # state) avoid a second full WAL parse.
+        if state is None:
+            state = journal.materialize()
+        for rid in sorted(state.replicas):
+            if rid in self.replicas and state.replicas[rid] == "draining":
+                self.placement.set_state(rid, DRAINING)
+        reregistered = 0
+        rewarmed: set[str] = set()
+        lost: list[str] = []
+        unreachable: set[str] = set()
+        for tenant in sorted(state.tenants):
+            meta = state.tenants[tenant]
+            owner = self.placement.place(tenant)
+            source = (
+                _dataset_from_wire(meta["source"])
+                if meta.get("source") else None
+            )
+            entry = _TenantEntry(
+                owner, source, max_classes=meta.get("max_classes")
+            )
+            entry.nota_threshold = meta.get("nota_threshold")
+            entry.quarantined = bool(meta.get("quarantined"))
+            if owner is None or source is None:
+                # No live replica to place on (traffic sheds typed until
+                # one revives) or a params-only source with nothing to
+                # re-register from — the DIRECTORY entry still recovers
+                # either way: zero tenant loss.
+                lost.append(tenant)
+            elif owner in unreachable:
+                # Already probed and failed: do not burn another
+                # transport deadline per tenant on a peer we know is
+                # down — its rows recover, the supervisor owns the rest.
+                pass
+            else:
+                # Per-tenant containment: ONE unreachable replica (a
+                # socket peer still down at cold start) must not abort
+                # the whole recovery — its directory rows recover, its
+                # registration waits for the supervisor's restart path,
+                # and every other tenant recovers fully.
+                try:
+                    if not self.replicas[owner].has_tenant(tenant):
+                        drive_tenant_state(
+                            self.replicas[owner], tenant, entry,
+                            reason="journal replay",
+                        )
+                        reregistered += 1
+                        rewarmed.add(owner)
+                except Exception:  # noqa: BLE001 — supervisor's job now
+                    unreachable.add(owner)
+            with self._lock:
+                self.directory[tenant] = entry
+        for rid in sorted(unreachable):
+            self.mark_replica_dead(
+                rid, reason="unreachable during recovery"
+            )
+        # A replica that lost its registry also lost its AOT-compiled
+        # query programs: warm it BEFORE it takes traffic, so the first
+        # post-recovery query is not a steady-state recompile (the
+        # zero-recompile invariant survives the crash).
+        for rid in sorted(rewarmed):
+            try:
+                self.replicas[rid].warmup()
+            except Exception:  # noqa: BLE001 — warmup is an optimization
+                pass
+        caught_up = (
+            self.catch_up_replicas(state.committed) if catch_up else []
+        )
+        summary = {
+            "tenants": len(state.tenants),
+            "reregistered": reregistered,
+            "unplaceable": len(lost),
+            "unreachable": len(unreachable),
+            "caught_up": len(caught_up),
+            "params_version": int(state.committed.get(
+                "params_version", 0
+            )),
+            "journal_records": int(state.applied),
+            "snapshot_seq": int(journal.snapshot_seq),
+        }
+        if self._logger is not None:
+            self._logger.log(
+                self.submitted, kind="fault", action="recovered",
+                **{k: float(v) for k, v in summary.items()},
+            )
+        return summary
+
+    def catch_up_replicas(self, committed: dict) -> list[dict]:
+        """Reconcile every UP replica to the journaled committed
+        params_version; returns one row per replica actually caught up
+        (also emitted as ``kind="fault"`` ``action="catchup"``)."""
+        rows = []
+        for rid in sorted(self.replicas):
+            if self.placement.state(rid) != UP:
+                continue
+            try:
+                row = self.catch_up_replica(rid, committed)
+            except Exception as e:  # noqa: BLE001 — one replica's
+                # failed catch-up (unreachable peer, refused restore)
+                # must not abort the others': it stays stale, loudly.
+                if self._logger is not None:
+                    self._logger.log(
+                        self.submitted, kind="fault",
+                        action="replica_stale_params", replica=rid,
+                        reason=f"catch-up failed: "
+                               f"{type(e).__name__}: {e}",
+                    )
+                continue
+            if row is not None:
+                rows.append(row)
+        return rows
+
+    def catch_up_replica(self, rid: str, committed: dict) -> dict | None:
+        """Re-drive the journaled publish on ONE stale replica: prepare
+        at the committed ckpt path pinned to the committed
+        params_version, then commit — the registry's zero-recompile
+        hot-swap, so steady-state traffic on every other tenant is
+        untouched. Returns the catch-up row, or None when the replica
+        is already current (or unreachable — the supervisor's problem,
+        not this path's)."""
+        target = int(committed.get("params_version", 0) or 0)
+        ckpt_dir = committed.get("ckpt_dir")
+        handle = self.replicas[rid]
+        try:
+            mine = int(handle.params_version)
+        except Exception:  # noqa: BLE001 — unreachable = supervisor's job
+            return None
+        if target <= 0 or mine >= target:
+            return None
+        if not ckpt_dir:
+            # A params-only publish left no re-drivable artifact: the
+            # skew is surfaced LOUDLY (the pre-ISSUE-15 warning), the
+            # repair needs an operator re-publish.
+            if self._logger is not None:
+                self._logger.log(
+                    self.submitted, kind="fault",
+                    action="replica_stale_params", replica=rid,
+                    params_version=float(mine),
+                    fleet_version=float(target),
+                )
+            return None
+        txn = handle.prepare_publish(
+            ckpt_dir=ckpt_dir, target_version=target
+        )
+        version = handle.commit_publish(txn)
+        # A committed publish CLEARS engine-level quarantine by design
+        # (fresh verified weights replace the suspect vectors — ISSUE
+        # 12). The catch-up re-drives an OLD publish, and the journal's
+        # quarantine ops came AFTER it: re-assert the directory's
+        # quarantine flags so replay order wins, not re-application
+        # order.
+        with self._lock:
+            held = [t for t, e in self.directory.items()
+                    if e.owner == rid and e.quarantined]
+        for tenant in held:
+            try:
+                handle.quarantine_tenant(
+                    tenant, reason="journal replay (post catch-up)"
+                )
+            except Exception:  # noqa: BLE001 — a tenant the replica
+                pass           # does not hold yet has nothing to clear
+        row = {"replica": rid, "from_version": mine,
+               "to_version": int(version)}
+        if self._logger is not None:
+            self._logger.log(
+                self.submitted, kind="fault", action="catchup",
+                replica=rid, from_version=float(mine),
+                to_version=float(version),
+            )
+        return row
+
+    def directory_view(self) -> dict:
+        """The tenant directory in canonical, JSON-ready form — the
+        bitwise-comparison artifact the recovery drill equates across a
+        kill/restart (support sources compare by their wire-form
+        digest, not object identity)."""
+        import hashlib
+
+        from induction_network_on_fewrel_tpu.fleet.transport import (
+            _dataset_to_wire,
+        )
+
+        with self._lock:
+            entries = sorted(self.directory.items())
+        view = {}
+        for tenant, e in entries:
+            digest = None
+            if e.source is not None and hasattr(e.source, "rel_names"):
+                wire = json.dumps(
+                    _dataset_to_wire(e.source), sort_keys=True
+                ).encode()
+                digest = hashlib.sha256(wire).hexdigest()[:16]
+            view[tenant] = {
+                "owner": e.owner,
+                "max_classes": e.max_classes,
+                "nota_threshold": e.nota_threshold,
+                "quarantined": bool(e.quarantined),
+                "source_digest": digest,
+            }
+        return view
 
     # --- observability ----------------------------------------------------
 

@@ -38,12 +38,18 @@ import json
 import socket
 import socketserver
 import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 
 from induction_network_on_fewrel_tpu.fleet.router import ReplicaHandle
+from induction_network_on_fewrel_tpu.obs.chaos import (
+    chaos_active,
+    chaos_fire,
+)
 from induction_network_on_fewrel_tpu.serving.batcher import (
     ExecuteError,
     Saturated,
+    TransportTimeout,
 )
 
 
@@ -84,22 +90,26 @@ def _dataset_from_wire(d: dict):
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         server: ReplicaServer = self.server.replica_server  # type: ignore
-        for line in self.rfile:
-            line = line.strip()
-            if not line:
-                continue
-            req = None
-            try:
-                req = json.loads(line)
-                resp = server.dispatch(req)
-            except Exception as e:  # noqa: BLE001 — typed errors -> wire
-                resp = _error_response(e)
-            self.wfile.write(
-                (json.dumps(resp) + "\n").encode()
-            )
-            self.wfile.flush()
-            if isinstance(req, dict) and req.get("op") == "bye":
-                return
+        server.track(self.connection)
+        try:
+            for line in self.rfile:
+                line = line.strip()
+                if not line:
+                    continue
+                req = None
+                try:
+                    req = json.loads(line)
+                    resp = server.dispatch(req)
+                except Exception as e:  # noqa: BLE001 — typed -> wire
+                    resp = _error_response(e)
+                self.wfile.write(
+                    (json.dumps(resp) + "\n").encode()
+                )
+                self.wfile.flush()
+                if isinstance(req, dict) and req.get("op") == "bye":
+                    return
+        finally:
+            server.untrack(self.connection)
 
 
 def _error_response(e: BaseException) -> dict:
@@ -124,6 +134,8 @@ class ReplicaServer:
         self._txns: dict[int, object] = {}
         self._txn_seq = 0
         self._txn_lock = threading.Lock()
+        self._active: set = set()      # live handler connections
+        self._active_lock = threading.Lock()
         srv = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True
         )
@@ -144,9 +156,33 @@ class ReplicaServer:
         self._thread.start()
         return self
 
+    def track(self, conn) -> None:
+        with self._active_lock:
+            self._active.add(conn)
+
+    def untrack(self, conn) -> None:
+        with self._active_lock:
+            self._active.discard(conn)
+
     def stop(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
+        # Sever live handler connections too: a stopped server must look
+        # like a DEAD PROCESS to its clients (connection reset on the
+        # next call), not like a process that stopped listening while
+        # old handler threads keep answering — the supervisor's probe
+        # depends on the distinction (ISSUE 15).
+        with self._active_lock:
+            active, self._active = set(self._active), set()
+        for conn in active:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
         with self._txn_lock:
             txns, self._txns = dict(self._txns), {}
         for txn in txns.values():
@@ -209,12 +245,19 @@ class ReplicaServer:
         if op == "drop_tenant":
             eng.registry.drop_tenant(req["tenant"])
             return {"ok": True}
+        if op == "has_tenant":
+            return {"ok": True,
+                    "has": bool(eng.registry.has_tenant(req["tenant"]))}
         if op == "publish_prepare":
             from induction_network_on_fewrel_tpu.serving.registry import (
                 load_params,
             )
 
-            txn = eng.prepare_publish(load_params(req["ckpt_dir"]))
+            tv = req.get("target_version")
+            txn = eng.prepare_publish(
+                load_params(req["ckpt_dir"]),
+                target_version=int(tv) if tv is not None else None,
+            )
             with self._txn_lock:
                 self._txn_seq += 1
                 token = self._txn_seq
@@ -253,13 +296,42 @@ class SocketReplica(ReplicaHandle):
     them; ``submit`` runs the blocking classify on the pool so the
     router still gets a Future. Requests on one connection are strictly
     request/response, so no per-connection lock is needed — a
-    connection is only ever used by the thread that dialed it."""
+    connection is only ever used by the thread that dialed it.
+
+    Transport hardening (ISSUE 15): every call carries a PER-CALL
+    deadline (``call_deadline_s`` default; classifies get the request
+    deadline plus the server's resolve slack) — a wedged peer raises
+    the typed ``TransportTimeout`` (a ``DeadlineExceeded``) instead of
+    blocking the calling thread forever, and the connection is dropped
+    so the next call re-dials. IDEMPOTENT control-plane calls (ping,
+    stats, register, thresholds, quarantine flips — never classify,
+    never the token-bearing two-phase publish ops) retry up to
+    ``retries`` times on connection errors with deterministic
+    exponential backoff. The ``net.partition`` / ``net.drop`` /
+    ``net.slow`` chaos points fire here, so every failure arm is
+    drillable from one ``--chaos`` spec."""
+
+    # Safe to resend: either read-only or last-write-wins on the server.
+    # classify is excluded (a retried request could be answered twice
+    # under load); the two-phase publish ops are excluded (the txn
+    # token is single-shot server-side — a blind resend can double-
+    # commit or hit an already-consumed token).
+    _IDEMPOTENT_OPS = frozenset({
+        "ping", "stats", "params_version", "warmup", "has_tenant",
+        "register", "set_nota_threshold", "quarantine", "unquarantine",
+        "drop_tenant",
+    })
 
     def __init__(self, replica_id: str, address: tuple[str, int],
-                 pool_size: int = 8, timeout_s: float = 120.0):
+                 pool_size: int = 8, timeout_s: float = 120.0,
+                 call_deadline_s: float = 30.0, retries: int = 2,
+                 retry_backoff_s: float = 0.05):
         self.replica_id = str(replica_id)
         self._address = address
-        self._timeout_s = timeout_s
+        self._timeout_s = timeout_s          # connect timeout
+        self._call_deadline_s = call_deadline_s
+        self._retries = max(int(retries), 0)
+        self._retry_backoff_s = retry_backoff_s
         self._tls = threading.local()
         self._conns: list[tuple[socket.socket, object]] = []
         self._conns_lock = threading.Lock()
@@ -299,16 +371,76 @@ class SocketReplica(ReplicaHandle):
             except OSError:
                 pass
 
-    def _call(self, **req) -> dict:
+    def _call(self, _deadline: float | None = None, **req) -> dict:
+        """One request/response with bounded retry: idempotent ops
+        resend on CONNECTION errors (never on ``TransportTimeout`` —
+        a wedged peer costs a full deadline per attempt, and the
+        supervisor/breaker own that diagnosis); everything else
+        surfaces the first failure."""
+        if self._closed:
+            # Local refusal, not a transport fault: retrying a closed
+            # handle can never succeed — fail immediately, before the
+            # retry loop burns its backoff budget on it.
+            raise ConnectionError(f"replica {self.replica_id}: closed")
+        op = req.get("op")
+        budget = self._retries if op in self._IDEMPOTENT_OPS else 0
+        attempt = 0
+        while True:
+            try:
+                return self._call_once(_deadline, req)
+            except TransportTimeout:
+                raise
+            except OSError:
+                if attempt >= budget:
+                    raise
+                attempt += 1
+                # Deterministic exponential backoff — no RNG (the
+                # chaos/drill replay discipline); per-thread, so no
+                # herd to de-synchronize.
+                time.sleep(self._retry_backoff_s * (2.0 ** (attempt - 1)))
+
+    def _call_once(self, deadline_s: float | None, req: dict) -> dict:
         if self._closed:
             raise ConnectionError(f"replica {self.replica_id}: closed")
+        if chaos_active():
+            if chaos_fire("net.partition",
+                          replica=self.replica_id) is not None:
+                raise ConnectionError(
+                    f"replica {self.replica_id}: injected partition"
+                )
+            slow = chaos_fire("net.slow", replica=self.replica_id)
+            if slow is not None:
+                time.sleep(float(slow.arg or 0.05))
         conn = getattr(self._tls, "conn", None)
         if conn is None:
             conn = self._connect()
         sock, rfile = conn
+        deadline = (self._call_deadline_s if deadline_s is None
+                    else deadline_s)
         try:
+            sock.settimeout(deadline)
             sock.sendall((json.dumps(req) + "\n").encode())
+            if chaos_active() and chaos_fire(
+                    "net.drop", replica=self.replica_id) is not None:
+                # The response is "lost": the peer may well have acted —
+                # exactly why only idempotent ops retry.
+                self._drop_conn(conn)
+                raise ConnectionError(
+                    f"replica {self.replica_id}: injected response drop"
+                )
             line = rfile.readline()
+        except socket.timeout:
+            # The per-call deadline (ISSUE 15): a wedged peer must not
+            # block this thread forever. The connection is DESYNCED by
+            # construction (a late response line would answer the next
+            # request) — drop it; typed so callers and the router's
+            # breaker can tell transport wedge (health) from a server-
+            # side deadline miss (load).
+            self._drop_conn(conn)
+            raise TransportTimeout(
+                f"replica {self.replica_id}: no response within "
+                f"{deadline:.1f}s (per-call deadline)"
+            )
         except OSError:
             self._drop_conn(conn)
             raise
@@ -346,16 +478,34 @@ class SocketReplica(ReplicaHandle):
                trace=None) -> Future:
         wire = _inst_to_wire(instance) if hasattr(instance, "tokens") \
             else instance
+        # The transport read deadline must sit ABOVE the server's
+        # resolve window (request deadline + its 30 s result slack) so
+        # a server-side deadline miss comes back as the typed wire
+        # error, and TransportTimeout fires only when the peer answers
+        # NOTHING — a wedged process, the case that is health.
+        wire_deadline = (
+            deadline_s if deadline_s is not None else self._call_deadline_s
+        ) + 35.0
         return self._pool.submit(
             lambda: self._call(
+                _deadline=wire_deadline,
                 op="classify", instance=wire, deadline_s=deadline_s,
                 tenant=tenant,
                 trace_id=trace.trace_id if trace is not None else None,
             )["verdict"]
         )
 
+    def ping(self) -> bool:
+        return bool(self._call(op="ping").get("ok"))
+
+    def has_tenant(self, tenant) -> bool:
+        return bool(self._call(op="has_tenant", tenant=tenant)["has"])
+
     def register_dataset(self, dataset, tenant, max_classes=None):
+        # Registration distills server-side (and may compile on the
+        # first shape): same headroom as the publish ops.
         return self._call(
+            _deadline=max(self._call_deadline_s, 120.0),
             op="register", dataset=_dataset_to_wire(dataset),
             tenant=tenant, max_classes=max_classes,
         )["classes"]
@@ -373,17 +523,27 @@ class SocketReplica(ReplicaHandle):
     def drop_tenant(self, tenant):
         self._call(op="drop_tenant", tenant=tenant)
 
-    def prepare_publish(self, params=None, ckpt_dir=None):
+    def prepare_publish(self, params=None, ckpt_dir=None,
+                        target_version=None):
         if ckpt_dir is None:
             raise ValueError(
                 "socket replicas publish from a shared checkpoint "
                 "directory (pass ckpt_dir; a raw params tree does not "
                 "cross the wire)"
             )
-        return self._call(op="publish_prepare", ckpt_dir=str(ckpt_dir))["txn"]
+        # Prepare restores + re-distills server-side: give it headroom
+        # beyond the default control-plane deadline.
+        return self._call(
+            _deadline=max(self._call_deadline_s, 120.0),
+            op="publish_prepare", ckpt_dir=str(ckpt_dir),
+            target_version=target_version,
+        )["txn"]
 
     def commit_publish(self, txn) -> int:
-        return int(self._call(op="publish_commit", txn=txn)["version"])
+        return int(self._call(
+            _deadline=max(self._call_deadline_s, 120.0),
+            op="publish_commit", txn=txn,
+        )["version"])
 
     def abort_publish(self, txn) -> None:
         self._call(op="publish_abort", txn=txn)
@@ -396,7 +556,11 @@ class SocketReplica(ReplicaHandle):
         return self._call(op="stats")["stats"]
 
     def warmup(self) -> int:
-        return int(self._call(op="warmup")["compiled"])
+        # Warmup AOT-compiles every bucket program — the slowest
+        # control-plane op by far on a cold process.
+        return int(self._call(
+            _deadline=max(self._call_deadline_s, 300.0), op="warmup",
+        )["compiled"])
 
     def close(self) -> None:
         if self._closed:

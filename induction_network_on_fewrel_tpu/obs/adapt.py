@@ -202,6 +202,7 @@ class AdaptationController:
         recorder=None,
         capture=None,
         on_event: Callable[[HealthEvent], None] | None = None,
+        journal=None,
     ):
         if retry_budget < 1:
             raise ValueError(f"retry_budget must be >= 1, got {retry_budget}")
@@ -226,6 +227,11 @@ class AdaptationController:
         self.recorder = recorder
         self.capture = capture
         self.on_event = on_event
+        # Optional fleet journal (ISSUE 15): the EXHAUSTED latch is
+        # control-plane state that must survive a router restart — a
+        # recovered fleet must not un-quarantine a flapping tenant and
+        # re-enter the retrain storm the damper stopped.
+        self.journal = journal
         self._lock = threading.RLock()
         self._loops: dict[str, _Loop] = {}
         self._busy = False           # one fine-tune at a time, fleet-wide
@@ -269,6 +275,24 @@ class AdaptationController:
         if not isinstance(tenant, str):
             return
         self.trigger(tenant, feature=str(ev.data.get("feature", "")))
+
+    def restore_exhausted(self, exhausted) -> None:
+        """Re-prime the PERMANENT exhaustion latches from a recovered
+        journal (fleet/journal.JournalState.adapt_exhausted: tenant ->
+        attempts). The latch is journaled at exhaustion time so a
+        router restart cannot forget it — without this read-back, a
+        recovered fleet would absorb nothing and the next drift
+        CRITICAL on a quarantined flapper would re-enter exactly the
+        retrain storm the damper stopped. Accepts a mapping or an
+        iterable of tenant names."""
+        items = (exhausted.items() if hasattr(exhausted, "items")
+                 else ((t, 0.0) for t in exhausted))
+        with self._lock:
+            for tenant, attempts in items:
+                loop = self._loops.setdefault(str(tenant), _Loop())
+                loop.state = EXHAUSTED
+                loop.attempts = max(loop.attempts,
+                                    int(float(attempts or 0.0)))
 
     # --- trigger ----------------------------------------------------------
 
@@ -595,6 +619,14 @@ class AdaptationController:
                 data={"tenant": tenant, "attempts": float(attempts),
                       "stage": stage},
             ))
+            if self.journal is not None:
+                try:
+                    self.journal.append(
+                        "adapt_exhausted", tenant=tenant,
+                        attempts=float(attempts),
+                    )
+                except Exception:  # noqa: BLE001 — the CRITICAL above
+                    pass           # is the hard signal either way
             if self.quarantine_fn is not None:
                 try:
                     self.quarantine_fn(

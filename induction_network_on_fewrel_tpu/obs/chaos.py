@@ -116,7 +116,38 @@ KNOWN_POINTS: dict[str, str] = {
         "attempt failed with the fleet untouched. ARG filters the "
         "tenant."
     ),
+    "net.partition": (
+        "at a SocketReplica call: raise ConnectionError before any bytes "
+        "move (the network between router and replica is gone, ISSUE 15) "
+        "— idempotent calls must retry within their bounded budget, the "
+        "breaker must count the failures. ARG filters the replica id."
+    ),
+    "net.drop": (
+        "at a SocketReplica call: the request is sent but the response "
+        "is 'lost' — the connection is invalidated and ConnectionError "
+        "raised (a dropped packet / dying peer mid-response). ARG "
+        "filters the replica id."
+    ),
+    "net.slow": (
+        "at a SocketReplica call: sleep before the call proceeds — "
+        "injected network latency for latency/SLO drills. ARG is the "
+        "PAYLOAD here — the delay in seconds (default 0.05), not a "
+        "filter (every arrival counts)."
+    ),
+    "journal.torn_write": (
+        "at a fleet-journal append (fleet/journal.py, ISSUE 15): write "
+        "a torn record — the header claims the full payload but only "
+        "half reaches disk (a crash mid-write) — and refuse further "
+        "appends from this journal object; reopening the directory must "
+        "truncate the tear and recover every record before it. ARG "
+        "filters the journal op name."
+    ),
 }
+
+
+# Points whose ARG is a PAYLOAD the fired site reads (directive.arg),
+# not an arrival filter — every arrival at the point counts.
+PAYLOAD_ARG_POINTS = frozenset({"net.slow"})
 
 
 @dataclasses.dataclass
@@ -188,12 +219,18 @@ class ChaosRegistry:
         ARG-filter key (``tenant`` on serving points, ``kind`` on
         checkpoint points) plus telemetry fields."""
         # ARG-filter key by point family: tenant on serving points, ring
-        # kind on checkpoint points, replica id on fleet points.
-        ctx_arg = ctx.get("tenant") or ctx.get("kind") or ctx.get("replica")
+        # kind on checkpoint points, replica id on fleet/net points, op
+        # name on journal points. On PAYLOAD-ARG points the ARG is data
+        # the fired site reads (net.slow's delay), never a filter.
+        ctx_arg = (ctx.get("tenant") or ctx.get("kind")
+                   or ctx.get("replica") or ctx.get("op"))
+        payload_arg = point in PAYLOAD_ARG_POINTS
         fired = None
         with self._lock:
             for d in self.directives:
-                if d.point != point or not d.matches(ctx_arg):
+                if d.point != point or not (
+                    payload_arg or d.matches(ctx_arg)
+                ):
                     continue
                 # EVERY matching directive counts this arrival — AT is
                 # "0-based arrival index at the point", and an earlier

@@ -70,6 +70,16 @@ class DeadlineExceeded(TimeoutError):
     """The request's deadline expired before it reached the device."""
 
 
+class TransportTimeout(DeadlineExceeded):
+    """A TRANSPORT-side deadline: the replica never answered a socket
+    call within the per-call deadline (fleet/transport.SocketReplica,
+    ISSUE 15). Typed as ``DeadlineExceeded`` so clients handle both the
+    same way, but distinguishable on purpose: a server-side deadline
+    miss is LOAD (the batcher expired the request — never fed to the
+    replica breaker), while a wedged peer that answers nothing is
+    HEALTH (the router's breaker counts it toward replica death)."""
+
+
 class ExecuteError(RuntimeError):
     """A launch failed on the device/host side: the batch's futures fail
     with THIS (typed, retry-after-bearing) error and nothing else — the

@@ -105,6 +105,15 @@ class CircuitBreaker:
             tb = self._tenants.get(tenant)
             return tb.state if tb is not None else CLOSED
 
+    def reset(self, tenant: str) -> None:
+        """Forget this key's breaker history (state back to CLOSED, no
+        transition callback). For SUPERVISED restarts (fleet/supervisor,
+        ISSUE 15): the replacement process shares nothing with the
+        process whose failures opened the breaker, so carrying the open
+        window over would shed a healthy replica."""
+        with self._lock:
+            self._tenants.pop(tenant, None)
+
     # --- worker side (launch outcomes) ------------------------------------
 
     def record_success(self, tenant: str, now: float | None = None) -> None:

@@ -145,6 +145,22 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
                    help="route through the fleet router even with "
                         "--replicas 1 (exercises the fleet front door "
                         "on a single-replica deployment)")
+    p.add_argument("--journal", default=None, metavar="DIR",
+                   help="durable control plane (ISSUE 15, fleet modes): "
+                        "write-ahead-log every control-plane op to this "
+                        "directory and, when it already holds records, "
+                        "RECOVER the tenant directory + committed "
+                        "params_version from it at startup (bitwise "
+                        "replay; stale replicas caught up via the "
+                        "journaled publish). RUNBOOK §20")
+    p.add_argument("--journal_fsync", default="commit",
+                   choices=["always", "commit", "off"],
+                   help="journal fsync policy: every append / committed "
+                        "publishes + compactions only (default) / leave "
+                        "it to the OS (RUNBOOK §20 tradeoff)")
+    p.add_argument("--journal_compact_every", type=int, default=512,
+                   help="auto-fold the WAL into snapshot.json past this "
+                        "many records (0 = manual compaction only)")
     p.add_argument("--slo_profile", action="store_true",
                    help="also attempt a jax.profiler trace in the SLO "
                         "auto-capture (default off on this image — a "
@@ -340,7 +356,7 @@ def _save_base_checkpoint(engine, out_dir: str) -> str:
 
 def _build_adapt(args, policy, *, drift, model, cfg, tok, src_ds, tgt_ds,
                  base_ckpt, publish_fn, quarantine_fn, logger=None,
-                 recorder=None, capture=None):
+                 recorder=None, capture=None, journal=None):
     """Assemble the AdaptationController from the serving context: the
     fine-tune reads the live artifact + the two corpora, the canary is
     tools/scenarios.run_canary over {in_domain, target} legs at the
@@ -418,6 +434,7 @@ def _build_adapt(args, policy, *, drift, model, cfg, tok, src_ds, tgt_ds,
         step_budget=policy["step_budget"],
         wall_budget_s=policy["wall_budget_s"],
         logger=logger, recorder=recorder, capture=capture,
+        journal=journal,
     )
 
 
@@ -659,15 +676,49 @@ def _serve_fleet(args, buckets, logger=None, watchdog=None, slo=None,
         queue_capacity_per_replica=args.queue_depth,
         trace_sample=args.trace_sample,
     )
-    control = FleetControl(router)
+    journal = None
+    if args.journal:
+        from induction_network_on_fewrel_tpu.fleet import FleetJournal
+
+        journal = FleetJournal(
+            args.journal, fsync=args.journal_fsync,
+            compact_every=args.journal_compact_every, logger=logger,
+        )
+    control = FleetControl(router, journal=journal)
     adapt = None
     try:
         first = replicas[sorted(replicas)[0]].engine
-        ds = _support_dataset(args, first.registry.k, seed=args.seed)
-        owner = control.register_tenant(
-            "default", ds, max_classes=args.max_classes,
-            nota_threshold=args.nota_threshold,
-        )
+        recovered_state = None
+        if journal is not None and journal.seq > 0:
+            # Cold-start recovery: the journal IS the directory. Every
+            # journaled tenant re-registers on its rendezvous owner and
+            # stale replicas catch up to the committed generation —
+            # re-registering "default" below would only double-journal.
+            # One materialize serves both recovery and the adaptation
+            # latch read-back further down.
+            recovered_state = journal.materialize()
+            summary = router.recover(journal, state=recovered_state)
+            print(f"fleet: recovered {summary['tenants']} tenant(s) from "
+                  f"{args.journal} (reregistered "
+                  f"{summary['reregistered']}, caught up "
+                  f"{summary['caught_up']} replica(s) to "
+                  f"v{summary['params_version']})", file=sys.stderr)
+        entry = router.directory.get("default")
+        if entry is None:
+            ds = _support_dataset(args, first.registry.k, seed=args.seed)
+            owner = control.register_tenant(
+                "default", ds, max_classes=args.max_classes,
+                nota_threshold=args.nota_threshold,
+            )
+        else:
+            # The recovered fleet serves the JOURNALED corpus — never a
+            # freshly rebuilt one (digest parity with the pre-crash
+            # registrations); fall back to a rebuild only for a
+            # params-only row with no recoverable source.
+            owner = entry.owner
+            ds = (entry.source if entry.source is not None
+                  else _support_dataset(args, first.registry.k,
+                                        seed=args.seed))
         compiled = sum(h.warmup() for h in router.replicas.values())
         print(f"fleet: {n} replica(s), default tenant placed on {owner}, "
               f"{compiled} bucket programs compiled", file=sys.stderr)
@@ -702,7 +753,14 @@ def _serve_fleet(args, buckets, logger=None, watchdog=None, slo=None,
                     control.quarantine_tenant(t, reason=reason)
                 ),
                 logger=logger, recorder=recorder, capture=capture,
+                journal=journal,
             )
+            if recovered_state is not None \
+                    and recovered_state.adapt_exhausted:
+                # The journaled PERMANENT exhaustion latches must
+                # survive the restart: re-prime them before the
+                # controller takes its first drift event.
+                adapt.restore_exhausted(recovered_state.adapt_exhausted)
             adapt.start()
             print("adaptation controller armed over the fleet fan-out "
                   f"(retries={policy['retry_budget']})", file=sys.stderr)
@@ -746,6 +804,8 @@ def _serve_fleet(args, buckets, logger=None, watchdog=None, slo=None,
         if adapt is not None:
             adapt.close()
         router.close()
+        if journal is not None:
+            journal.close()
         if logger is not None:
             logger.close()
 

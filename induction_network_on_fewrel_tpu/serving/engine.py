@@ -442,12 +442,17 @@ class InferenceEngine:
     # Commit runs through _traced_publish so a fan-out publish gets the
     # same span, swap counter, and drift re-arm a local publish gets.
 
-    def prepare_publish(self, new_params):
+    def prepare_publish(self, new_params, target_version=None):
         """Phase 1 on this replica: validation gate + full re-distill,
         nothing visible to the data plane yet. Returns the registry's
         ``PublishTransaction``; the caller must ``commit_publish`` or
-        abort it (same thread)."""
-        return self.registry.prepare_publish(new_params)
+        abort it (same thread). ``target_version`` pins the generation
+        the commit lands at — the recovery catch-up spelling (a
+        restarted replica re-drives the journaled publish AT the
+        fleet's committed version, ISSUE 15)."""
+        return self.registry.prepare_publish(
+            new_params, target_version=target_version
+        )
 
     def commit_publish(self, txn) -> int:
         """Phase 2: commit a prepared transaction with the engine-side

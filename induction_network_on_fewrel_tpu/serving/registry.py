@@ -488,7 +488,9 @@ class TenantRegistry:
 
     # --- two-phase publish (fleet fan-out, ISSUE 13) ----------------------
 
-    def prepare_publish(self, new_params) -> "PublishTransaction":
+    def prepare_publish(self, new_params,
+                        target_version: int | None = None,
+                        ) -> "PublishTransaction":
         """Phase 1 of a two-phase publish: acquire the publish-serial
         lock (HELD until ``commit()``/``abort()`` on the returned
         transaction), run the validation gate and every re-distill pass,
@@ -512,11 +514,26 @@ class TenantRegistry:
         thread may be committed/aborted from another — the socket
         transport's server prepares on one connection-handler thread
         and commits/aborts on whichever handler thread the phase-2 op
-        arrives on (fleet/transport.py)."""
+        arrives on (fleet/transport.py).
+
+        ``target_version`` pins the generation the commit lands at
+        (instead of the default ``params_version + 1``) — the recovery
+        catch-up primitive (ISSUE 15): a restarted replica whose counter
+        reset to 0 re-drives the journaled publish AT the fleet's
+        committed version, restoring uniformity instead of forking a
+        private version history. It must be ahead of the local counter;
+        catching up "backwards" is a logic error, refused here."""
         self._publish_serial.acquire()
         try:
             from induction_network_on_fewrel_tpu.obs.chaos import chaos_fire
 
+            if target_version is not None \
+                    and target_version <= self.params_version:
+                raise PublishError(
+                    f"catch-up target_version {target_version} is not "
+                    f"ahead of the local params_version "
+                    f"{self.params_version}"
+                )
             if chaos_fire("publish.nan_params",
                           step=self.params_version) is not None:
                 from induction_network_on_fewrel_tpu.datapipe.faults import (
@@ -524,13 +541,14 @@ class TenantRegistry:
                 )
 
                 new_params = poison_tree(new_params)
-            staged = self._prepare_serialized(new_params)
+            staged = self._prepare_serialized(new_params, target_version)
         except BaseException:
             self._publish_serial.release()
             raise
         return PublishTransaction(self, staged)
 
-    def _prepare_serialized(self, new_params) -> dict:
+    def _prepare_serialized(self, new_params,
+                            target_version: int | None = None) -> dict:
         from induction_network_on_fewrel_tpu.obs.chaos import chaos_fire
 
         # Pre-swap validation gate, part 1 — BEFORE burning device time
@@ -546,7 +564,8 @@ class TenantRegistry:
             # Optional quality floor (scenario-harness miniature): runs
             # outside every lock; a raise vetoes the publish.
             self.publish_canary(new_params)
-        new_version = self.params_version + 1
+        new_version = (int(target_version) if target_version is not None
+                       else self.params_version + 1)
         # old slot id -> freshly distilled [C] vector (accumulated across
         # passes; slots never mutate in place, so a vector distilled in
         # pass 1 stays valid for the swap even if pass 2 adds more).

@@ -135,6 +135,25 @@ KNOWN_KINDS = frozenset({
     # verdicts served while quarantined). All scalar/str fields;
     # obs_report's faults section renders injections and reactions side
     # by side.
+    # Durable-control-plane actions (ISSUE 15, fleet/journal.py +
+    # router recovery + fleet/supervisor.py):
+    # action="journal_truncated" (reason, bytes_dropped, records_kept —
+    # a torn/corrupt WAL tail truncated at the bad record; everything
+    # before it replays), action="recovered" (tenants, reregistered,
+    # unplaceable, caught_up, params_version, journal_records,
+    # snapshot_seq — one cold-start recovery summary per
+    # FleetRouter.recover), action="catchup" (replica, from_version,
+    # to_version — a stale replica re-driven to the journaled committed
+    # generation via the zero-recompile publish),
+    # action="replica_restarted" (replica, ok 0/1, attempt, reason on
+    # failure — one per supervised restart attempt),
+    # action="replica_restart_exhausted" (replica, attempts — the
+    # bounded restart budget burned out; the replica is permanent-dead
+    # and failover owns its tenants), and
+    # action="supervisor_poll_error" (reason — a supervision pass
+    # raised and was contained; silence here would make a broken
+    # supervisor look healthy). obs_report's recovery section reads
+    # these.
     "fault",
     # Fleet-tier telemetry (ISSUE 13, fleet/router.py + fleet/control.py,
     # three record shapes, all scalar/str): (a) the AGGREGATE router
@@ -150,7 +169,9 @@ KNOWN_KINDS = frozenset({
     # (c) EVENT records: event="fanout_publish" (publish_s, replicas,
     # params_version — the all-or-nothing fleet publish),
     # event="replica_add" and event="replace" (moved, tenants —
-    # re-placement churn). Replica-death containment emits kind="fault"
+    # re-placement churn), event="journal_compact" (snapshot_seq,
+    # tenants — the fleet journal folded its WAL into snapshot.json,
+    # ISSUE 15). Replica-death containment emits kind="fault"
     # action="replica_dead"/"replica_recover" next to these.
     # tools/obs_report.py's fleet section splits on replica/event.
     "fleet",

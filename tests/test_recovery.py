@@ -1,0 +1,791 @@
+"""Durable control plane tier-1 tests (ISSUE 15): journal framing /
+determinism / torn-tail truncation / compaction equivalence, supervisor
+backoff + budget on the injectable clock, socket per-call deadlines +
+bounded idempotent retry + net.* chaos, the router's deadline-vs-health
+breaker accounting, and the miniature recovery drill replayed against
+the committed RECOVERY_r*.json band (the fleet-miniature discipline)."""
+
+import glob
+import json
+import os
+import socket
+import socketserver
+import sys
+import threading
+from concurrent.futures import Future
+
+import pytest
+
+from induction_network_on_fewrel_tpu.fleet import (
+    DEAD,
+    UP,
+    FleetControl,
+    FleetJournal,
+    FleetRouter,
+    JournalError,
+    ReplicaHandle,
+    ReplicaSupervisor,
+)
+from induction_network_on_fewrel_tpu.fleet.journal import WAL_NAME
+from induction_network_on_fewrel_tpu.fleet.supervisor import (
+    deterministic_jitter,
+)
+from induction_network_on_fewrel_tpu.fleet.transport import SocketReplica
+from induction_network_on_fewrel_tpu.obs.chaos import ChaosRegistry, install
+from induction_network_on_fewrel_tpu.serving.batcher import (
+    DeadlineExceeded,
+    TransportTimeout,
+)
+from induction_network_on_fewrel_tpu.serving.breaker import CircuitBreaker
+from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import loadgen  # noqa: E402
+import obs_report  # noqa: E402
+
+
+def _ops(journal):
+    journal.append("tenant_register", tenant="t0", source=None,
+                   max_classes=None, nota_threshold=0.5)
+    journal.append("replica_add", replica="r0")
+    journal.append("tenant_threshold", tenant="t0", threshold=0.25)
+    journal.append("publish_commit", params_version=1, ckpt_dir="/x/ckpt")
+    journal.append("tenant_quarantine", tenant="t0", reason="op")
+
+
+# --- journal: framing, determinism, torn tail, compaction -------------------
+
+
+def test_journal_replay_is_deterministic_and_byte_identical(tmp_path):
+    """Same ops -> byte-identical WAL files AND byte-identical
+    materialized state (json.dumps of the canonical dict) — the
+    invariant every recovery path leans on."""
+    a, b = FleetJournal(tmp_path / "a"), FleetJournal(tmp_path / "b")
+    _ops(a), _ops(b)
+    a.close(), b.close()
+    assert (tmp_path / "a" / WAL_NAME).read_bytes() == \
+        (tmp_path / "b" / WAL_NAME).read_bytes()
+    sa = json.dumps(a.materialize().to_dict(), sort_keys=True)
+    sb = json.dumps(b.materialize().to_dict(), sort_keys=True)
+    assert sa == sb
+    # And replaying the SAME journal twice is stable.
+    assert sa == json.dumps(a.materialize().to_dict(), sort_keys=True)
+    st = a.materialize()
+    assert st.tenants["t0"] == {
+        "source": None, "max_classes": None, "nota_threshold": 0.25,
+        "quarantined": True,
+    }
+    assert st.committed == {"params_version": 1, "ckpt_dir": "/x/ckpt"}
+    assert st.replicas == {"r0": "up"}
+
+
+def test_journal_torn_tail_truncates_and_recovers_prefix(tmp_path):
+    """A short tail (crash mid-write) AND a CRC-corrupt record both
+    truncate at the bad record: everything before replays, the file is
+    repaired in place, and appends land cleanly afterward."""
+    j = FleetJournal(tmp_path / "j", logger=None)
+    _ops(j)
+    j.close()
+    wal = tmp_path / "j" / WAL_NAME
+    # Tear: drop the last 5 bytes of the final record.
+    blob = wal.read_bytes()
+    wal.write_bytes(blob[:-5])
+    logger = MetricsLogger(tmp_path / "run", quiet=True)
+    j2 = FleetJournal(tmp_path / "j", logger=logger)
+    st = j2.materialize()
+    assert st.applied == 4                      # the 5th op is gone
+    assert st.tenants["t0"]["quarantined"] is False
+    # The repair happened on disk; a fresh append then replays.
+    j2.append("tenant_quarantine", tenant="t0", reason="again")
+    assert j2.materialize().tenants["t0"]["quarantined"] is True
+    j2.close()
+    logger.close()
+    recs = [json.loads(line) for line in
+            (tmp_path / "run" / "metrics.jsonl").read_text().splitlines()]
+    trunc = [r for r in recs if r.get("action") == "journal_truncated"]
+    assert len(trunc) == 1 and trunc[0]["records_kept"] == 4.0
+    # CRC corruption MID-file: replay keeps only the records before it.
+    blob = wal.read_bytes()
+    flipped = bytearray(blob)
+    flipped[len(blob) // 2] ^= 0xFF
+    wal.write_bytes(bytes(flipped))
+    j3 = FleetJournal(tmp_path / "j")
+    assert 0 < j3.materialize().applied < 5
+    j3.close()
+
+
+def test_journal_snapshot_compaction_equivalence(tmp_path):
+    """compacted replay == full replay, including ops appended AFTER
+    the compaction — and auto-compaction triggers past compact_every."""
+    full = FleetJournal(tmp_path / "full")
+    compacted = FleetJournal(tmp_path / "compacted")
+    _ops(full), _ops(compacted)
+    compacted.compact()
+    assert compacted.records == 0 and compacted.snapshot_seq == 5
+    for j in (full, compacted):
+        j.append("tenant_unquarantine", tenant="t0", reason="done")
+        j.append("publish_commit", params_version=2, ckpt_dir="/x/ckpt2")
+    assert json.dumps(full.materialize().to_dict(), sort_keys=True) == \
+        json.dumps(compacted.materialize().to_dict(), sort_keys=True)
+    # Auto-compaction: the WAL never grows past the knob, and the
+    # state still equals an uncompacted journal of the same ops.
+    auto = FleetJournal(tmp_path / "auto", compact_every=3)
+    ref = FleetJournal(tmp_path / "ref")
+    _ops(auto), _ops(ref)
+    assert auto.records < 3 and auto.seq == 5 and auto.snapshot_seq >= 3
+    assert json.dumps(auto.materialize().to_dict(), sort_keys=True) == \
+        json.dumps(ref.materialize().to_dict(), sort_keys=True)
+    full.close(), compacted.close(), auto.close(), ref.close()
+
+
+def test_journal_refuses_bad_knobs_and_ops(tmp_path):
+    with pytest.raises(JournalError):
+        FleetJournal(tmp_path / "x", fsync="sometimes")
+    j = FleetJournal(tmp_path / "x")
+    with pytest.raises(JournalError):
+        j.append("tenant_obliterate", tenant="t0")
+    j.close()
+
+
+def test_journal_torn_write_chaos_point(tmp_path):
+    """The injected crash: the fired append writes a torn record, the
+    journal object refuses further writes (the process 'died'), and
+    reopening the directory truncates + recovers everything before."""
+    j = FleetJournal(tmp_path / "j")
+    _ops(j)
+    before = json.dumps(j.materialize().to_dict(), sort_keys=True)
+    install(ChaosRegistry.parse("journal.torn_write@0"))
+    try:
+        j.append("tenant_threshold", tenant="t0", threshold=0.9)
+    finally:
+        install(None)
+    with pytest.raises(JournalError):
+        j.append("tenant_threshold", tenant="t0", threshold=0.9)
+    j.close()
+    j2 = FleetJournal(tmp_path / "j")
+    assert json.dumps(j2.materialize().to_dict(), sort_keys=True) == before
+    j2.close()
+
+
+# --- supervisor: backoff, budget, probes (stub replicas, zero engines) ------
+
+
+class _SupReplica(ReplicaHandle):
+    def __init__(self, rid, alive=True, version=1):
+        self.replica_id = rid
+        self.alive = alive
+        self.version = version
+        self.registered: list[str] = []
+        self.thresholds: dict[str, float] = {}
+        self.quarantined: list[str] = []
+        self.warmups = 0
+
+    def submit(self, instance, deadline_s=None, tenant="default",
+               trace=None):
+        f: Future = Future()
+        f.set_result({"label": "rel0", "tenant": tenant,
+                      "replica": self.replica_id})
+        return f
+
+    def ping(self):
+        if not self.alive:
+            raise ConnectionError("down")
+        return True
+
+    def has_tenant(self, tenant):
+        return tenant in self.registered
+
+    def register_dataset(self, dataset, tenant, max_classes=None):
+        self.registered.append(tenant)
+        return []
+
+    def set_nota_threshold(self, threshold, tenant):
+        self.thresholds[tenant] = threshold
+
+    def quarantine_tenant(self, tenant, reason=""):
+        self.quarantined.append(tenant)
+
+    def unquarantine_tenant(self, tenant, reason=""):
+        pass
+
+    def drop_tenant(self, tenant):
+        pass
+
+    def prepare_publish(self, params=None, ckpt_dir=None,
+                        target_version=None):
+        return ("txn", target_version)
+
+    def commit_publish(self, txn):
+        self.version = txn[1] if txn[1] is not None else self.version + 1
+        return self.version
+
+    def abort_publish(self, txn):
+        pass
+
+    @property
+    def params_version(self):
+        return self.version
+
+    def stats_snapshot(self):
+        return {"served": 0, "steady_recompiles": 0}
+
+    def warmup(self):
+        self.warmups += 1
+        return 0
+
+    def close(self):
+        pass
+
+
+def _Ds():
+    """A tiny REAL dataset (wire-serializable, so journal round-trips
+    and recovery can re-register it)."""
+    from induction_network_on_fewrel_tpu.data.fewrel import (
+        FewRelDataset,
+        Instance,
+    )
+
+    inst = Instance(tokens=("alpha", "beta", "gamma"),
+                    head_pos=(0,), tail_pos=(2,))
+    return FewRelDataset({"rel0": [inst, inst], "rel1": [inst]})
+
+
+def _sup_fleet(tmp_path, restart_fn, clock, **kw):
+    replicas = {f"r{i}": _SupReplica(f"r{i}") for i in range(2)}
+    router = FleetRouter(replicas)
+    control = FleetControl(
+        router, journal=FleetJournal(tmp_path / "journal")
+    )
+    for i in range(6):
+        control.register_tenant(f"t{i}", _Ds())
+    # The committed generation a restarted replica must catch up to
+    # (the stub's prepare ignores the path and honors target_version).
+    control.journal.append("publish_commit", params_version=1,
+                           ckpt_dir="/x/ckpt")
+    sup = ReplicaSupervisor(
+        router, restart_fn, journal=control.journal,
+        backoff_s=1.0, restart_budget=3, clock=clock, **kw
+    )
+    return router, control, sup
+
+
+def test_supervisor_backoff_schedule_and_budget(tmp_path):
+    """Failed restarts wait exactly backoff_s * 2^(attempt-1) plus the
+    deterministic jitter; the budget exhausts into permanent-dead with
+    one replica_restart_exhausted record; forgive() re-arms."""
+    clock = {"t": 0.0}
+    calls = {"n": 0}
+
+    def restart_fn(rid):
+        calls["n"] += 1
+        raise RuntimeError("spawn refused")
+
+    router, control, sup = _sup_fleet(
+        tmp_path, restart_fn, lambda: clock["t"]
+    )
+    try:
+        router.mark_replica_dead("r0", reason="test")
+        assert sup.poll()["failed"] == ["r0"] and calls["n"] == 1
+        d1 = sup.next_delay("r0", 1)
+        assert 1.0 <= d1 <= 1.25          # base 1.0 + <=25% jitter
+        # Jitter is a pure function — same inputs, same delay.
+        assert d1 == sup.next_delay("r0", 1)
+        assert deterministic_jitter("r0", 1) == deterministic_jitter(
+            "r0", 1
+        )
+        clock["t"] = d1 - 1e-6
+        p = sup.poll()
+        assert calls["n"] == 1 and p["failed"] == []   # inside backoff
+        clock["t"] = d1 + 1e-6
+        assert sup.poll()["failed"] == ["r0"] and calls["n"] == 2
+        d2 = sup.next_delay("r0", 2)
+        assert 2.0 <= d2 <= 2.5           # doubled
+        clock["t"] += d2 + 1e-6
+        p = sup.poll()                    # attempt 3: budget burned
+        assert p["exhausted"] == ["r0"] and calls["n"] == 3
+        assert sup.exhausted("r0")
+        clock["t"] += 1000.0
+        assert sup.poll()["failed"] == [] and calls["n"] == 3  # permanent
+        sup.forgive("r0")
+        assert sup.poll()["failed"] == ["r0"] and calls["n"] == 4
+    finally:
+        control.journal.close()
+        router.close()
+
+
+def test_supervisor_restart_reregisters_catches_up_revives(tmp_path):
+    """A successful restart: fresh handle adopted, its directory
+    tenants re-registered (threshold + quarantine carried), caught up
+    to the journaled committed version, warmed, revived in placement —
+    and its breaker history reset."""
+    clock = {"t": 0.0}
+    adopted = {}
+
+    def restart_fn(rid):
+        adopted["handle"] = _SupReplica(rid, version=0)
+        return adopted["handle"]
+
+    router, control, sup = _sup_fleet(
+        tmp_path, restart_fn, lambda: clock["t"]
+    )
+    router.breaker = CircuitBreaker(failure_threshold=1, open_s=9.0)
+    try:
+        control.set_nota_threshold("t0", 0.4)
+        victim = router.directory["t0"].owner   # owns >= t0 by choice
+        mine = [t for t, e in router.directory.items()
+                if e.owner == victim]
+        control.quarantine_tenant(mine[0])
+        router.breaker.record_failure(victim)   # opened pre-restart
+        router.mark_replica_dead(victim, reason="test")
+        p = sup.poll()
+        assert p["restarted"] == [victim]
+        fresh = adopted["handle"]
+        assert router.replicas[victim] is fresh
+        assert router.placement.state(victim) == UP
+        assert sorted(fresh.registered) == sorted(mine)
+        assert fresh.thresholds["t0"] == 0.4
+        assert mine[0] in fresh.quarantined
+        assert fresh.version == 1               # caught up (journal v1)
+        assert fresh.warmups >= 1
+        assert router.breaker.state(victim) == "closed"
+    finally:
+        control.journal.close()
+        router.close()
+
+
+def test_supervisor_probe_failure_marks_dead(tmp_path):
+    clock = {"t": 0.0}
+    router, control, sup = _sup_fleet(
+        tmp_path, lambda rid: _SupReplica(rid), lambda: clock["t"]
+    )
+    try:
+        router.replicas["r1"].alive = False
+        p = sup.poll()
+        assert p["marked_dead"] == ["r1"]
+        assert router.placement.state("r1") == DEAD
+    finally:
+        control.journal.close()
+        router.close()
+
+
+# --- router recovery over stubs ---------------------------------------------
+
+
+def test_router_recover_rebuilds_directory_from_journal(tmp_path):
+    """Directory rows (owner/threshold/quarantine) rebuild bitwise from
+    the journal on a FRESH router; a params-only publish (no ckpt) on a
+    stale replica surfaces replica_stale_params instead of inventing a
+    catch-up."""
+    replicas = {f"r{i}": _SupReplica(f"r{i}") for i in range(2)}
+    router = FleetRouter(replicas)
+    journal = FleetJournal(tmp_path / "j")
+    control = FleetControl(router, journal=journal)
+    for i in range(5):
+        control.register_tenant(f"t{i}", _Ds())
+    control.set_nota_threshold("t1", 0.3)
+    control.quarantine_tenant("t2", reason="hold")
+    journal.append("publish_commit", params_version=4, ckpt_dir=None)
+    view = router.directory_view()
+    router.close()
+
+    fresh = {f"r{i}": _SupReplica(f"r{i}", version=0) for i in range(2)}
+    logger = MetricsLogger(tmp_path / "run", quiet=True)
+    router2 = FleetRouter(fresh, logger=logger)
+    summary = router2.recover(journal)
+    assert summary["tenants"] == 5
+    # Both fresh replicas lost their registries: every tenant
+    # re-registers on its (identical, pure-rendezvous) owner.
+    assert summary["reregistered"] == 5
+    assert router2.directory_view() == view
+    assert router2.directory["t1"].nota_threshold == 0.3
+    assert router2.directory["t2"].quarantined is True
+    logger.close()
+    recs = [json.loads(line) for line in
+            (tmp_path / "run" / "metrics.jsonl").read_text().splitlines()]
+    stale = [r for r in recs
+             if r.get("action") == "replica_stale_params"]
+    assert len(stale) == 2        # both replicas at v0 < journaled v4
+    assert [r for r in recs if r.get("action") == "recovered"]
+    journal.close()
+    router2.close()
+
+
+def test_router_deadline_miss_is_load_not_health():
+    """A server-side DeadlineExceeded on the future must NOT feed the
+    replica breaker (TimeoutError IS an OSError subclass — the exact
+    trap); a TransportTimeout (wedged peer) MUST."""
+    class _DL(_SupReplica):
+        def __init__(self, rid, exc):
+            super().__init__(rid)
+            self.exc = exc
+
+        def submit(self, instance, deadline_s=None, tenant="default",
+                   trace=None):
+            f: Future = Future()
+            f.set_exception(self.exc)
+            return f
+
+    for exc, expect_open in (
+        (DeadlineExceeded("expired in queue"), False),
+        (TransportTimeout("peer wedged"), True),
+    ):
+        replicas = {"r0": _DL("r0", exc)}
+        router = FleetRouter(
+            replicas,
+            breaker=CircuitBreaker(failure_threshold=1, open_s=30.0),
+        )
+        control = FleetControl(router)
+        control.register_tenant("t0", _Ds())
+        fut = router.submit("q", tenant="t0")
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=5.0)
+        assert (router.breaker.state("r0") == "open") is expect_open, exc
+        router.close()
+
+
+# --- socket transport: per-call deadline, retry, net chaos ------------------
+
+
+class _WedgedServer:
+    """Accepts connections, reads forever, never answers — the wedged
+    peer a per-call deadline exists for."""
+
+    def __init__(self):
+        self._srv = socket.socket()
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(4)
+        self.address = self._srv.getsockname()
+        self._conns = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            while True:
+                c, _ = self._srv.accept()
+                self._conns.append(c)   # hold it open, say nothing
+        except OSError:
+            pass
+
+    def close(self):
+        self._srv.close()
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+class _EchoHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        for line in self.rfile:
+            if not line.strip():
+                continue
+            req = json.loads(line)
+            self.server.ops.append(req["op"])  # type: ignore[attr-defined]
+            resp = {"ok": True, "version": 7, "has": True,
+                    "stats": {}, "compiled": 0, "classes": []}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+def _echo_server():
+    srv = socketserver.ThreadingTCPServer(
+        ("127.0.0.1", 0), _EchoHandler, bind_and_activate=True
+    )
+    srv.daemon_threads = True
+    srv.ops = []  # type: ignore[attr-defined]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def test_socket_per_call_deadline_typed_timeout():
+    """A wedged peer surfaces as the typed TransportTimeout (a
+    DeadlineExceeded) within the per-call deadline instead of blocking
+    the calling thread forever — and the connection re-dials next
+    call."""
+    srv = _WedgedServer()
+    try:
+        rep = SocketReplica("w0", srv.address, call_deadline_s=0.3,
+                            retries=0)
+        with pytest.raises(DeadlineExceeded) as exc:
+            _ = rep.params_version
+        assert isinstance(exc.value, TransportTimeout)
+        rep.close()
+    finally:
+        srv.close()
+
+
+def test_socket_idempotent_retry_and_net_chaos():
+    """net.partition on the FIRST attempt of an idempotent call is
+    retried within the bounded budget (deterministic backoff);
+    exhausting the budget surfaces ConnectionError; net.drop
+    invalidates the connection; classify never retries."""
+    srv = _echo_server()
+    try:
+        rep = SocketReplica("e0", srv.server_address[:2],
+                            call_deadline_s=5.0, retries=2,
+                            retry_backoff_s=0.001)
+        # One partition, then clean: the retry heals it.
+        install(ChaosRegistry.parse("net.partition@0:e0"))
+        assert rep.params_version == 7
+        install(None)
+        # More partitions than the budget: typed connection failure.
+        install(ChaosRegistry.parse("net.partition@0*9:e0"))
+        with pytest.raises(ConnectionError):
+            _ = rep.params_version
+        install(None)
+        # net.drop: request sent, response "lost", conn invalidated —
+        # an idempotent op retries onto a FRESH connection and lands.
+        install(ChaosRegistry.parse("net.drop@0:e0"))
+        assert rep.has_tenant("t0") is True
+        install(None)
+        # classify (NOT idempotent): the same injected partition
+        # surfaces instead of being silently resent.
+        install(ChaosRegistry.parse("net.partition@0:e0"))
+        fut = rep.submit({"tokens": ["a"]}, deadline_s=1.0)
+        with pytest.raises(ConnectionError):
+            fut.result(timeout=10.0)
+        install(None)
+        # net.slow: ARG is the delay PAYLOAD (never a filter) — the
+        # call still lands, measurably later.
+        import time as _time
+
+        install(ChaosRegistry.parse("net.slow@0:0.05"))
+        t0 = _time.monotonic()
+        assert rep.params_version == 7
+        assert _time.monotonic() - t0 >= 0.05
+        install(None)
+        rep.close()
+    finally:
+        install(None)
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_adapt_exhausted_latch_survives_via_journal(tmp_path):
+    """The journaled adapt_exhausted latch is READ BACK: a recovered
+    controller absorbs the quarantined flapper's drift triggers (no
+    retrain storm), while other tenants still arm."""
+    from induction_network_on_fewrel_tpu.obs.adapt import (
+        AdaptationController,
+    )
+
+    journal = FleetJournal(tmp_path / "j")
+    ctl = AdaptationController(
+        train_fn=lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("must not train")
+        ),
+        canary_fn=None,
+        publish_fn=lambda *a, **k: 0,
+        journal=journal,
+    )
+    # Simulate a prior life's exhaustion having been journaled...
+    journal.append("adapt_exhausted", tenant="flapper", attempts=3.0)
+    # ...and a restarted controller re-priming from the replay.
+    ctl2 = AdaptationController(
+        train_fn=lambda *a, **k: None, canary_fn=None,
+        publish_fn=lambda *a, **k: 0,
+    )
+    ctl2.restore_exhausted(journal.materialize().adapt_exhausted)
+    assert ctl2.trigger("flapper") is False      # absorbed: PERMANENT
+    assert ctl2.trigger("healthy") is True       # others arm normally
+    journal.close()
+
+
+# --- slow lane: supervised restart over the REAL socket transport ----------
+
+
+@pytest.mark.slow
+def test_supervisor_restart_over_socket_transport(tmp_path):
+    """The ISSUE 15 socket-mode arc end to end: a journaled 2-replica
+    socket fleet, one replica's server process 'dies' (server stopped,
+    engine closed), the supervisor's probe marks it dead, restart_fn
+    spawns a FRESH engine + server + SocketReplica, and the adopted
+    replica is re-registered + caught up to the journaled committed
+    generation before taking traffic again."""
+    import jax
+
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.data import (
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.data.tokenizer import (
+        GloveTokenizer,
+    )
+    from induction_network_on_fewrel_tpu.fleet.transport import (
+        ReplicaServer,
+        SocketReplica,
+    )
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.serving.buckets import zero_batch
+    from induction_network_on_fewrel_tpu.serving.engine import (
+        InferenceEngine,
+    )
+    from induction_network_on_fewrel_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+    from induction_network_on_fewrel_tpu.train.steps import init_state
+
+    cfg = ExperimentConfig(
+        model="induction", encoder="cnn", hidden_size=16,
+        vocab_size=122, word_dim=8, pos_dim=2, max_length=16,
+        induction_dim=8, ntn_slices=4, routing_iters=2,
+        n=3, train_n=3, k=2, q=2, device="cpu",
+    )
+    vocab = make_synthetic_glove(vocab_size=cfg.vocab_size - 2,
+                                 word_dim=cfg.word_dim)
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    model = build_model(cfg, glove_init=vocab.vectors)
+    params = model.init(
+        jax.random.key(0),
+        zero_batch(cfg.max_length, (1, cfg.n, cfg.k)),
+        zero_batch(cfg.max_length, (1, 2)),
+    )
+    state = init_state(
+        model, cfg,
+        zero_batch(cfg.max_length, (1, cfg.n, cfg.k)),
+        zero_batch(cfg.max_length, (1, cfg.total_q)),
+    )
+    ckpt = str(tmp_path / "ckpt")
+    mngr = CheckpointManager(ckpt, cfg, stage="off")
+    try:
+        mngr.save(0, state, val_accuracy=0.0)
+        mngr.wait()
+    finally:
+        mngr.close()
+    datasets = [
+        make_synthetic_fewrel(num_relations=3, instances_per_relation=8,
+                              vocab_size=cfg.vocab_size - 2, seed=s)
+        for s in range(2)
+    ]
+
+    def mk_engine():
+        return InferenceEngine(model, params, cfg, tok, k=cfg.k,
+                               buckets=(1, 2))
+
+    engines = [mk_engine() for _ in range(2)]
+    servers = [ReplicaServer(e).start() for e in engines]
+    spawned: list = []
+    router = None
+    try:
+        clients = {
+            f"r{i}": SocketReplica(f"r{i}", srv.address,
+                                   call_deadline_s=10.0)
+            for i, srv in enumerate(servers)
+        }
+        router = FleetRouter(dict(clients))
+        journal = FleetJournal(tmp_path / "journal")
+        control = FleetControl(router, journal=journal)
+        for i in range(4):
+            control.register_tenant(f"t{i}", datasets[i % 2])
+        for c in clients.values():
+            c.warmup()
+        assert control.publish_checkpoint(ckpt) == 1   # journaled
+        pools = [
+            [inst for r in ds.rel_names
+             for inst in ds.instances[r][cfg.k:]]
+            for ds in datasets
+        ]
+        victim = router.directory["t0"].owner
+        vi = int(victim[1:])
+        servers[vi].stop()
+        engines[vi].close()
+
+        def restart_fn(rid):
+            assert rid == victim
+            eng = mk_engine()
+            srv = ReplicaServer(eng).start()
+            spawned.append((srv, eng))
+            return SocketReplica(rid, srv.address, call_deadline_s=10.0)
+
+        sup = ReplicaSupervisor(router, restart_fn, journal=journal,
+                                backoff_s=0.01)
+        p = sup.poll()                      # probe fails -> dead
+        assert victim in p["marked_dead"]
+        p = sup.poll()                      # restart + adopt
+        assert p["restarted"] == [victim]
+        assert router.replicas[victim].params_version == 1  # caught up
+        assert router.replicas[victim].has_tenant("t0")
+        v = router.classify(pools[0][0], 15.0, tenant="t0")
+        assert v["tenant"] == "t0" and not v.get("degraded")
+        journal.close()
+    finally:
+        if router is not None:
+            router.close()
+        for srv, eng in spawned:
+            srv.stop()
+            eng.close()
+        for srv in servers:
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001 — already stopped above
+                pass
+        for e in engines:
+            e.close()
+
+
+# --- the committed artifact + miniature replay ------------------------------
+
+
+def _latest_recovery_artifact():
+    paths = sorted(glob.glob(os.path.join(_REPO, "RECOVERY_r*.json")))
+    assert paths, "no committed RECOVERY_r*.json artifact"
+    return json.loads(open(paths[-1]).read())
+
+
+def test_recovery_artifact_complete():
+    """Acceptance shape: all three arms present and green, the
+    zero-bands zero, the drill passed."""
+    art = _latest_recovery_artifact()
+    assert art["passed"]
+    rk = art["router_kill"]
+    assert rk["directory_bitwise"] and rk["placement_identical"]
+    assert rk["tenants_lost"] == 0 and rk["errors"] == 0
+    assert rk["reregistered"] >= 1 and rk["caught_up"] >= 1
+    assert rk["params_version_uniform"] and rk["quarantine_survived"]
+    rep = art["replica_kill"]
+    assert rep["backoff_honored"] and rep["recovered"]
+    assert rep["params_version_uniform"]
+    assert rep["dropped_during_catchup"] == 0
+    assert rep["steady_recompiles"] == 0
+    tt = art["torn_tail"]
+    assert tt["append_refused_after_tear"] and tt["prefix_recovered"]
+    assert tt["appendable_after_heal"]
+    assert art["zero_bands"] == {
+        "tenants_lost": 0, "steady_recompiles": 0,
+        "dropped_during_catchup": 0,
+    }
+
+
+def test_recovery_tier1_regression_gate(tmp_path):
+    """Replay the committed artifact's miniature drill in-process: the
+    durability invariants must hold EXACTLY (placement and journal
+    replay are pure functions of the ids — a hash/framing change must
+    re-emit RECOVERY_r*.json), and the telemetry it emits is
+    schema-clean."""
+    art = _latest_recovery_artifact()
+    logger = MetricsLogger(tmp_path, quiet=True)
+    try:
+        res = loadgen.recovery_tier1_drill(
+            seed=int(art["seed"]), logger=logger
+        )
+    finally:
+        logger.close()
+    assert res["passed"], res
+    assert res["placement_distribution"] == art["placement_distribution"]
+    assert res["router_kill"]["lost_replica"] == \
+        art["router_kill"]["lost_replica"]
+    assert res["router_kill"]["reregistered"] == \
+        art["router_kill"]["reregistered"]
+    assert res["replica_kill"]["victim"] == art["replica_kill"]["victim"]
+    assert res["replica_kill"]["restart_attempts"] == \
+        art["replica_kill"]["restart_attempts"]
+    assert res["zero_bands"] == art["zero_bands"]
+    n, errors = obs_report.check_schema(tmp_path / "metrics.jsonl")
+    assert errors == [], errors

@@ -130,6 +130,18 @@ BANDS: dict[str, tuple[str, float]] = {
     "adapt.passed": ("floor", 1.0),
     "adapt.recovered": ("floor", 1.0),
     "adapt.exhausted_latched": ("floor", 1.0),
+    # Recovery drill (ISSUE 15, RECOVERY_r*.json): the durability
+    # invariants as zero-bands — a router kill/restart loses no tenant,
+    # a supervised replica catch-up drops nothing and recompiles
+    # nothing — plus the pass/bitwise-directory floors. Journal/restart
+    # counts are recorded unbanded.
+    "recovery.tenants_lost": ("zero", 0.0),
+    "recovery.steady_recompiles": ("zero", 0.0),
+    "recovery.dropped_during_catchup": ("zero", 0.0),
+    "recovery.passed": ("floor", 1.0),
+    "recovery.directory_bitwise": ("floor", 1.0),
+    "recovery.placement_identical": ("floor", 1.0),
+    "recovery.torn_prefix_recovered": ("floor", 1.0),
 }
 
 
@@ -322,6 +334,38 @@ def _adapt_points(points: dict, path: str, data: dict) -> int:
     return sum(len(v) for v in points.values()) - before
 
 
+def _recovery_points(points: dict, path: str, data: dict) -> int:
+    """RECOVERY_r*.json (tools/loadgen.py --recovery_drill): the
+    durable-control-plane drill — zero-bands (tenant loss, steady
+    recompiles, drops during catch-up), the bitwise/placement/torn-tail
+    floors, and recorded (unbanded) journal + restart counts."""
+    rnd, src = _round_of(path), os.path.basename(path)
+    before = sum(len(v) for v in points.values())
+    zero = data.get("zero_bands") or {}
+    for key in ("tenants_lost", "steady_recompiles",
+                "dropped_during_catchup"):
+        _point(points, f"recovery.{key}", rnd, src, zero.get(key))
+    _point(points, "recovery.passed", rnd, src,
+           1.0 if data.get("passed") else 0.0)
+    rk = data.get("router_kill") or {}
+    _point(points, "recovery.directory_bitwise", rnd, src,
+           1.0 if rk.get("directory_bitwise") else 0.0)
+    _point(points, "recovery.placement_identical", rnd, src,
+           1.0 if rk.get("placement_identical") else 0.0)
+    _point(points, "recovery.reregistered", rnd, src,
+           rk.get("reregistered"))
+    _point(points, "recovery.caught_up", rnd, src, rk.get("caught_up"))
+    rep = data.get("replica_kill") or {}
+    _point(points, "recovery.restart_attempts", rnd, src,
+           rep.get("restart_attempts"))
+    tt = data.get("torn_tail") or {}
+    _point(points, "recovery.torn_prefix_recovered", rnd, src,
+           1.0 if tt.get("prefix_recovered") else 0.0)
+    _point(points, "recovery.journal_records", rnd, src,
+           data.get("journal_records_at_kill"))
+    return sum(len(v) for v in points.values()) - before
+
+
 _EXTRACTORS = (
     ("BENCH_r*.json", _bench_points),
     ("ROOFLINE_r*.json", _roofline_points),
@@ -330,6 +374,7 @@ _EXTRACTORS = (
     ("CHAOS_r*.json", _chaos_points),
     ("FLEET_r*.json", _fleet_points),
     ("ADAPT_r*.json", _adapt_points),
+    ("RECOVERY_r*.json", _recovery_points),
 )
 
 

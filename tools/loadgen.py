@@ -183,6 +183,23 @@ def parse_args(argv=None):
                         "0 = off")
     p.add_argument("--fleet_artifact", default=None, metavar="PATH",
                    help="write the FLEET_r*.json soak artifact here")
+    p.add_argument("--recovery_drill", action="store_true",
+                   help="durable-control-plane drill (ISSUE 15), "
+                        "standalone mode on its own miniature journaled "
+                        "fleet: kill the router mid-life (one replica "
+                        "host lost with it) -> recover(journal) rebuilds "
+                        "the directory BITWISE with identical placement, "
+                        "zero tenants lost, the fresh replica "
+                        "re-registered + caught up to the journaled "
+                        "params_version; kill a replica -> the "
+                        "supervisor restarts it (backoff honored on an "
+                        "injected clock) with automatic catch-up to the "
+                        "uniform generation, zero drops, zero steady "
+                        "recompiles; tear the journal tail -> replay "
+                        "truncates at the bad record and recovers "
+                        "everything before it")
+    p.add_argument("--recovery_artifact", default=None, metavar="PATH",
+                   help="write the RECOVERY_r*.json drill artifact here")
     p.add_argument("--slo_profile", action="store_true",
                    help="also attempt a jax.profiler trace in the SLO "
                         "auto-capture (default off: on this image a "
@@ -2248,6 +2265,380 @@ def fleet_tier1_drill(seed: int = 0, logger=None) -> dict:
             own_logger.close()
 
 
+def recovery_tier1_drill(seed: int = 0, logger=None) -> dict:
+    """The ISSUE 15 durability drill, miniature + deterministic (the
+    fleet_tier1_drill discipline — the committed RECOVERY artifact IS
+    the tier-1 replay): one journaled 3-replica fleet, then the three
+    recovery arms end to end.
+
+    * **Router kill-9**: every control-plane op write-ahead-logged,
+      then the router object is thrown away mid-life (the crash) WITH
+      one replica's process replaced by a fresh engine (empty registry,
+      params_version 0 — the host that also died). A fresh router's
+      ``recover(journal)`` must rebuild the directory BITWISE (owners,
+      thresholds, quarantine flags, support digests), keep placement
+      identical, re-register + catch the fresh replica up to the
+      journaled committed generation, and lose ZERO tenants.
+    * **Replica kill -> supervised restart**: the supervisor's first
+      restart attempt is made to fail (backoff honored on the injected
+      clock — attempt 2 runs only after the deterministic-jitter
+      delay), the second succeeds: re-registration, catch-up to the
+      uniform params_version, warmup, breaker reset, revive — with
+      traffic to the surviving replicas dropping NOTHING during the
+      window and zero steady-state recompiles fleet-wide.
+    * **Torn journal tail**: the ``journal.torn_write`` chaos point
+      tears the WAL mid-record; reopening the journal truncates at the
+      tear (action="journal_truncated"), recovers every record before
+      it, and the journal accepts appends again.
+    """
+    import jax
+    from collections import Counter
+
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.data import (
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.data.tokenizer import GloveTokenizer
+    from induction_network_on_fewrel_tpu.fleet import (
+        FleetControl,
+        FleetJournal,
+        FleetRouter,
+        InProcessReplica,
+        ReplicaSupervisor,
+    )
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.obs.chaos import (
+        ChaosRegistry,
+        install,
+    )
+    from induction_network_on_fewrel_tpu.serving.breaker import CircuitBreaker
+    from induction_network_on_fewrel_tpu.serving.buckets import zero_batch
+    from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
+    from induction_network_on_fewrel_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+    from induction_network_on_fewrel_tpu.train.steps import init_state
+    from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+    R, T = 3, 18
+    cfg = ExperimentConfig(
+        model="induction", encoder="cnn", hidden_size=16,
+        vocab_size=122, word_dim=8, pos_dim=2, max_length=16,
+        induction_dim=8, ntn_slices=4, routing_iters=2,
+        n=3, train_n=3, k=2, q=2, device="cpu", seed=seed,
+    )
+    vocab = make_synthetic_glove(
+        vocab_size=cfg.vocab_size - 2, word_dim=cfg.word_dim
+    )
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    model = build_model(cfg, glove_init=vocab.vectors)
+    params = model.init(
+        jax.random.key(seed),
+        zero_batch(cfg.max_length, (1, cfg.n, cfg.k)),
+        zero_batch(cfg.max_length, (1, 2)),
+    )
+    own_logger = logger if logger is not None else MetricsLogger(
+        None, quiet=True
+    )
+    tmp = tempfile.TemporaryDirectory(prefix="recovery_drill_")
+    out: dict = {"replicas": R, "tenants": T, "seed": seed}
+    routers: list = []
+    journals: list = []
+    try:
+        # The publishable artifact the journaled catch-up re-drives.
+        ckpt = os.path.join(tmp.name, "ckpt")
+        state0 = init_state(
+            model, cfg,
+            zero_batch(cfg.max_length, (1, cfg.n, cfg.k)),
+            zero_batch(cfg.max_length, (1, cfg.total_q)),
+            rng=jax.random.key(seed),
+        )
+        mngr = CheckpointManager(ckpt, cfg, stage="off")
+        try:
+            mngr.save(0, state0, val_accuracy=0.0)
+            mngr.wait()
+        finally:
+            mngr.close()
+
+        journal = FleetJournal(
+            os.path.join(tmp.name, "journal"), fsync="always",
+            logger=own_logger,
+        )
+        journals.append(journal)
+
+        def mk():
+            return InferenceEngine(
+                model, params, cfg, tok, k=cfg.k, buckets=(1, 2, 4),
+                logger=own_logger,
+            )
+
+        replicas = {
+            f"r{i:02d}": InProcessReplica(f"r{i:02d}", mk())
+            for i in range(R)
+        }
+        router = FleetRouter(
+            replicas, logger=own_logger,
+            breaker=CircuitBreaker(failure_threshold=3, open_s=1.0),
+            queue_capacity_per_replica=64,
+        )
+        routers.append(router)
+        control = FleetControl(router, journal=journal)
+        datasets = [
+            make_synthetic_fewrel(
+                num_relations=cfg.n, instances_per_relation=cfg.k + 6,
+                vocab_size=cfg.vocab_size - 2, seed=seed + 101 * d,
+            )
+            for d in range(4)
+        ]
+        names = [f"t{i:02d}" for i in range(T)]
+        for i, tenant in enumerate(names):
+            control.register_tenant(tenant, datasets[i % 4])
+            if i % 3 == 0:
+                control.set_nota_threshold(tenant, 0.25 + 0.05 * (i % 4))
+        for h in router.replicas.values():
+            h.warmup()
+        pools = {
+            t: [
+                inst for rel in datasets[i % 4].rel_names
+                for inst in datasets[i % 4].instances[rel][cfg.k:]
+            ]
+            for i, t in enumerate(names)
+        }
+        # The journaled publish every catch-up re-drives (version 1
+        # fleet-wide, ckpt path recorded).
+        control.publish_checkpoint(ckpt)
+        # Quarantine AFTER the publish (journal order matters: a
+        # committed publish clears engine-level quarantine by design,
+        # so recovery must re-assert flags journaled after it — the
+        # exact replay-order case the drill proves).
+        control.quarantine_tenant(names[1], reason="drill: operator hold")
+        dir_before = router.directory_view()
+        placement_before = router.placement.owners(names)
+        out["placement_distribution"] = dict(sorted(Counter(
+            e.owner for e in router.directory.values()
+        ).items()))
+        out["journal_records_at_kill"] = journal.seq
+
+        # --- ARM A: router kill-9 + one replica host lost -----------------
+        # Mid-traffic: these futures are IN FLIGHT when the router
+        # dies. The replicas own the queued work, so they must resolve
+        # normally even though the router object that admitted them is
+        # gone (zero drops from the crash itself).
+        lost_rid = sorted(replicas)[1]
+        survivors_of_lost = [
+            t for t, e in router.directory.items() if e.owner != lost_rid
+        ]
+        inflight = [
+            router.submit(pools[t][1], 10.0, tenant=t)
+            for t in survivors_of_lost[:6]
+        ]
+        replicas[lost_rid].close()   # that host died WITH the router
+        replicas2 = dict(replicas)
+        replicas2[lost_rid] = InProcessReplica(lost_rid, mk())
+        # The "restarted" router process: fresh object, fresh breaker,
+        # nothing carried over but the journal directory on disk.
+        journal2 = FleetJournal(
+            os.path.join(tmp.name, "journal"), fsync="always",
+            logger=own_logger,
+        )
+        journals.append(journal2)
+        router2 = FleetRouter(
+            replicas2, logger=own_logger,
+            breaker=CircuitBreaker(failure_threshold=3, open_s=1.0),
+            queue_capacity_per_replica=64,
+        )
+        routers.append(router2)
+        control2 = FleetControl(router2, journal=journal2)
+        summary = router2.recover(journal2)
+        dir_after = router2.directory_view()
+        inflight_survived = all(
+            "label" in f.result(timeout=30.0) for f in inflight
+        )
+        served = degraded = errors = 0
+        for t in names:
+            try:
+                v = router2.classify(pools[t][0], 10.0, tenant=t)
+                served += 1
+                degraded += bool(v.get("degraded"))
+            except Exception:  # noqa: BLE001 — counted: the zero-band
+                errors += 1
+        versions = {
+            rid: h.params_version for rid, h in router2.replicas.items()
+        }
+        out["router_kill"] = {
+            "lost_replica": lost_rid,
+            "directory_bitwise": dir_after == dir_before,
+            "placement_identical":
+                router2.placement.owners(names) == placement_before,
+            "tenants_lost": T - len(router2.directory),
+            "reregistered": summary["reregistered"],
+            "caught_up": summary["caught_up"],
+            "params_version_uniform": len(set(versions.values())) == 1,
+            "params_version": max(versions.values()),
+            "inflight_at_kill": len(inflight),
+            "inflight_survived": inflight_survived,
+            "served": served,
+            # names[1] is the operator-quarantined tenant: its degraded
+            # verdict PROVES the flag survived the crash.
+            "quarantine_survived": degraded == 1,
+            "errors": errors,
+        }
+
+        # --- ARM B: replica kill -> supervised restart --------------------
+        clock = {"t": 0.0}
+        attempts = {"n": 0}
+
+        def restart_fn(rid):
+            attempts["n"] += 1
+            if attempts["n"] == 1:
+                raise RuntimeError("injected spawn failure (drill)")
+            return InProcessReplica(rid, mk())
+
+        sup = ReplicaSupervisor(
+            router2, restart_fn, journal=journal2,
+            backoff_s=0.5, restart_budget=3,
+            clock=lambda: clock["t"], logger=own_logger,
+        )
+        victim = router2.directory[names[0]].owner
+        victim_tenants = [
+            t for t, e in router2.directory.items() if e.owner == victim
+        ]
+        router2.replicas[victim].close()
+        router2.mark_replica_dead(victim, reason="drill kill")
+        # Traffic to the SURVIVORS while the victim is down + restarting:
+        # the dropped_during_catchup zero-band.
+        survivors = [t for t in names if t not in victim_tenants
+                     and t != names[1]]
+        catchup_errors = 0
+        for t in survivors:
+            try:
+                router2.classify(pools[t][0], 10.0, tenant=t)
+            except Exception:  # noqa: BLE001 — counted: the zero-band
+                catchup_errors += 1
+        p1 = sup.poll()                      # attempt 1: injected failure
+        delay = sup.next_delay(victim, 1)
+        clock["t"] = delay * 0.5
+        p2 = sup.poll()                      # inside backoff: must not try
+        clock["t"] = delay + 1e-6
+        p3 = sup.poll()                      # attempt 2: succeeds
+        for t in survivors:
+            try:
+                router2.classify(pools[t][0], 10.0, tenant=t)
+            except Exception:  # noqa: BLE001 — counted: the zero-band
+                catchup_errors += 1
+        recovered = all(
+            not router2.classify(
+                pools[t][0], 10.0, tenant=t
+            ).get("degraded")
+            for t in victim_tenants[:4] if t != names[1]
+        )
+        versions = {
+            rid: h.params_version for rid, h in router2.replicas.items()
+        }
+        steady = sum(
+            h.stats_snapshot()["steady_recompiles"]
+            for h in router2.replicas.values()
+        )
+        out["replica_kill"] = {
+            "victim": victim,
+            "affected_tenants": len(victim_tenants),
+            "restart_attempts": attempts["n"],
+            "backoff_honored": (
+                p1["failed"] == [victim] and p2["restarted"] == []
+                and p2["failed"] == [] and p3["restarted"] == [victim]
+            ),
+            "caught_up_version": max(versions.values()),
+            "params_version_uniform": len(set(versions.values())) == 1,
+            "recovered": recovered,
+            "dropped_during_catchup": catchup_errors,
+            "steady_recompiles": steady,
+        }
+
+        # --- ARM C: torn journal tail -------------------------------------
+        state_before_tear = json.dumps(
+            journal2.materialize().to_dict(), sort_keys=True
+        )
+        install(ChaosRegistry.parse("journal.torn_write@0",
+                                    logger=own_logger))
+        control2.set_nota_threshold(names[2], 0.5)   # the torn append
+        install(None)
+        torn_refused = False
+        try:
+            control2.set_nota_threshold(names[3], 0.5)
+        except Exception:  # noqa: BLE001 — the journal must refuse
+            torn_refused = True
+        journal3 = FleetJournal(
+            os.path.join(tmp.name, "journal"), fsync="always",
+            logger=own_logger,
+        )
+        state_after_heal = json.dumps(
+            journal3.materialize().to_dict(), sort_keys=True
+        )
+        # Healed: appends land again and replay picks them up.
+        journal3.append("tenant_threshold", tenant=names[2], threshold=0.5)
+        out["torn_tail"] = {
+            "append_refused_after_tear": torn_refused,
+            "prefix_recovered": state_after_heal == state_before_tear,
+            "appendable_after_heal":
+                journal3.materialize().tenants[names[2]]["nota_threshold"]
+                == 0.5,
+        }
+        journal3.close()
+
+        out["zero_bands"] = {
+            "tenants_lost": out["router_kill"]["tenants_lost"],
+            "steady_recompiles": out["replica_kill"]["steady_recompiles"],
+            "dropped_during_catchup":
+                out["replica_kill"]["dropped_during_catchup"],
+        }
+        out["passed"] = check_recovery_drill(out)
+        return out
+    finally:
+        install(None)
+        for r in routers:
+            r.close()
+        for j in journals:
+            j.close()
+        if logger is None:
+            own_logger.close()
+        tmp.cleanup()
+
+
+def check_recovery_drill(out: dict) -> bool:
+    """The drill's acceptance: bitwise directory + identical placement
+    + zero tenant loss after the router kill, supervised restart with
+    honored backoff catching the replica up to the uniform generation
+    with zero drops and zero steady recompiles, and the torn tail
+    recovering its full clean prefix."""
+    rk = out.get("router_kill", {})
+    rep = out.get("replica_kill", {})
+    tt = out.get("torn_tail", {})
+    zb = out.get("zero_bands", {})
+    return bool(
+        rk.get("directory_bitwise")
+        and rk.get("placement_identical")
+        and rk.get("tenants_lost") == 0
+        and rk.get("reregistered", 0) >= 1
+        and rk.get("caught_up", 0) >= 1
+        and rk.get("params_version_uniform")
+        and rk.get("quarantine_survived")
+        and rk.get("inflight_survived")
+        and rk.get("errors") == 0
+        and rep.get("backoff_honored")
+        and rep.get("params_version_uniform")
+        and rep.get("recovered")
+        and rep.get("dropped_during_catchup") == 0
+        and rep.get("steady_recompiles") == 0
+        and tt.get("append_refused_after_tear")
+        and tt.get("prefix_recovered")
+        and tt.get("appendable_after_heal")
+        and zb.get("tenants_lost") == 0
+        and zb.get("steady_recompiles") == 0
+        and zb.get("dropped_during_catchup") == 0
+    )
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     import numpy as np
@@ -2268,10 +2659,10 @@ def main(argv=None) -> int:
 
     tmp = None
     ckpt = args.ckpt
-    if ckpt is None and not args.adapt_drill:
-        # --adapt_drill trains its own miniature world (the default
-        # synthetic checkpoint would be dead weight — and one more
-        # orbax world in the process for no reason).
+    if ckpt is None and not (args.adapt_drill or args.recovery_drill):
+        # --adapt_drill and --recovery_drill build their own miniature
+        # worlds (the default synthetic checkpoint would be dead weight
+        # — and one more orbax world in the process for no reason).
         tmp = tempfile.TemporaryDirectory(prefix="loadgen_")
         print("building synthetic-data checkpoint...", file=sys.stderr)
         ckpt = make_synthetic_checkpoint(args, tmp.name)
@@ -2360,6 +2751,55 @@ def main(argv=None) -> int:
                 with open(args.fleet_artifact, "w") as f:
                     json.dump(report, f, indent=1)
                 print(f"wrote {args.fleet_artifact}", file=sys.stderr)
+            if args.run_dir:
+                print(f"telemetry in {args.run_dir} — render with "
+                      f"'python tools/obs_report.py {args.run_dir}'",
+                      file=sys.stderr)
+            return rc
+        if args.recovery_drill:
+            # Standalone mode (like --fleet): the durable control plane
+            # is the system under test, on its own miniature journaled
+            # fleet — the scheduler arms are skipped.
+            drill = recovery_tier1_drill(seed=args.seed, logger=logger)
+            rk, rep, tt = (drill["router_kill"], drill["replica_kill"],
+                           drill["torn_tail"])
+            print(f"[recovery drill/router-kill] bitwise="
+                  f"{rk['directory_bitwise']} "
+                  f"placement={rk['placement_identical']} "
+                  f"lost={rk['tenants_lost']} "
+                  f"reregistered={rk['reregistered']} "
+                  f"caught_up={rk['caught_up']} "
+                  f"uniform=v{rk['params_version']} "
+                  f"errors={rk['errors']}")
+            print(f"[recovery drill/replica-kill] victim={rep['victim']} "
+                  f"attempts={rep['restart_attempts']} "
+                  f"backoff_honored={rep['backoff_honored']} "
+                  f"uniform={rep['params_version_uniform']} "
+                  f"recovered={rep['recovered']} "
+                  f"dropped={rep['dropped_during_catchup']} "
+                  f"recompiles={rep['steady_recompiles']}")
+            print(f"[recovery drill/torn-tail] "
+                  f"refused={tt['append_refused_after_tear']} "
+                  f"prefix={tt['prefix_recovered']} "
+                  f"healed={tt['appendable_after_heal']}")
+            if not drill["passed"]:
+                print("FAIL[recovery drill]: durability invariants did "
+                      "not hold", file=sys.stderr)
+                rc = 1
+            report = {
+                "round": 1,
+                "generated_by": "tools/loadgen.py --recovery_drill",
+                **drill,
+            }
+            print(json.dumps({
+                k: report[k] for k in
+                ("replicas", "tenants", "zero_bands", "passed")
+                if k in report
+            }))
+            if args.recovery_artifact:
+                with open(args.recovery_artifact, "w") as fh:
+                    json.dump(report, fh, indent=1)
+                print(f"wrote {args.recovery_artifact}", file=sys.stderr)
             if args.run_dir:
                 print(f"telemetry in {args.run_dir} — render with "
                       f"'python tools/obs_report.py {args.run_dir}'",

@@ -869,6 +869,67 @@ def fleet_summary(recs: list[dict]) -> dict | None:
     return out
 
 
+def recovery_summary(recs: list[dict]) -> dict | None:
+    """Durable-control-plane section (ISSUE 15): journal health
+    (compactions, truncated tails), cold-start recoveries (tenant /
+    re-registration / catch-up counts from the last
+    ``action="recovered"`` record), per-replica catch-up rows, and
+    supervised restart outcomes — the recovery ledger next to the
+    faults section's containment ledger."""
+    faults = [r for r in recs if r.get("kind") == "fault"]
+    compacts = [
+        r for r in recs
+        if r.get("kind") == "fleet" and r.get("event") == "journal_compact"
+    ]
+    recovered = [r for r in faults if r.get("action") == "recovered"]
+    catchups = [r for r in faults if r.get("action") == "catchup"]
+    restarts = [r for r in faults
+                if r.get("action") == "replica_restarted"]
+    truncated = [r for r in faults
+                 if r.get("action") == "journal_truncated"]
+    exhausted = [r for r in faults
+                 if r.get("action") == "replica_restart_exhausted"]
+    if not (recovered or catchups or restarts or truncated or compacts):
+        return None
+    out: dict = {}
+    if recovered:
+        last = recovered[-1]
+        out["recoveries"] = len(recovered)
+        out["last_recovery"] = {
+            k: int(last[k]) for k in (
+                "tenants", "reregistered", "unplaceable", "unreachable",
+                "caught_up", "params_version", "journal_records",
+                "snapshot_seq",
+            ) if k in last
+        }
+    if catchups:
+        out["catchup_rows"] = [
+            f"{c.get('replica')}: v{int(c.get('from_version', 0))} -> "
+            f"v{int(c.get('to_version', 0))}"
+            for c in catchups[-5:]
+        ]
+    if restarts:
+        ok = sum(1 for r in restarts if r.get("ok") == 1.0)
+        out["replica_restarts"] = {
+            "ok": ok, "failed": len(restarts) - ok,
+        }
+    if exhausted:
+        out["restart_budget_exhausted"] = sorted(
+            {str(r.get("replica")) for r in exhausted}
+        )
+    if truncated:
+        out["journal_truncations"] = len(truncated)
+        out["last_truncation"] = (
+            f"{truncated[-1].get('reason')} "
+            f"(-{int(truncated[-1].get('bytes_dropped', 0))} B, "
+            f"{int(truncated[-1].get('records_kept', 0))} records kept)"
+        )
+    if compacts:
+        out["journal_compactions"] = len(compacts)
+        out["snapshot_seq"] = compacts[-1].get("snapshot_seq")
+    return out
+
+
 def health_summary(recs: list[dict]) -> dict:
     events = [r for r in recs if r.get("kind") == "health"]
     by_event: dict[str, int] = {}
@@ -998,9 +1059,10 @@ def render(report: dict) -> str:
     for e in errors[:10]:
         lines.append(f"  ! {e}")
     for section in ("train", "mfu", "eval", "perf", "compile", "serve",
-                    "fleet", "adapt", "faults", "traces", "slo", "quality",
-                    "scenarios", "ckpt", "input_pipeline", "comms",
-                    "roofline", "health", "flight_recorder", "overhead"):
+                    "fleet", "adapt", "faults", "recovery", "traces",
+                    "slo", "quality", "scenarios", "ckpt",
+                    "input_pipeline", "comms", "roofline", "health",
+                    "flight_recorder", "overhead"):
         body = report.get(section)
         if body is None:
             continue
@@ -1068,6 +1130,7 @@ def main(argv=None) -> int:
         "fleet": fleet_summary(recs),
         "adapt": adapt_summary(recs),
         "faults": fault_summary(recs),
+        "recovery": recovery_summary(recs),
         "traces": trace_summary(recs),
         "slo": slo_summary(recs),
         "quality": quality_summary(recs),
