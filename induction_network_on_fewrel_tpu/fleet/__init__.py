@@ -19,8 +19,19 @@
 * ``supervisor`` — replica supervision: health probes, restart with
   exponential backoff + deterministic jitter, bounded budget degrading
   to permanent-dead, re-registration + params catch-up on restart.
+* ``autoscaler`` — the elasticity policy loop (ISSUE 16): target-band
+  occupancy/shed/burn signals with hysteresis + cool-down driving
+  journaled scale-out (spawn -> catch-up -> pre-warm -> join) and
+  drain-in (drain -> wait-for-inflight -> replace -> retire).
+* ``standby``    — the WAL-tailing hot standby (ISSUE 16): read-only
+  incremental replay of the primary's journal, single-writer lease
+  fencing zombie primaries, seconds-scale promotion with tenants
+  served degraded-NOTA (never dropped) during the window.
 """
 
+from induction_network_on_fewrel_tpu.fleet.autoscaler import (
+    FleetAutoscaler,
+)
 from induction_network_on_fewrel_tpu.fleet.control import (
     FleetControl,
     FleetPublishError,
@@ -28,7 +39,12 @@ from induction_network_on_fewrel_tpu.fleet.control import (
 from induction_network_on_fewrel_tpu.fleet.journal import (
     FleetJournal,
     JournalError,
+    JournalLease,
     JournalState,
+    JournalTailer,
+)
+from induction_network_on_fewrel_tpu.fleet.standby import (
+    HotStandby,
 )
 from induction_network_on_fewrel_tpu.fleet.placement import (
     DEAD,
@@ -50,14 +66,18 @@ __all__ = [
     "DEAD",
     "DRAINING",
     "UP",
+    "FleetAutoscaler",
     "FleetControl",
     "FleetJournal",
     "FleetPlacement",
     "FleetPublishError",
     "FleetRouter",
+    "HotStandby",
     "InProcessReplica",
     "JournalError",
+    "JournalLease",
     "JournalState",
+    "JournalTailer",
     "ReplicaHandle",
     "ReplicaSupervisor",
     "placement_score",

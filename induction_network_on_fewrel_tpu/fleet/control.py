@@ -198,6 +198,40 @@ class FleetControl:
         self.router.revive_replica(replica, reason=reason)
         self._journal("replica_revive", replica=replica)
 
+    def retire_replica(self, replica: str) -> None:
+        """Remove a replica from the fleet FOR GOOD (the autoscaler's
+        drain-in endpoint, also an operator op). Refuses while the
+        directory still names it as an owner — drain + ``replace_tenants``
+        first; retiring is the last step, after in-flight work is out."""
+        owners = {e.owner for e in self.router.directory.values()}
+        if replica in owners:
+            raise ValueError(
+                f"replica {replica!r} still owns tenants — drain it and "
+                "run replace_tenants() before retiring"
+            )
+        handle = self.router.replicas.get(replica)
+        self.router.remove_replica(replica)
+        self._journal("replica_retire", replica=replica)
+        if handle is not None:
+            try:
+                handle.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+    def forgive_replica(self, replica: str, supervisor=None) -> None:
+        """Operator escape hatch: re-arm a replica's supervisor restart
+        budget (``ReplicaSupervisor.forgive``), journaled so the audit
+        trail shows WHO un-latched a restart-exhausted replica (the
+        replay itself is neutral — budgets are process-local)."""
+        if supervisor is not None:
+            supervisor.forgive(replica)
+        self._journal("replica_forgive", replica=replica)
+        if self._logger is not None:
+            self._logger.log(
+                self.router.submitted, kind="fleet",
+                event="replica_forgive", replica=replica,
+            )
+
     def replace_tenants(self) -> int:
         """Re-register every displaced tenant (registered owner !=
         current placement) on its new owner, carrying its NOTA threshold

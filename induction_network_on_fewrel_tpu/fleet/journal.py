@@ -53,6 +53,7 @@ from induction_network_on_fewrel_tpu.obs.chaos import chaos_fire
 
 WAL_NAME = "wal.log"
 SNAPSHOT_NAME = "snapshot.json"
+LEASE_NAME = "lease.json"
 
 _HEADER = struct.Struct("<II")   # (payload length, crc32(payload))
 
@@ -78,6 +79,10 @@ KNOWN_OPS = frozenset({
     "replica_add",          # replica, meta (optional address dict)
     "replica_drain",        # replica
     "replica_revive",       # replica
+    "replica_retire",       # replica (drained out of membership for good)
+    "replica_forgive",      # replica (supervisor restart-budget re-arm;
+    #                         an audit record — replay-neutral, because
+    #                         supervisor budgets are process-local)
     "publish_commit",       # params_version, ckpt_dir (nullable)
     "adapt_exhausted",      # tenant, attempts (the permanent latch)
 })
@@ -132,6 +137,10 @@ class JournalState:
             self.replicas[str(rec.get("replica"))] = "draining"
         elif op == "replica_revive":
             self.replicas[str(rec.get("replica"))] = "up"
+        elif op == "replica_retire":
+            self.replicas.pop(str(rec.get("replica")), None)
+        elif op == "replica_forgive":
+            pass   # audit-only: restart budgets are process-local state
         elif op == "publish_commit":
             self.committed = {
                 "params_version": int(rec["params_version"]),
@@ -203,6 +212,8 @@ class FleetJournal:
         #                      "process" died mid-append; reopen to heal
         self.snapshot_seq = 0     # ops folded into snapshot.json
         self._wal_records = 0
+        self._lease_owner = None   # set by acquire_lease/adopt_lease
+        self._lease_epoch = None
         snap = self.dir / SNAPSHOT_NAME
         if snap.exists():
             self.snapshot_seq = int(
@@ -241,6 +252,7 @@ class FleetJournal:
                     "the writing process is 'dead' — reopen the journal "
                     "directory to truncate and recover"
                 )
+            self._check_lease()
             seq = self.seq
             payload = json.dumps(
                 {"op": op, "seq": seq, **fields}, sort_keys=True
@@ -274,6 +286,40 @@ class FleetJournal:
             if self._fh is not None:
                 self._fh.flush()
                 os.fsync(self._fh.fileno())
+
+    # --- single-writer lease ----------------------------------------------
+
+    def acquire_lease(self, owner: str) -> int:
+        """Take (or take over) the journal's single-writer lease. Every
+        acquisition bumps the epoch; from then on every ``append`` checks
+        the lease file and REFUSES when another writer holds a newer
+        epoch — a fenced-off zombie primary cannot split-brain the log."""
+        with self._lock:
+            epoch = JournalLease(self.dir).acquire(owner)
+            self._lease_owner = owner
+            self._lease_epoch = epoch
+            return epoch
+
+    def adopt_lease(self, owner: str, epoch: int) -> None:
+        """Bind to a lease already acquired out-of-band (the standby
+        acquires BEFORE opening its own ``FleetJournal``, so the fence is
+        up during the catch-up replay, not after)."""
+        with self._lock:
+            self._lease_owner = owner
+            self._lease_epoch = int(epoch)
+
+    def _check_lease(self) -> None:
+        if self._lease_epoch is None:
+            return   # unleased journal: single-process mode, no fence
+        held = JournalLease(self.dir).read()
+        if (held.get("epoch") != self._lease_epoch
+                or held.get("owner") != self._lease_owner):
+            raise JournalError(
+                f"journal lease lost: held by "
+                f"{held.get('owner')!r} epoch {held.get('epoch')} "
+                f"(we are {self._lease_owner!r} epoch {self._lease_epoch}) "
+                "— split-brain append refused"
+            )
 
     def _open(self):
         if self._fh is None or self._fh.closed:
@@ -402,3 +448,121 @@ class FleetJournal:
                 tenants=float(len(state.tenants)),
             )
         return state
+
+
+class JournalLease:
+    """The journal directory's single-writer latch: ``lease.json`` holds
+    ``{"owner", "epoch"}``, written by atomic tmp+rename. ``acquire``
+    bumps the epoch, so a standby taking over FENCES the old primary —
+    the zombie's next ``append`` reads a lease it no longer holds and
+    raises instead of split-braining the log. This is a cooperative
+    lease (every writer goes through ``FleetJournal.append``'s check),
+    which is exactly the guarantee a single-host drill can prove."""
+
+    def __init__(self, journal_dir: str | Path):
+        self.path = Path(journal_dir) / LEASE_NAME
+
+    def read(self) -> dict:
+        """The current lease ({"owner": None, "epoch": 0} when unheld)."""
+        if not self.path.exists():
+            return {"owner": None, "epoch": 0}
+        try:
+            d = json.loads(self.path.read_text())
+        except (json.JSONDecodeError, OSError):
+            return {"owner": None, "epoch": 0}
+        return {"owner": d.get("owner"), "epoch": int(d.get("epoch", 0))}
+
+    def acquire(self, owner: str) -> int:
+        """Take the lease as ``owner``; returns the new (bumped) epoch."""
+        epoch = self.read()["epoch"] + 1
+        tmp = self.path.with_suffix(".tmp")
+        with open(tmp, "w") as f:
+            json.dump({"owner": owner, "epoch": epoch}, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        return epoch
+
+
+class JournalTailer:
+    """A READ-ONLY incremental reader of a journal another process owns —
+    the hot standby's view of the primary's WAL. Unlike ``FleetJournal``,
+    the tailer NEVER truncates: a short or CRC-failing tail here is most
+    likely an append in progress by the live primary, so the tailer stops
+    at the last clean frame and retries from there next ``poll``.
+
+    Compaction-aware: when the primary folds the WAL into
+    ``snapshot.json`` (the WAL shrinks under our offset, or the snapshot
+    base advances past ours), the tailer rebases — reload the snapshot,
+    re-read the fresh WAL from byte 0. A compaction racing a single poll
+    can transiently rebase on the pre-compact snapshot; the next poll
+    reads the settled pair and self-heals (``JournalState.apply`` is a
+    pure overwrite-fold, so re-application cannot diverge the state)."""
+
+    def __init__(self, journal_dir: str | Path):
+        self.dir = Path(journal_dir)
+        self.state = JournalState()
+        self._offset = 0         # clean WAL bytes folded into state
+        self._snap_applied = 0   # snapshot base folded into state
+
+    @property
+    def applied(self) -> int:
+        """Total ops folded into the tailed state (mirrors journal.seq)."""
+        return self.state.applied
+
+    def poll(self) -> int:
+        """Fold newly committed ops into the tailed state; returns how
+        many ops the state advanced by this call."""
+        before = self.state.applied
+        snap = None
+        snap_path = self.dir / SNAPSHOT_NAME
+        if snap_path.exists():
+            try:
+                snap = json.loads(snap_path.read_text())
+            except (json.JSONDecodeError, OSError):
+                snap = None   # racing the atomic rename; next poll wins
+        snap_applied = int(snap.get("applied", 0)) if snap else 0
+        wal = self.dir / WAL_NAME
+        wal_size = wal.stat().st_size if wal.exists() else 0
+        if snap_applied > self._snap_applied or wal_size < self._offset:
+            # The primary compacted: rebase on the snapshot, restart the
+            # WAL read from byte 0.
+            self.state = (JournalState.from_dict(snap) if snap
+                          else JournalState())
+            self._snap_applied = snap_applied
+            self._offset = 0
+        records, clean = self._read_from(self._offset)
+        for rec in records:
+            self.state.apply(rec)
+        self._offset = clean
+        return self.state.applied - before
+
+    def _read_from(self, offset: int) -> tuple[list[dict], int]:
+        """Parse complete frames from ``offset``; returns (records, new
+        clean offset). Read-only — a torn/in-progress tail is left for
+        the next poll, never truncated."""
+        path = self.dir / WAL_NAME
+        if not path.exists():
+            return [], offset
+        with open(path, "rb") as f:
+            f.seek(offset)
+            blob = f.read()
+        records: list[dict] = []
+        off = 0
+        clean = 0
+        while off + _HEADER.size <= len(blob):
+            length, crc = _HEADER.unpack_from(blob, off)
+            start, end = off + _HEADER.size, off + _HEADER.size + length
+            if end > len(blob):
+                break
+            payload = blob[start:end]
+            if zlib.crc32(payload) != crc:
+                break
+            try:
+                rec = json.loads(payload)
+            except json.JSONDecodeError:
+                break
+            records.append(rec)
+            off = end
+            clean = off
+        return records, offset + clean

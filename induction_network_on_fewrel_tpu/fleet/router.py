@@ -582,6 +582,23 @@ class FleetRouter:
     def drain_replica(self, replica: str) -> None:
         self.placement.set_state(replica, DRAINING)
 
+    def remove_replica(self, replica: str) -> None:
+        """Retire a replica out of the fleet for good (the autoscaler's
+        drain-in endpoint): drop it from placement and the handle map.
+        The caller owns the safety argument — drained first, tenants
+        moved off (``replace_tenants``), in-flight work waited out."""
+        if replica not in self.replicas:
+            raise KeyError(f"unknown replica {replica!r}")
+        self.placement.remove_replica(replica)
+        with self._lock:
+            self.replicas.pop(replica, None)
+            self.routed.pop(replica, None)
+        if self._logger is not None:
+            self._logger.log(
+                self.submitted, kind="fleet", event="replica_retire",
+                replica=replica, replicas=float(len(self.replicas)),
+            )
+
     def pending_failover(self) -> tuple[str, ...]:
         """Tenants whose registered owner differs from their current
         placement — the set ``control.replace_tenants()`` will move."""

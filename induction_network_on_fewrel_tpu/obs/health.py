@@ -240,6 +240,12 @@ class HealthWatchdog:
                         int(rec.get("served", 0)),
                     )
                     self._check_shed(step, rec)
+            if kind == "scale":
+                # A completed scale decision re-arms the stuck latch:
+                # the next stall is a new incident.
+                if rec.get("event") in ("scale_out", "drain_in"):
+                    self._latched.discard("scale_stuck")
+                self._check_finite(step, rec)
             if kind == "data":
                 self.observe_feed(
                     produced=int(rec.get("produced", 0)),
@@ -432,6 +438,30 @@ class HealthWatchdog:
             ))
         elif action == "replica_recover":
             self._latched.discard(f"replica_dead:{rec.get('replica')}")
+        elif action == "scale_stuck":
+            # Elasticity tier (ISSUE 16): a scale decision (spawn/warm
+            # on scale-out, wait-for-inflight on drain-in) could not
+            # complete within the autoscaler's budget. Once-latched; a
+            # later COMPLETED scale event (kind="scale",
+            # event="scale_out"/"drain_in") re-arms it.
+            if "scale_stuck" in self._latched:
+                return
+            self._latched.add("scale_stuck")
+            self._emit(HealthEvent(
+                event="scale_stuck", severity=CRITICAL, step=step,
+                message=(
+                    f"autoscaler {rec.get('direction')} decision stuck "
+                    f"after {rec.get('waited_s')}s "
+                    f"(budget {rec.get('budget_s')}s): "
+                    f"{rec.get('reason')}"
+                ),
+                data={
+                    k: rec[k] for k in
+                    ("direction", "replica", "reason", "waited_s",
+                     "budget_s")
+                    if k in rec
+                },
+            ))
         elif action == "publish_rollback":
             if "publish_rollback" in self._latched:
                 return
@@ -915,6 +945,12 @@ class SLOEngine:
                     round(frac / obj.budget, 3) if obj.budget > 0 else 0.0
                 )
             return out
+
+    def tenants(self) -> tuple[str, ...]:
+        """Tenants with recorded traffic, sorted — the autoscaler sweeps
+        these for its max-burn pressure signal."""
+        with self._lock:
+            return tuple(sorted(self._windows))
 
     def evaluate(self, now: float | None = None) -> list[HealthEvent]:
         """Sweep every tenant's windows; emit (and return) new events.

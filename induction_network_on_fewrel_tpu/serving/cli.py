@@ -161,6 +161,43 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--journal_compact_every", type=int, default=512,
                    help="auto-fold the WAL into snapshot.json past this "
                         "many records (0 = manual compaction only)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="elasticity (ISSUE 16, fleet modes): arm the "
+                        "SLO-driven autoscaler — occupancy/shed/burn "
+                        "target band with hysteresis + cool-down, "
+                        "journaled scale-out (spawn, catch-up, pre-warm, "
+                        "join) and drain-in (drain, replace, wait-for-"
+                        "inflight, retire). RUNBOOK §21")
+    p.add_argument("--autoscale_min", type=int, default=1,
+                   help="autoscaler floor: never drain below this many "
+                        "replicas")
+    p.add_argument("--autoscale_max", type=int, default=4,
+                   help="autoscaler ceiling: never scale past this many "
+                        "replicas")
+    p.add_argument("--autoscale_interval_s", type=float, default=5.0,
+                   help="seconds between autoscaler policy ticks")
+    p.add_argument("--standby", action="store_true",
+                   help="hot-standby mode (ISSUE 16): instead of "
+                        "serving, TAIL the --journal WAL read-only "
+                        "(applying ops as they commit), then PROMOTE — "
+                        "take the single-writer lease (fencing the old "
+                        "primary), final catch-up replay, rebuild + "
+                        "warm the fleet, and serve. With "
+                        "--control_socket, promotion waits for a "
+                        "{\"op\": \"promote\"} command; without, it "
+                        "happens after the initial catch-up. RUNBOOK §21")
+    p.add_argument("--standby_poll_s", type=float, default=0.5,
+                   help="seconds between standby WAL tail polls")
+    p.add_argument("--control_socket", default=None, metavar="PATH",
+                   help="fleet/standby modes: serve operator commands on "
+                        "this unix socket (JSON lines): drain / forgive "
+                        "/ revive / retire / stats (fleet), status / "
+                        "promote (standby) — journaled like every other "
+                        "control op")
+    p.add_argument("--send", default=None, metavar="JSON",
+                   help="client mode: send one JSON command (e.g. "
+                        "'{\"op\": \"drain\", \"replica\": \"r01\"}') "
+                        "to --control_socket, print the reply, exit")
     p.add_argument("--slo_profile", action="store_true",
                    help="also attempt a jax.profiler trace in the SLO "
                         "auto-capture (default off on this image — a "
@@ -474,6 +511,17 @@ def serve_main(argv=None) -> int:
     if args.adapt and not args.drift:
         parser.error("--adapt needs --drift (the controller subscribes "
                      "to the drift detector's CRITICALs)")
+    if args.send is not None:
+        if not args.control_socket:
+            parser.error("--send needs --control_socket (the server "
+                         "address to talk to)")
+        return _control_send(args.control_socket, args.send)
+    if args.standby and not args.journal:
+        parser.error("--standby needs --journal (the WAL directory "
+                     "to tail)")
+    if args.autoscale and not (args.replicas > 1 or args.router):
+        parser.error("--autoscale needs fleet mode (--router or "
+                     "--replicas > 1)")
     buckets = tuple(int(b) for b in args.buckets.split(","))
 
     # Device selection must happen before any jax backend init — reuse the
@@ -549,6 +597,10 @@ def serve_main(argv=None) -> int:
         if reg is not None:
             reg.install()
             print(f"chaos plan armed: {args.chaos}", file=sys.stderr)
+    if args.standby:
+        return _serve_standby(args, buckets, logger=logger,
+                              watchdog=watchdog, slo=slo, drift=drift,
+                              recorder=recorder, capture=capture)
     if args.replicas > 1 or args.router:
         return _serve_fleet(args, buckets, logger=logger,
                             watchdog=watchdog, slo=slo, drift=drift,
@@ -637,6 +689,189 @@ def serve_main(argv=None) -> int:
             logger.close()
 
 
+def _start_control_server(path: str, handlers: dict, stop_evt):
+    """The operator escape hatch (ISSUE 16 satellite): a unix-socket
+    JSON-lines command server. One request line in, one
+    ``{"ok": bool, ...}`` line out; every mutating handler goes through
+    the journaled ``FleetControl`` ops, so ``drain r01`` from the CLI
+    leaves the same audit trail as the in-process call."""
+    import socket
+    import threading
+
+    if os.path.exists(path):
+        os.unlink(path)
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(path)
+    srv.listen(4)
+    srv.settimeout(0.25)
+
+    def run():
+        try:
+            while not stop_evt.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                with conn:
+                    f = conn.makefile("rwb")
+                    line = f.readline()
+                    if not line:
+                        continue
+                    try:
+                        req = json.loads(line)
+                        fn = handlers.get(req.get("op"))
+                        if fn is None:
+                            resp = {
+                                "ok": False,
+                                "error": (
+                                    f"unknown op {req.get('op')!r} "
+                                    f"(known: {sorted(handlers)})"
+                                ),
+                            }
+                        else:
+                            resp = {"ok": True, "result": fn(req)}
+                    except Exception as e:  # noqa: BLE001 — reported
+                        resp = {"ok": False,
+                                "error": f"{type(e).__name__}: {e}"}
+                    f.write((json.dumps(resp) + "\n").encode())
+                    f.flush()
+        finally:
+            srv.close()
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    t = threading.Thread(target=run, name="fleet-control-socket",
+                         daemon=True)
+    t.start()
+    return t
+
+
+def _control_send(path: str, payload: str) -> int:
+    """``--send`` client: one command to a --control_socket server."""
+    import socket
+
+    req = json.loads(payload)
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.connect(path)
+        s.sendall((json.dumps(req) + "\n").encode())
+        line = s.makefile("rb").readline()
+    print(line.decode().strip())
+    return 0 if json.loads(line).get("ok") else 1
+
+
+def _serve_standby(args, buckets, logger=None, watchdog=None, slo=None,
+                   drift=None, recorder=None, capture=None) -> int:
+    """Hot-standby mode (ISSUE 16): tail the primary's WAL read-only,
+    then promote — lease takeover (fencing the old primary's appends),
+    final catch-up replay, fleet rebuild + warm from the tailed state —
+    and serve. With ``--control_socket`` the tail loop runs until a
+    ``{"op": "promote"}`` command arrives; without one, promotion
+    happens right after the initial catch-up (the scripted/drill
+    spelling). Replica handles are rebuilt in-process from the journaled
+    membership — a multi-host deployment would dial its transport
+    handles here instead; everything downstream is identical."""
+    import threading
+
+    from induction_network_on_fewrel_tpu.fleet import (
+        HotStandby,
+        InProcessReplica,
+    )
+    from induction_network_on_fewrel_tpu.serving.breaker import (
+        CircuitBreaker,
+    )
+
+    standby = HotStandby(args.journal, logger=logger)
+    standby.poll()
+    print(f"standby: tailing {args.journal} — {standby.applied} op(s) "
+          f"applied, {len(standby.tenants())} tenant(s)", file=sys.stderr)
+    promote_evt = threading.Event()
+    stop_evt = threading.Event()
+    if args.control_socket:
+        _start_control_server(args.control_socket, {
+            "status": lambda req: {
+                "applied": standby.applied,
+                "tenants": len(standby.tenants()),
+                "promoted": standby.promoted,
+            },
+            "promote": lambda req: (
+                promote_evt.set(), {"promoting": True}
+            )[1],
+        }, stop_evt)
+        while not promote_evt.wait(args.standby_poll_s):
+            standby.poll()
+
+    def mk_engine():
+        return _build_engine(
+            args, buckets, logger=logger, watchdog=watchdog, slo=slo,
+            drift=drift, breaker=_build_breaker(args),
+        )
+
+    handles = {
+        rid: InProcessReplica(rid, mk_engine())
+        for rid in sorted(standby.state.replicas)
+    }
+    if not handles:
+        print("standby: the journal names no replicas — nothing to "
+              "promote", file=sys.stderr)
+        stop_evt.set()
+        return 1
+    summary = standby.promote(
+        handles,
+        breaker=CircuitBreaker(failure_threshold=3,
+                               open_s=args.breaker_open_s),
+        queue_capacity_per_replica=args.queue_depth,
+        trace_sample=args.trace_sample,
+    )
+    router = standby.router
+    print(f"standby: PROMOTED in {summary['promote_s']:.3f}s — "
+          f"{summary['tenants']} tenant(s), reregistered "
+          f"{summary['reregistered']}, caught up {summary['caught_up']} "
+          f"replica(s) to v{summary['params_version']} "
+          f"(lease epoch {summary['lease_epoch']})", file=sys.stderr)
+    try:
+        if args.input:
+            stream = sys.stdin if args.input == "-" else open(args.input)
+            try:
+                for line in stream:
+                    line = line.strip()
+                    if line:
+                        print(json.dumps(router.classify(
+                            json.loads(line), args.deadline_ms / 1e3,
+                            tenant="default",
+                        )), flush=True)
+            finally:
+                if stream is not sys.stdin:
+                    stream.close()
+        else:
+            entry = router.directory.get("default")
+            if entry is not None and entry.source is not None:
+                ds = entry.source
+                names = list(ds.rel_names)
+                if entry.max_classes is not None:
+                    names = names[: entry.max_classes]
+                k = handles[sorted(handles)[0]].engine.registry.k
+                _demo(
+                    lambda inst: router.submit(
+                        inst, args.deadline_ms / 1e3, tenant="default"
+                    ),
+                    ds, names, k, args.demo_queries, seed=args.seed,
+                )
+        router.emit_stats()
+        print("standby stats: " + json.dumps(router.snapshot()),
+              file=sys.stderr)
+        return 0
+    finally:
+        stop_evt.set()
+        if args.run_dir:
+            _write_prometheus(args.run_dir)
+        router.close()
+        standby.journal.close()
+        if logger is not None:
+            logger.close()
+
+
 def _serve_fleet(args, buckets, logger=None, watchdog=None, slo=None,
                  drift=None, recorder=None, capture=None) -> int:
     """Fleet-mode serving (ISSUE 13): ``--replicas`` in-process engine
@@ -684,8 +919,18 @@ def _serve_fleet(args, buckets, logger=None, watchdog=None, slo=None,
             args.journal, fsync=args.journal_fsync,
             compact_every=args.journal_compact_every, logger=logger,
         )
+        # Single-writer latch (ISSUE 16): hold the lease so a standby's
+        # promotion fences THIS process — a zombie primary's next append
+        # raises instead of split-braining the WAL.
+        epoch = journal.acquire_lease("primary")
+        print(f"fleet: journal lease acquired (epoch {epoch})",
+              file=sys.stderr)
     control = FleetControl(router, journal=journal)
     adapt = None
+    scaler = None
+    import threading
+
+    stop_evt = threading.Event()
     try:
         first = replicas[sorted(replicas)[0]].engine
         recovered_state = None
@@ -722,6 +967,68 @@ def _serve_fleet(args, buckets, logger=None, watchdog=None, slo=None,
         compiled = sum(h.warmup() for h in router.replicas.values())
         print(f"fleet: {n} replica(s), default tenant placed on {owner}, "
               f"{compiled} bucket programs compiled", file=sys.stderr)
+
+        if args.autoscale:
+            from induction_network_on_fewrel_tpu.fleet import (
+                FleetAutoscaler,
+            )
+
+            scaler = FleetAutoscaler(
+                control,
+                lambda rid: InProcessReplica(rid, mk_engine()),
+                slo=slo,
+                min_replicas=args.autoscale_min,
+                max_replicas=args.autoscale_max,
+                logger=logger,
+            )
+
+            def _tick_loop():
+                while not stop_evt.wait(args.autoscale_interval_s):
+                    try:
+                        scaler.tick()
+                    except Exception as e:  # noqa: BLE001 — the loop
+                        # must outlive one bad tick; stuck decisions
+                        # already latch their own CRITICAL.
+                        print(f"autoscaler tick failed: "
+                              f"{type(e).__name__}: {e}",
+                              file=sys.stderr)
+
+            threading.Thread(target=_tick_loop, name="fleet-autoscaler",
+                             daemon=True).start()
+            print(f"autoscaler armed: {args.autoscale_min}.."
+                  f"{args.autoscale_max} replicas, tick every "
+                  f"{args.autoscale_interval_s}s", file=sys.stderr)
+
+        if args.control_socket:
+            def _drain(req):
+                control.drain_replica(req["replica"])
+                return {"replica": req["replica"],
+                        "moved": control.replace_tenants()}
+
+            def _forgive(req):
+                control.forgive_replica(req["replica"])
+                return {"replica": req["replica"]}
+
+            def _revive(req):
+                control.revive_replica(req["replica"],
+                                       reason="operator")
+                return {"replica": req["replica"],
+                        "moved": control.replace_tenants()}
+
+            def _retire(req):
+                control.retire_replica(req["replica"])
+                return {"replica": req["replica"],
+                        "replicas": len(router.replicas)}
+
+            _start_control_server(args.control_socket, {
+                "drain": _drain,
+                "forgive": _forgive,
+                "revive": _revive,
+                "retire": _retire,
+                "stats": lambda req: router.snapshot(),
+            }, stop_evt)
+            print(f"control socket listening on {args.control_socket} "
+                  "(drain/forgive/revive/retire/stats)", file=sys.stderr)
 
         if args.adapt:
             from induction_network_on_fewrel_tpu.config import (
@@ -799,6 +1106,7 @@ def _serve_fleet(args, buckets, logger=None, watchdog=None, slo=None,
               file=sys.stderr)
         return 0
     finally:
+        stop_evt.set()
         if args.run_dir:
             _write_prometheus(args.run_dir)
         if adapt is not None:

@@ -175,6 +175,28 @@ KNOWN_KINDS = frozenset({
     # action="replica_dead"/"replica_recover" next to these.
     # tools/obs_report.py's fleet section splits on replica/event.
     "fleet",
+    # Elasticity telemetry (ISSUE 16, fleet/autoscaler.py +
+    # fleet/standby.py), three record shapes, all scalar/str: (a) one
+    # TICK record per autoscaler policy evaluation (no ``event`` field)
+    # with replicas / live / occupancy (mean batch fill across UP
+    # replicas) / queue_depth (mean) / shed_delta (router door sheds
+    # since the last tick) / burn_fast (max fast-window burn rate
+    # across SLO tenants, 0 when no SLO engine) / pressure + idle (0/1
+    # — this tick's classification) / high_streak + low_streak (the
+    # hysteresis counters) / action (str: none / cooldown / pending /
+    # scale_out / drain_in / at_max / at_min) — the replica-count
+    # timeline obs_report's elasticity section renders; (b) EVENT
+    # records: event="scale_out" (replica, scale_s, warm_compiles,
+    # occupancy / shed_delta / burn_fast at decision time — the trigger
+    # signals), event="drain_in" (replica, drain_s, moved), and
+    # event="promotion" (promote_s, tenants, replicas, applied — the
+    # standby took the front door after catch-up replay); (c) the
+    # standby's TAIL record event="tail" (applied, lag — ops behind the
+    # primary's journal at poll time). A stuck scale decision emits
+    # kind="fault" action="scale_stuck" (direction, reason, waited_s,
+    # budget_s) next to these — once-latched CRITICAL, re-armed by the
+    # next completed scale event.
+    "scale",
     # Self-healing adaptation telemetry (ISSUE 14, obs/adapt.py): one
     # record per controller action, all scalar/str with ``action`` (str),
     # ``tenant`` (str), ``state`` (the machine state after the action),

@@ -142,6 +142,18 @@ BANDS: dict[str, tuple[str, float]] = {
     "recovery.directory_bitwise": ("floor", 1.0),
     "recovery.placement_identical": ("floor", 1.0),
     "recovery.torn_prefix_recovered": ("floor", 1.0),
+    # Elasticity drill (ISSUE 16, ELASTIC_r*.json): scaling must be
+    # free — a scale-out/drain-in cycle drops nothing and recompiles
+    # nothing in steady state, a standby promotion loses no tenant —
+    # plus the pass/promotion floors. Warm/tick/tail counts are
+    # recorded unbanded.
+    "scale.dropped_during_scale": ("zero", 0.0),
+    "scale.dropped_during_promotion": ("zero", 0.0),
+    "scale.tenants_lost": ("zero", 0.0),
+    "scale.steady_recompiles": ("zero", 0.0),
+    "scale.passed": ("floor", 1.0),
+    "scale.promotion_recovered": ("floor", 1.0),
+    "scale.split_brain_refused": ("floor", 1.0),
 }
 
 
@@ -366,6 +378,39 @@ def _recovery_points(points: dict, path: str, data: dict) -> int:
     return sum(len(v) for v in points.values()) - before
 
 
+def _elastic_points(points: dict, path: str, data: dict) -> int:
+    """ELASTIC_r*.json (tools/loadgen.py --elastic_drill): the
+    elasticity drill — zero-bands (drops through scale events and the
+    promotion window, tenant loss, steady recompiles), the pass /
+    promotion-bitwise / split-brain floors, and recorded (unbanded)
+    warm-compile, move, and tail counts."""
+    rnd, src = _round_of(path), os.path.basename(path)
+    before = sum(len(v) for v in points.values())
+    zero = data.get("zero_bands") or {}
+    for key in ("dropped_during_scale", "dropped_during_promotion",
+                "tenants_lost", "steady_recompiles"):
+        _point(points, f"scale.{key}", rnd, src, zero.get(key))
+    _point(points, "scale.passed", rnd, src,
+           1.0 if data.get("passed") else 0.0)
+    so = data.get("scale_out") or {}
+    _point(points, "scale.warm_compiles", rnd, src,
+           so.get("warm_compiles"))
+    _point(points, "scale.moved", rnd, src, so.get("moved"))
+    di = data.get("drain_in") or {}
+    _point(points, "scale.drain_inflight", rnd, src,
+           di.get("inflight_at_drain"))
+    pr = data.get("promotion") or {}
+    _point(points, "scale.promotion_recovered", rnd, src,
+           1.0 if (pr.get("directory_bitwise")
+                   and pr.get("placement_identical")
+                   and pr.get("tenants_lost") == 0) else 0.0)
+    _point(points, "scale.split_brain_refused", rnd, src,
+           1.0 if pr.get("split_brain_refused") else 0.0)
+    _point(points, "scale.degraded_during_promotion", rnd, src,
+           pr.get("degraded_during_promotion"))
+    return sum(len(v) for v in points.values()) - before
+
+
 _EXTRACTORS = (
     ("BENCH_r*.json", _bench_points),
     ("ROOFLINE_r*.json", _roofline_points),
@@ -375,6 +420,7 @@ _EXTRACTORS = (
     ("FLEET_r*.json", _fleet_points),
     ("ADAPT_r*.json", _adapt_points),
     ("RECOVERY_r*.json", _recovery_points),
+    ("ELASTIC_r*.json", _elastic_points),
 )
 
 

@@ -200,6 +200,21 @@ def parse_args(argv=None):
                         "everything before it")
     p.add_argument("--recovery_artifact", default=None, metavar="PATH",
                    help="write the RECOVERY_r*.json drill artifact here")
+    p.add_argument("--elastic_drill", action="store_true",
+                   help="elasticity drill (ISSUE 16), standalone mode on "
+                        "its own miniature journaled fleet: ramp -> the "
+                        "autoscaler scales out (spawn, journaled catch-up, "
+                        "pre-warm BEFORE traffic — zero recompiles through "
+                        "the scale event); trough -> drain-in (drain, "
+                        "wait-for-inflight, replace, retire — nothing "
+                        "dropped); second ramp -> router kill-9 "
+                        "mid-decision -> the WAL-tailing hot standby "
+                        "promotes (lease fences the zombie primary, final "
+                        "catch-up replay, directory BITWISE, tenants "
+                        "served degraded-NOTA during the window, never "
+                        "dropped)")
+    p.add_argument("--elastic_artifact", default=None, metavar="PATH",
+                   help="write the ELASTIC_r*.json drill artifact here")
     p.add_argument("--slo_profile", action="store_true",
                    help="also attempt a jax.profiler trace in the SLO "
                         "auto-capture (default off: on this image a "
@@ -1903,6 +1918,43 @@ def run_fleet_soak(args, ckpt, logger, recorder, capture) -> dict:
                 pub["error"] = repr(e)
             pub["publish_s"] = round(time.monotonic() - p0, 4)
 
+        # Per-window, per-replica occupancy/shed time series (ISSUE 16
+        # satellite): the autoscaler A/B and the elastic-drill verdict
+        # need the TRAJECTORY through the load, not just endpoint
+        # aggregates — the sampler rides the traffic phase on a side
+        # thread and lands in the artifact.
+        ts_windows: list = []
+        ts_window_s = max(args.duration / 8.0, 0.25)
+        ts_stop = threading.Event()
+
+        def _sample_timeseries():
+            w = 0
+            last_shed = router.snapshot()["shed"]
+            while not ts_stop.wait(ts_window_s):
+                snap = router.snapshot()
+                row = {
+                    "window": w,
+                    "t_s": round((w + 1) * ts_window_s, 3),
+                    "shed_delta": snap["shed"] - last_shed,
+                    "inflight": snap["inflight"],
+                    "replicas": {},
+                }
+                last_shed = snap["shed"]
+                for rid in sorted(router.replicas):
+                    try:
+                        s = router.replicas[rid].stats_snapshot()
+                    except Exception:  # noqa: BLE001 — dead mid-drill
+                        continue
+                    row["replicas"][rid] = {
+                        "occupancy": s["batch_occupancy"],
+                        "queue_depth": s["queue_depth"],
+                        "served": s["served"],
+                    }
+                ts_windows.append(row)
+                w += 1
+
+        sampler = threading.Thread(target=_sample_timeseries, daemon=True)
+        sampler.start()
         timer = threading.Timer(max(args.duration / 2, 0.5), _publish)
         timer.start()
         traffic = _run_fleet_closed(
@@ -1910,6 +1962,11 @@ def run_fleet_soak(args, ckpt, logger, recorder, capture) -> dict:
             args.seed,
         )
         timer.join(timeout=120.0)
+        ts_stop.set()
+        sampler.join(timeout=10.0)
+        out["timeseries"] = {
+            "window_s": ts_window_s, "windows": ts_windows,
+        }
         wall = traffic.pop("wall")
         out["traffic"] = traffic
         per_replica = {}
@@ -2639,6 +2696,406 @@ def check_recovery_drill(out: dict) -> bool:
     )
 
 
+def elastic_tier1_drill(seed: int = 0, logger=None) -> dict:
+    """The ISSUE 16 elasticity drill, miniature + deterministic (the
+    committed ELASTIC artifact IS the tier-1 replay): one journaled
+    single-replica fleet with a hot standby tailing the WAL, then the
+    full diurnal cycle end to end.
+
+    * **Ramp -> scale-out**: two consecutive pressure readings on the
+      autoscaler's injected clock (the SENSOR is scripted, like chaos
+      injection; the scale MECHANICS are real) spawn a fresh replica,
+      catch it up to the journaled committed params_version, pre-warm
+      exactly the tenants the rendezvous will hand it, and only then
+      join placement — traffic through the scale event drops nothing
+      and the newcomer's first queries hit compiled programs (zero
+      steady recompiles THROUGH the scale event).
+    * **Trough -> drain-in**: idle readings drain the LIFO victim with
+      requests still queued on it — the policy waits for an EMPTY
+      queue before ``replace_tenants`` moves the registrations and
+      ``replica_retire`` removes it, so every in-flight future
+      resolves with a real verdict (drain-in never drops).
+    * **Second ramp -> router kill-9 -> standby promotion**: the
+      primary router object is thrown away mid-ramp; the standby
+      serves known tenants degraded-NOTA (never dropped) until
+      ``promote()`` — lease first (the zombie primary's next journal
+      append raises instead of split-braining the log), final
+      catch-up replay, then a recover() that rebuilds the directory
+      BITWISE with identical placement and zero tenants lost.
+    """
+    import jax
+
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.data import (
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.data.tokenizer import GloveTokenizer
+    from induction_network_on_fewrel_tpu.fleet import (
+        FleetAutoscaler,
+        FleetControl,
+        FleetJournal,
+        FleetRouter,
+        HotStandby,
+        InProcessReplica,
+        JournalError,
+    )
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.serving.breaker import CircuitBreaker
+    from induction_network_on_fewrel_tpu.serving.buckets import zero_batch
+    from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
+    from induction_network_on_fewrel_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+    from induction_network_on_fewrel_tpu.train.steps import init_state
+    from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+    T = 12
+    cfg = ExperimentConfig(
+        model="induction", encoder="cnn", hidden_size=16,
+        vocab_size=122, word_dim=8, pos_dim=2, max_length=16,
+        induction_dim=8, ntn_slices=4, routing_iters=2,
+        n=3, train_n=3, k=2, q=2, device="cpu", seed=seed,
+    )
+    vocab = make_synthetic_glove(
+        vocab_size=cfg.vocab_size - 2, word_dim=cfg.word_dim
+    )
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    model = build_model(cfg, glove_init=vocab.vectors)
+    params = model.init(
+        jax.random.key(seed),
+        zero_batch(cfg.max_length, (1, cfg.n, cfg.k)),
+        zero_batch(cfg.max_length, (1, 2)),
+    )
+    own_logger = logger if logger is not None else MetricsLogger(
+        None, quiet=True
+    )
+    tmp = tempfile.TemporaryDirectory(prefix="elastic_drill_")
+    out: dict = {"replicas_start": 1, "tenants": T, "seed": seed}
+    routers: list = []
+    journals: list = []
+    handles: dict = {}
+    standby = None
+    try:
+        ckpt = os.path.join(tmp.name, "ckpt")
+        state0 = init_state(
+            model, cfg,
+            zero_batch(cfg.max_length, (1, cfg.n, cfg.k)),
+            zero_batch(cfg.max_length, (1, cfg.total_q)),
+            rng=jax.random.key(seed),
+        )
+        mngr = CheckpointManager(ckpt, cfg, stage="off")
+        try:
+            mngr.save(0, state0, val_accuracy=0.0)
+            mngr.wait()
+        finally:
+            mngr.close()
+
+        jdir = os.path.join(tmp.name, "journal")
+        journal = FleetJournal(jdir, fsync="always", logger=own_logger)
+        journals.append(journal)
+        journal.acquire_lease("primary")   # the single-writer latch
+
+        def mk():
+            return InferenceEngine(
+                model, params, cfg, tok, k=cfg.k, buckets=(1, 2, 4),
+                logger=own_logger,
+            )
+
+        def spawn(rid):
+            handles[rid] = InProcessReplica(rid, mk())
+            return handles[rid]
+
+        handles["r00"] = InProcessReplica("r00", mk())
+        router = FleetRouter(
+            {"r00": handles["r00"]}, logger=own_logger,
+            breaker=CircuitBreaker(failure_threshold=3, open_s=1.0),
+            queue_capacity_per_replica=64,
+        )
+        routers.append(router)
+        control = FleetControl(router, journal=journal)
+        datasets = [
+            make_synthetic_fewrel(
+                num_relations=cfg.n, instances_per_relation=cfg.k + 6,
+                vocab_size=cfg.vocab_size - 2, seed=seed + 101 * d,
+            )
+            for d in range(4)
+        ]
+        names = [f"t{i:02d}" for i in range(T)]
+        for i, tenant in enumerate(names):
+            control.register_tenant(tenant, datasets[i % 4])
+            if i % 3 == 0:
+                control.set_nota_threshold(tenant, 0.25 + 0.05 * (i % 4))
+        handles["r00"].warmup()
+        pools = {
+            t: [
+                inst for rel in datasets[i % 4].rel_names
+                for inst in datasets[i % 4].instances[rel][cfg.k:]
+            ]
+            for i, t in enumerate(names)
+        }
+        control.publish_checkpoint(ckpt)   # v1 — what catch-up re-drives
+        control.quarantine_tenant(names[1], reason="drill: operator hold")
+
+        # The hot standby arms BEFORE anything interesting happens and
+        # tails the same WAL from here on.
+        standby = HotStandby(jdir, owner="standby", logger=own_logger)
+        standby.poll()
+
+        clockd = {"t": 0.0}
+        scaler = FleetAutoscaler(
+            control, spawn, min_replicas=1, max_replicas=2,
+            high_occupancy=0.75, low_occupancy=0.20,
+            high_windows=2, low_windows=2,
+            cooldown_s=5.0, scale_budget_s=30.0,
+            clock=lambda: clockd["t"], logger=own_logger,
+        )
+
+        def serve_all(front) -> tuple:
+            served = degraded = errors = 0
+            for t in names:
+                try:
+                    v = front.classify(pools[t][0], 10.0, tenant=t)
+                    served += 1
+                    degraded += bool(v.get("degraded"))
+                except Exception:  # noqa: BLE001 — counted: the zero-band
+                    errors += 1
+            return served, degraded, errors
+
+        # --- PHASE A: ramp -> scale-out -------------------------------
+        _, deg_a0, err_a0 = serve_all(router)
+        hot = {"occupancy": 0.92, "shed_delta": 3}
+        actions_a = [scaler.tick(dict(hot))["action"]]
+        clockd["t"] = 1.0
+        actions_a.append(scaler.tick(dict(hot))["action"])
+        ev = dict(scaler.last_event or {})
+        _, deg_a1, err_a1 = serve_all(router)
+        _, _, err_a2 = serve_all(router)   # steady pass: compiled programs
+        versions = {
+            rid: h.params_version for rid, h in router.replicas.items()
+        }
+        out["scale_out"] = {
+            "actions": actions_a,
+            "ticks_to_scale": len(actions_a),
+            "replica": ev.get("replica"),
+            "warm_compiles": ev.get("warm_compiles", 0),
+            "moved": ev.get("moved", 0),
+            "replicas_after": len(router.replicas),
+            "params_version_uniform": len(set(versions.values())) == 1,
+            "params_version": max(versions.values()),
+            "quarantine_held": deg_a0 == 1 and deg_a1 == 1,
+            "errors": err_a0 + err_a1 + err_a2,
+        }
+        tail_a = standby.poll()
+
+        # --- PHASE B: trough -> drain-in (in-flight pinned) -----------
+        victim = sorted(router.replicas)[-1]
+        owned = [t for t, e in router.directory.items()
+                 if e.owner == victim and t != names[1]]
+        inflight = [
+            router.submit(pools[t][1], 10.0, tenant=t) for t in owned[:4]
+        ]
+        clockd["t"] = 10.0   # past the scale-out cool-down
+        actions_b = []
+        for _ in range(60):
+            actions_b.append(scaler.tick({"occupancy": 0.02})["action"])
+            clockd["t"] += 1.0
+            if actions_b[-1] == "drain_in":
+                break
+            if actions_b[-1] == "pending":
+                time.sleep(0.05)   # real queue draining on the victim
+        ev2 = dict(scaler.last_event or {})
+        inflight_drain_ok = all(
+            "label" in f.result(timeout=30.0) for f in inflight
+        )
+        _, deg_b, err_b = serve_all(router)
+        out["drain_in"] = {
+            "replica": ev2.get("replica"),
+            "victim_matches": ev2.get("replica") == victim,
+            "inflight_at_drain": len(inflight),
+            "inflight_survived": inflight_drain_ok,
+            "moved": ev2.get("moved", 0),
+            "replicas_after": len(router.replicas),
+            "tenants_intact": len(router.directory) == T,
+            "drained": actions_b[-1] == "drain_in",
+            "errors": err_b,
+        }
+        tail_b = standby.poll()
+
+        # --- PHASE C: second ramp -> kill-9 -> promotion --------------
+        clockd["t"] += 10.0   # past the drain-in cool-down
+        scaler.tick(dict(hot))
+        clockd["t"] += 1.0
+        actions_c = scaler.tick(dict(hot))["action"]
+        ev3 = dict(scaler.last_event or {})
+        _, _, err_c = serve_all(router)
+        dir_before = router.directory_view()
+        placement_before = router.placement.owners(names)
+        inflight2 = [
+            router.submit(pools[t][1], 10.0, tenant=t)
+            for t in names[2:8] if t != names[1]
+        ]
+        # Kill-9: the router object (and its breaker) is GONE. The
+        # replica engines are separate "processes" and keep working the
+        # queues they own; the zombie control plane object survives to
+        # prove the lease fence below.
+        zombie_journal = journal
+        routers.remove(router)
+        del router, control
+
+        # The promotion window: known tenants get degraded NOTA in
+        # microseconds — served, never dropped; unknown tenants are
+        # refused loudly.
+        window_deg = 0
+        for t in names[:3]:
+            v = standby.classify(pools[t][0], tenant=t)
+            window_deg += bool(v.get("degraded") and v.get("failover"))
+        try:
+            standby.classify(pools[names[0]][0], tenant="t99")
+            unknown_refused = False
+        except ValueError:
+            unknown_refused = True
+
+        # The standby has NOT polled since before the second scale-out:
+        # r02's replica_add + tenant moves are exactly what promote()'s
+        # final catch-up replay must fold (final_tail_ops >= 1 below).
+        live_handles = {
+            rid: h for rid, h in handles.items() if rid != victim
+        }
+        promo = standby.promote(
+            live_handles,
+            breaker=CircuitBreaker(failure_threshold=3, open_s=1.0),
+            queue_capacity_per_replica=64,
+        )
+        routers.append(standby.router)
+        journals.append(standby.journal)
+        dir_after = standby.router.directory_view()
+        inflight_kill_ok = all(
+            "label" in f.result(timeout=30.0) for f in inflight2
+        )
+        _, deg_p, err_p = serve_all(standby)
+
+        # The zombie primary tries to append behind the new leader's
+        # back: the lease check must refuse (split-brain fence).
+        try:
+            zombie_journal.append(
+                "tenant_threshold", tenant=names[3], threshold=0.4
+            )
+            split_brain_refused = False
+        except JournalError:
+            split_brain_refused = True
+        # ... while the PROMOTED writer's journaled ops land fine.
+        control3 = FleetControl(
+            standby.router, journal=standby.journal, logger=own_logger,
+        )
+        control3.set_nota_threshold(names[2], 0.45)
+        promoted_writer_ok = (
+            standby.journal.materialize()
+            .tenants[names[2]]["nota_threshold"] == 0.45
+        )
+
+        out["promotion"] = {
+            "scale_out2_replica": ev3.get("replica"),
+            "second_ramp_action": actions_c,
+            "replicas_at_kill": len(live_handles),
+            "directory_bitwise": dir_after == dir_before,
+            "placement_identical":
+                standby.router.placement.owners(names) == placement_before,
+            "tenants_lost": T - len(standby.router.directory),
+            "degraded_during_promotion": window_deg,
+            "unknown_tenant_refused": unknown_refused,
+            "inflight_at_kill": len(inflight2),
+            "inflight_survived": inflight_kill_ok,
+            "promote_s": round(promo["promote_s"], 4),
+            "final_tail_ops": promo["final_tail_ops"],
+            "applied": promo["applied"],
+            "lease_epoch": promo["lease_epoch"],
+            "split_brain_refused": split_brain_refused,
+            "promoted_writer_ok": promoted_writer_ok,
+            "quarantine_held": deg_p == 1,
+            "errors": err_p + err_c,
+        }
+        out["standby"] = {
+            "tail_ops_scale": tail_a,
+            "tail_ops_drain": tail_b,
+            "applied": standby.applied,
+        }
+        steady = sum(
+            h.stats_snapshot()["steady_recompiles"]
+            for h in handles.values()
+        )
+        out["zero_bands"] = {
+            "dropped_during_scale":
+                out["scale_out"]["errors"] + out["drain_in"]["errors"],
+            "dropped_during_promotion":
+                out["promotion"]["errors"]
+                + (0 if inflight_kill_ok else len(inflight2)),
+            "tenants_lost": out["promotion"]["tenants_lost"],
+            "steady_recompiles": steady,
+        }
+        out["passed"] = check_elastic_drill(out)
+        return out
+    finally:
+        for r in routers:
+            r.close()
+        for j in journals:
+            j.close()
+        for h in handles.values():
+            try:
+                h.close()
+            except Exception:  # noqa: BLE001 — already closed is fine
+                pass
+        if logger is None:
+            own_logger.close()
+        tmp.cleanup()
+
+
+def check_elastic_drill(out: dict) -> bool:
+    """The drill's acceptance: hysteresis-gated scale-out with the
+    newcomer caught up + pre-warmed BEFORE traffic, drain-in that
+    retires only after the queue empties (in-flight pinned), standby
+    promotion rebuilding the directory bitwise with the zombie primary
+    fenced — and every elasticity zero-band at zero."""
+    so = out.get("scale_out", {})
+    di = out.get("drain_in", {})
+    pr = out.get("promotion", {})
+    sb = out.get("standby", {})
+    zb = out.get("zero_bands", {})
+    return bool(
+        so.get("actions") == ["none", "scale_out"]
+        and so.get("replicas_after") == 2
+        and so.get("warm_compiles", 0) >= 1
+        and so.get("moved", 0) >= 1
+        and so.get("params_version_uniform")
+        and so.get("params_version") == 1
+        and so.get("quarantine_held")
+        and di.get("victim_matches")
+        and di.get("drained")
+        and di.get("replicas_after") == 1
+        and di.get("tenants_intact")
+        and di.get("inflight_at_drain", 0) >= 1
+        and di.get("inflight_survived")
+        and pr.get("second_ramp_action") == "scale_out"
+        and pr.get("replicas_at_kill") == 2
+        and pr.get("directory_bitwise")
+        and pr.get("placement_identical")
+        and pr.get("tenants_lost") == 0
+        and pr.get("degraded_during_promotion", 0) >= 1
+        and pr.get("unknown_tenant_refused")
+        and pr.get("inflight_survived")
+        and pr.get("final_tail_ops", 0) >= 1
+        and pr.get("split_brain_refused")
+        and pr.get("promoted_writer_ok")
+        and pr.get("quarantine_held")
+        and sb.get("tail_ops_scale", 0) >= 1
+        and sb.get("tail_ops_drain", 0) >= 1
+        and zb.get("dropped_during_scale") == 0
+        and zb.get("dropped_during_promotion") == 0
+        and zb.get("tenants_lost") == 0
+        and zb.get("steady_recompiles") == 0
+    )
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     import numpy as np
@@ -2659,10 +3116,11 @@ def main(argv=None) -> int:
 
     tmp = None
     ckpt = args.ckpt
-    if ckpt is None and not (args.adapt_drill or args.recovery_drill):
-        # --adapt_drill and --recovery_drill build their own miniature
-        # worlds (the default synthetic checkpoint would be dead weight
-        # — and one more orbax world in the process for no reason).
+    if ckpt is None and not (args.adapt_drill or args.recovery_drill
+                             or args.elastic_drill):
+        # --adapt_drill / --recovery_drill / --elastic_drill build
+        # their own miniature worlds (the default synthetic checkpoint
+        # would be dead weight — one more orbax world for no reason).
         tmp = tempfile.TemporaryDirectory(prefix="loadgen_")
         print("building synthetic-data checkpoint...", file=sys.stderr)
         ckpt = make_synthetic_checkpoint(args, tmp.name)
@@ -2800,6 +3258,57 @@ def main(argv=None) -> int:
                 with open(args.recovery_artifact, "w") as fh:
                     json.dump(report, fh, indent=1)
                 print(f"wrote {args.recovery_artifact}", file=sys.stderr)
+            if args.run_dir:
+                print(f"telemetry in {args.run_dir} — render with "
+                      f"'python tools/obs_report.py {args.run_dir}'",
+                      file=sys.stderr)
+            return rc
+        if args.elastic_drill:
+            # Standalone mode (like --fleet): the elasticity tier is
+            # the system under test, on its own miniature journaled
+            # fleet + hot standby — the scheduler arms are skipped.
+            drill = elastic_tier1_drill(seed=args.seed, logger=logger)
+            so, di, pr = (drill["scale_out"], drill["drain_in"],
+                          drill["promotion"])
+            print(f"[elastic drill/scale-out] replica={so['replica']} "
+                  f"ticks={so['ticks_to_scale']} "
+                  f"warm_compiles={so['warm_compiles']} "
+                  f"moved={so['moved']} "
+                  f"uniform=v{so['params_version']} "
+                  f"errors={so['errors']}")
+            print(f"[elastic drill/drain-in] replica={di['replica']} "
+                  f"inflight={di['inflight_at_drain']} "
+                  f"survived={di['inflight_survived']} "
+                  f"moved={di['moved']} "
+                  f"tenants_intact={di['tenants_intact']} "
+                  f"errors={di['errors']}")
+            print(f"[elastic drill/promotion] "
+                  f"bitwise={pr['directory_bitwise']} "
+                  f"placement={pr['placement_identical']} "
+                  f"lost={pr['tenants_lost']} "
+                  f"degraded_window={pr['degraded_during_promotion']} "
+                  f"tail_ops={pr['final_tail_ops']} "
+                  f"split_brain_refused={pr['split_brain_refused']} "
+                  f"promote_s={pr['promote_s']} "
+                  f"errors={pr['errors']}")
+            if not drill["passed"]:
+                print("FAIL[elastic drill]: elasticity invariants did "
+                      "not hold", file=sys.stderr)
+                rc = 1
+            report = {
+                "round": 1,
+                "generated_by": "tools/loadgen.py --elastic_drill",
+                **drill,
+            }
+            print(json.dumps({
+                k: report[k] for k in
+                ("replicas_start", "tenants", "zero_bands", "passed")
+                if k in report
+            }))
+            if args.elastic_artifact:
+                with open(args.elastic_artifact, "w") as fh:
+                    json.dump(report, fh, indent=1)
+                print(f"wrote {args.elastic_artifact}", file=sys.stderr)
             if args.run_dir:
                 print(f"telemetry in {args.run_dir} — render with "
                       f"'python tools/obs_report.py {args.run_dir}'",

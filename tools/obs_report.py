@@ -930,6 +930,68 @@ def recovery_summary(recs: list[dict]) -> dict | None:
     return out
 
 
+def elasticity_summary(recs: list[dict]) -> dict | None:
+    """Elasticity section (ISSUE 16, kind="scale"): the autoscaler's
+    tick timeline (replica count over pressure/idle classifications),
+    completed scale decisions with the trigger signals that justified
+    them, standby tail progress, and promotions — next to the fleet
+    section's router ledger. ``action="scale_stuck"`` faults land in
+    the faults/health sections; this is the decision ledger."""
+    scale = [r for r in recs if r.get("kind") == "scale"]
+    if not scale:
+        return None
+    ticks = [r for r in scale if "event" not in r]
+    outs = [r for r in scale if r.get("event") == "scale_out"]
+    drains = [r for r in scale if r.get("event") == "drain_in"]
+    tails = [r for r in scale if r.get("event") == "tail"]
+    promos = [r for r in scale if r.get("event") == "promotion"]
+    stuck = [r for r in recs if r.get("kind") == "fault"
+             and r.get("action") == "scale_stuck"]
+    out: dict = {"ticks": len(ticks)}
+    if ticks:
+        counts = [int(r.get("replicas", 0)) for r in ticks]
+        out["replicas"] = (
+            f"{counts[-1]} now (min {min(counts)}, max {max(counts)} "
+            f"over {len(ticks)} ticks)"
+        )
+        out["pressure_ticks"] = sum(
+            1 for r in ticks if r.get("pressure") == 1.0
+        )
+        out["idle_ticks"] = sum(1 for r in ticks if r.get("idle") == 1.0)
+    if outs or drains:
+        out["decisions"] = [
+            f"{r['event']}: {r.get('replica')} "
+            + (f"warm={int(r.get('warm_compiles', 0))} " if
+               r.get("event") == "scale_out" else "")
+            + f"moved={int(r.get('moved', 0))} "
+            f"-> {int(r.get('replicas', 0))} replicas"
+            for r in (outs + drains)[-6:]
+        ]
+    if tails:
+        out["standby_tail"] = (
+            f"{len(tails)} polls with progress, "
+            f"{int(tails[-1].get('applied', 0))} ops applied"
+        )
+    if promos:
+        last = promos[-1]
+        out["promotions"] = len(promos)
+        out["last_promotion"] = (
+            f"{last.get('promote_s')}s, "
+            f"{int(last.get('tenants', 0))} tenants over "
+            f"{int(last.get('replicas', 0))} replicas, "
+            f"lease epoch {int(last.get('lease_epoch', 0))}, "
+            f"{int(last.get('final_tail_ops', 0))} final tail ops"
+        )
+    if stuck:
+        out["scale_stuck"] = [
+            f"{r.get('direction')} {r.get('replica') or '?'}: "
+            f"{r.get('reason')} (waited {r.get('waited_s')}s "
+            f"of {r.get('budget_s')}s budget)"
+            for r in stuck[-3:]
+        ]
+    return out
+
+
 def health_summary(recs: list[dict]) -> dict:
     events = [r for r in recs if r.get("kind") == "health"]
     by_event: dict[str, int] = {}
@@ -1059,8 +1121,8 @@ def render(report: dict) -> str:
     for e in errors[:10]:
         lines.append(f"  ! {e}")
     for section in ("train", "mfu", "eval", "perf", "compile", "serve",
-                    "fleet", "adapt", "faults", "recovery", "traces",
-                    "slo", "quality", "scenarios", "ckpt",
+                    "fleet", "elasticity", "adapt", "faults", "recovery",
+                    "traces", "slo", "quality", "scenarios", "ckpt",
                     "input_pipeline", "comms", "roofline", "health",
                     "flight_recorder", "overhead"):
         body = report.get(section)
@@ -1128,6 +1190,7 @@ def main(argv=None) -> int:
         "compile": compile_summary(recs),
         "serve": serve_summary(recs),
         "fleet": fleet_summary(recs),
+        "elasticity": elasticity_summary(recs),
         "adapt": adapt_summary(recs),
         "faults": fault_summary(recs),
         "recovery": recovery_summary(recs),
