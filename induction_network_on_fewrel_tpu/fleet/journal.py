@@ -278,7 +278,20 @@ class FleetJournal:
             self._wal_records += 1
             if self.compact_every and self._wal_records >= self.compact_every:
                 self._compact_locked()
-            return seq
+        # One kind="fleet" event="journal_op" record per append (ISSUE
+        # 17): the WAL payload itself carries NO timestamp (replay is
+        # deterministic by contract, above), so THIS record is where a
+        # control-plane decision acquires its wall-clock position on
+        # the fleet timeline — tools/fleet_report.py orders journal ops
+        # by these records and cross-checks op/seq against the replayed
+        # WAL. Emitted outside the journal lock: the logger has its own
+        # lock, and a slow metrics disk must not serialize appends.
+        if self._logger is not None:
+            self._logger.log(
+                seq, kind="fleet", event="journal_op", op=op,
+                seq=float(seq),
+            )
+        return seq
 
     def sync(self) -> None:
         """Force an fsync regardless of policy (operator barrier)."""
@@ -536,6 +549,16 @@ class JournalTailer:
             self.state.apply(rec)
         self._offset = clean
         return self.state.applied - before
+
+    def records(self) -> list[dict]:
+        """The WAL's clean-frame records from byte 0, read-only — no
+        state fold, no truncation. tools/fleet_report.py's replay
+        source: each record carries ``op`` and ``seq``, cross-checked
+        against the router's ``kind="fleet"`` ``event="journal_op"``
+        telemetry (ISSUE 17). Ops folded into a snapshot are NOT here;
+        the snapshot's ``applied`` count says how many seqs precede the
+        WAL."""
+        return self._read_from(0)[0]
 
     def _read_from(self, offset: int) -> tuple[list[dict], int]:
         """Parse complete frames from ``offset``; returns (records, new

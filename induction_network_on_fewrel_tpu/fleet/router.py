@@ -37,6 +37,11 @@ str field) restating that replica's serving counters, and event records
 for control-plane actions. Replica-death containment also emits
 ``kind="fault"`` ``action="replica_dead"`` records, which the health
 watchdog latches as once-per-replica CRITICALs (re-armed by recovery).
+ISSUE 17 added ``kind="hop"`` records — one per SAMPLED routed request
+with router-side segments (route/queue/wire/remote/respond) tiling the
+fleet-level latency exactly (see ``_emit_hop``) — and the labeled-gauge
+fleet rollup (``bind_registry``): per-replica qps/occupancy/percentile/
+breaker gauges in one metrics.prom scrape.
 """
 
 from __future__ import annotations
@@ -112,6 +117,15 @@ class ReplicaHandle:
         peer is gone or wedged; an in-process replica is alive by
         construction."""
         return True
+
+    @property
+    def clock_offset_s(self) -> float:
+        """Estimated (replica clock − router clock) in seconds — 0.0
+        for an in-process replica (one clock, by construction). The
+        socket transport estimates it per connection via the NTP-style
+        handshake (fleet/transport.ClockSync, ISSUE 17); the router
+        stamps it on ``kind="hop"`` records as ``offset_ms``."""
+        return 0.0
 
     @property
     def params_version(self) -> int:
@@ -281,6 +295,15 @@ class FleetRouter:
         self.replaced = 0             # tenants re-registered after a
         #                               membership/health change (churn)
         self._emit_step = 0
+        # Fleet rollup state (ISSUE 17): per-replica (time, served) at
+        # the last emit, for the qps column; registry families when
+        # bind_registry() was called (default unbound — no new work on
+        # the emit path).
+        self._t0 = time.monotonic()
+        self._prev_emit: dict[str, tuple[float, float]] = {}
+        self._families: dict[str, object] = {}
+        self._bound_registry = None
+        self._bound_fns: list[tuple[str, object]] = []
 
     # --- capacity / fairness ----------------------------------------------
 
@@ -360,16 +383,30 @@ class FleetRouter:
                     return self._degraded_future(tenant)
             trace = self._tracer.maybe_trace()
             handle = self.replicas[target]
+            hop = None
             try:
                 if trace is not None:
+                    # Hop tiling stamps (ISSUE 17): t0 at mint, t1 once
+                    # the fleet/route span is open (route_ms = span +
+                    # placement bookkeeping), t2 once handle.submit
+                    # returned (queue_ms = local enqueue: the socket
+                    # transport's pool hand-off or the engine's
+                    # admission). The done callback adds t3/t4 and
+                    # splits t3−t2 into wire_ms + remote_ms using the
+                    # replica-reported total (_emit_hop). Stamps exist
+                    # ONLY on the sampled path — rate 0 stays
+                    # allocation-free.
+                    t0 = time.monotonic()
                     tracker = get_tracker()
                     with tracker.trace(trace):
                         with tracker.span("fleet/route", xplane=False,
                                           tenant=tenant, replica=target):
+                            t1 = time.monotonic()
                             fut = handle.submit(
                                 instance, deadline_s, tenant=tenant,
                                 trace=trace,
                             )
+                    hop = (trace, t0, t1, time.monotonic())
                 else:
                     fut = handle.submit(instance, deadline_s, tenant=tenant)
             except Saturated:
@@ -403,8 +440,8 @@ class FleetRouter:
             # second time.
             reserved = False
             fut.add_done_callback(
-                lambda f, t=tenant, r=target, p=probe:
-                    self._on_done(f, t, r, probe=p)
+                lambda f, t=tenant, r=target, p=probe, h=hop:
+                    self._on_done(f, t, r, probe=p, hop=h)
             )
             return fut
         finally:
@@ -424,8 +461,10 @@ class FleetRouter:
                 self._inflight[tenant] = n
 
     def _on_done(self, fut: Future, tenant: str, replica: str,
-                 probe: bool = False) -> None:
+                 probe: bool = False, hop=None) -> None:
         self._release_inflight(tenant)
+        if hop is not None:
+            self._emit_hop(fut, tenant, replica, hop)
         if self.breaker is None:
             return
         exc = fut.exception()
@@ -471,6 +510,53 @@ class FleetRouter:
             entry = self.directory.get(tenant)
             if entry is not None and entry.owner == replica:
                 self.breaker.record_failure(replica)
+
+    def _emit_hop(self, fut: Future, tenant: str, replica: str,
+                  hop: tuple) -> None:
+        """One ``kind="hop"`` record per SAMPLED routed request (ISSUE
+        17 tentpole): router-side segments that tile the measured
+        fleet-level latency EXACTLY — every ``*_ms`` comes off the same
+        monotonic stamps, the PR 8 discipline — with
+        ``hop_ms = router_ms − remote_ms``: what the fleet hop added on
+        top of the replica's own measured total. ``remote_ms`` is the
+        replica's verdict ``latency_ms`` (two DURATIONS subtract with
+        no clock alignment needed), clamped into [0, t3−t2] so a
+        replica whose reported total exceeds the observed round-trip
+        (clock step mid-request) cannot drive ``wire_ms`` negative.
+        ``offset_ms`` is the transport's clock-offset estimate, for
+        aligning replica-side ABSOLUTE timestamps in
+        tools/fleet_report.py. Failed futures emit nothing — their
+        story is the fault/breaker records."""
+        if self._logger is None or fut.cancelled() \
+                or fut.exception() is not None:
+            return
+        trace, t0, t1, t2 = hop
+        verdict = fut.result()
+        if not isinstance(verdict, dict):
+            return
+        t3 = time.monotonic()
+        lat = verdict.get("latency_ms")
+        remote_s = (
+            min(max(float(lat) / 1e3, 0.0), max(t3 - t2, 0.0))
+            if isinstance(lat, (int, float)) else 0.0
+        )
+        offset_s = float(
+            getattr(self.replicas.get(replica), "clock_offset_s", 0.0)
+            or 0.0
+        )
+        t4 = time.monotonic()
+        self._logger.log(
+            self.submitted, kind="hop",
+            trace_id=trace.trace_id, tenant=tenant, replica=replica,
+            route_ms=round((t1 - t0) * 1e3, 3),
+            queue_ms=round((t2 - t1) * 1e3, 3),
+            wire_ms=round((t3 - t2 - remote_s) * 1e3, 3),
+            remote_ms=round(remote_s * 1e3, 3),
+            respond_ms=round((t4 - t3) * 1e3, 3),
+            router_ms=round((t4 - t0) * 1e3, 3),
+            hop_ms=round((t4 - t0 - remote_s) * 1e3, 3),
+            offset_ms=round(offset_s * 1e3, 3),
+        )
 
     def _degraded_future(self, tenant: str) -> Future:
         """An immediately-resolved degraded NOTA verdict — the fleet's
@@ -867,33 +953,131 @@ class FleetRouter:
                 "inflight": sum(self._inflight.values()),
             }
 
+    def bind_registry(self, registry=None, prefix: str = "fleet") -> None:
+        """Expose the fleet rollup through the shared obs/
+        CounterRegistry (ISSUE 17): aggregate counters as pull-style
+        gauges over ``snapshot()`` (ONE home for the formulas), and the
+        per-replica columns as LABELED gauge families
+        (``fleet_replica_*{replica="r01"}``) updated by ``emit_stats``
+        — one scrape of metrics.prom shows the whole fleet."""
+        from induction_network_on_fewrel_tpu.obs.export import get_registry
+
+        reg = registry or get_registry()
+        self._bound_registry = reg
+        self._bound_prefix = prefix
+        self._bound_fns = []
+
+        def agg(name: str, help: str = "") -> None:
+            f = lambda k=name: float(self.snapshot()[k])  # noqa: E731
+            self._bound_fns.append((f"{prefix}_{name}", f))
+            reg.gauge_fn(f"{prefix}_{name}", f, help)
+
+        agg("live", "replicas UP in placement")
+        agg("dead", "replicas marked dead")
+        agg("tenants", "registered fleet tenants")
+        agg("submitted", "requests through the fleet front door")
+        agg("shed", "fleet-share door sheds")
+        agg("degraded_served", "failover NOTA verdicts from the router")
+        agg("pending_failover", "tenants awaiting re-placement")
+        agg("inflight", "fleet-wide in-flight requests")
+        for col, help in (
+            ("qps", "served/s over the last emit interval"),
+            ("p50_ms", "median replica latency"),
+            ("p99_ms", "tail replica latency"),
+            ("batch_occupancy", "real rows / bucket slots"),
+            ("queue_depth", "replica admission queue depth"),
+            ("shed", "replica-level shed-load rejections"),
+            ("steady_recompiles", "programs compiled after warmup"),
+            ("routed", "requests routed to the replica"),
+            ("up", "1 = UP in placement"),
+            ("breaker_open", "1 = breaker open, 0.5 = half-open"),
+        ):
+            self._families[col] = reg.labeled_gauge(
+                f"{prefix}_replica_{col}", help=help
+            )
+
+    def unbind_registry(self) -> None:
+        """Release the gauge_fn closures (identity-checked) and the
+        labeled families — the ServingStats.unbind_registry discipline,
+        so a closed router stops rendering stale fleet values."""
+        reg = self._bound_registry
+        if reg is None:
+            return
+        for name, f in self._bound_fns:
+            reg.unregister(name, fn=f)
+        prefix = getattr(self, "_bound_prefix", "fleet")
+        for col, fam in self._families.items():
+            reg.unregister(f"{prefix}_replica_{col}", inst=fam)
+        self._bound_registry = None
+        self._bound_fns = []
+        self._families = {}
+
     def emit_stats(self, step: int | None = None) -> None:
         """One aggregate ``kind="fleet"`` record + one per-replica record
         (``replica`` field) restating that replica's serving counters —
-        the fleet section of tools/obs_report.py splits on the field."""
+        the fleet section of tools/obs_report.py splits on the field.
+        ISSUE 17 grew the per-replica shape into the fleet ROLLUP: qps
+        (served delta over the emit interval), shed, deadline_missed,
+        and the router's breaker state string; when ``bind_registry``
+        was called the same columns update the labeled gauge families,
+        so metrics.prom restates this record per replica."""
         if self._logger is None:
             return
         step = self.submitted if step is None else step
         self._logger.log(step, kind="fleet", **self.snapshot())
         states = self.placement.states()
+        now = time.monotonic()
         for rid in sorted(self.replicas):
             try:
                 snap = self.replicas[rid].stats_snapshot()
             except Exception:  # noqa: BLE001 — a dead replica has no stats
                 snap = {}
-            self._logger.log(
-                step, kind="fleet", replica=rid,
-                state=states.get(rid, "removed"),
-                routed=float(self.routed.get(rid, 0)),
-                **{
-                    k: snap[k] for k in (
-                        "served", "p50_ms", "p99_ms", "batch_occupancy",
-                        "steady_recompiles", "queue_depth", "degraded",
-                    ) if k in snap
-                },
+            served = float(snap.get("served", 0.0))
+            prev_t, prev_served = self._prev_emit.get(
+                rid, (self._t0, 0.0)
             )
+            dt = max(now - prev_t, 1e-9)
+            qps = max(served - prev_served, 0.0) / dt
+            self._prev_emit[rid] = (now, served)
+            row: dict = {
+                "state": states.get(rid, "removed"),
+                "routed": float(self.routed.get(rid, 0)),
+                "qps": round(qps, 3),
+            }
+            row.update({
+                k: snap[k] for k in (
+                    "served", "p50_ms", "p99_ms", "batch_occupancy",
+                    "steady_recompiles", "queue_depth", "degraded",
+                    "shed", "deadline_missed",
+                ) if k in snap
+            })
+            if self.breaker is not None:
+                row["breaker"] = str(self.breaker.state(rid))
+            self._logger.log(step, kind="fleet", replica=rid, **row)
+            if self._families:
+                self._update_families(rid, row)
+
+    def _update_families(self, rid: str, row: dict) -> None:
+        brk = row.get("breaker")
+        values = {
+            "qps": row.get("qps", 0.0),
+            "p50_ms": row.get("p50_ms", 0.0),
+            "p99_ms": row.get("p99_ms", 0.0),
+            "batch_occupancy": row.get("batch_occupancy", 0.0),
+            "queue_depth": row.get("queue_depth", 0.0),
+            "shed": row.get("shed", 0.0),
+            "steady_recompiles": row.get("steady_recompiles", 0.0),
+            "routed": row.get("routed", 0.0),
+            "up": 1.0 if row.get("state") == UP else 0.0,
+            "breaker_open": {"open": 1.0, "half_open": 0.5}.get(brk, 0.0),
+        }
+        for col, v in values.items():
+            fam = self._families.get(col)
+            if fam is not None:
+                fam.set(float(v), replica=rid)
 
     def close(self) -> None:
+        self.unbind_registry()
         for handle in self.replicas.values():
             try:
                 handle.close()

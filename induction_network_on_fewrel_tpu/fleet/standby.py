@@ -159,6 +159,15 @@ class HotStandby:
         self.journal = journal
         self.router = router
         self.promoted = True
+        # Identity hand-off (ISSUE 17): from this instant this process IS
+        # the fleet front door — records it emits (hop, fleet rollups)
+        # must say "router", not "standby", or fleet_report's timeline
+        # attributes post-promotion routing to a process that no longer
+        # exists in that role. The pre-promotion records keep "standby",
+        # so the transition itself is visible in the timeline.
+        set_ident = getattr(self._logger, "set_identity", None)
+        if callable(set_ident):
+            set_ident("router")
         promote_s = self._clock() - t0
         if self._logger is not None:
             self._logger.log(

@@ -27,9 +27,22 @@ plane's all-or-nothing fan-out works unchanged across processes.
 
 Scope: the wire format favors clarity over throughput (tokens travel as
 JSON); it is the correctness-faithful IPC arm the slow-lane tests
-exercise, not a tuned RPC stack. TraceContext crosses as ``trace_id``
-(the id string is the cross-process identity; span stitching by id is
-exactly how the in-process hop works too).
+exercise, not a tuned RPC stack.
+
+Trace stitching (ISSUE 17): the FULL ``TraceContext`` crosses the wire —
+``trace_id`` AND ``span_id`` (the originating span, normally the
+router's ``fleet/route`` span). The id string alone is NOT enough: a
+replica that rebuilds the context with ``span_id=0`` treats its first
+span as the trace's origin, and the router→replica parent link is lost
+— the stitched waterfall degenerates into two sibling trees that merely
+share an id. With the span id carried, the replica's top-level
+``serve/*`` spans parent to the router's span exactly as a cross-THREAD
+adoption does in-process (obs/spans.SpanTracker.span). Alongside it,
+each new connection runs an NTP-style clock handshake: ``op="clock"``
+probes collect (t0 send, t1 server recv, t2 server send, t3 recv)
+quadruples and ``ClockSync`` keeps a rolling-median offset estimate —
+how tools/fleet_report.py aligns replica-side wall clocks onto the
+router's timeline.
 """
 
 from __future__ import annotations
@@ -51,6 +64,71 @@ from induction_network_on_fewrel_tpu.serving.batcher import (
     Saturated,
     TransportTimeout,
 )
+
+
+class ClockSync:
+    """NTP-style clock-offset estimator for one router→replica link
+    (ISSUE 17). Each probe contributes four timestamps — t0 client
+    send, t1 server receive, t2 server send, t3 client receive — and
+    one offset sample ``((t1 - t0) + (t2 - t3)) / 2``: the
+    symmetric-path estimate of (server clock − client clock). The
+    estimate is the rolling MEDIAN of the last ``window`` samples,
+    robust to the occasional probe that straddles a GC pause or a
+    loaded accept queue (an asymmetric leg skews the mean, not the
+    median). A sample's RTT, ``(t3 - t0) - (t2 - t1)``, bounds its
+    error at half-RTT; the median across probes keeps the estimate
+    near the fastest probe's bound. Thread-safe: every dialing thread
+    of a ``SocketReplica`` feeds the same estimator."""
+
+    __slots__ = ("window", "_samples", "_rtts", "_lock")
+
+    def __init__(self, window: int = 15):
+        self.window = max(1, int(window))
+        self._samples: list[float] = []
+        self._rtts: list[float] = []
+        self._lock = threading.Lock()
+
+    def observe(self, t0: float, t1: float, t2: float, t3: float) -> float:
+        """Fold one probe quadruple in; returns this probe's offset
+        sample (server − client, seconds)."""
+        sample = ((t1 - t0) + (t2 - t3)) / 2.0
+        rtt = max(0.0, (t3 - t0) - (t2 - t1))
+        with self._lock:
+            self._samples.append(sample)
+            self._rtts.append(rtt)
+            if len(self._samples) > self.window:
+                del self._samples[0]
+                del self._rtts[0]
+        return sample
+
+    @property
+    def samples(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    def offset_s(self) -> float:
+        """Current estimate of (server clock − client clock) in
+        seconds; 0.0 before any probe has landed."""
+        with self._lock:
+            xs = sorted(self._samples)
+        if not xs:
+            return 0.0
+        mid = len(xs) // 2
+        if len(xs) % 2:
+            return xs[mid]
+        return (xs[mid - 1] + xs[mid]) / 2.0
+
+    def rtt_s(self) -> float:
+        """Median probe round-trip (the error bound's scale); 0.0
+        before any probe."""
+        with self._lock:
+            xs = sorted(self._rtts)
+        if not xs:
+            return 0.0
+        mid = len(xs) // 2
+        if len(xs) % 2:
+            return xs[mid]
+        return (xs[mid - 1] + xs[mid]) / 2.0
 
 
 def _inst_to_wire(inst) -> dict:
@@ -198,13 +276,28 @@ class ReplicaServer:
         eng = self.engine
         if op in ("ping", "bye"):
             return {"ok": True}
+        if op == "clock":
+            # NTP-style probe (ISSUE 17): stamp receive and send on the
+            # SERVER's wall clock; the client supplies t0/t3 and feeds
+            # the quadruple to its ClockSync. Two separate stamps on
+            # purpose — the processing gap between them is subtracted
+            # out of the client's RTT bound.
+            t_recv = time.time()
+            return {"ok": True, "t_recv": t_recv, "t_send": time.time()}
         if op == "classify":
             from induction_network_on_fewrel_tpu.obs.spans import (
                 TraceContext,
             )
 
+            # Rebuild the FULL context: span_id is the router-side
+            # originating span, so this replica's top-level serve/*
+            # spans parent to it (the cross-process stitch — the module
+            # docstring says why id-only is not enough).
             trace = (
-                TraceContext(str(req["trace_id"]))
+                TraceContext(
+                    str(req["trace_id"]),
+                    span_id=int(req.get("span_id") or 0),
+                )
                 if req.get("trace_id") else None
             )
             inst = req["instance"]
@@ -319,8 +412,14 @@ class SocketReplica(ReplicaHandle):
     _IDEMPOTENT_OPS = frozenset({
         "ping", "stats", "params_version", "warmup", "has_tenant",
         "register", "set_nota_threshold", "quarantine", "unquarantine",
-        "drop_tenant",
+        "drop_tenant", "clock",
     })
+
+    # Probes per NEW connection feeding the link's ClockSync: enough
+    # for the median to shrug off one slow probe, cheap enough that a
+    # re-dial after a transport error stays sub-millisecond on
+    # localhost.
+    _CLOCK_PROBES = 3
 
     def __init__(self, replica_id: str, address: tuple[str, int],
                  pool_size: int = 8, timeout_s: float = 120.0,
@@ -340,9 +439,24 @@ class SocketReplica(ReplicaHandle):
             thread_name_prefix=f"replica-{replica_id}",
         )
         self._closed = False
+        self._clock = ClockSync()   # shared across all dialed threads
         self._connect()   # dial eagerly: fail fast on a bad address
 
     def _connect(self) -> tuple[socket.socket, object]:
+        conn = self._dial()
+        if not self._clock_handshake(conn):
+            # A probe went UNANSWERED (wedged peer, garbled frame):
+            # its late reply would be read as the next RPC's response,
+            # and a timed-out buffered reader is poisoned for good
+            # (CPython latches _timeout_occurred) — so the stream is
+            # unusable either way. Replace it with a fresh one, no
+            # probes; the offset estimate keeps whatever samples
+            # earlier connections contributed.
+            self._drop_conn(conn)
+            conn = self._dial()
+        return conn
+
+    def _dial(self) -> tuple[socket.socket, object]:
         sock = socket.create_connection(
             self._address, timeout=self._timeout_s
         )
@@ -351,6 +465,50 @@ class SocketReplica(ReplicaHandle):
         with self._conns_lock:
             self._conns.append(conn)
         return conn
+
+    def _clock_handshake(self, conn) -> bool:
+        """Per-connection NTP-style offset probes (ISSUE 17). Writes
+        directly on the fresh socket (NOT through ``_call`` — we are
+        inside ``_connect`` and must not recurse). Best-effort for the
+        ESTIMATE (a refused probe leaves the rolling median as it was)
+        but strict about FRAMING: returns False iff a probe went
+        unanswered or unparseable, i.e. the request/response stream
+        can no longer be trusted and the caller must replace it."""
+        sock, rfile = conn
+        try:
+            sock.settimeout(min(self._call_deadline_s, 5.0))
+            for _ in range(self._CLOCK_PROBES):
+                t0 = time.time()
+                sock.sendall(b'{"op": "clock"}\n')
+                line = rfile.readline()
+                t3 = time.time()
+                if not line:
+                    return False      # peer closed mid-handshake
+                try:
+                    resp = json.loads(line)
+                except ValueError:
+                    return False      # garbled frame: desynced
+                if not resp.get("ok"):
+                    # An answered refusal (pre-ISSUE-17 server): the
+                    # framing is intact, there is just no clock op.
+                    return True
+                try:
+                    self._clock.observe(
+                        t0, float(resp["t_recv"]), float(resp["t_send"]),
+                        t3,
+                    )
+                except (KeyError, TypeError, ValueError):
+                    return True       # answered but malformed fields
+            return True
+        except OSError:
+            return False              # timeout/transport fault mid-probe
+
+    @property
+    def clock_offset_s(self) -> float:
+        """Estimated (replica clock − router clock), seconds — the
+        rolling median over this handle's connection handshakes. The
+        router stamps it on ``kind="hop"`` records as ``offset_ms``."""
+        return self._clock.offset_s()
 
     def _drop_conn(self, conn) -> None:
         """Invalidate this thread's cached connection: after any
@@ -492,6 +650,10 @@ class SocketReplica(ReplicaHandle):
                 op="classify", instance=wire, deadline_s=deadline_s,
                 tenant=tenant,
                 trace_id=trace.trace_id if trace is not None else None,
+                # The parent link (ISSUE 17): without span_id the
+                # replica re-roots the trace and the stitched chain
+                # breaks — see the module docstring.
+                span_id=trace.span_id if trace is not None else None,
             )["verdict"]
         )
 

@@ -9,6 +9,10 @@ the same model:
 * ``gauge(name)`` — last-written values.
 * ``gauge_fn(name, fn)`` — computed at render time (e.g. queue depth read
   from the live batcher instead of mirrored on every mutation).
+* ``labeled_gauge(name)`` — a gauge FAMILY keyed by label set
+  (``fleet_replica_qps{replica="r01"}``), the fleet rollup's per-replica
+  exposition shape (ISSUE 17): one scrape shows every replica without
+  minting one metric name per replica id.
 * ``histogram(name)`` — bucketed distributions (serving latency), rendered
   as the standard ``_bucket``/``_sum``/``_count`` family. Each bucket
   remembers the most recent **exemplar trace_id** observed into it
@@ -134,6 +138,61 @@ class Histogram:
             )
 
 
+def _escape_label(v: str) -> str:
+    """Label-value escaping per the exposition format: backslash,
+    double-quote, and newline are the three characters with meaning
+    inside a quoted label value."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+class GaugeFamily:
+    """Labeled gauge family (ISSUE 17): one child value per unique
+    label set, rendered as ``name{k="v",...} value`` — the shape the
+    fleet rollup needs (``fleet_replica_qps{replica="r01"}``), where a
+    plain Gauge would force one metric NAME per replica and break every
+    dashboard aggregation. ``set`` is last-write-wins per label set
+    (gauge semantics); ``remove`` retires a series (a drained replica
+    must stop being scraped, not freeze at its last value)."""
+
+    __slots__ = ("_children", "_lock")
+
+    def __init__(self):
+        self._children: dict[tuple[tuple[str, str], ...], float] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(labels: dict) -> tuple[tuple[str, str], ...]:
+        if not labels:
+            raise ValueError("a labeled gauge needs at least one label")
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        for k, _ in key:
+            _check_name(k)
+        return key
+
+    def set(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = float(value)
+
+    def remove(self, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._children.pop(key, None)
+
+    def state(self) -> dict[tuple[tuple[str, str], ...], float]:
+        with self._lock:
+            return dict(self._children)
+
+    @property
+    def value(self) -> float:
+        """Registry-snapshot scalar: the live series count (the full
+        family renders only in the Prometheus exposition)."""
+        with self._lock:
+            return float(len(self._children))
+
+
 class CounterRegistry:
     """Named counters/gauges with idempotent registration: asking for the
     same name twice returns the same instrument, so independent modules
@@ -188,6 +247,12 @@ class CounterRegistry:
                     f"{name!r} already registered as {type(inst).__name__}"
                 )
             return inst
+
+    def labeled_gauge(self, name: str, help: str = "") -> GaugeFamily:
+        """Labeled gauge family; idempotent like counter/gauge —
+        re-asking returns the existing family, so the router's
+        re-binds across restarts share one series table."""
+        return self._get(name, help, GaugeFamily)
 
     def gauge_fn(self, name: str, fn: Callable[[], float], help: str = "") -> None:
         """Register a pull-style gauge evaluated at render time.
@@ -269,6 +334,16 @@ class CounterRegistry:
                     lines.append(line)
                 lines.append(f"{full}_sum {total_sum:g}")
                 lines.append(f"{full}_count {total}")
+                continue
+            if isinstance(inst, GaugeFamily):
+                if helps.get(name):
+                    lines.append(f"# HELP {full} {helps[name]}")
+                lines.append(f"# TYPE {full} gauge")
+                for key, v in sorted(inst.state().items()):
+                    lbl = ",".join(
+                        f'{k}="{_escape_label(val)}"' for k, val in key
+                    )
+                    lines.append(f"{full}{{{lbl}}} {v:g}")
                 continue
             mtype = "counter" if isinstance(inst, Counter) else "gauge"
             if name in fns:

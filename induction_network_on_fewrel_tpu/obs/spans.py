@@ -151,6 +151,13 @@ class SpanTracker:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
+        # Process identity (ISSUE 17): when set, snapshot() stamps
+        # proc_role/proc_replica/proc_pid on every span dict — the
+        # same fields MetricsLogger.set_identity stamps on records, so
+        # a span dumped by the flight recorder names the process it
+        # came from. Applied at READ time: the hot enter/exit path
+        # stays two clock calls and an append.
+        self.identity: dict[str, object] = {}
         # RLock: the flight recorder's SIGTERM dump snapshots this tracker
         # from a signal handler that may interrupt the same thread inside
         # _append — a plain lock would deadlock the dump.
@@ -164,6 +171,15 @@ class SpanTracker:
         self._tls = threading.local()
         self._t0 = time.monotonic()
         self._xplane = xplane_bridge
+
+    def set_identity(self, role: str, replica: str | None = None) -> None:
+        """Stamp this process's identity onto future snapshot() output.
+        Mirrors MetricsLogger.set_identity so spans and metrics records
+        from one process carry the same proc_* fields."""
+        ident: dict[str, object] = {"proc_role": str(role), "proc_pid": os.getpid()}
+        if replica is not None:
+            ident["proc_replica"] = str(replica)
+        self.identity = ident
 
     # --- recording -------------------------------------------------------
 
@@ -292,7 +308,11 @@ class SpanTracker:
         """Completed spans, oldest first, as plain dicts."""
         with self._lock:
             ordered = self._ring[self._next_slot:] + self._ring[:self._next_slot]
-        return [s.to_dict() for s in ordered]
+        out = [s.to_dict() for s in ordered]
+        if self.identity:
+            for d in out:
+                d.update(self.identity)
+        return out
 
     def durations(self, name: str) -> list[float]:
         with self._lock:

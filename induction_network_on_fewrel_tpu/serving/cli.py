@@ -537,6 +537,18 @@ def serve_main(argv=None) -> int:
     # One logger owned HERE (not per-engine): serve_main closes its
     # persistent metrics.jsonl handle on exit.
     logger = MetricsLogger(args.run_dir) if args.run_dir else None
+    if logger is not None:
+        # Process identity (ISSUE 17): every record this process emits
+        # carries proc_role/proc_pid (+ t_unix), so
+        # tools/fleet_report.py can merge the fleet's streams into one
+        # causally-ordered timeline. An in-process fleet is ONE process
+        # wearing the router hat; a real multi-process deployment gives
+        # each replica its own run dir and "serve" role.
+        logger.set_identity(
+            "standby" if args.standby
+            else "router" if (args.replicas > 1 or args.router)
+            else "serve"
+        )
     watchdog = None
     recorder = None
     needs_obs = (
@@ -825,6 +837,9 @@ def _serve_standby(args, buckets, logger=None, watchdog=None, slo=None,
         trace_sample=args.trace_sample,
     )
     router = standby.router
+    # The promoted router IS the fleet front door now: expose the same
+    # rollup gauges the primary served (ISSUE 17).
+    router.bind_registry()
     print(f"standby: PROMOTED in {summary['promote_s']:.3f}s — "
           f"{summary['tenants']} tenant(s), reregistered "
           f"{summary['reregistered']}, caught up {summary['caught_up']} "
@@ -911,6 +926,10 @@ def _serve_fleet(args, buckets, logger=None, watchdog=None, slo=None,
         queue_capacity_per_replica=args.queue_depth,
         trace_sample=args.trace_sample,
     )
+    # Fleet rollup gauges (ISSUE 17): per-replica labeled families +
+    # aggregate gauge_fns land in the same registry _write_prometheus
+    # renders — one metrics.prom scrape shows the whole fleet.
+    router.bind_registry()
     journal = None
     if args.journal:
         from induction_network_on_fewrel_tpu.fleet import FleetJournal

@@ -171,10 +171,36 @@ KNOWN_KINDS = frozenset({
     # event="replica_add" and event="replace" (moved, tenants —
     # re-placement churn), event="journal_compact" (snapshot_seq,
     # tenants — the fleet journal folded its WAL into snapshot.json,
-    # ISSUE 15). Replica-death containment emits kind="fault"
-    # action="replica_dead"/"replica_recover" next to these.
+    # ISSUE 15), and event="journal_op" (op, seq — one per WAL append,
+    # ISSUE 17: the journal payload itself carries no timestamp by the
+    # deterministic-replay contract, so THIS record is where a control-
+    # plane decision acquires a wall-clock position on the fleet
+    # timeline; tools/fleet_report.py cross-checks op/seq against the
+    # replayed WAL). Replica-death containment emits kind="fault"
+    # action="replica_dead"/"replica_recover" next to these. The
+    # PER-REPLICA shape grew fleet-rollup fields in ISSUE 17: qps
+    # (served delta over the emit interval), shed, deadline_missed,
+    # and breaker (str: closed/open/half_open — the router's view).
     # tools/obs_report.py's fleet section splits on replica/event.
     "fleet",
+    # Cross-process hop telemetry (ISSUE 17, fleet/router.py): one
+    # record per SAMPLED routed request with trace_id (str), tenant
+    # (str), replica (str), and the router-side segment breakdown in ms
+    # — route_ms (placement + breaker/door admission), queue_ms
+    # (handle.submit: serialization + socket write + local pool queue),
+    # wire_ms (round-trip residual after subtracting the replica's own
+    # measured total), remote_ms (the replica-reported end-to-end
+    # latency_ms for this request), respond_ms (router-side completion
+    # accounting) — whose sum equals router_ms, the request's measured
+    # fleet-level latency (same timestamps by construction, the PR 8
+    # segments-sum-exactly discipline). hop_ms = router_ms − remote_ms
+    # is the fleet tax: everything the hop added on top of the replica.
+    # offset_ms is the NTP-style estimated clock offset to that replica
+    # (fleet/transport.ClockSync rolling median; 0.0 for in-process
+    # handles) — used by tools/fleet_report.py to align replica-side
+    # absolute timestamps onto the router timeline, and gated by its
+    # --check skew bound. All scalar/str — the schema contract holds.
+    "hop",
     # Elasticity telemetry (ISSUE 16, fleet/autoscaler.py +
     # fleet/standby.py), three record shapes, all scalar/str: (a) one
     # TICK record per autoscaler policy evaluation (no ``event`` field)
@@ -257,6 +283,12 @@ class MetricsLogger:
             out.mkdir(parents=True, exist_ok=True)
             self.path = out / "metrics.jsonl"
         self.hooks: list[Callable[[dict], None]] = []
+        # Process identity (ISSUE 17): when set, every record carries
+        # proc_role/proc_replica/proc_pid plus t_unix (absolute wall
+        # clock) so a multi-process fleet's streams can be merged into
+        # one causally-ordered timeline (tools/fleet_report.py).
+        # Default-off: single-process runs keep their exact old shape.
+        self._identity: dict[str, object] = {}
         # Optional TensorBoard scalars (SURVEY.md §5.5). tensorflow is a
         # heavyweight import (~6 s), so it loads only when a dir is given;
         # metrics.jsonl stays the always-on machine-readable record.
@@ -273,13 +305,33 @@ class MetricsLogger:
         if hook not in self.hooks:
             self.hooks.append(hook)
 
+    def set_identity(self, role: str, replica: str | None = None) -> None:
+        """Stamp process identity on every subsequent record (ISSUE 17):
+        proc_role (router/serve/standby), proc_replica when this logger
+        belongs to one replica, proc_pid, and a per-record t_unix
+        absolute timestamp. ``wall_s`` stays monotonic-relative (the
+        in-process ordering key); t_unix is the CROSS-process key —
+        comparable across streams only up to clock offset, which the
+        hop records carry (fleet/transport.ClockSync)."""
+        import os
+
+        ident: dict[str, object] = {
+            "proc_role": str(role), "proc_pid": os.getpid(),
+        }
+        if replica is not None:
+            ident["proc_replica"] = str(replica)
+        self._identity = ident
+
     def log(self, step: int, kind: str = "train", **scalars) -> None:
         rec = {
             "step": int(step),
             "kind": kind,
             "wall_s": round(time.monotonic() - self._t0, 3),
-            **{k: _coerce(v) for k, v in scalars.items()},
         }
+        if self._identity:
+            rec.update(self._identity)
+            rec["t_unix"] = round(time.time(), 6)
+        rec.update({k: _coerce(v) for k, v in scalars.items()})
         if self.path is not None:
             line = json.dumps(
                 {k: json_sanitize(v) for k, v in rec.items()}
@@ -303,7 +355,8 @@ class MetricsLogger:
             fields = " ".join(
                 f"{k}={v}" if isinstance(v, str) else f"{k}={v:.4g}"
                 for k, v in rec.items()
-                if k not in ("step", "kind", "wall_s")
+                if k not in ("step", "kind", "wall_s", "proc_role",
+                             "proc_replica", "proc_pid", "t_unix")
             )
             print(f"[{kind}] step={step} {fields}", file=sys.stderr, flush=True)
         for hook in self.hooks:

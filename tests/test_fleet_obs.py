@@ -1,0 +1,483 @@
+"""Fleet observability tier-1 tests (ISSUE 17): the NTP-style clock
+offset estimator, hop-segment tiling against measured fleet latency,
+fleet rollup arithmetic vs per-replica truth, the cross-process parent
+chain over a real socket, timeline ordering across interleaved streams,
+the fleet_report --check gate on a miniature drill, and the sampling
+overhead gates (rate 0 emits nothing; the sampled record tax stays
+under 2% of router p50 amortized)."""
+
+import json
+import os
+import sys
+import time
+from concurrent.futures import Future
+
+import jax
+import pytest
+
+from induction_network_on_fewrel_tpu.config import ExperimentConfig
+from induction_network_on_fewrel_tpu.data import (
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.data.tokenizer import GloveTokenizer
+from induction_network_on_fewrel_tpu.fleet import (
+    FleetControl,
+    FleetRouter,
+    InProcessReplica,
+    ReplicaHandle,
+)
+from induction_network_on_fewrel_tpu.fleet.journal import FleetJournal
+from induction_network_on_fewrel_tpu.fleet.transport import (
+    ClockSync,
+    ReplicaServer,
+    SocketReplica,
+)
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.obs.spans import (
+    TraceContext,
+    TraceSampler,
+    get_tracker,
+    new_trace_id,
+)
+from induction_network_on_fewrel_tpu.serving.buckets import zero_batch
+from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
+from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import fleet_report  # noqa: E402
+
+CFG = ExperimentConfig(
+    model="induction", encoder="cnn", hidden_size=16,
+    vocab_size=122, word_dim=8, pos_dim=2, max_length=16,
+    induction_dim=8, ntn_slices=4, routing_iters=2,
+    n=3, train_n=3, k=2, q=2, device="cpu",
+)
+
+HOP_SEGS = ("route_ms", "queue_ms", "wire_ms", "remote_ms", "respond_ms")
+
+
+@pytest.fixture(scope="module")
+def world():
+    vocab = make_synthetic_glove(vocab_size=CFG.vocab_size - 2,
+                                 word_dim=CFG.word_dim)
+    tok = GloveTokenizer(vocab, max_length=CFG.max_length)
+    model = build_model(CFG, glove_init=vocab.vectors)
+    params = model.init(
+        jax.random.key(0),
+        zero_batch(CFG.max_length, (1, CFG.n, CFG.k)),
+        zero_batch(CFG.max_length, (1, 2)),
+    )
+    datasets = [
+        make_synthetic_fewrel(
+            num_relations=3, instances_per_relation=8,
+            vocab_size=CFG.vocab_size - 2, seed=s,
+        )
+        for s in range(3)
+    ]
+    return tok, model, params, datasets
+
+
+def _pool(ds, k=CFG.k):
+    return [i for r in ds.rel_names for i in ds.instances[r][k:]]
+
+
+def _mk_engine(world, logger=None):
+    tok, model, params, _ = world
+    return InferenceEngine(model, params, CFG, tok, k=CFG.k,
+                           buckets=(1, 2), logger=logger)
+
+
+# --- clock offset estimator -------------------------------------------------
+
+
+def _probe(t0: float, offset: float, leg: float = 0.004,
+           serve: float = 0.002):
+    """One symmetric probe quadruple with the server clock ``offset``
+    seconds ahead of the client clock."""
+    t1 = t0 + leg + offset          # server receive, server clock
+    t2 = t1 + serve                 # server send, server clock
+    t3 = t0 + leg + serve + leg     # client receive, client clock
+    return t0, t1, t2, t3
+
+
+@pytest.mark.parametrize("offset", [0.5, -0.5])
+def test_clock_sync_recovers_skew_both_directions(offset):
+    """A symmetric-path probe recovers (server − client) exactly, for a
+    server ahead AND a server behind — the sign discipline every
+    downstream consumer (hop offset_ms, fleet_report's timeline
+    alignment) depends on."""
+    cs = ClockSync()
+    for i in range(5):
+        sample = cs.observe(*_probe(100.0 + i, offset))
+        assert sample == pytest.approx(offset, abs=1e-9)
+    assert cs.offset_s() == pytest.approx(offset, abs=1e-9)
+    assert cs.rtt_s() == pytest.approx(0.008, abs=1e-9)
+
+
+def test_clock_sync_median_rejects_asymmetric_outlier():
+    """One probe whose return leg straddled a stall skews the mean, not
+    the rolling median — the estimate stays at the true offset."""
+    cs = ClockSync()
+    for i in range(4):
+        cs.observe(*_probe(10.0 + i, 0.25))
+    # Outlier: the reply leg took 400ms (asymmetric path), which biases
+    # that single sample by ~-200ms.
+    t0, t1, t2, _ = _probe(20.0, 0.25)
+    cs.observe(t0, t1, t2, t0 + 0.004 + 0.002 + 0.4)
+    assert cs.offset_s() == pytest.approx(0.25, abs=1e-9)
+
+
+def test_clock_sync_window_trims():
+    cs = ClockSync(window=3)
+    for i in range(10):
+        cs.observe(*_probe(float(i), 0.1))
+    assert cs.samples == 3
+    assert ClockSync().offset_s() == 0.0   # no probes yet -> 0, not NaN
+
+
+# --- real-socket: handshake + stitched parent chain -------------------------
+
+
+def test_socket_parent_chain_and_handshake(world, tmp_path):
+    """Satellite (b) regression: over a REAL socket, the wire carries
+    the full TraceContext — the replica's ``serve/submit`` span must
+    parent to the ROUTER-side originating span id, not float as a
+    second root. Rides the same connection: the connect-time clock
+    handshake has landed its probes and reads ~0 offset in-process."""
+    tok, model, params, datasets = world
+    engine = _mk_engine(world)
+    srv = ReplicaServer(engine).start()
+    client = None
+    try:
+        client = SocketReplica("r0", srv.address)
+        client.register_dataset(datasets[0], "t0")
+        # Connect-time handshake: probes landed, same-process clocks.
+        assert client._clock.samples >= 3
+        assert abs(client.clock_offset_s) < 0.05
+        tracker = get_tracker()
+        ctx = TraceContext(new_trace_id())
+        with tracker.trace(ctx):
+            with tracker.span("client/request", xplane=False):
+                origin_span = ctx.span_id
+                assert origin_span != 0
+                v = client.submit(
+                    _pool(datasets[0])[0], 10.0, tenant="t0", trace=ctx,
+                ).result(timeout=30.0)
+        assert v["tenant"] == "t0"
+        spans = [d for d in get_tracker().snapshot()
+                 if d.get("trace_id") == ctx.trace_id]
+        serve_spans = [d for d in spans if d["name"] == "serve/submit"]
+        assert serve_spans, f"no serve/submit span stitched: {spans}"
+        assert serve_spans[0]["parent_id"] == origin_span
+    finally:
+        if client is not None:
+            client.close()
+        srv.stop()
+        engine.close()
+
+
+# --- hop tiling -------------------------------------------------------------
+
+
+def test_hop_segments_tile_router_latency(world, tmp_path):
+    """The PR 8 discipline at the fleet tier: every sampled request's
+    route/queue/wire/remote/respond segments come off the same monotonic
+    stamps and must sum to router_ms EXACTLY (3-decimal rounding is the
+    only slack), with hop_ms = router_ms − remote_ms and remote clamped
+    into the observed round-trip."""
+    records = []
+    logger = MetricsLogger(None, quiet=True)
+    logger.add_hook(records.append)
+    engine = _mk_engine(world, logger=logger)
+    router = FleetRouter({"r0": InProcessReplica("r0", engine)},
+                         logger=logger, trace_sample=1.0)
+    try:
+        control = FleetControl(router)
+        control.register_tenant("t0", world[3][0])
+        router.replicas["r0"].warmup()
+        pool = _pool(world[3][0])
+        for i in range(8):
+            router.classify(pool[i % len(pool)], 10.0, tenant="t0")
+    finally:
+        router.close()
+        logger.close()
+    hops = [r for r in records if r.get("kind") == "hop"]
+    assert len(hops) == 8
+    for h in hops:
+        ssum = sum(h[k] for k in HOP_SEGS)
+        assert ssum == pytest.approx(h["router_ms"], abs=0.01), h
+        assert h["hop_ms"] == pytest.approx(
+            h["router_ms"] - h["remote_ms"], abs=0.01)
+        assert 0.0 <= h["remote_ms"] <= h["router_ms"] + 0.01
+        assert all(h[k] >= 0.0 for k in HOP_SEGS)
+        assert h["trace_id"] and h["replica"] == "r0"
+        # In-process handle: no wire, no clock to offset.
+        assert h["offset_ms"] == 0.0
+
+
+def test_sample_rate_zero_emits_nothing(world):
+    """Satellite (f): rate 0 is the production default and must be
+    allocation-free — the sampler short-circuits to None, the router
+    never stamps, no hop (and no replica trace) record exists."""
+    s = TraceSampler(0.0)
+    assert all(s.maybe_trace() is None for _ in range(1000))
+    records = []
+    logger = MetricsLogger(None, quiet=True)
+    logger.add_hook(records.append)
+    engine = _mk_engine(world, logger=logger)
+    router = FleetRouter({"r0": InProcessReplica("r0", engine)},
+                         logger=logger, trace_sample=0.0)
+    try:
+        control = FleetControl(router)
+        control.register_tenant("t0", world[3][0])
+        router.replicas["r0"].warmup()
+        pool = _pool(world[3][0])
+        for i in range(6):
+            router.classify(pool[i % len(pool)], 10.0, tenant="t0")
+        router.emit_stats()
+    finally:
+        router.close()
+        logger.close()
+    assert [r for r in records if r.get("kind") == "hop"] == []
+    assert [r for r in records
+            if r.get("kind") == "trace" and "total_ms" in r] == []
+
+
+def test_hop_record_tax_under_gate(world, tmp_path):
+    """Satellite (f) overhead gate: the hop record's emission cost —
+    json-encode + crash-visible write of the 13-field record — must
+    stay under 2% of the measured router p50 when amortized at a 10%
+    sampling rate (the drill's ceiling for production profiles)."""
+    logger = MetricsLogger(tmp_path / "gate", quiet=True)
+    records = []
+    logger.add_hook(records.append)
+    engine = _mk_engine(world, logger=logger)
+    router = FleetRouter({"r0": InProcessReplica("r0", engine)},
+                         logger=logger, trace_sample=1.0)
+    try:
+        control = FleetControl(router)
+        control.register_tenant("t0", world[3][0])
+        router.replicas["r0"].warmup()
+        pool = _pool(world[3][0])
+        for i in range(24):
+            router.classify(pool[i % len(pool)], 10.0, tenant="t0")
+        hops = [r for r in records if r.get("kind") == "hop"]
+        p50_ms = sorted(h["router_ms"] for h in hops)[len(hops) // 2]
+        n = 300
+        t0 = time.perf_counter()
+        for i in range(n):
+            logger.log(
+                i, kind="hop", trace_id="gate-00000001", tenant="t0",
+                replica="r0", route_ms=0.01, queue_ms=0.1, wire_ms=0.0,
+                remote_ms=0.5, respond_ms=0.01, router_ms=0.62,
+                hop_ms=0.12, offset_ms=0.0,
+            )
+        emit_ms = (time.perf_counter() - t0) / n * 1e3
+    finally:
+        router.close()
+        logger.close()
+    assert 0.1 * emit_ms < 0.02 * p50_ms, (
+        f"hop record tax {emit_ms:.4f}ms/record "
+        f"({0.1 * emit_ms:.4f}ms amortized at 10% sampling) vs "
+        f"2% of router p50 {p50_ms:.3f}ms"
+    )
+
+
+# --- fleet rollup vs per-replica truth --------------------------------------
+
+
+class _RollupStub(ReplicaHandle):
+    """Immediate-verdict handle with a controllable stats snapshot —
+    the rollup test needs exact arithmetic, not engine noise."""
+
+    def __init__(self, rid):
+        self.replica_id = rid
+        self.served = 0
+
+    def submit(self, instance, deadline_s=None, tenant="default",
+               trace=None) -> Future:
+        self.served += 1
+        fut: Future = Future()
+        fut.set_result({"tenant": tenant, "replica": self.replica_id,
+                        "latency_ms": 0.1})
+        return fut
+
+    def register_dataset(self, dataset, tenant, max_classes=None):
+        return tenant
+
+    def has_tenant(self, tenant):
+        return True
+
+    def stats_snapshot(self):
+        return {"served": float(self.served), "p50_ms": 1.0,
+                "p99_ms": 2.0, "batch_occupancy": 1.0,
+                "steady_recompiles": 0.0, "queue_depth": 0.0,
+                "shed": 0.0, "deadline_missed": 0.0, "degraded": 0.0}
+
+    def close(self):
+        pass
+
+
+def test_fleet_rollup_matches_per_replica_truth():
+    """emit_stats restates each replica's OWN counters (served straight
+    from the snapshot) and derives qps from the served delta over the
+    emit interval: traffic between emits shows up on exactly the
+    replicas that served it, an idle interval rolls up to qps=0
+    everywhere, and the aggregate row counts the live fleet."""
+    records = []
+    logger = MetricsLogger(None, quiet=True)
+    logger.add_hook(records.append)
+    stubs = {f"r{i}": _RollupStub(f"r{i}") for i in range(3)}
+    router = FleetRouter(dict(stubs), logger=logger)
+    try:
+        control = FleetControl(router)
+        for i in range(9):
+            control.register_tenant(f"t{i:02d}", object())
+        for i in range(9):
+            router.classify("q", tenant=f"t{i:02d}")
+        time.sleep(0.02)
+        router.emit_stats()
+        rows = {r["replica"]: r for r in records
+                if r.get("kind") == "fleet" and "replica" in r}
+        agg = [r for r in records
+               if r.get("kind") == "fleet" and "replica" not in r
+               and "event" not in r][-1]
+        assert set(rows) == set(stubs)
+        for rid, stub in stubs.items():
+            assert rows[rid]["served"] == float(stub.served)
+            assert rows[rid]["routed"] == float(
+                router.routed.get(rid, 0))
+            # qps sign matches the interval's truth: replicas that
+            # served have qps > 0, untouched replicas roll up 0.
+            assert (rows[rid]["qps"] > 0) == (stub.served > 0)
+            assert rows[rid]["state"] == "up"
+        assert sum(stub.served for stub in stubs.values()) == 9
+        assert agg["live"] == 3.0 and agg["submitted"] == 9.0
+        # Second emit over an idle interval: served deltas are zero, so
+        # every replica's qps must read 0 — the rollup is a RATE, not a
+        # restated lifetime counter.
+        records.clear()
+        time.sleep(0.02)
+        router.emit_stats()
+        rows2 = [r for r in records
+                 if r.get("kind") == "fleet" and "replica" in r]
+        assert rows2 and all(r["qps"] == 0.0 for r in rows2)
+    finally:
+        router.close()
+        logger.close()
+
+
+# --- timeline ordering across interleaved streams ---------------------------
+
+
+def test_timeline_orders_interleaved_journals():
+    """Records from three processes, interleaved and clock-skewed: the
+    timeline must order on OFFSET-CORRECTED absolute time (replica
+    t_unix minus its estimated offset), keep journal ops labeled with
+    their seq, and count — not guess at — records that carry no
+    absolute timestamp."""
+    router_recs = [
+        {"kind": "fleet", "event": "journal_op", "op": "replica_add",
+         "seq": 3, "t_unix": 100.0},
+        {"kind": "fault", "action": "replica_dead", "replica": "rB",
+         "reason": "drill", "tenants": 2, "t_unix": 101.5},
+        {"kind": "fleet", "event": "journal_op", "op": "publish_commit",
+         "seq": 4, "t_unix": 103.0},
+    ]
+    replica_recs = {
+        # rA's clock runs 500ms AHEAD: its 101.4 stamp is really 100.9,
+        # which must sort BEFORE the router's 101.5 fault.
+        "rA": [{"kind": "health", "event": "slo_fast_burn",
+                "tenant": "t0", "burn_fast": 9.0, "t_unix": 101.4}],
+        # rB's clock runs 250ms BEHIND: its 102.0 stamp is really
+        # 102.25 — between the fault and the publish.
+        "rB": [
+            {"kind": "health", "event": "queue_stuck",
+             "severity": "critical", "message": "wedged",
+             "t_unix": 102.0},
+            # No t_unix: identity stamping off — unplaceable across
+            # processes, counted rather than invented.
+            {"kind": "health", "event": "slo_slow_burn", "tenant": "t1",
+             "burn_fast": 2.0},
+        ],
+    }
+    tl = fleet_report.build_timeline(
+        router_recs, replica_recs, {"rA": 500.0, "rB": -250.0}
+    )
+    assert tl["events"] == 5 and tl["unplaced_events"] == 1
+    order = [(e["src"], e["event"].split()[0]) for e in tl["raw"]]
+    assert order == [
+        ("router", "journal"),       # replica_add @ 100.0
+        ("rA", "SLO"),               # 101.4 - 0.5 = 100.9
+        ("router", "replica"),       # rB DEAD @ 101.5
+        ("rB", "CRITICAL"),          # 102.0 + 0.25 = 102.25
+        ("router", "journal"),       # publish_commit @ 103.0
+    ], order
+    assert "seq=3" in tl["raw"][0]["event"]
+    assert tl["raw"][0]["t"] == 0.0   # rebased to the first event
+
+
+# --- the miniature drill: fleet_report --check in tier-1 --------------------
+
+
+def test_fleet_report_check_green_on_miniature_drill(world, tmp_path):
+    """The fleet_report gate end-to-end on a real miniature fleet laid
+    out as the multi-stream convention: every sampled hop stitches, the
+    WAL cross-check agrees with the journal_op telemetry, the timeline
+    places every event — --check exits 0. Then one orphaned replica
+    trace is planted and the gate must go LOUD (exit 1)."""
+    tok, model, params, datasets = world
+    root = tmp_path / "fleet"
+    loggers = []
+
+    def mk(rid):
+        lg = MetricsLogger(root / rid, quiet=True)
+        lg.set_identity("serve", replica=rid)
+        loggers.append(lg)
+        return InProcessReplica(rid, _mk_engine(world, logger=lg))
+
+    replicas = {rid: mk(rid) for rid in ("r01", "r02")}
+    rlog = MetricsLogger(root / "router", quiet=True)
+    rlog.set_identity("router")
+    loggers.append(rlog)
+    router = FleetRouter(dict(replicas), logger=rlog, trace_sample=1.0)
+    journal = FleetJournal(root / "journal", logger=rlog)
+    control = FleetControl(router, journal=journal)
+    try:
+        for i, t in enumerate(("t0", "t1", "t2")):
+            control.register_tenant(t, datasets[i])
+        for h in router.replicas.values():
+            h.warmup()
+        for i in range(9):
+            t = f"t{i % 3}"
+            router.classify(_pool(datasets[i % 3])[i % 4], 10.0,
+                            tenant=t)
+        control.add_replica(mk("r03"))
+        control.replace_tenants()
+        control.publish_params(params)
+        for i in range(6):
+            router.classify(_pool(datasets[i % 3])[i % 4], 10.0,
+                            tenant=f"t{i % 3}")
+        router.emit_stats()
+    finally:
+        router.close()
+        for lg in loggers:
+            lg.close()
+    assert fleet_report.main([str(root), "--check"]) == 0
+
+    # Plant an orphan: a replica-side request trace no hop ever named.
+    with open(root / "r01" / "metrics.jsonl", "a") as f:
+        f.write(json.dumps({
+            "step": 999, "kind": "trace", "wall_s": 9.9,
+            "trace_id": "dead-00000099", "tenant": "t0",
+            "queue_ms": 0.1, "pack_ms": 0.1, "execute_ms": 0.1,
+            "respond_ms": 0.1, "total_ms": 0.4,
+            "proc_role": "serve", "proc_replica": "r01",
+            "proc_pid": os.getpid(), "t_unix": time.time(),
+        }) + "\n")
+    assert fleet_report.main([str(root), "--check"]) == 1

@@ -154,6 +154,17 @@ BANDS: dict[str, tuple[str, float]] = {
     "scale.passed": ("floor", 1.0),
     "scale.promotion_recovered": ("floor", 1.0),
     "scale.split_brain_refused": ("floor", 1.0),
+    # Fleet observability drill (ISSUE 17, OBSFLEET_r*.json): the
+    # stitching invariants as zero-bands — every sampled hop must find
+    # its replica-side trace (unstitched_frac=0) and no replica trace
+    # may go unclaimed (orphan_spans=0) — plus the pass/ordering
+    # floors. Hop-tax latencies are recorded unbanded (documented-
+    # unstable sandbox, same policy as serve.*).
+    "obsfleet.orphan_spans": ("zero", 0.0),
+    "obsfleet.unstitched_frac": ("zero", 0.0),
+    "obsfleet.passed": ("floor", 1.0),
+    "obsfleet.stitch_coverage": ("floor", 1.0),
+    "obsfleet.incidents_ordered": ("floor", 1.0),
 }
 
 
@@ -411,6 +422,37 @@ def _elastic_points(points: dict, path: str, data: dict) -> int:
     return sum(len(v) for v in points.values()) - before
 
 
+def _obsfleet_points(points: dict, path: str, data: dict) -> int:
+    """OBSFLEET_r*.json (tools/loadgen.py --fleet_obs_drill): the fleet
+    observability drill — zero-bands (orphan spans, unstitched hops),
+    the pass / full-coverage / incident-ordering floors, and recorded
+    (unbanded) hop-tax percentiles + clock-offset spread."""
+    rnd, src = _round_of(path), os.path.basename(path)
+    before = sum(len(v) for v in points.values())
+    zero = data.get("zero_bands") or {}
+    for key in ("orphan_spans", "unstitched_frac"):
+        _point(points, f"obsfleet.{key}", rnd, src, zero.get(key))
+    _point(points, "obsfleet.passed", rnd, src,
+           1.0 if data.get("passed") else 0.0)
+    st = data.get("stitching") or {}
+    _point(points, "obsfleet.stitch_coverage", rnd, src,
+           st.get("stitch_coverage"))
+    _point(points, "obsfleet.hop_records", rnd, src,
+           st.get("hop_records"))
+    tl = data.get("timeline") or {}
+    _point(points, "obsfleet.incidents_ordered", rnd, src,
+           1.0 if tl.get("incidents_ordered") else 0.0)
+    _point(points, "obsfleet.timeline_events", rnd, src,
+           tl.get("events"))
+    hp = data.get("hop") or {}
+    _point(points, "obsfleet.hop_ms_p50", rnd, src, hp.get("hop_ms_p50"))
+    _point(points, "obsfleet.hop_ms_p99", rnd, src, hp.get("hop_ms_p99"))
+    ck = data.get("clock") or {}
+    _point(points, "obsfleet.max_offset_ms", rnd, src,
+           ck.get("max_offset_ms"))
+    return sum(len(v) for v in points.values()) - before
+
+
 _EXTRACTORS = (
     ("BENCH_r*.json", _bench_points),
     ("ROOFLINE_r*.json", _roofline_points),
@@ -421,6 +463,7 @@ _EXTRACTORS = (
     ("ADAPT_r*.json", _adapt_points),
     ("RECOVERY_r*.json", _recovery_points),
     ("ELASTIC_r*.json", _elastic_points),
+    ("OBSFLEET_r*.json", _obsfleet_points),
 )
 
 

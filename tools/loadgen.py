@@ -215,6 +215,21 @@ def parse_args(argv=None):
                         "dropped)")
     p.add_argument("--elastic_artifact", default=None, metavar="PATH",
                    help="write the ELASTIC_r*.json drill artifact here")
+    p.add_argument("--fleet_obs_drill", action="store_true",
+                   help="fleet observability drill (ISSUE 17), standalone "
+                        "mode on its own miniature fleet: 3 replicas with "
+                        "per-process telemetry streams laid out as the "
+                        "tools/fleet_report.py run-dir convention "
+                        "(router/, r*/, journal/), open-loop load through "
+                        "one scale-out + one replica kill + one fan-out "
+                        "publish mid-run; asserts every sampled hop "
+                        "stitches to its replica-side trace, zero orphan "
+                        "spans, the incidents land in the timeline in "
+                        "fire order, and fleet_report --check is green "
+                        "(requires --run_dir: the fleet layout lands "
+                        "there)")
+    p.add_argument("--obsfleet_artifact", default=None, metavar="PATH",
+                   help="write the OBSFLEET_r*.json drill artifact here")
     p.add_argument("--slo_profile", action="store_true",
                    help="also attempt a jax.profiler trace in the SLO "
                         "auto-capture (default off: on this image a "
@@ -233,6 +248,9 @@ def parse_args(argv=None):
         p.error("--chaos_drill needs --run_dir (captures land there)")
     if args.adapt_drill and not args.run_dir:
         p.error("--adapt_drill needs --run_dir (captures land there)")
+    if args.fleet_obs_drill and not args.run_dir:
+        p.error("--fleet_obs_drill needs --run_dir (the fleet's "
+                "multi-stream layout lands there)")
     return args
 
 
@@ -3096,6 +3114,229 @@ def check_elastic_drill(out: dict) -> bool:
     )
 
 
+def fleet_obs_drill(seed: int = 0, fleet_dir: str | None = None) -> dict:
+    """The fleet observability drill (ISSUE 17): a 3-replica fleet laid
+    out as the MULTI-STREAM run-dir convention tools/fleet_report.py
+    ingests — ``router/`` (router-process telemetry: hops, rollups,
+    journal-op events), one dir per replica (identity-stamped engine
+    streams with the sampled request waterfalls), ``journal/`` (the
+    WAL) — driven with open-loop load through one scale-out, one
+    replica kill, and one fan-out publish mid-run. The stitched report
+    is the system under test: every sampled hop must stitch to its
+    replica-side trace (unstitched_frac=0), no replica trace may go
+    unclaimed (orphan_spans=0), the journal ops must land in the
+    timeline in the order they were fired, and fleet_report --check
+    must be green. Stamped into OBSFLEET_r*.json."""
+    from pathlib import Path
+
+    import jax
+
+    import fleet_report
+
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.data import (
+        make_synthetic_fewrel,
+        make_synthetic_glove,
+    )
+    from induction_network_on_fewrel_tpu.data.tokenizer import GloveTokenizer
+    from induction_network_on_fewrel_tpu.fleet import (
+        FleetControl,
+        FleetRouter,
+        InProcessReplica,
+    )
+    from induction_network_on_fewrel_tpu.fleet.journal import FleetJournal
+    from induction_network_on_fewrel_tpu.models import build_model
+    from induction_network_on_fewrel_tpu.serving.batcher import Saturated
+    from induction_network_on_fewrel_tpu.serving.buckets import zero_batch
+    from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
+    from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+    if fleet_dir is None:
+        raise ValueError("fleet_obs_drill needs a fleet dir (--run_dir)")
+    root = Path(fleet_dir)
+    root.mkdir(parents=True, exist_ok=True)
+    R, T = 3, 6
+    cfg = ExperimentConfig(
+        model="induction", encoder="cnn", hidden_size=16,
+        vocab_size=122, word_dim=8, pos_dim=2, max_length=16,
+        induction_dim=8, ntn_slices=4, routing_iters=2,
+        n=3, train_n=3, k=2, q=2, device="cpu", seed=seed,
+    )
+    vocab = make_synthetic_glove(
+        vocab_size=cfg.vocab_size - 2, word_dim=cfg.word_dim
+    )
+    tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+    model = build_model(cfg, glove_init=vocab.vectors)
+    params = model.init(
+        jax.random.key(seed),
+        zero_batch(cfg.max_length, (1, cfg.n, cfg.k)),
+        zero_batch(cfg.max_length, (1, 2)),
+    )
+    datasets = [
+        make_synthetic_fewrel(
+            num_relations=cfg.n, instances_per_relation=cfg.k + 6,
+            vocab_size=cfg.vocab_size - 2, seed=seed + 101 * d,
+        )
+        for d in range(4)
+    ]
+    loggers: list = []
+
+    def mk(rid):
+        # ONE stream per process-equivalent: each replica gets its own
+        # run dir + logger stamped with its serve identity — what makes
+        # the streams separable again after fleet_report merges them.
+        lg = MetricsLogger(root / rid, quiet=True)
+        lg.set_identity("serve", replica=rid)
+        loggers.append(lg)
+        return InProcessReplica(rid, InferenceEngine(
+            model, params, cfg, tok, k=cfg.k, buckets=(1, 2, 4),
+            logger=lg,
+        ))
+
+    replicas = {f"r{i + 1:02d}": mk(f"r{i + 1:02d}") for i in range(R)}
+    rlog = MetricsLogger(root / "router", quiet=True)
+    rlog.set_identity("router")
+    loggers.append(rlog)
+    router = FleetRouter(dict(replicas), logger=rlog, trace_sample=0.5,
+                         queue_capacity_per_replica=64)
+    journal = FleetJournal(root / "journal", logger=rlog)
+    control = FleetControl(router, journal=journal)
+    out: dict = {"replicas": R, "tenants": T, "seed": seed}
+    futs: list = []
+    try:
+        names = [f"t{i:02d}" for i in range(T)]
+        for i, t in enumerate(names):
+            control.register_tenant(t, datasets[i % 4])
+        for h in router.replicas.values():
+            h.warmup()
+        pools = {
+            t: [
+                inst for rel in datasets[i % 4].rel_names
+                for inst in datasets[i % 4].instances[rel][cfg.k:]
+            ]
+            for i, t in enumerate(names)
+        }
+
+        def open_loop(n, phase):
+            # Open loop: fixed arrival cadence, completions collected at
+            # the end — queueing shows up in the hop segments instead of
+            # gating the arrival rate.
+            for s in range(n):
+                t = names[(s + phase) % T]
+                try:
+                    futs.append(router.submit(
+                        pools[t][s % len(pools[t])], 10.0, tenant=t,
+                    ))
+                except Saturated:
+                    pass
+                time.sleep(0.002)
+
+        open_loop(24, 0)
+        # Incident 1: scale-out (journals replica_add; churn re-placed).
+        control.add_replica(mk(f"r{R + 1:02d}"))
+        control.replace_tenants()
+        router.replicas[f"r{R + 1:02d}"].warmup()
+        open_loop(24, 1)
+        # Incident 2: replica kill. The engine object keeps draining its
+        # queue (in-flight sampled requests still land their replica
+        # traces — nothing goes orphan), but placement fails over and
+        # the timeline gets its fault record.
+        victim = router.directory[names[0]].owner
+        router.mark_replica_dead(victim, reason="drill")
+        control.replace_tenants()
+        open_loop(24, 2)
+        # Incident 3: fan-out publish (journals publish_commit).
+        control.publish_params(params)
+        open_loop(24, 3)
+        served = degraded = 0
+        for f in futs:
+            v = f.result(timeout=30.0)
+            served += 1
+            degraded += bool(v.get("degraded"))
+        out["requests"] = {"submitted": len(futs), "served": served,
+                           "degraded": degraded}
+        out["victim"] = victim
+        router.emit_stats()
+    finally:
+        router.close()
+        for lg in loggers:
+            lg.close()
+
+    # The stitched report IS the acceptance: run the same code path
+    # ``fleet_report --check`` runs, on the layout just written.
+    router_dir, rep_dirs, jdir = fleet_report.discover(root, None, [], None)
+    report = fleet_report.build_report(
+        root, router_dir, rep_dirs, jdir,
+        skew_bound_ms=250.0, n_waterfalls=3,
+    )
+    st = report["stitching"]
+    hops = [r for r in fleet_report.load_stream(router_dir)
+            if r.get("kind") == "hop"]
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        xs = sorted(vals)
+        return xs[min(len(xs) - 1, int(q / 100.0 * len(xs)))]
+
+    hop_ms = [float(r["hop_ms"]) for r in hops]
+    router_ms = [float(r["router_ms"]) for r in hops]
+    out["stitching"] = {
+        "hop_records": st["hop_records"],
+        "stitched": st["stitched"],
+        "stitch_coverage": round(
+            st["stitched"] / st["hop_records"], 4
+        ) if st["hop_records"] else 0.0,
+        "unstitched_frac": st["unstitched_frac"],
+        "orphan_spans": st["orphan_spans"],
+    }
+    out["hop"] = {
+        "hop_ms_p50": pct(hop_ms, 50), "hop_ms_p99": pct(hop_ms, 99),
+        "router_ms_p50": pct(router_ms, 50),
+        "router_ms_p99": pct(router_ms, 99),
+    }
+    out["clock"] = {
+        "max_offset_ms": report["worst_skew_ms"],
+        "per_replica": report["clock_offset_ms"],
+    }
+    tl = report["timeline"]["raw"]
+
+    def first(pred):
+        return next((i for i, e in enumerate(tl) if pred(e)), None)
+
+    i_add = first(lambda e: "journal replica_add" in e["event"])
+    i_kill = first(lambda e: "DEAD" in e["event"])
+    i_pub = first(lambda e: "journal publish_commit" in e["event"])
+    out["timeline"] = {
+        "events": report["timeline"]["events"],
+        "unplaced": report["timeline"]["unplaced_events"],
+        "journal_ops": sum(
+            1 for e in tl if e["event"].startswith("journal ")
+        ),
+        "incidents_ordered": (
+            None not in (i_add, i_kill, i_pub)
+            and i_add < i_kill < i_pub
+        ),
+    }
+    out["zero_bands"] = {
+        "orphan_spans": st["orphan_spans"],
+        "unstitched_frac": st["unstitched_frac"],
+    }
+    out["check_failures"] = report["failures"]
+    out["waterfalls_rendered"] = len(report["waterfalls"])
+    out["passed"] = bool(
+        not report["failures"]
+        and st["hop_records"] >= 10
+        and out["stitching"]["stitch_coverage"] == 1.0
+        and st["orphan_spans"] == 0
+        and out["timeline"]["incidents_ordered"]
+        and out["timeline"]["unplaced"] == 0
+        and out["waterfalls_rendered"] >= 1
+        and out["requests"]["served"] == out["requests"]["submitted"]
+    )
+    return out
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     import numpy as np
@@ -3117,7 +3358,8 @@ def main(argv=None) -> int:
     tmp = None
     ckpt = args.ckpt
     if ckpt is None and not (args.adapt_drill or args.recovery_drill
-                             or args.elastic_drill):
+                             or args.elastic_drill
+                             or args.fleet_obs_drill):
         # --adapt_drill / --recovery_drill / --elastic_drill build
         # their own miniature worlds (the default synthetic checkpoint
         # would be dead weight — one more orbax world for no reason).
@@ -3133,7 +3375,12 @@ def main(argv=None) -> int:
     # carry the scheduler, so obs_report can split); SLO engines are
     # per-arm (fresh burn windows each).
     logger = recorder = capture = None
-    if args.run_dir:
+    if args.fleet_obs_drill:
+        # The obs drill lays its OWN multi-stream convention under
+        # --run_dir (router/, r*/, journal/) — a shared top-level
+        # metrics.jsonl would be a fifth stream nothing reads.
+        pass
+    elif args.run_dir:
         from induction_network_on_fewrel_tpu.obs import (
             DiagnosticsCapture,
             FlightRecorder,
@@ -3313,6 +3560,48 @@ def main(argv=None) -> int:
                 print(f"telemetry in {args.run_dir} — render with "
                       f"'python tools/obs_report.py {args.run_dir}'",
                       file=sys.stderr)
+            return rc
+        if args.fleet_obs_drill:
+            # Standalone mode (like --fleet): the observability plane
+            # is the system under test, on its own miniature fleet laid
+            # out as the fleet_report run-dir convention.
+            drill = fleet_obs_drill(seed=args.seed,
+                                    fleet_dir=args.run_dir)
+            st, hp, tl = (drill["stitching"], drill["hop"],
+                          drill["timeline"])
+            print(f"[fleet obs drill] hops={st['hop_records']} "
+                  f"coverage={st['stitch_coverage']} "
+                  f"unstitched_frac={st['unstitched_frac']} "
+                  f"orphans={st['orphan_spans']} "
+                  f"hop_p50={hp['hop_ms_p50']}ms "
+                  f"hop_p99={hp['hop_ms_p99']}ms "
+                  f"router_p50={hp['router_ms_p50']}ms; "
+                  f"timeline events={tl['events']} "
+                  f"journal_ops={tl['journal_ops']} "
+                  f"incidents_ordered={tl['incidents_ordered']} "
+                  f"check_failures={len(drill['check_failures'])}")
+            if not drill["passed"]:
+                print("FAIL[fleet obs drill]: stitching/timeline "
+                      "invariants did not hold", file=sys.stderr)
+                rc = 1
+            report = {
+                "round": 1,
+                "generated_by": "tools/loadgen.py --fleet_obs_drill",
+                **drill,
+            }
+            print(json.dumps({
+                k: report[k] for k in
+                ("replicas", "tenants", "stitching", "hop", "timeline",
+                 "zero_bands", "passed")
+                if k in report
+            }))
+            if args.obsfleet_artifact:
+                with open(args.obsfleet_artifact, "w") as fh:
+                    json.dump(report, fh, indent=1)
+                print(f"wrote {args.obsfleet_artifact}", file=sys.stderr)
+            print(f"fleet layout in {args.run_dir} — render with "
+                  f"'python tools/fleet_report.py {args.run_dir}'",
+                  file=sys.stderr)
             return rc
         if args.adapt_drill:
             # Standalone mode (like --fleet): the adaptation loop is the

@@ -120,6 +120,30 @@ def load_records(path: Path) -> list[dict]:
     return recs
 
 
+def _process_identity(recs: list[dict]) -> str | None:
+    """The per-process identity column (ISSUE 17): multi-process fleet
+    streams stamp proc_role/proc_replica/proc_pid on every record
+    (utils/metrics.MetricsLogger.set_identity). Folded to one string per
+    distinct process so single-process runs (no identity set) render
+    exactly as before — the column only appears when the stream carries
+    it."""
+    seen: dict[tuple, None] = {}
+    for r in recs:
+        role = r.get("proc_role")
+        if not isinstance(role, str):
+            continue
+        key = (role, r.get("proc_replica"), r.get("proc_pid"))
+        seen.setdefault(key, None)
+    if not seen:
+        return None
+    parts = []
+    for role, replica, pid in seen:
+        tag = f"{role}/{replica}" if isinstance(replica, str) else role
+        parts.append(f"{tag} pid={int(pid)}" if isinstance(
+            pid, (int, float)) else tag)
+    return ", ".join(parts)
+
+
 def train_summary(recs: list[dict]) -> dict | None:
     """Per-window step times from consecutive train records: each record
     logs at wall_s having advanced `step`; dt/dstep is the honest
@@ -201,6 +225,9 @@ def serve_summary(recs: list[dict]) -> dict | None:
         and not isinstance(r.get("tenant"), str)
     ]
     out: dict = {"records": len(serves)}
+    proc = _process_identity(serves)
+    if proc:
+        out["process"] = proc
     if aggregate:
         last = aggregate[-1]
         out.update({
@@ -530,6 +557,9 @@ def trace_summary(recs: list[dict]) -> dict | None:
     if not traces and not control:
         return None
     out: dict = {"records": len(traces) + len(control)}
+    proc = _process_identity(traces)
+    if proc:
+        out["process"] = proc
     if traces:
         out["sampled_requests"] = len(traces)
 
@@ -827,6 +857,9 @@ def fleet_summary(recs: list[dict]) -> dict | None:
         and not isinstance(r.get("event"), str)
     ]
     out: dict = {"records": len(fleet)}
+    proc = _process_identity(fleet)
+    if proc:
+        out["process"] = proc
     if aggregate:
         last = aggregate[-1]
         out.update({
@@ -841,8 +874,9 @@ def fleet_summary(recs: list[dict]) -> dict | None:
         for r in replica_recs:   # last record per replica wins
             by_replica[r["replica"]] = {
                 k: r[k] for k in (
-                    "state", "routed", "served", "p50_ms", "p99_ms",
+                    "state", "routed", "qps", "served", "p50_ms", "p99_ms",
                     "batch_occupancy", "steady_recompiles", "queue_depth",
+                    "breaker",
                 ) if k in r
             }
         out["replica_table"] = {
@@ -866,6 +900,65 @@ def fleet_summary(recs: list[dict]) -> dict | None:
     ]
     if deaths:
         out["replica_dead_faults"] = len(deaths)
+    return out
+
+
+HOP_SEGMENTS = ("route", "queue", "wire", "remote", "respond")
+
+
+def hop_summary(recs: list[dict]) -> dict | None:
+    """Cross-process hop section (ISSUE 17, kind="hop"): router-side
+    segments per sampled routed request. Headlines: segment medians,
+    router_ms / hop_ms percentiles (hop_ms = the fleet tax on top of
+    the replica's own total), the tiling check (segments sum to
+    router_ms within 5% — same timestamps by construction, so the bar
+    should read 1.0), per-replica sample counts, and the last clock-
+    offset estimate per replica (the fleet_report skew input)."""
+    hops = [
+        r for r in recs
+        if r.get("kind") == "hop"
+        and isinstance(r.get("router_ms"), (int, float))
+    ]
+    if not hops:
+        return None
+    out: dict = {"records": len(hops)}
+    proc = _process_identity(hops)
+    if proc:
+        out["process"] = proc
+
+    def pct(key: str, q: float) -> float | None:
+        xs = [
+            float(r[key]) for r in hops
+            if isinstance(r.get(key), (int, float))
+        ]
+        return round(_percentile(xs, q), 3) if xs else None
+
+    for s in HOP_SEGMENTS:
+        out[f"{s}_ms_p50"] = pct(f"{s}_ms", 50)
+    out["router_ms_p50"] = pct("router_ms", 50)
+    out["router_ms_p99"] = pct("router_ms", 99)
+    out["hop_ms_p50"] = pct("hop_ms", 50)
+    out["hop_ms_p99"] = pct("hop_ms", 99)
+    sums_ok = sum(
+        1 for r in hops
+        if float(r["router_ms"]) > 0 and abs(
+            sum(float(r.get(f"{s}_ms", 0.0)) for s in HOP_SEGMENTS)
+            - float(r["router_ms"])
+        ) <= 0.05 * float(r["router_ms"])
+    )
+    out["segments_sum_ok_frac"] = round(sums_ok / len(hops), 4)
+    by_replica: dict[str, int] = {}
+    offsets: dict[str, float] = {}
+    for r in hops:
+        rid = str(r.get("replica"))
+        by_replica[rid] = by_replica.get(rid, 0) + 1
+        if isinstance(r.get("offset_ms"), (int, float)):
+            offsets[rid] = float(r["offset_ms"])
+    out["by_replica"] = {k: by_replica[k] for k in sorted(by_replica)}
+    if any(offsets.values()):
+        out["clock_offset_ms"] = {
+            k: offsets[k] for k in sorted(offsets)
+        }
     return out
 
 
@@ -1121,7 +1214,8 @@ def render(report: dict) -> str:
     for e in errors[:10]:
         lines.append(f"  ! {e}")
     for section in ("train", "mfu", "eval", "perf", "compile", "serve",
-                    "fleet", "elasticity", "adapt", "faults", "recovery",
+                    "fleet", "hops", "elasticity", "adapt", "faults",
+                    "recovery",
                     "traces", "slo", "quality", "scenarios", "ckpt",
                     "input_pipeline", "comms", "roofline", "health",
                     "flight_recorder", "overhead"):
@@ -1190,6 +1284,7 @@ def main(argv=None) -> int:
         "compile": compile_summary(recs),
         "serve": serve_summary(recs),
         "fleet": fleet_summary(recs),
+        "hops": hop_summary(recs),
         "elasticity": elasticity_summary(recs),
         "adapt": adapt_summary(recs),
         "faults": fault_summary(recs),
