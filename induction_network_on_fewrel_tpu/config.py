@@ -206,6 +206,20 @@ class ExperimentConfig:
     # event="grad_probe" in metrics.jsonl). 0 = off. Live-token
     # single-device path only (cached/adv paths skip it with a warning).
     grad_probe_every: int = 0
+    # Quantized serving data plane (ISSUE 18, serving/registry.py): dtype
+    # of the RESIDENT per-tenant class-vector matrix on the serving chip.
+    # "f32" (default), "bf16", or "int8" (per-tenant symmetric scale, the
+    # scale itself kept f32 and passed into the compiled program). Serving
+    # runtime knob, NOT an architecture field: checkpoints always hold f32
+    # class vectors; residency is a deployment decision per tenant.
+    resident_dtype: str = "f32"
+    # Quantization parity police (ISSUE 18, modeled on grad_probe_every):
+    # every K scored batches of a quantized tenant, shadow-score the same
+    # queries against the f32 class matrix and record verdict agreement +
+    # margin drift (serving/stats.py, and obs/drift.py observe_parity so
+    # a quantization regression trips the SAME alarm path as model
+    # drift). 0 = off.
+    quant_probe_every: int = 0
     # Telemetry-failure injection: corrupt the LOGGED loss with NaN once
     # the step counter crosses this value (training state is untouched) —
     # exercises watchdog trip + flight-recorder dump end-to-end the way
@@ -417,6 +431,44 @@ def parse_canary_plan(spec: str) -> dict[str, float]:
             raise ValueError(f"canary plan names leg {leg!r} twice")
         floors[leg] = floor
     return floors
+
+
+# Legal values for the resident class-matrix dtype (ISSUE 18). Order is
+# the density ladder: f32 is the checkpoint truth, bf16 halves resident
+# bytes with dequant-free scoring (a plain upcast the head does anyway),
+# int8 quarters them behind a per-tenant symmetric f32 scale.
+RESIDENT_DTYPE_CHOICES = ("f32", "bf16", "int8")
+
+
+def resolve_quant_policy(knobs: Any, base: "ExperimentConfig | None" = None):
+    """ONE home for the quantized-serving knob resolution (ISSUE 18, the
+    models/build.resolve_runtime_backends discipline), shared by
+    serve.py and the loadgen drills. ``knobs`` is any object with
+    ``resident_dtype``/``quant_probe_every`` attributes — an
+    ExperimentConfig or an argparse namespace; a missing or None
+    attribute falls back to ``base`` (the served checkpoint's stored
+    config), then to the ExperimentConfig default. Returns the validated
+    policy dict {"resident_dtype", "probe_every"}."""
+    fields = {f.name: f.default for f in dataclasses.fields(ExperimentConfig)}
+
+    def knob(name):
+        v = getattr(knobs, name, None)
+        if v is None and base is not None:
+            v = getattr(base, name, None)
+        return fields[name] if v is None else v
+
+    dtype = str(knob("resident_dtype"))
+    if dtype not in RESIDENT_DTYPE_CHOICES:
+        raise ValueError(
+            f"resident_dtype must be one of {RESIDENT_DTYPE_CHOICES}, "
+            f"got {dtype!r}"
+        )
+    probe_every = int(knob("quant_probe_every"))
+    if probe_every < 0:
+        raise ValueError(
+            f"quant_probe_every must be >= 0, got {probe_every}"
+        )
+    return {"resident_dtype": dtype, "probe_every": probe_every}
 
 
 def resolve_adapt_policy(knobs: Any, base: "ExperimentConfig | None" = None):

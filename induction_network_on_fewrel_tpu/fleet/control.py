@@ -117,10 +117,26 @@ class FleetControl:
     ) -> str:
         """Register ``tenant``'s support corpus on its rendezvous owner;
         returns the owning replica id. The source is recorded in the
-        router directory so failover can re-register it elsewhere."""
+        router directory so failover can re-register it elsewhere.
+
+        When the router carries a ``resident_budget_bytes``, placement
+        capacity is derived from RESIDENT BYTES (ISSUE 18), not tenant
+        count: a registration that would land on a replica already at
+        its byte budget is refused up front — quantized (bf16/int8)
+        tenants pack ~2-4x denser than f32 under the same budget."""
         owner = self.router.placement.place(tenant)
         if owner is None:
             raise RuntimeError("no live replica to place the tenant on")
+        budget = self.router.resident_budget_bytes
+        if budget is not None:
+            used = self.router.replica_resident_bytes(owner)
+            if used >= budget:
+                raise RuntimeError(
+                    f"replica {owner!r} is at its resident-byte budget "
+                    f"({used:.0f}/{budget:.0f} bytes) — cannot place "
+                    f"tenant {tenant!r}; lower the tenant's resident "
+                    f"dtype or add replicas"
+                )
         handle = self.router.replicas[owner]
         handle.register_dataset(dataset, tenant, max_classes=max_classes)
         entry = _TenantEntry(owner, dataset, max_classes=max_classes)

@@ -255,6 +255,13 @@ class FleetRouter:
     ``max_queue_depth``). Like the per-replica tenant share it binds
     only once a second tenant has submitted — a single-tenant fleet
     keeps full capacity.
+
+    ``resident_budget_bytes`` (ISSUE 18): per-replica budget for
+    resident class-vector bytes. Placement capacity is derived from
+    BYTES, not tenant count — an int8 tenant is ~4x cheaper than its
+    f32 twin, so the same replica holds ~4x the tenants. ``None``
+    (default) keeps the pre-quantization behavior: unbounded residency,
+    queue depth is the only capacity signal.
     """
 
     def __init__(
@@ -265,15 +272,22 @@ class FleetRouter:
         fleet_share: float = 0.5,
         trace_sample: float = 0.0,
         queue_capacity_per_replica: int = 64,
+        resident_budget_bytes: float | None = None,
     ):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
+        if resident_budget_bytes is not None and resident_budget_bytes <= 0:
+            raise ValueError(
+                f"resident_budget_bytes must be positive, got "
+                f"{resident_budget_bytes}"
+            )
         self.replicas: dict[str, ReplicaHandle] = dict(replicas)
         self.placement = FleetPlacement(self.replicas)
         self._logger = logger
         self._tracer = TraceSampler(trace_sample)
         self.fleet_share = fleet_share
         self._capacity_per_replica = queue_capacity_per_replica
+        self.resident_budget_bytes = resident_budget_bytes
         # Per-replica circuit breaker: serving/breaker.CircuitBreaker
         # keyed by REPLICA id — consecutive forwarded-launch failures
         # open it, the open transition marks the replica dead in
@@ -313,6 +327,15 @@ class FleetRouter:
 
     def _tenant_cap(self) -> int:
         return max(1, int(self._fleet_capacity() * self.fleet_share))
+
+    def replica_resident_bytes(self, rid: str) -> float:
+        """Bytes of resident class vectors on one replica (0.0 when the
+        replica is dead or predates the resident_bytes gauge)."""
+        try:
+            snap = self.replicas[rid].stats_snapshot()
+        except Exception:  # noqa: BLE001 — dead replica: no residency
+            return 0.0
+        return float(snap.get("resident_bytes", 0.0))
 
     # --- data plane -------------------------------------------------------
 
@@ -991,6 +1014,8 @@ class FleetRouter:
             ("routed", "requests routed to the replica"),
             ("up", "1 = UP in placement"),
             ("breaker_open", "1 = breaker open, 0.5 = half-open"),
+            ("resident_bytes", "bytes of resident class vectors"),
+            ("quant_agreement", "sampled quantized-vs-f32 verdict agreement"),
         ):
             self._families[col] = reg.labeled_gauge(
                 f"{prefix}_replica_{col}", help=help
@@ -1048,7 +1073,8 @@ class FleetRouter:
                 k: snap[k] for k in (
                     "served", "p50_ms", "p99_ms", "batch_occupancy",
                     "steady_recompiles", "queue_depth", "degraded",
-                    "shed", "deadline_missed",
+                    "shed", "deadline_missed", "resident_bytes",
+                    "quant_probes", "quant_agreement",
                 ) if k in snap
             })
             if self.breaker is not None:
@@ -1070,6 +1096,8 @@ class FleetRouter:
             "routed": row.get("routed", 0.0),
             "up": 1.0 if row.get("state") == UP else 0.0,
             "breaker_open": {"open": 1.0, "half_open": 0.5}.get(brk, 0.0),
+            "resident_bytes": row.get("resident_bytes", 0.0),
+            "quant_agreement": row.get("quant_agreement", 1.0),
         }
         for col, v in values.items():
             fam = self._families.get(col)

@@ -73,10 +73,18 @@ class RelationNTN(nn.Module):
             "tensor_slices", nn.initializers.glorot_normal(batch_axis=(0,)), (self.slices, C, C)
         )
         # One contraction for all (query, class, slice) triples; MXU-sized.
+        # Bilinear slices stay in the compute dtype; accumulation is pinned
+        # to f32 (preferred_element_type) so sub-f32 residents (ISSUE 18
+        # quantized serving) never accumulate in the narrow dtype. No-op
+        # when everything is already f32.
         cM = jnp.einsum(
-            "bnc,hcd->bnhd", class_vec, M.astype(self.compute_dtype)
+            "bnc,hcd->bnhd", class_vec, M.astype(self.compute_dtype),
+            preferred_element_type=jnp.float32,
         )
-        v = nn.relu(jnp.einsum("bnhd,bqd->bqnh", cM, query))
+        v = nn.relu(jnp.einsum(
+            "bnhd,bqd->bqnh", cM, query,
+            preferred_element_type=jnp.float32,
+        ))
         out = nn.Dense(1, dtype=self.compute_dtype, param_dtype=jnp.float32)(v)
         return out[..., 0]  # [B, TQ, N]
 
@@ -148,11 +156,21 @@ class InductionNetwork(FewShotModel):
             return self.induction(sup_enc)
 
     def score_queries(
-        self, class_vec: jnp.ndarray, query: dict[str, Any]
+        self, class_vec: jnp.ndarray, query: dict[str, Any],
+        scale: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         """([B, N, C] class vectors, [B, TQ] query token dict) -> relation
         logits [B, TQ, N(+1)] — the steady-state serving path: one encoder
-        pass over the queries plus the NTN score, no support work at all."""
+        pass over the queries plus the NTN score, no support work at all.
+
+        ``class_vec`` may be a quantized resident matrix (ISSUE 18): bf16
+        rides the existing head-dtype upcast dequant-free; int8 passes its
+        per-tenant symmetric f32 ``scale`` and is dequantized here, inside
+        the compiled program — the [B, N, C] matrix is tiny next to the
+        query encoder, so the dequant is noise while the resident (HBM)
+        copy stays int8."""
+        if scale is not None:
+            class_vec = class_vec.astype(jnp.float32) * scale
         if isinstance(query, dict):
             with jax.named_scope("encoder"):
                 qry_enc = self.encode(

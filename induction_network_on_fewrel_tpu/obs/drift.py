@@ -125,6 +125,9 @@ class DriftDetector:
         eval_interval_s: float = 1.0,
         nota_rate_floor: float = 0.05,
         rel_floor: float = 0.1,
+        parity_floor: float = 0.99,
+        parity_margin_band: float = 0.25,
+        parity_window: int = 8,
         logger=None,
         recorder=None,
         capture=None,
@@ -143,7 +146,25 @@ class DriftDetector:
         ``nota_rate_floor``: absolute band floor for the NOTA rate (a
         clean baseline has rate 0.0 with std 0.0); margin/entropy floor
         at ``rel_floor`` of their baseline scale instead (score units are
-        model-dependent)."""
+        model-dependent).
+
+        Quantization parity bands (ISSUE 18): ``parity_floor`` is the
+        absolute verdict-agreement floor the parity police holds
+        quantized tenants to (WARNING below it, CRITICAL past
+        ``crit_factor`` of the shortfall band ``1 - parity_floor``);
+        ``parity_margin_band`` bounds the mean |margin drift| vs f32 the
+        same way; ``parity_window`` is how many probes the rolling
+        parity means average over. Unlike the drift features these need
+        NO calibration baseline — f32 agreement is an absolute bar, not
+        a distribution."""
+        if not 0.0 < parity_floor <= 1.0:
+            raise ValueError(
+                f"parity_floor must be in (0, 1], got {parity_floor}"
+            )
+        if parity_window < 1:
+            raise ValueError(
+                f"parity_window must be >= 1, got {parity_window}"
+            )
         if min_count is None:
             min_count = min(32, window)
         if baseline_n < 2 or window < 2 or min_count < 2:
@@ -162,6 +183,9 @@ class DriftDetector:
         self.eval_interval_s = eval_interval_s
         self.nota_rate_floor = nota_rate_floor
         self.rel_floor = rel_floor
+        self.parity_floor = parity_floor
+        self.parity_margin_band = parity_margin_band
+        self.parity_window = parity_window
         self.logger = logger
         self.recorder = recorder
         self.capture = capture
@@ -175,6 +199,9 @@ class DriftDetector:
         self._win: dict[str, deque] = {}
         self._seen: dict[str, int] = {}       # verdicts observed per tenant
         self._last_eval: dict[str, float] = {}
+        # tenant -> rolling window of parity-probe outcomes
+        # (agreement, margin_drift, rows) — ISSUE 18 parity police.
+        self._parity_win: dict[str, deque] = {}
         self.rearms = 0
         self.events: deque[HealthEvent] = deque(maxlen=512)
         self.tripped = False
@@ -223,6 +250,7 @@ class DriftDetector:
         with self._lock:
             tenants = [tenant] if tenant is not None else list(
                 set(self._baseline) | set(self._base_buf) | set(self._win)
+                | set(self._parity_win)
             )
             # Quiet no-op when the target never accumulated state: the
             # engine re-arms on every control-plane change (register /
@@ -230,6 +258,7 @@ class DriftDetector:
             # any traffic must not spam drift_rearm events.
             had_any = any(
                 t in self._baseline or t in self._base_buf or t in self._win
+                or t in self._parity_win
                 for t in tenants
             )
             for t in tenants:
@@ -237,6 +266,11 @@ class DriftDetector:
                 self._base_buf.pop(t, None)
                 self._win.pop(t, None)
                 self._last_eval.pop(t, None)
+                # Parity windows drop with the rest: a publish or a
+                # residency roll changes the quantization error, so old
+                # probe outcomes no longer describe the live matrix
+                # (and _unlatch clears the quant_* latches by prefix).
+                self._parity_win.pop(t, None)
                 self._unlatch(t)
             if had_any:
                 self.rearms += 1
@@ -297,6 +331,93 @@ class DriftDetector:
         for ev, latch in pending:
             self._send(ev, latch)
         return [ev for ev, _ in pending]
+
+    def observe_parity(
+        self,
+        tenant: str,
+        agreement: float,
+        margin_drift: float,
+        rows: int = 1,
+        now: float | None = None,
+    ) -> list[HealthEvent]:
+        """One quantization parity-probe outcome (ISSUE 18): the engine's
+        sampled f32 shadow-score hands over the probe's verdict-agreement
+        fraction and mean |margin drift|. Judged against the ABSOLUTE
+        parity bands (no calibration baseline — f32 IS the reference) on
+        every probe, and routed through the exact same latch/auto-
+        capture/on_event path as feature drift, so a quantization
+        regression trips the same alarms the adaptation loop (PR 13)
+        listens to. Returns newly emitted events (tests/drills)."""
+        pending: list[tuple[HealthEvent, str]] = []
+        with self._lock:
+            win = self._parity_win.setdefault(
+                tenant, deque(maxlen=self.parity_window)
+            )
+            win.append((float(agreement), float(margin_drift), int(rows)))
+            total = sum(r for _, _, r in win)
+            agree = sum(a * r for a, _, r in win) / max(total, 1)
+            drift = sum(d * r for _, d, r in win) / max(total, 1)
+            checks = (
+                # (feature, shift, band): agreement judged as shortfall
+                # below 1.0 against the floor's allowance; margin drift
+                # as an absolute excursion from 0.
+                ("quant_agreement", 1.0 - agree, 1.0 - self.parity_floor),
+                ("quant_margin_drift", drift, self.parity_margin_band),
+            )
+            for f, shift, band in checks:
+                warn_latch = f"drift:{tenant}:{f}:warning"
+                crit_latch = f"drift:{tenant}:{f}:critical"
+                if shift <= band:
+                    self._latched.discard(warn_latch)
+                    self._latched.discard(crit_latch)
+                    continue
+                severity = (
+                    CRITICAL if shift > self.crit_factor * band else WARNING
+                )
+                latch = crit_latch if severity == CRITICAL else warn_latch
+                if latch in self._latched:
+                    continue
+                self._latched.add(latch)
+                if severity == CRITICAL:
+                    self._latched.add(warn_latch)
+                cur = agree if f == "quant_agreement" else drift
+                pending.append((HealthEvent(
+                    event="prediction_drift", severity=severity,
+                    step=self._seen.get(tenant, 0),
+                    message=(
+                        f"tenant {tenant!r} {f} {cur:.4g} breached the "
+                        f"quantization parity band {band:.4g} "
+                        f"({total} probed rows)"
+                    ),
+                    data={
+                        "tenant": tenant, "feature": f,
+                        "baseline": 1.0 if f == "quant_agreement" else 0.0,
+                        "current": round(cur, 6),
+                        "band": round(band, 6), "window": total,
+                    },
+                ), latch))
+        for ev, latch in pending:
+            self._send(ev, latch)
+        return [ev for ev, _ in pending]
+
+    def parity_state(self, tenant: str) -> dict | None:
+        """{agreement, margin_drift, probes, rows} rolling parity view for
+        a tenant with probe history; None otherwise."""
+        with self._lock:
+            win = self._parity_win.get(tenant)
+            if not win:
+                return None
+            total = sum(r for _, _, r in win)
+            return {
+                "agreement": round(
+                    sum(a * r for a, _, r in win) / max(total, 1), 6
+                ),
+                "margin_drift": round(
+                    sum(d * r for _, d, r in win) / max(total, 1), 6
+                ),
+                "probes": len(win),
+                "rows": total,
+            }
 
     # --- judgment ---------------------------------------------------------
 
@@ -417,6 +538,7 @@ class DriftDetector:
         its periodic stats emit."""
         with self._lock:
             tenants = sorted(self._baseline)
+            parity_tenants = sorted(self._parity_win)
         for tenant in tenants:
             st = self.drift_state(tenant)
             if st is None:
@@ -431,3 +553,14 @@ class DriftDetector:
                 fields[f"{f}_cur"] = st[f]["cur"]
                 fields[f"{f}_band"] = st[f]["band"]
             logger.log(step, kind="quality", **fields)
+        for tenant in parity_tenants:
+            st = self.parity_state(tenant)
+            if st is None:
+                continue
+            logger.log(
+                step, kind="quality", tenant=tenant, probe="quant_parity",
+                agreement=st["agreement"], margin_drift=st["margin_drift"],
+                probes=float(st["probes"]), rows=float(st["rows"]),
+                agreement_floor=self.parity_floor,
+                margin_band=self.parity_margin_band,
+            )

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import ml_dtypes
 import numpy as np
 
 # Powers of two up to 16: at CPU/TPU serving shapes the encoder matmuls for
@@ -33,6 +34,30 @@ DEFAULT_BUCKETS = (1, 2, 4, 8, 16)
 QUERY_DTYPES = {
     "word": np.int32, "pos1": np.int16, "pos2": np.int16, "mask": np.int8,
 }
+
+# Resident class-matrix dtypes (ISSUE 18 quantized serving). The AOT
+# executables are dtype-exact, so the resident dtype is PART of the program
+# cache key — mixed-precision tenants co-resident on one replica each hit
+# their own compiled program instead of colliding in one signature. int8
+# programs additionally take the per-tenant symmetric dequant scale (f32
+# scalar) as an argument, so re-quantizing a tenant never recompiles.
+RESIDENT_DTYPES = {
+    "f32": np.dtype(np.float32),
+    "bf16": np.dtype(ml_dtypes.bfloat16),
+    "int8": np.dtype(np.int8),
+}
+_DTYPE_NAMES = {v: k for k, v in RESIDENT_DTYPES.items()}
+
+
+def resident_dtype_name(dtype) -> str:
+    """np dtype of a resident class matrix -> its knob name ("f32"/...)."""
+    name = _DTYPE_NAMES.get(np.dtype(dtype))
+    if name is None:
+        raise ValueError(
+            f"class matrix dtype {np.dtype(dtype)} is not a resident dtype "
+            f"(expected one of {sorted(RESIDENT_DTYPES)})"
+        )
+    return name
 
 
 def zero_batch(max_length: int, lead: tuple[int, ...]) -> dict[str, np.ndarray]:
@@ -96,7 +121,8 @@ def make_serving_mesh(dp: int):
 
 
 class QueryProgramCache:
-    """AOT-compiled ``score_queries`` executables keyed by (n_classes, bucket).
+    """AOT-compiled ``score_queries`` executables keyed by
+    (n_classes, bucket, resident dtype).
 
     The program signature is ``(params, class_mat [N, C], query leaves
     [bucket, L]) -> logits [bucket, N(+1)]``: params and the class matrix are
@@ -120,7 +146,7 @@ class QueryProgramCache:
         self._jax = jax
         self._stats = stats
         self._mesh = mesh
-        self._exe: dict[tuple[int, int], Any] = {}
+        self._exe: dict[tuple[int, int, str], Any] = {}
         self.compiles = 0
         self.in_warmup = False
 
@@ -132,66 +158,99 @@ class QueryProgramCache:
             )
             return logits[0]  # [bucket, N(+1)]
 
+        def score_int8(params, class_mat, scale, query):
+            logits = model.apply(
+                params, class_mat[None],
+                {k: v[None] for k, v in query.items()},
+                scale,
+                method="score_queries",
+            )
+            return logits[0]  # [bucket, N(+1)]
+
         self._score = score
+        self._score_int8 = score_int8
 
     def _compile(self, params, n_classes: int, class_dim: int,
-                 bucket: int, max_length: int):
+                 bucket: int, max_length: int, dtype: str):
         jax = self._jax
         aval = lambda s, d: jax.ShapeDtypeStruct(s, d)  # noqa: E731
         p_avals = jax.tree.map(lambda x: aval(x.shape, x.dtype), params)
-        mat = aval((n_classes, class_dim), np.float32)
+        mat = aval((n_classes, class_dim), RESIDENT_DTYPES[dtype])
         query = {
             k: aval((bucket, max_length), dt) for k, dt in QUERY_DTYPES.items()
         }
+        fn = self._score_int8 if dtype == "int8" else self._score
         if self._mesh is not None and bucket % self._mesh.shape["dp"] == 0:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             rep = NamedSharding(self._mesh, P())
             row = NamedSharding(self._mesh, P("dp", None))
+            mat_shardings = (rep, rep) if dtype == "int8" else (rep,)
             jitted = jax.jit(
-                self._score,
+                fn,
                 in_shardings=(
                     jax.tree.map(lambda _: rep, p_avals),
-                    rep,
+                    *mat_shardings,
                     {k: row for k in query},
                 ),
                 out_shardings=rep,
             )
         else:
-            jitted = jax.jit(self._score)
-        exe = jitted.lower(p_avals, mat, query).compile()
+            jitted = jax.jit(fn)
+        if dtype == "int8":
+            scale = aval((), np.float32)
+            exe = jitted.lower(p_avals, mat, scale, query).compile()
+        else:
+            exe = jitted.lower(p_avals, mat, query).compile()
         self.compiles += 1
         if self._stats is not None:
             self._stats.record_compile(during_warmup=self.in_warmup)
         return exe
 
     def get(self, params, n_classes: int, class_dim: int, bucket: int,
-            max_length: int):
-        key = (n_classes, bucket)
+            max_length: int, dtype: str = "f32"):
+        key = (n_classes, bucket, dtype)
         exe = self._exe.get(key)
         if exe is None:
             exe = self._exe[key] = self._compile(
-                params, n_classes, class_dim, bucket, max_length
+                params, n_classes, class_dim, bucket, max_length, dtype
             )
         return exe
 
     def warmup(self, params, n_classes: int, class_dim: int,
-               buckets: tuple[int, ...], max_length: int) -> int:
-        """Compile every bucket's program for the current class count;
-        returns the number of programs compiled by this call."""
+               buckets: tuple[int, ...], max_length: int,
+               dtypes: tuple[str, ...] = ("f32",)) -> int:
+        """Compile every bucket's program for the current class count, one
+        per resident dtype in ``dtypes``; returns the number of programs
+        compiled by this call."""
         before = self.compiles
         self.in_warmup = True
         try:
-            for b in buckets:
-                self.get(params, n_classes, class_dim, b, max_length)
+            for dt in dtypes:
+                for b in buckets:
+                    self.get(params, n_classes, class_dim, b, max_length, dt)
         finally:
             self.in_warmup = False
         return self.compiles - before
 
-    def run(self, params, class_mat, query: dict[str, np.ndarray]) -> np.ndarray:
-        """Execute the (n_classes, bucket) program; compiles on miss (counted
-        as a steady-state recompile unless inside warmup)."""
+    def run(self, params, class_mat, query: dict[str, np.ndarray],
+            scale=None) -> np.ndarray:
+        """Execute the (n_classes, bucket, dtype) program — the dtype comes
+        off the class matrix itself, so mixed-precision tenants sharing
+        this cache can never hit each other's signatures. Compiles on miss
+        (counted as a steady-state recompile unless inside warmup). int8
+        matrices require their per-tenant f32 ``scale``."""
         bucket, max_length = query["word"].shape
         n, c = class_mat.shape
-        exe = self.get(params, n, c, bucket, max_length)
+        dtype = resident_dtype_name(class_mat.dtype)
+        exe = self.get(params, n, c, bucket, max_length, dtype)
+        if dtype == "int8":
+            if scale is None:
+                raise ValueError(
+                    "int8 resident class matrix scored without its dequant "
+                    "scale"
+                )
+            return np.asarray(
+                exe(params, class_mat, np.float32(scale), query)
+            )
         return np.asarray(exe(params, class_mat, query))

@@ -240,6 +240,20 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
                    help="pre-publish canary plan 'leg:floor[,...]' over "
                         "legs in_domain/target (tools/scenarios."
                         "run_canary floors), or 'off'")
+    # Quantized serving data plane (ISSUE 18): knobs resolved in ONE
+    # home (config.resolve_quant_policy) — None inherits the checkpoint
+    # config, same discipline as the adapt knobs above.
+    p.add_argument("--resident_dtype", default=None,
+                   choices=["f32", "bf16", "int8"],
+                   help="storage dtype for resident class vectors: bf16 "
+                        "halves, int8 quarters resident bytes per tenant "
+                        "(per-tenant symmetric scale, f32 accumulation; "
+                        "default f32 or the checkpoint config)")
+    p.add_argument("--quant_probe_every", type=int, default=None,
+                   help="shadow-score every Nth quantized batch against "
+                        "f32 and feed verdict agreement + margin drift "
+                        "into the drift detector's parity bands "
+                        "(0 = off; the --grad_probe_every of serving)")
     p.add_argument("--seed", type=int, default=0)
     return p
 
@@ -279,6 +293,8 @@ def _build_engine(args, buckets, logger=None, watchdog=None, slo=None,
             dp=args.dp, logger=logger, watchdog=watchdog,
             slo=slo, drift=drift, breaker=breaker,
             trace_sample=trace_sample,
+            resident_dtype=args.resident_dtype,
+            quant_probe_every=args.quant_probe_every,
         )
     return _fresh_engine(args, buckets, logger=logger, watchdog=watchdog,
                          slo=slo, drift=drift, breaker=breaker,
@@ -324,6 +340,8 @@ def _fresh_engine(args, buckets, logger=None, watchdog=None, slo=None,
         dp=args.dp, logger=logger, watchdog=watchdog,
         slo=slo, drift=drift, breaker=breaker,
         trace_sample=trace_sample,
+        resident_dtype=args.resident_dtype,
+        quant_probe_every=args.quant_probe_every,
     )
 
 

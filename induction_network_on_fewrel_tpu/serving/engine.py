@@ -109,6 +109,15 @@ from induction_network_on_fewrel_tpu.serving.stats import ServingStats
 NO_RELATION = "no_relation"
 
 
+class _QuantKnobs:
+    """Adapter handing the engine's quant kwargs to the one-home
+    ``config.resolve_quant_policy`` resolver (None = inherit)."""
+
+    def __init__(self, resident_dtype, quant_probe_every):
+        self.resident_dtype = resident_dtype
+        self.quant_probe_every = quant_probe_every
+
+
 def degraded_verdict(tenant: str, *, snapshot_version: int = -1,
                      latency_ms: float = 0.0,
                      failover: bool = False) -> dict:
@@ -158,6 +167,8 @@ class InferenceEngine:
         breaker=None,
         trace_sample: float = 0.0,
         start: bool = True,
+        resident_dtype: str | None = None,
+        quant_probe_every: int | None = None,
     ):
         if cfg.model != "induction":
             raise ValueError(
@@ -218,6 +229,21 @@ class InferenceEngine:
         if breaker is not None and breaker.on_transition is None:
             breaker.on_transition = self._on_breaker_transition
 
+        # Quantized serving knobs (ISSUE 18): None inherits the served
+        # config's stored values through the one-home resolver — a train
+        # run that stamped resident_dtype serves quantized with no flag.
+        from induction_network_on_fewrel_tpu.config import (
+            resolve_quant_policy,
+        )
+
+        quant = resolve_quant_policy(
+            _QuantKnobs(resident_dtype, quant_probe_every), base=cfg
+        )
+        self.quant_probe_every = quant["probe_every"]
+        # Parity-probe cadence counter: only the single batcher worker
+        # thread touches it (_run_group), so a plain int is race-free.
+        self._quant_batches = 0
+
         self.stats = ServingStats(slo=slo)
         self.stats.bind_registry()
         # Sampled trace records awaiting their deferred jsonl flush
@@ -226,7 +252,13 @@ class InferenceEngine:
         self.registry = TenantRegistry(
             model, params, tokenizer,
             k=k if k is not None else cfg.k, logger=logger,
+            resident_dtype=quant["resident_dtype"],
         )
+        # Capacity accounting (ISSUE 18): the density denominator. The
+        # stats object exposes chip-resident bytes per tenant through
+        # the same snapshot/registry-gauge spine as every other serving
+        # counter — fleet rollups read it off stats_snapshot rows.
+        self.stats.bind_resident(self.registry.resident_bytes)
         self._mesh = make_serving_mesh(dp) if dp and dp > 1 else None
         self.programs = QueryProgramCache(
             model, stats=self.stats, mesh=self._mesh
@@ -376,18 +408,47 @@ class InferenceEngine:
 
     def warmup(self) -> int:
         """AOT-compile every bucket's query program for every registered
-        tenant's class count; returns how many programs this call compiled
-        (tenants sharing a class count share programs). After warmup,
-        steady-state traffic is zero-recompile (stats.steady_recompiles
-        counts violations)."""
+        tenant's (class count, resident dtype); returns how many programs
+        this call compiled (tenants sharing both share programs). When the
+        parity police is armed, a quantized tenant's f32 SHADOW programs
+        compile here too — a steady-state probe must never be the first
+        caller of an f32 signature. After warmup, steady-state traffic is
+        zero-recompile (stats.steady_recompiles counts violations)."""
         compiled = 0
         for tenant in self.registry.tenants():
             snap = self.registry.snapshot(tenant)
-            n, c = np.asarray(snap.matrix).shape
+            n, c = snap.matrix.shape
+            dtypes = [snap.resident_dtype]
+            if self.quant_probe_every > 0 and snap.resident_dtype != "f32":
+                dtypes.append("f32")
             compiled += self.programs.warmup(
-                snap.params, n, c, self.batcher.buckets, self.max_length
+                snap.params, n, c, self.batcher.buckets, self.max_length,
+                dtypes=tuple(dtypes),
             )
         return compiled
+
+    def set_resident_dtype(self, tenant: str, dtype: str):
+        """Re-quantize one live tenant to ``dtype`` — the parity-alarm
+        rollback path (RUNBOOK: roll the tenant to "f32" when the quant
+        parity alarm fires). Compiles the new dtype's bucket programs
+        FIRST (counted as warmup), then swaps the registry snapshot, so
+        the tenant's next batch hits a ready executable: the
+        zero-steady-state-recompile gate holds across the roll. Re-arms
+        the tenant's drift baseline — residency changes the margin
+        distribution by construction, and the parity latches must clear
+        once the regression is rolled away."""
+        snap = self.registry.snapshot(tenant)
+        n, c = snap.matrix.shape
+        dtypes = [dtype]
+        if self.quant_probe_every > 0 and dtype != "f32":
+            dtypes.append("f32")
+        self.programs.warmup(
+            snap.params, n, c, self.batcher.buckets, self.max_length,
+            dtypes=tuple(dtypes),
+        )
+        snap = self.registry.set_resident_dtype(tenant, dtype)
+        self._drift_rearm(tenant, reason=f"resident_dtype {dtype}")
+        return snap
 
     # --- hot-swap publish -------------------------------------------------
 
@@ -638,7 +699,9 @@ class InferenceEngine:
         t0 = time.monotonic()
         with span("serve/execute", links=links, rows=len(batch),
                   bucket=bucket):
-            logits = self.programs.run(snap.params, snap.matrix, query)
+            logits = self.programs.run(
+                snap.params, snap.matrix, query, scale=snap.scale
+            )
         t_exec_end = time.monotonic()
         exec_s = t_exec_end - t0
         self.stats.record_batch(len(batch), bucket, exec_s)
@@ -679,6 +742,18 @@ class InferenceEngine:
                     tenant, nota=verdict["nota"],
                     margin=verdict["margin"], entropy=verdict["entropy"],
                 )
+        if self.quant_probe_every > 0 and snap.shadow is not None:
+            # Parity police (ISSUE 18, the grad_probe_every of serving):
+            # every K-th quantized batch re-scores the SAME padded query
+            # block against the tenant's f32 shadow matrix and compares
+            # VERDICTS (the FewRel 2.0 acceptance bar — NOTA flips and
+            # label flips — not raw logit equality) plus margin drift.
+            # Also after the resolution loop: the probe pays a second
+            # program launch and may write a drift capture; clients
+            # never wait on either.
+            self._quant_batches += 1
+            if self._quant_batches % self.quant_probe_every == 0:
+                self._parity_probe(tenant, snap, query, logits, len(batch))
         if traced:
             # now - enqueued_at == queue + pack + execute + respond by
             # construction: the four segments tile [enqueued_at, now]
@@ -733,6 +808,41 @@ class InferenceEngine:
                 action="degraded_verdicts", tenant=tenant,
                 served=float(len(batch)),
             )
+
+    def _parity_probe(self, tenant: str, snap, query, logits, rows) -> None:
+        """One sampled shadow-score: re-run the padded query block against
+        the tenant's f32 shadow matrix, compare per-row VERDICTS (label +
+        NOTA flag) and margins, and feed the results to stats and the
+        drift detector's parity bands — a quantization regression trips
+        the SAME alarm path as model drift. Probe failures are contained
+        here (one fault record): the batch already answered its clients,
+        so a broken probe must not fail futures or feed the breaker."""
+        try:
+            ref = self.programs.run(snap.params, snap.shadow, query)
+            agree, drift_sum = 0, 0.0
+            for i in range(rows):
+                vq = self._verdict(logits[i], snap)
+                vf = self._verdict(ref[i], snap)
+                if vq["label"] == vf["label"] and vq["nota"] == vf["nota"]:
+                    agree += 1
+                drift_sum += abs(vq["margin"] - vf["margin"])
+            agreement = agree / rows
+            margin_drift = drift_sum / rows
+            self.stats.record_quant_probe(
+                tenant, agreement, margin_drift, rows
+            )
+            if self.drift is not None:
+                self.drift.observe_parity(
+                    tenant, agreement=agreement,
+                    margin_drift=margin_drift, rows=rows,
+                )
+        except Exception as e:  # noqa: BLE001 — probe must not hurt serving
+            if self._logger is not None:
+                self._logger.log(
+                    self.stats.served, kind="fault",
+                    action="quant_probe_error", tenant=tenant,
+                    cause=f"{type(e).__name__}: {e}",
+                )
 
     def _on_breaker_transition(self, tenant, frm, to, failures, now) -> None:
         """Breaker transitions -> one kind="fault" record each; the

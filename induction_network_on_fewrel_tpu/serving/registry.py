@@ -57,8 +57,12 @@ from typing import Any
 
 import numpy as np
 
+from induction_network_on_fewrel_tpu.config import RESIDENT_DTYPE_CHOICES
 from induction_network_on_fewrel_tpu.obs.spans import span
-from induction_network_on_fewrel_tpu.serving.buckets import QUERY_DTYPES
+from induction_network_on_fewrel_tpu.serving.buckets import (
+    QUERY_DTYPES,
+    RESIDENT_DTYPES,
+)
 
 DEFAULT_TENANT = "default"
 
@@ -68,6 +72,43 @@ class PublishError(RuntimeError):
     mid-flight and rolled back: the registry generation is UNCHANGED and
     every tenant still serves its pre-publish snapshot. The caller's
     artifact is bad, the fleet is fine."""
+
+
+class QuantArtifactError(ValueError):
+    """int8 quantization of a tenant's class matrix produced a degenerate
+    artifact (a row collapsed to all-zero under the tenant scale, or a
+    fully saturated row): the same never-becomes-resident discipline as
+    the NaN'd-artifact gate — registration is refused, a publish rolls
+    back, and an operator re-quantization quarantines the tenant."""
+
+
+def quantize_int8(stack: np.ndarray) -> tuple[np.ndarray, np.float32]:
+    """[N, C] f32 host stack -> (int8 matrix, per-tenant symmetric f32
+    scale). One scalar scale per tenant (max-abs / 127): the scale rides
+    into the compiled program as an ARGUMENT, so re-quantizing never
+    recompiles, and symmetric quantization needs no zero-point."""
+    amax = float(np.max(np.abs(stack))) if stack.size else 0.0
+    scale = np.float32(amax / 127.0) if amax > 0.0 else np.float32(1.0)
+    q = np.clip(np.rint(stack / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def quant_artifact(stack: np.ndarray, q: np.ndarray) -> str | None:
+    """Reason string when the int8 form of ``stack`` carries a degenerate
+    artifact, else None. Two failure shapes (ISSUE 18 satellite): a class
+    row whose magnitudes collapse to all-zero under the TENANT-wide scale
+    (one outlier row eating the dynamic range of the others), and a fully
+    saturated row (every element pinned at ±127 — an overflowed or
+    corrupt source)."""
+    for i in range(q.shape[0]):
+        if np.abs(q[i]).max() == 0 and np.abs(stack[i]).max() > 0.0:
+            return (
+                f"int8 dynamic-range collapse: class row {i} quantized to "
+                f"all-zero under the tenant scale"
+            )
+        if np.abs(q[i]).min() >= 127:
+            return f"int8 overflow: class row {i} fully saturated"
+    return None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,6 +134,15 @@ class Snapshot:
     # the next successful publish (a committed generation re-validates
     # every vector).
     degraded: bool = False
+    # Quantized residency (ISSUE 18). ``matrix`` above is the RESIDENT
+    # form — f32, bf16, or per-tenant-scaled symmetric int8; ``scale`` is
+    # the int8 dequant scale (f32 scalar, passed into the compiled
+    # program as an argument). ``shadow`` keeps the f32 host stack for
+    # quantized tenants — the parity police's reference matrix (host
+    # RAM, deliberately NOT counted as resident bytes).
+    resident_dtype: str = "f32"
+    scale: Any = None
+    shadow: Any = None
 
     @property
     def n_classes(self) -> int:
@@ -137,13 +187,25 @@ class TenantRegistry:
     pre-fleet callers and the simple CLI keep working.
     """
 
-    def __init__(self, model, params, tokenizer, k: int = 5, logger=None):
+    def __init__(self, model, params, tokenizer, k: int = 5, logger=None,
+                 resident_dtype: str = "f32"):
         import jax
 
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
+        if resident_dtype not in RESIDENT_DTYPE_CHOICES:
+            raise ValueError(
+                f"resident_dtype must be one of {RESIDENT_DTYPE_CHOICES}, "
+                f"got {resident_dtype!r}"
+            )
         self._model, self.params, self._tok, self.k = model, params, tokenizer, k
         self._logger = logger
+        # Quantized residency (ISSUE 18): the registry-wide default dtype
+        # for published class matrices plus per-tenant overrides (the
+        # parity-alarm rollback path pins a single tenant back to f32
+        # while the rest of the replica stays quantized).
+        self.resident_dtype = resident_dtype
+        self._tenant_dtype: dict[str, str] = {}
         self._lock = threading.Lock()
         # Publishes serialize among themselves here (held across their
         # whole snapshot -> distill -> swap cycle) WITHOUT holding the
@@ -285,6 +347,12 @@ class TenantRegistry:
                 s, tenant=dst, version=self._version
             )
             self._tenants[dst] = snap
+            # The clone inherits src's residency override (or lack of
+            # one) so its NEXT republish quantizes the way src does.
+            if src in self._tenant_dtype:
+                self._tenant_dtype[dst] = self._tenant_dtype[src]
+            else:
+                self._tenant_dtype.pop(dst, None)
             if replaced is not None and set(replaced.slots) - set(snap.slots):
                 self._gc_slots_locked()
             return snap
@@ -674,10 +742,15 @@ class TenantRegistry:
             staged_snaps: dict[str, Snapshot] = {}
             for tenant, snap in self._tenants.items():
                 slots = [live_map[s] for s in snap.slots]
-                matrix = self._jax.device_put(
-                    np.stack([staged_pool[by_digest_new[
-                        self._pool[s].digest]].vec for s in snap.slots])
-                )
+                stack = np.stack([staged_pool[by_digest_new[
+                    self._pool[s].digest]].vec for s in snap.slots])
+                try:
+                    matrix, scale, shadow = self._residency(stack, tenant)
+                except QuantArtifactError as e:
+                    # Same rollback as a non-finite vector: the new
+                    # weights produce class vectors this tenant's int8
+                    # residency cannot represent — nothing committed.
+                    raise PublishError(f"validation gate: {e}") from e
                 version += 1
                 staged_snaps[tenant] = Snapshot(
                     tenant=tenant, version=version,
@@ -685,6 +758,8 @@ class TenantRegistry:
                     names=snap.names, slots=tuple(slots), matrix=matrix,
                     params=new_params,
                     nota_threshold=snap.nota_threshold, k=self.k,
+                    resident_dtype=self.dtype_for(tenant), scale=scale,
+                    shadow=shadow,
                 )
             # COMMIT — plain assignments only; nothing below can raise.
             self._pool.update(staged_pool)
@@ -751,6 +826,67 @@ class TenantRegistry:
         still referenced)."""
         return len(self._pool)
 
+    # --- quantized residency (ISSUE 18) -----------------------------------
+
+    def dtype_for(self, tenant: str) -> str:
+        """Resident dtype this tenant publishes at: the per-tenant
+        override when one is set, else the registry default."""
+        return self._tenant_dtype.get(tenant, self.resident_dtype)
+
+    def set_resident_dtype(self, tenant: str, dtype: str) -> Snapshot:
+        """Re-quantize a live tenant to ``dtype`` from the f32 slot-pool
+        truth and republish (CoW version bump; no re-distill — the pool
+        keeps every vector in f32). This is the parity-alarm ROLLBACK
+        path (RUNBOOK): roll the tenant to "f32" and its next batch
+        scores unquantized. A degenerate int8 artifact reverts the
+        override, QUARANTINES the tenant (same guard behavior as the
+        NaN'd-artifact gate), and raises QuantArtifactError."""
+        if dtype not in RESIDENT_DTYPE_CHOICES:
+            raise ValueError(
+                f"resident_dtype must be one of {RESIDENT_DTYPE_CHOICES}, "
+                f"got {dtype!r}"
+            )
+        artifact = None
+        with self._lock:
+            snap = self._require_locked(tenant)
+            prev = self._tenant_dtype.get(tenant)
+            self._tenant_dtype[tenant] = dtype
+            try:
+                snap = self._publish_locked(
+                    tenant, list(snap.names), list(snap.slots), gc=False
+                )
+            except QuantArtifactError as e:
+                if prev is None:
+                    self._tenant_dtype.pop(tenant, None)
+                else:
+                    self._tenant_dtype[tenant] = prev
+                artifact = e
+        if artifact is not None:
+            self.quarantine_tenant(tenant, reason=str(artifact))
+            raise artifact
+        if self._logger is not None:
+            self._logger.log(
+                snap.version, kind="serve", event="resident_dtype",
+                tenant=tenant, dtype=dtype,
+            )
+        return snap
+
+    def resident_bytes(self) -> dict[str, float]:
+        """Per-tenant CHIP-resident bytes of the published snapshot: the
+        [N, C] matrix in its resident dtype plus the f32 dequant scale.
+        Host-side copies (slot pool, parity shadow) spend host RAM, not
+        HBM, and are deliberately excluded — this gauge is the density
+        denominator the capacity accounting divides by. GIL-atomic."""
+        out: dict[str, float] = {}
+        for tenant, snap in list(self._tenants.items()):
+            nbytes = int(np.dtype(snap.matrix.dtype).itemsize)
+            for dim in snap.matrix.shape:
+                nbytes *= int(dim)
+            if snap.scale is not None:
+                nbytes += 4
+            out[tenant] = float(nbytes)
+        return out
+
     # --- internals (call with the lock held) ------------------------------
 
     def _require_locked(self, tenant: str) -> Snapshot:
@@ -761,7 +897,33 @@ class TenantRegistry:
 
     def _drop_tenant_locked(self, tenant: str) -> None:
         del self._tenants[tenant]
+        self._tenant_dtype.pop(tenant, None)
         self._gc_slots_locked()
+
+    def _residency(self, stack: np.ndarray, tenant: str):
+        """Stage the RESIDENT form of a stacked [N, C] f32 class matrix
+        (ISSUE 18): device_put in the tenant's resident dtype. Returns
+        ``(matrix, scale, shadow)`` — scale is the int8 dequant scalar
+        (else None), shadow the f32 host stack kept for the parity
+        police (else None). Raises QuantArtifactError when int8
+        quantization degenerates: a registration refuses, a publish
+        rolls back, an operator re-quantization quarantines — a
+        degenerate matrix never becomes resident, exactly like the
+        NaN'd-artifact gate."""
+        dtype = self.dtype_for(tenant)
+        if dtype == "f32":
+            return self._jax.device_put(stack), None, None
+        if dtype == "bf16":
+            mat = self._jax.device_put(stack.astype(RESIDENT_DTYPES["bf16"]))
+            return mat, None, stack
+        q, scale = quantize_int8(stack)
+        reason = quant_artifact(stack, q)
+        if reason is not None:
+            raise QuantArtifactError(
+                f"registration refused: {reason} (tenant {tenant!r}; "
+                f"degenerate quantization must never become resident)"
+            )
+        return self._jax.device_put(q), scale, stack
 
     def _publish_locked(
         self, tenant: str, names: list[str], slots: list[int],
@@ -771,8 +933,8 @@ class TenantRegistry:
         if nota_threshold == "inherit":
             nota_threshold = prev.nota_threshold if prev else None
         self._version += 1
-        matrix = self._jax.device_put(
-            np.stack([self._pool[s].vec for s in slots])
+        matrix, scale, shadow = self._residency(
+            np.stack([self._pool[s].vec for s in slots]), tenant
         )
         snap = Snapshot(
             tenant=tenant, version=self._version,
@@ -783,6 +945,8 @@ class TenantRegistry:
             # quarantine — only unquarantine_tenant or a committed
             # publish (which re-validates every vector) does.
             degraded=prev.degraded if prev else False,
+            resident_dtype=self.dtype_for(tenant), scale=scale,
+            shadow=shadow,
         )
         self._tenants[tenant] = snap
         # GC only when this publish actually DROPPED slot references —

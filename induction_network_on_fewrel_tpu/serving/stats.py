@@ -107,9 +107,19 @@ class _TenantStats:
 
     __slots__ = ("served", "rejected", "shed", "deadline_missed", "lat",
                  "nota", "quality_n", "margin", "entropy",
-                 "execute_errors", "breaker_shed", "degraded")
+                 "execute_errors", "breaker_shed", "degraded",
+                 "quant_probes", "quant_rows", "quant_agree_rows",
+                 "quant_margin_sum")
 
     def __init__(self, reservoir_cap: int):
+        # Quantization parity police (ISSUE 18): sampled shadow-score
+        # outcomes — probe launches, rows compared, rows whose VERDICT
+        # (label + NOTA flag) agreed with f32, and the summed per-row
+        # |margin drift| (means come out at read time).
+        self.quant_probes = 0
+        self.quant_rows = 0
+        self.quant_agree_rows = 0
+        self.quant_margin_sum = 0.0
         self.served = 0
         self.rejected = 0
         self.shed = 0
@@ -168,6 +178,13 @@ class ServingStats:
         self.steady_compiles = 0    # programs compiled AFTER warmup — the
         #                             zero-recompile acceptance counter
         self.swaps = 0              # atomic hot-swap publishes applied
+        self.quant_probes = 0       # parity-police shadow-score launches
+        # Resident-bytes provider (ISSUE 18 capacity accounting): the
+        # engine binds registry.resident_bytes here; snapshots then carry
+        # chip-resident bytes per tenant through the same spine as every
+        # other counter. Called OUTSIDE this object's lock (the registry
+        # has its own).
+        self._resident = None
 
     # --- recording -------------------------------------------------------
 
@@ -312,6 +329,39 @@ class ServingStats:
                 else a * exec_s + (1 - a) * self._exec_ewma_s
             )
 
+    def bind_resident(self, provider) -> None:
+        """Attach the resident-bytes provider: a callable returning
+        {tenant: chip-resident bytes} (registry.resident_bytes)."""
+        self._resident = provider
+
+    def resident_bytes_snapshot(self) -> dict[str, float]:
+        """Per-tenant chip-resident bytes from the bound provider ({} when
+        none is bound). Never raises — capacity gauges must not take the
+        serving path down with them."""
+        prov = self._resident
+        if prov is None:
+            return {}
+        try:
+            return {t: float(b) for t, b in prov().items()}
+        except Exception:  # noqa: BLE001 — gauge-only path
+            return {}
+
+    def record_quant_probe(
+        self, tenant: str | None, agreement: float, margin_drift: float,
+        rows: int,
+    ) -> None:
+        """One parity-police probe outcome: ``agreement`` is the fraction
+        of ``rows`` whose quantized verdict matched the f32 shadow,
+        ``margin_drift`` the mean per-row |margin delta|."""
+        with self._lock:
+            self.quant_probes += 1
+            ts = self._tenant(tenant)
+            if ts is not None:
+                ts.quant_probes += 1
+                ts.quant_rows += rows
+                ts.quant_agree_rows += int(round(agreement * rows))
+                ts.quant_margin_sum += float(margin_drift) * rows
+
     def record_compile(self, during_warmup: bool) -> None:
         with self._lock:
             if during_warmup:
@@ -418,6 +468,8 @@ class ServingStats:
         derived("batch_occupancy", "real rows / bucket slots executed")
         derived("p50_ms", "median request latency")
         derived("p99_ms", "tail request latency")
+        derived("resident_bytes", "chip-resident class-matrix bytes")
+        derived("quant_agreement", "parity-police verdict agreement vs f32")
 
     def unbind_registry(self) -> None:
         """Release this stats object's callbacks from the registry (engine
@@ -438,12 +490,18 @@ class ServingStats:
         self._bound_fns = []
 
     def snapshot(self, queue_depth: int | None = None) -> dict:
+        # Provider call BEFORE taking our lock (it holds the registry's).
+        resident = self.resident_bytes_snapshot()
         with self._lock:
             p50 = self._lat.percentile(50)
             p99 = self._lat.percentile(99)
             occ = (
                 self.batch_rows / self.batch_slots if self.batch_slots else 0.0
             )
+            agree_rows = sum(
+                ts.quant_agree_rows for ts in self._tenants.values()
+            )
+            quant_rows = sum(ts.quant_rows for ts in self._tenants.values())
             snap = {
                 "served": self.served,
                 "rejected": self.rejected,
@@ -459,6 +517,17 @@ class ServingStats:
                 "warmup_compiles": self.warmup_compiles,
                 "steady_recompiles": self.steady_compiles,
                 "swaps": self.swaps,
+                # Capacity accounting (ISSUE 18): total chip-resident
+                # class-matrix bytes — the fleet rollup's density
+                # numerator-per-replica. 0.0 with no provider bound.
+                "resident_bytes": round(sum(resident.values()), 1),
+                "quant_probes": self.quant_probes,
+                # Rows-weighted verdict agreement across tenants; 1.0
+                # with no probes (vacuous truth keeps floor checks
+                # green for unquantized arms).
+                "quant_agreement": round(
+                    agree_rows / quant_rows, 4
+                ) if quant_rows else 1.0,
             }
         if queue_depth is not None:
             snap["queue_depth"] = queue_depth
@@ -466,7 +535,8 @@ class ServingStats:
 
     def tenant_snapshot(self) -> dict[str, dict]:
         """Consistent per-tenant view: {tenant: {served, rejected, shed,
-        deadline_missed, p50_ms, p99_ms}}."""
+        deadline_missed, p50_ms, p99_ms, resident_bytes}}."""
+        resident = self.resident_bytes_snapshot()
         with self._lock:
             out = {}
             for name, ts in self._tenants.items():
@@ -481,6 +551,7 @@ class ServingStats:
                     "degraded": ts.degraded,
                     "p50_ms": round(p50, 3) if p50 is not None else 0.0,
                     "p99_ms": round(p99, 3) if p99 is not None else 0.0,
+                    "resident_bytes": resident.get(name, 0.0),
                 }
             return out
 
@@ -505,6 +576,16 @@ class ServingStats:
                     "margin_p50": round(m50, 4) if m50 is not None else 0.0,
                     "entropy_p50": round(e50, 4) if e50 is not None else 0.0,
                 }
+                if ts.quant_rows:
+                    # Parity-police slice (ISSUE 18): verdict agreement
+                    # vs the f32 shadow + mean |margin drift| over every
+                    # probed row of this tenant.
+                    out[name]["quant_agreement"] = round(
+                        ts.quant_agree_rows / ts.quant_rows, 4
+                    )
+                    out[name]["quant_margin_drift"] = round(
+                        ts.quant_margin_sum / ts.quant_rows, 4
+                    )
             return out
 
     def emit(self, logger, step: int, queue_depth: int | None = None) -> None:

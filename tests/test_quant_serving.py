@@ -1,0 +1,482 @@
+"""Quantized serving data plane (ISSUE 18): bf16/int8 resident class
+vectors + NTN scoring with drift-gated parity.
+
+Covers: int8 quantize/artifact math, dtype-keyed program-cache signatures
+(mixed-precision tenants never collide), resident-byte accounting and the
+>= 3.5x int8 density win, verdict parity of the quantized paths against
+f32 on a BRIEFLY-TRAINED model (an untrained model scores near-ties
+everywhere — agreement on it gauges tie-breaking, not quantization), the
+parity police tripping the SAME drift alarm path as model drift on an
+injected bad-scale tenant, degenerate-quantization quarantine, the
+zero-steady-state-recompile gate under mixed-dtype co-residency, and
+byte-derived fleet placement capacity.
+"""
+
+import dataclasses
+import time
+
+import numpy as np
+import pytest
+
+from induction_network_on_fewrel_tpu.config import (
+    ExperimentConfig,
+    resolve_quant_policy,
+)
+from induction_network_on_fewrel_tpu.data import (
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.data.tokenizer import GloveTokenizer
+from induction_network_on_fewrel_tpu.fleet.control import FleetControl
+from induction_network_on_fewrel_tpu.fleet.router import FleetRouter
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.obs import DriftDetector
+from induction_network_on_fewrel_tpu.obs.health import CRITICAL
+from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+from induction_network_on_fewrel_tpu.serving.buckets import (
+    RESIDENT_DTYPES,
+    resident_dtype_name,
+)
+from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
+from induction_network_on_fewrel_tpu.serving.registry import (
+    QuantArtifactError,
+    quant_artifact,
+    quantize_int8,
+)
+from induction_network_on_fewrel_tpu.serving.stats import ServingStats
+from induction_network_on_fewrel_tpu.train import FewShotTrainer
+from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+# Tiny flagship-shaped config (the tests/test_serving.py world) + the
+# training fields the parity fixture needs.
+CFG = ExperimentConfig(
+    model="induction", encoder="cnn", hidden_size=16,
+    vocab_size=122, word_dim=8, pos_dim=2, max_length=16,
+    induction_dim=8, ntn_slices=4, routing_iters=2,
+    n=3, train_n=3, k=2, q=2, batch_size=2, lr=5e-3, val_step=0,
+    device="cpu",
+)
+
+
+@pytest.fixture(scope="module")
+def trained_world():
+    """(tok, model, params, ds): ~150 optimizer steps on the synthetic
+    corpus — enough for REAL verdict margins (test_train.py overfits the
+    same generator in 200), so parity floors measure quantization."""
+    vocab = make_synthetic_glove(vocab_size=CFG.vocab_size - 2,
+                                 word_dim=CFG.word_dim)
+    tok = GloveTokenizer(vocab, max_length=CFG.max_length)
+    ds = make_synthetic_fewrel(
+        num_relations=5, instances_per_relation=12,
+        vocab_size=CFG.vocab_size - 2, seed=7,
+    )
+    model = build_model(CFG, glove_init=vocab.vectors)
+    trainer = FewShotTrainer(
+        model, CFG,
+        EpisodeSampler(ds, tok, n=CFG.n, k=CFG.k, q=CFG.q,
+                       batch_size=CFG.batch_size, seed=3),
+        logger=MetricsLogger(quiet=True),
+    )
+    state = trainer.train(num_iters=150)
+    return tok, model, state.params, ds
+
+
+def _engine(trained_world, **kw):
+    tok, model, params, ds = trained_world
+    eng = InferenceEngine(
+        model, params, CFG, tok, k=CFG.k,
+        buckets=kw.pop("buckets", (1, 2, 4)),
+        start=kw.pop("start", True), **kw,
+    )
+    return eng, ds
+
+
+def _held_out(ds):
+    return [i for r in ds.rel_names for i in ds.instances[r][CFG.k:]]
+
+
+def _wait_for(cond, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return cond()
+
+
+# --- quantization math ----------------------------------------------------
+
+
+def test_quantize_int8_roundtrip_and_scale():
+    rng = np.random.default_rng(0)
+    stack = rng.normal(size=(6, 32)).astype(np.float32)
+    q, scale = quantize_int8(stack)
+    assert q.dtype == np.int8 and scale.dtype == np.float32
+    assert np.abs(q).max() <= 127
+    # Symmetric rounding: dequantized error bounded by half a step.
+    np.testing.assert_allclose(
+        q.astype(np.float32) * scale, stack, atol=float(scale) / 2 + 1e-7
+    )
+    # All-zero stack: scale falls back to 1.0 (no divide-by-zero).
+    qz, sz = quantize_int8(np.zeros((2, 4), np.float32))
+    assert float(sz) == 1.0 and not qz.any()
+    assert quant_artifact(np.zeros((2, 4), np.float32), qz) is None
+
+
+def test_quant_artifact_flags_degenerate_rows():
+    # Dynamic-range collapse: one huge (healthy-spread) row sets the
+    # per-tenant scale, a small (but live) row quantizes to all zeros.
+    stack = np.ones((2, 8), np.float32)
+    stack[0] = np.linspace(1e3, 1e4, 8)
+    stack[1] *= 1e-3
+    q, _ = quantize_int8(stack)
+    reason = quant_artifact(stack, q)
+    assert reason is not None and "collapse" in reason
+    # Full saturation: a constant row pins every element at the clip.
+    flat = np.full((1, 8), 100.0, np.float32)
+    qf, _ = quantize_int8(flat)
+    reason = quant_artifact(flat, qf)
+    assert reason is not None and "saturated" in reason
+    # Healthy spread: no artifact.
+    healthy = np.linspace(-1.0, 1.0, 16, dtype=np.float32).reshape(2, 8)
+    qh, _ = quantize_int8(healthy)
+    assert quant_artifact(healthy, qh) is None
+
+
+def test_resident_dtype_name_rejects_unknown():
+    assert resident_dtype_name(np.int8) == "int8"
+    assert resident_dtype_name(RESIDENT_DTYPES["bf16"]) == "bf16"
+    with pytest.raises(ValueError, match="not a resident dtype"):
+        resident_dtype_name(np.float64)
+
+
+def test_resolve_quant_policy_one_home():
+    class Knobs:
+        resident_dtype = "int8"
+        quant_probe_every = 3
+
+    q = resolve_quant_policy(Knobs())
+    assert q == {"resident_dtype": "int8", "probe_every": 3}
+    # None inherits base (the checkpoint config), default f32/off.
+    class NoneKnobs:
+        resident_dtype = None
+        quant_probe_every = None
+
+    assert resolve_quant_policy(NoneKnobs()) == {
+        "resident_dtype": "f32", "probe_every": 0,
+    }
+    assert resolve_quant_policy(NoneKnobs(), base=Knobs()) == {
+        "resident_dtype": "int8", "probe_every": 3,
+    }
+    class Bad:
+        resident_dtype = "fp4"
+        quant_probe_every = None
+
+    with pytest.raises(ValueError, match="resident_dtype"):
+        resolve_quant_policy(Bad())
+
+
+# --- residency + accounting -----------------------------------------------
+
+
+def test_resident_bytes_density(trained_world):
+    """int8 residency must be >= 3.5x smaller than f32 per tenant — the
+    tenant-density headline (bytes derive placement capacity)."""
+    eng, ds = _engine(trained_world, start=False)
+    try:
+        eng.register_dataset(ds)
+        f32_bytes = eng.registry.resident_bytes()["default"]
+        n, c = np.asarray(eng.registry.snapshot().matrix).shape
+        assert f32_bytes == n * c * 4
+        eng.warmup()
+        eng.set_resident_dtype("default", "int8")
+        snap = eng.registry.snapshot()
+        assert np.asarray(snap.matrix).dtype == np.int8
+        assert snap.shadow is not None and snap.scale is not None
+        int8_bytes = eng.registry.resident_bytes()["default"]
+        assert int8_bytes == n * c + 4          # + the f32 scale scalar
+        assert f32_bytes / int8_bytes >= 3.5
+        # The stats gauge restates the registry sum.
+        assert eng.stats.snapshot()["resident_bytes"] == int8_bytes
+        # bf16 residency halves f32 and needs no scale.
+        eng.set_resident_dtype("default", "bf16")
+        assert eng.registry.resident_bytes()["default"] == n * c * 2
+        assert eng.registry.snapshot().scale is None
+    finally:
+        eng.close()
+
+
+def test_degenerate_quantization_quarantines(trained_world, monkeypatch):
+    """A dtype flip whose quantization comes out degenerate must never
+    become resident: the registry refuses it, reverts the override, and
+    quarantines the tenant (served degraded — same containment as a
+    NaN'd artifact)."""
+    import induction_network_on_fewrel_tpu.serving.registry as regmod
+
+    eng, ds = _engine(trained_world, start=False)
+    try:
+        eng.register_dataset(ds)
+        eng.warmup()
+
+        def collapse(stack):
+            return np.zeros_like(stack, dtype=np.int8), np.float32(1.0)
+
+        monkeypatch.setattr(regmod, "quantize_int8", collapse)
+        with pytest.raises(QuantArtifactError, match="refused"):
+            eng.set_resident_dtype("default", "int8")
+        snap = eng.registry.snapshot()
+        assert snap.degraded                       # quarantined
+        assert eng.registry.dtype_for("default") == "f32"  # reverted
+        assert np.asarray(snap.matrix).dtype == np.float32
+        # A healthy re-flip after the fix makes the int8 form resident,
+        # but — same discipline as registration on a quarantined
+        # tenant — does NOT clear the quarantine; the explicit
+        # unquarantine (or a committed publish) does.
+        monkeypatch.undo()
+        eng.set_resident_dtype("default", "int8")
+        snap = eng.registry.snapshot()
+        assert snap.degraded
+        assert np.asarray(snap.matrix).dtype == np.int8
+        eng.registry.unquarantine_tenant("default", reason="scale fixed")
+        assert not eng.registry.snapshot().degraded
+    finally:
+        eng.close()
+
+
+# --- parity ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", ["bf16", "int8"])
+def test_quantized_parity_vs_f32(trained_world, dtype):
+    """Seeded held-out episodes through the quantized data plane with the
+    parity police shadow-scoring EVERY batch: verdict agreement >= 99%,
+    margin drift inside the band, zero steady-state recompiles."""
+    eng, ds = _engine(trained_world, resident_dtype=dtype,
+                      quant_probe_every=1)
+    try:
+        eng.register_dataset(ds)
+        eng.warmup()
+        queries = _held_out(ds)
+        for inst in queries:
+            v = eng.classify(inst)
+            assert "label" in v
+        assert _wait_for(
+            lambda: eng.stats.snapshot()["quant_probes"]
+            >= len(queries) - 1
+        )
+        snap = eng.stats.snapshot()
+        assert snap["quant_agreement"] >= 0.99
+        assert snap["steady_recompiles"] == 0
+        quality = eng.stats.quality_snapshot()["default"]
+        assert quality["quant_margin_drift"] <= 0.25
+    finally:
+        eng.close()
+
+
+def test_bad_scale_trips_drift_alarm(trained_world):
+    """The drill the parity police exists for: a tenant whose resident
+    int8 scale is corrupted (here: inflated 50x in place) must trip the
+    SAME once-latched prediction_drift CRITICAL path as model drift —
+    the PR 13/14 adaptation loop triggers on exactly this selector."""
+    det = DriftDetector(window=16, baseline_n=8, min_count=8)
+    eng, ds = _engine(trained_world, resident_dtype="int8",
+                      quant_probe_every=1, drift=det)
+    try:
+        eng.register_dataset(ds)
+        eng.warmup()
+        snap = eng.registry.snapshot()
+        eng.registry._tenants["default"] = dataclasses.replace(
+            snap, scale=np.float32(float(snap.scale) * 50.0)
+        )
+        for inst in _held_out(ds):
+            eng.classify(inst)
+        assert _wait_for(lambda: det.tripped)
+        quant_crits = [
+            ev for ev in det.events
+            if ev.event == "prediction_drift" and ev.severity == CRITICAL
+            and str(ev.data.get("feature", "")).startswith("quant_")
+        ]
+        assert quant_crits, [e.data for e in det.events]
+        # Once-latched: the stream is not spammed while still bad.
+        n = len(quant_crits)
+        eng.classify(_held_out(ds)[0])
+        time.sleep(0.2)
+        assert len([
+            ev for ev in det.events
+            if ev.severity == CRITICAL
+            and str(ev.data.get("feature", "")).startswith("quant_")
+        ]) == n
+        # The corrupted scale inflates every margin ~50x: the drift
+        # shows up in the margin band (verdicts can still agree — NTN
+        # argmax is not scale-invariant but often survives).
+        state = det.parity_state("default")
+        assert state is not None
+        assert state["margin_drift"] > 0.25
+        # rearm (the publish/rollback path) clears the parity latches.
+        det.rearm("default", reason="test rollback")
+        assert det.parity_state("default") is None
+    finally:
+        eng.close()
+
+
+def test_observe_parity_bands_direct():
+    """Unit-level band math: in-band probes emit nothing; a shortfall
+    past crit_factor x band goes straight to CRITICAL; back-in-band
+    probes re-arm the latch."""
+    det = DriftDetector(window=16, baseline_n=8, min_count=8)
+    assert det.observe_parity("t", agreement=1.0, margin_drift=0.01,
+                              rows=8) == []
+    evs = det.observe_parity("t", agreement=0.5, margin_drift=2.0,
+                             rows=64)
+    feats = {e.data["feature"] for e in evs}
+    assert feats == {"quant_agreement", "quant_margin_drift"}
+    assert all(e.severity == CRITICAL for e in evs)
+    assert det.tripped
+    # Latched: same breach, no new events.
+    assert det.observe_parity("t", agreement=0.5, margin_drift=2.0,
+                              rows=64) == []
+    # Flush the window back in band -> latch released, next breach fires.
+    for _ in range(16):
+        det.observe_parity("t", agreement=1.0, margin_drift=0.0,
+                           rows=1000)
+    evs = det.observe_parity("t", agreement=0.0, margin_drift=5.0,
+                             rows=10**6)
+    assert evs and all(e.severity == CRITICAL for e in evs)
+
+
+# --- mixed-dtype co-residency ---------------------------------------------
+
+
+def test_mixed_dtype_zero_recompile_soak(trained_world):
+    """Two tenants at different resident dtypes on ONE engine: the dtype
+    is part of the program-cache key, so they can never collide in a
+    compiled signature — interleaved traffic stays at zero steady-state
+    recompiles."""
+    eng, ds = _engine(trained_world, resident_dtype="f32",
+                      quant_probe_every=1)
+    try:
+        eng.register_dataset(ds, tenant="plain")
+        eng.register_dataset(ds, tenant="dense")
+        eng.warmup()
+        eng.set_resident_dtype("dense", "int8")
+        keys = set(eng.programs._exe)
+        n = len(ds.rel_names)
+        assert any(k[0] == n and k[2] == "f32" for k in keys)
+        assert any(k[0] == n and k[2] == "int8" for k in keys)
+        queries = _held_out(ds)[:10]
+        verdicts = {}
+        for tenant in ("plain", "dense"):
+            verdicts[tenant] = [
+                eng.classify(inst, tenant=tenant) for inst in queries
+            ]
+        snap = eng.stats.snapshot()
+        assert snap["steady_recompiles"] == 0
+        assert snap["served"] == 2 * len(queries)
+        # Same corpus, same params: the quantized tenant agrees with its
+        # f32 co-resident on these held-out rows.
+        agree = sum(
+            a["label"] == b["label"]
+            for a, b in zip(verdicts["plain"], verdicts["dense"])
+        )
+        assert agree >= 9
+        # Rolling dense back to f32 (the RUNBOOK parity-alarm remedy)
+        # reuses the warmed f32 programs: still zero recompiles.
+        eng.set_resident_dtype("dense", "f32")
+        for inst in queries[:4]:
+            eng.classify(inst, tenant="dense")
+        assert eng.stats.snapshot()["steady_recompiles"] == 0
+    finally:
+        eng.close()
+
+
+# --- stats plumbing -------------------------------------------------------
+
+
+def test_stats_quant_gauges():
+    stats = ServingStats()
+    snap = stats.snapshot()
+    assert snap["quant_probes"] == 0
+    assert snap["quant_agreement"] == 1.0   # vacuous without probes
+    assert snap["resident_bytes"] == 0.0    # no provider bound
+    stats.bind_resident(lambda: {"a": 100.0, "b": 28.0})
+    # quality_snapshot only lists tenants with quality-bearing verdicts
+    # (the engine always serves before it probes).
+    stats.record_done(0.001, tenant="a", nota=False, margin=0.5,
+                      entropy=0.1)
+    stats.record_quant_probe("a", agreement=0.75, margin_drift=0.1,
+                             rows=4)
+    stats.record_quant_probe("a", agreement=1.0, margin_drift=0.3,
+                             rows=4)
+    snap = stats.snapshot()
+    assert snap["quant_probes"] == 2
+    assert snap["quant_agreement"] == pytest.approx(0.875)
+    assert snap["resident_bytes"] == 128.0
+    per = stats.tenant_snapshot()
+    assert per["a"]["resident_bytes"] == 100.0
+    quality = stats.quality_snapshot()["a"]
+    assert quality["quant_agreement"] == pytest.approx(0.875)
+    assert quality["quant_margin_drift"] == pytest.approx(0.2)
+
+
+# --- byte-derived fleet capacity ------------------------------------------
+
+
+class _FakeHandle:
+    """Minimal ReplicaHandle for placement-capacity tests: carries a
+    settable resident_bytes gauge and records registrations."""
+
+    def __init__(self):
+        self.resident = 0.0
+        self.registered = []
+
+    def register_dataset(self, dataset, tenant, max_classes=None):
+        self.registered.append(tenant)
+
+    def set_nota_threshold(self, threshold, tenant):
+        pass
+
+    def stats_snapshot(self):
+        return {"served": 0, "resident_bytes": self.resident}
+
+    def close(self):
+        pass
+
+
+def test_fleet_capacity_derived_from_bytes():
+    handles = {"r0": _FakeHandle(), "r1": _FakeHandle()}
+    router = FleetRouter(handles, resident_budget_bytes=100.0)
+    try:
+        control = FleetControl(router)
+        # Rendezvous placement is a pure function of the ids: find one
+        # tenant per owner.
+        by_owner = {}
+        for i in range(32):
+            name = f"t{i}"
+            owner = router.placement.place(name)
+            by_owner.setdefault(owner, name)
+            if len(by_owner) == 2:
+                break
+        assert set(by_owner) == {"r0", "r1"}
+        # Under budget: placement admits the tenant.
+        assert control.register_tenant(by_owner["r0"], None) == "r0"
+        # Owner at its byte budget: registration refused up front, and
+        # the directory never learns the tenant.
+        handles["r0"].resident = 150.0
+        victim = next(
+            f"u{i}" for i in range(64)
+            if router.placement.place(f"u{i}") == "r0"
+        )
+        with pytest.raises(RuntimeError, match="resident-byte budget"):
+            control.register_tenant(victim, None)
+        assert victim not in router.directory
+        # The other replica still has headroom.
+        assert control.register_tenant(by_owner["r1"], None) == "r1"
+        # Per-replica gauge the rollup restates.
+        assert router.replica_resident_bytes("r0") == 150.0
+    finally:
+        router.close()
+
+
+def test_fleet_budget_validation():
+    with pytest.raises(ValueError, match="resident_budget_bytes"):
+        FleetRouter({"r0": _FakeHandle()}, resident_budget_bytes=0.0)

@@ -165,6 +165,20 @@ BANDS: dict[str, tuple[str, float]] = {
     "obsfleet.passed": ("floor", 1.0),
     "obsfleet.stitch_coverage": ("floor", 1.0),
     "obsfleet.incidents_ordered": ("floor", 1.0),
+    # Quantized serving A/B (ISSUE 18, QUANT_r*.json): the density
+    # regression gates — quantized arms must drop nothing and recompile
+    # nothing (the zero-recompile gate holds per resident dtype), the
+    # sampled shadow-vs-f32 verdict agreement has a hard floor, and the
+    # f32/int8 resident-bytes ratio (the tenant-density headline) must
+    # not erode. Absolute qps/p99 recorded unbanded (documented-unstable
+    # sandbox, same policy as serve.*); tenants-per-chip is a labeled
+    # CPU projection, recorded for the ratio trajectory only.
+    "quant.dropped": ("zero", 0.0),
+    "quant.steady_recompiles": ("zero", 0.0),
+    "quant.passed": ("floor", 1.0),
+    "quant.agreement.bf16": ("floor", 0.99),
+    "quant.agreement.int8": ("floor", 0.99),
+    "quant.bytes_ratio_f32_over_int8": ("floor", 3.5),
 }
 
 
@@ -453,6 +467,40 @@ def _obsfleet_points(points: dict, path: str, data: dict) -> int:
     return sum(len(v) for v in points.values()) - before
 
 
+def _quant_points(points: dict, path: str, data: dict) -> int:
+    """QUANT_r*.json (tools/loadgen.py --quant_ab): the quantized-
+    serving A/B — zero-bands (dropped, steady recompiles across all
+    three arms), the pass / verdict-agreement / bytes-ratio floors, and
+    recorded (unbanded) per-arm qps/p99, margin drift, resident bytes
+    per tenant and the projected tenants-per-chip density."""
+    rnd, src = _round_of(path), os.path.basename(path)
+    before = sum(len(v) for v in points.values())
+    zero = data.get("zero_bands") or {}
+    for key in ("dropped", "steady_recompiles"):
+        _point(points, f"quant.{key}", rnd, src, zero.get(key))
+    _point(points, "quant.passed", rnd, src,
+           1.0 if data.get("passed") else 0.0)
+    arms = data.get("arms") or {}
+    for dt, arm in sorted(arms.items()):
+        if dt != "f32":
+            _point(points, f"quant.agreement.{dt}", rnd, src,
+                   arm.get("quant_agreement"))
+            _point(points, f"quant.margin_drift.{dt}", rnd, src,
+                   arm.get("quant_margin_drift"))
+        _point(points, f"quant.qps.{dt}", rnd, src, arm.get("qps"))
+        _point(points, f"quant.p99_ms.{dt}", rnd, src, arm.get("p99_ms"))
+        _point(points, f"quant.bytes_per_tenant.{dt}", rnd, src,
+               arm.get("resident_bytes_per_tenant"))
+    den = data.get("density") or {}
+    _point(points, "quant.bytes_ratio_f32_over_int8", rnd, src,
+           den.get("bytes_ratio_f32_over_int8"))
+    for dt, v in sorted((den.get("tenants_per_chip_projected")
+                         or {}).items()):
+        _point(points, f"quant.tenants_per_chip_projected.{dt}",
+               rnd, src, v)
+    return sum(len(v) for v in points.values()) - before
+
+
 _EXTRACTORS = (
     ("BENCH_r*.json", _bench_points),
     ("ROOFLINE_r*.json", _roofline_points),
@@ -464,6 +512,7 @@ _EXTRACTORS = (
     ("RECOVERY_r*.json", _recovery_points),
     ("ELASTIC_r*.json", _elastic_points),
     ("OBSFLEET_r*.json", _obsfleet_points),
+    ("QUANT_r*.json", _quant_points),
 )
 
 

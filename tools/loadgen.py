@@ -230,6 +230,17 @@ def parse_args(argv=None):
                         "there)")
     p.add_argument("--obsfleet_artifact", default=None, metavar="PATH",
                    help="write the OBSFLEET_r*.json drill artifact here")
+    p.add_argument("--quant_ab", action="store_true",
+                   help="standalone quantized-serving A/B drill (ISSUE "
+                        "18): three arms — f32 / bf16 / int8 resident "
+                        "class vectors — under the same open-loop "
+                        "arrivals, parity-probing every quantized batch "
+                        "against f32; stamps qps, tails, verdict "
+                        "agreement, margin drift, resident bytes per "
+                        "tenant and the projected tenants-per-chip "
+                        "density into QUANT_r*.json")
+    p.add_argument("--quant_artifact", default=None, metavar="PATH",
+                   help="write the QUANT_r*.json drill artifact here")
     p.add_argument("--slo_profile", action="store_true",
                    help="also attempt a jax.profiler trace in the SLO "
                         "auto-capture (default off: on this image a "
@@ -254,9 +265,15 @@ def parse_args(argv=None):
     return args
 
 
-def make_synthetic_checkpoint(args, tmpdir: str) -> str:
+def make_synthetic_checkpoint(args, tmpdir: str, train_iters: int = 0) -> str:
     """Fresh-init induction weights saved through the real CheckpointManager
-    (so the engine exercises the genuine restore path)."""
+    (so the engine exercises the genuine restore path).
+
+    ``train_iters > 0`` (the --quant_ab path) first trains briefly on a
+    disjoint-seed synthetic corpus so the served verdicts carry REAL
+    margins: an untrained model scores near-ties everywhere, and argmax
+    over near-ties flips under ANY numeric noise — a parity floor
+    measured on it gauges the tie-breaking, not the quantization."""
     import jax
 
     from induction_network_on_fewrel_tpu.config import ExperimentConfig
@@ -268,6 +285,7 @@ def make_synthetic_checkpoint(args, tmpdir: str) -> str:
     cfg = ExperimentConfig(
         device=args.device, n=args.N, train_n=args.N, k=args.K,
         na_rate=args.na_rate, vocab_size=2002, seed=args.seed,
+        val_step=0,
     )
     vocab = make_synthetic_glove(vocab_size=cfg.vocab_size - 2,
                                  word_dim=cfg.word_dim)
@@ -278,6 +296,31 @@ def make_synthetic_checkpoint(args, tmpdir: str) -> str:
                        zero_batch(cfg.max_length, (1, cfg.n, cfg.k)),
                        zero_batch(cfg.max_length, (1, cfg.total_q)),
                        rng=jax.random.key(cfg.seed))
+    if train_iters > 0:
+        from induction_network_on_fewrel_tpu.data import (
+            GloveTokenizer,
+            make_synthetic_fewrel,
+        )
+        from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+        from induction_network_on_fewrel_tpu.train import FewShotTrainer
+        from induction_network_on_fewrel_tpu.utils.metrics import (
+            MetricsLogger,
+        )
+
+        train_ds = make_synthetic_fewrel(
+            num_relations=max(args.N, 5) * 2,
+            instances_per_relation=args.K + 10,
+            vocab_size=2000, seed=args.seed + 9999,
+        )
+        tok = GloveTokenizer(vocab, max_length=cfg.max_length)
+        trainer = FewShotTrainer(
+            model, cfg,
+            EpisodeSampler(train_ds, tok, n=cfg.n, k=cfg.k, q=cfg.q,
+                           batch_size=cfg.batch_size,
+                           na_rate=cfg.na_rate, seed=args.seed + 1),
+            logger=MetricsLogger(quiet=True),
+        )
+        state = trainer.train(num_iters=train_iters, state=state)
     ckpt = os.path.join(tmpdir, "ckpt")
     mngr = CheckpointManager(ckpt, cfg, stage="off")
     try:
@@ -289,7 +332,8 @@ def make_synthetic_checkpoint(args, tmpdir: str) -> str:
 
 
 def build_engine(args, ckpt: str, scheduler: str, logger=None, slo=None,
-                 drift=None, breaker=None):
+                 drift=None, breaker=None, resident_dtype=None,
+                 quant_probe_every=None):
     from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
 
     return InferenceEngine.from_checkpoint(
@@ -302,6 +346,8 @@ def build_engine(args, ckpt: str, scheduler: str, logger=None, slo=None,
         dp=args.serving_dp,
         logger=logger, slo=slo, drift=drift, breaker=breaker,
         trace_sample=args.trace_sample,
+        resident_dtype=resident_dtype,
+        quant_probe_every=quant_probe_every,
     )
 
 
@@ -377,9 +423,14 @@ def check_registry_parity(engine, ds, tenant: str = "default") -> float:
     )
 
     bucket = select_bucket(len(names), engine.batcher.buckets)
+    # snap.scale is the per-tenant int8 dequant scale (None for f32/bf16
+    # residents) — a quantized tenant's parity is checked on its REAL
+    # serving path, quantization error and all; the caller picks the
+    # tolerance per resident dtype.
     served = engine.programs.run(
         snap.params, snap.matrix,
         {key: pad_rows(qry[key][0], bucket) for key in qry},
+        scale=snap.scale,
     )[: len(names)]
     return float(np.max(np.abs(direct - served)))
 
@@ -3337,6 +3388,170 @@ def fleet_obs_drill(seed: int = 0, fleet_dir: str | None = None) -> dict:
     return out
 
 
+# --- quantized-serving A/B drill (ISSUE 18) ---------------------------------
+#
+# Three arms — f32 / bf16 / int8 resident class vectors — against the same
+# synthetic checkpoint and the same seeded open-loop arrivals. Quantized
+# arms shadow-score EVERY batch against f32 (quant_probe_every=1: the
+# drill wants maximum parity evidence, production samples), so the
+# artifact's verdict-agreement and margin-drift numbers cover the whole
+# run, not a sample. The density section projects tenants-per-chip from
+# the MEASURED resident bytes per tenant against a nominal budget — a
+# projection, clearly labeled, because this drill runs on CPU; the real
+# chip A/B is queued on the BASELINE.md backlog.
+
+# Nominal per-chip budget for RESIDENT CLASS VECTORS (1 GiB): params,
+# activations and XLA workspace own the rest of HBM. The projection's
+# honesty lives in the ratio between arms, not the absolute count.
+QUANT_RESIDENT_BUDGET_BYTES = 2**30
+
+# Per-arm parity tolerance for the registry-vs-direct forward check:
+# f32 residents must match the episodic path to float error; quantized
+# residents carry real quantization error, gated well inside the 0.25
+# margin-drift band (the VERDICT agreement floor is the real quality
+# gate for those arms).
+QUANT_PARITY_TOL = {"f32": 1e-4, "bf16": 0.25, "int8": 0.25}
+
+
+def run_quant_arm(args, ckpt, dtype: str, logger=None) -> dict:
+    """One resident-dtype arm: fresh engine, registered tenants, parity
+    check, open-loop phase, stats snapshot. Returns the arm record."""
+    import numpy as np
+
+    engine = build_engine(
+        args, ckpt, "continuous", logger=logger,
+        resident_dtype=dtype,
+        quant_probe_every=0 if dtype == "f32" else 1,
+    )
+    try:
+        tenants = register_tenants(engine, args)
+        compiled = engine.warmup()
+        parity = max(
+            check_registry_parity(engine, ds, tenant=t)
+            for t, ds in tenants.items()
+        )
+        print(f"[quant ab/{dtype}] warmup {compiled} programs, parity "
+              f"max|delta| = {parity:.2e} (tol {QUANT_PARITY_TOL[dtype]})",
+              file=sys.stderr)
+        pools = _pools(tenants, args.K)
+        rng = np.random.default_rng(args.seed)  # same arrivals per arm
+        lat, rej, miss, dropped, wall, offered, _ = run_open(
+            engine, pools, args.rate, args.duration, rng,
+        )
+        flat = _flat(lat)
+        snap = engine.stats.snapshot(
+            queue_depth=engine.batcher.queue_depth
+        )
+        resident = engine.registry.resident_bytes()
+        quality = engine.stats.quality_snapshot()
+        drifts = [
+            q["quant_margin_drift"] for q in quality.values()
+            if "quant_margin_drift" in q
+        ]
+        return {
+            "dtype": dtype,
+            "warmup_compiles": compiled,
+            "parity_max_delta": parity,
+            "parity_tol": QUANT_PARITY_TOL[dtype],
+            "offered_qps": round(offered / wall, 1),
+            "qps": round(len(flat) / wall, 1),
+            "p50_ms": pct_ms(flat, 50),
+            "p99_ms": pct_ms(flat, 99),
+            "served": snap["served"],
+            "rejected": rej,
+            "deadline_miss": miss,
+            "dropped": dropped,
+            "steady_recompiles": snap["steady_recompiles"],
+            "resident_bytes": snap["resident_bytes"],
+            "resident_bytes_per_tenant": round(
+                sum(resident.values()) / max(len(resident), 1), 1
+            ),
+            "quant_probes": snap["quant_probes"],
+            "quant_agreement": snap["quant_agreement"],
+            "quant_margin_drift": round(
+                sum(drifts) / len(drifts), 4
+            ) if drifts else 0.0,
+        }
+    finally:
+        engine.close()
+
+
+def run_quant_ab(args, ckpt, logger=None) -> dict:
+    """The three-arm drill + the density projection + the gates."""
+    arms = {
+        dt: run_quant_arm(args, ckpt, dt, logger=logger)
+        for dt in ("f32", "bf16", "int8")
+    }
+    bpt = {dt: arms[dt]["resident_bytes_per_tenant"] for dt in arms}
+    density = {
+        "resident_budget_bytes_nominal": QUANT_RESIDENT_BUDGET_BYTES,
+        "projection_note": (
+            "tenants_per_chip = budget / measured bytes-per-tenant; a "
+            "CPU-measured projection — real-chip A/B queued on the "
+            "BASELINE.md backlog"
+        ),
+        "bytes_ratio_f32_over_int8": round(
+            bpt["f32"] / max(bpt["int8"], 1e-9), 2
+        ),
+        "bytes_ratio_f32_over_bf16": round(
+            bpt["f32"] / max(bpt["bf16"], 1e-9), 2
+        ),
+        "tenants_per_chip_projected": {
+            dt: int(QUANT_RESIDENT_BUDGET_BYTES // max(bpt[dt], 1.0))
+            for dt in arms
+        },
+    }
+    out = {
+        "arms": arms,
+        "density": density,
+        "parity_floor": 0.99,
+        "margin_drift_band": 0.25,
+        "zero_bands": {
+            "dropped": sum(a["dropped"] for a in arms.values()),
+            "steady_recompiles": sum(
+                a["steady_recompiles"] for a in arms.values()
+            ),
+        },
+    }
+    out["check_failures"] = check_quant_ab(out)
+    out["passed"] = not out["check_failures"]
+    return out
+
+
+def check_quant_ab(out: dict) -> list:
+    """Gate the drill: every failure is a named string (stamped into the
+    artifact so a red run says WHICH invariant broke)."""
+    fails = []
+    for name, v in out["zero_bands"].items():
+        if v != 0:
+            fails.append(f"zero_band:{name}={v}")
+    for dt, arm in out["arms"].items():
+        if not (arm["parity_max_delta"] < arm["parity_tol"]):
+            fails.append(
+                f"parity:{dt}={arm['parity_max_delta']:.3g}"
+                f">={arm['parity_tol']}"
+            )
+        if dt != "f32":
+            if arm["quant_probes"] <= 0:
+                fails.append(f"no_probes:{dt}")
+            if arm["quant_agreement"] < out["parity_floor"]:
+                fails.append(
+                    f"agreement:{dt}={arm['quant_agreement']:.4f}"
+                    f"<{out['parity_floor']}"
+                )
+            if arm["quant_margin_drift"] > out["margin_drift_band"]:
+                fails.append(
+                    f"margin_drift:{dt}={arm['quant_margin_drift']:.4f}"
+                    f">{out['margin_drift_band']}"
+                )
+    if out["density"]["bytes_ratio_f32_over_int8"] < 3.5:
+        fails.append(
+            f"density:f32/int8="
+            f"{out['density']['bytes_ratio_f32_over_int8']}<3.5"
+        )
+    return fails
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     import numpy as np
@@ -3365,7 +3580,12 @@ def main(argv=None) -> int:
         # would be dead weight — one more orbax world for no reason).
         tmp = tempfile.TemporaryDirectory(prefix="loadgen_")
         print("building synthetic-data checkpoint...", file=sys.stderr)
-        ckpt = make_synthetic_checkpoint(args, tmp.name)
+        # The quant A/B measures verdict agreement — it needs a model
+        # with real margins, not fresh-init near-ties (see
+        # make_synthetic_checkpoint).
+        ckpt = make_synthetic_checkpoint(
+            args, tmp.name, train_iters=60 if args.quant_ab else 0
+        )
 
     arms = (
         ["continuous", "microbatch"] if args.scheduler == "ab"
@@ -3658,6 +3878,52 @@ def main(argv=None) -> int:
                 with open(args.adapt_artifact, "w") as fh:
                     json.dump(report, fh, indent=1)
                 print(f"wrote {args.adapt_artifact}", file=sys.stderr)
+            if args.run_dir:
+                print(f"telemetry in {args.run_dir} — render with "
+                      f"'python tools/obs_report.py {args.run_dir}'",
+                      file=sys.stderr)
+            return rc
+        if args.quant_ab:
+            # Standalone mode (like --fleet): the quantized data plane
+            # is the system under test — the scheduler arms are skipped.
+            drill = run_quant_ab(args, ckpt, logger=logger)
+            den = drill["density"]
+            for dt, a in drill["arms"].items():
+                print(f"[quant ab/{dt}] qps={a['qps']} "
+                      f"p50={a['p50_ms']}ms p99={a['p99_ms']}ms "
+                      f"bytes/tenant={a['resident_bytes_per_tenant']} "
+                      f"probes={a['quant_probes']} "
+                      f"agreement={a['quant_agreement']} "
+                      f"margin_drift={a['quant_margin_drift']} "
+                      f"dropped={a['dropped']} "
+                      f"recompiles={a['steady_recompiles']}")
+            print(f"[quant ab/density] f32/int8 bytes ratio "
+                  f"{den['bytes_ratio_f32_over_int8']}x, projected "
+                  f"tenants/chip {den['tenants_per_chip_projected']}")
+            if not drill["passed"]:
+                print(f"FAIL[quant ab]: {drill['check_failures']}",
+                      file=sys.stderr)
+                rc = 1
+            report = {
+                "round": 1,
+                "generated_by": "tools/loadgen.py --quant_ab",
+                "config": {
+                    "tenants": args.tenants, "N": args.N, "K": args.K,
+                    "buckets": args.buckets, "rate": args.rate,
+                    "duration": args.duration, "device": args.device,
+                    "seed": args.seed,
+                },
+                **drill,
+            }
+            print(json.dumps({
+                k: report[k] for k in
+                ("config", "density", "zero_bands", "passed")
+                if k in report
+            }))
+            if args.quant_artifact:
+                with open(args.quant_artifact, "w") as fh:
+                    json.dump(report, fh, indent=1)
+                print(f"wrote {args.quant_artifact}", file=sys.stderr)
             if args.run_dir:
                 print(f"telemetry in {args.run_dir} — render with "
                       f"'python tools/obs_report.py {args.run_dir}'",
