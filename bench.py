@@ -399,6 +399,12 @@ def _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips) -> i
         "datapipe": datapipe_leg,
         "serving": serving_leg,
         "scenarios": scenarios_leg,
+        # Per-geometry roofline rows (ISSUE 19): the paper's (N, K) eval
+        # grid priced analytically at THIS config — episode FLOPs and
+        # HBM step bytes scale with the episode geometry, and the grid
+        # rows put 5w1s/10w1s/10w5s next to the flagship's numbers in
+        # every bench artifact (same shared formulas as the ledgers).
+        "geometry": _geometry_rows(cfg, comms_u),
     }
     print(json.dumps(summary))
     _append_trend_input(summary, backend)
@@ -428,6 +434,36 @@ def _append_trend_input(summary: dict, backend: str) -> None:
         print(f"bench: trend-input append failed: {e!r}", file=sys.stderr)
 
 
+def _geometry_rows(cfg, corpus_rows=None) -> dict:
+    """{<N>w<K>s: {flops_per_episode, step_bytes, lstm_residual_bytes}}
+    over the paper eval grid — analytic, from the same utils/flops +
+    utils/roofline formulas the headline row uses, at the config's
+    resolved knobs with only the episode geometry replaced."""
+    import dataclasses
+
+    from induction_network_on_fewrel_tpu.serving.geometry import GRID, grid_key
+    from induction_network_on_fewrel_tpu.utils.flops import (
+        bilstm_induction_train_flops,
+    )
+    from induction_network_on_fewrel_tpu.utils.roofline import (
+        lstm_residual_bytes,
+        step_bytes,
+    )
+
+    rows = {}
+    for n, k in GRID:
+        gcfg = dataclasses.replace(cfg, train_n=n, n=n, k=k)
+        rows[grid_key(n, k)] = {
+            "flops_per_episode":
+                bilstm_induction_train_flops(gcfg)["per_episode"],
+            "step_bytes": step_bytes(
+                gcfg, corpus_rows=corpus_rows, lstm_cs_window=0
+            ),
+            "lstm_residual_bytes": lstm_residual_bytes(gcfg),
+        }
+    return rows
+
+
 def _scenarios_leg():
     """The tier-1 miniature quality numbers (tools/scenarios.py), flat:
     in-domain / cross-domain / DA-mixture accuracy + NOTA best-F1 — the
@@ -441,6 +477,12 @@ def _scenarios_leg():
             "in_domain_accuracy", "cross_domain_accuracy",
             "da_mixture_accuracy", "nota_best_f1",
         )
+    }
+    # Per-(N, K) grid accuracies with CIs (ISSUE 19) — the miniature
+    # world's grid, banded in TREND via the GEOM artifact's copy.
+    out["grid"] = {
+        key: {"accuracy": leg["accuracy"], "acc_ci95": leg["acc_ci95"]}
+        for key, leg in res.get("grid", {}).items()
     }
     out["wall_s"] = res["wall_s"]
     print(

@@ -220,6 +220,21 @@ class ExperimentConfig:
     # a quantization regression trips the SAME alarm path as model
     # drift). 0 = off.
     quant_probe_every: int = 0
+    # Geometry plane (ISSUE 19, serving/geometry.py): the N-tier ladder
+    # resident [N, C] class stacks pad up to, bounding compiled query
+    # programs by tiers x buckets x resident dtypes regardless of how
+    # many distinct relation counts the fleet's tenants carry. Comma-
+    # separated ascending ints, or "off" for exact-N residency (the
+    # pre-tier behavior, kept as the loadgen A/B arm). Serving runtime
+    # knob like resident_dtype: checkpoints are geometry-free here —
+    # padding is a deployment decision.
+    geometry_tiers: str = "4,8,16,32,64"
+    # Geometry-aware rendezvous placement (fleet/placement.py): when
+    # > 0, each N-tier's tenants concentrate onto this many "home"
+    # replicas (rendezvous top-k on the tier, then rendezvous on the
+    # tenant within the home set) so one replica is never stuck
+    # compiling every tier's program family. 0 = tier-blind placement.
+    geometry_tier_spread: int = 0
     # Telemetry-failure injection: corrupt the LOGGED loss with NaN once
     # the step counter crosses this value (training state is untouched) —
     # exercises watchdog trip + flight-recorder dump end-to-end the way
@@ -469,6 +484,37 @@ def resolve_quant_policy(knobs: Any, base: "ExperimentConfig | None" = None):
             f"quant_probe_every must be >= 0, got {probe_every}"
         )
     return {"resident_dtype": dtype, "probe_every": probe_every}
+
+
+def resolve_geometry_policy(
+    knobs: Any, base: "ExperimentConfig | None" = None
+):
+    """ONE home for the geometry-plane knob resolution (ISSUE 19, same
+    discipline as ``resolve_quant_policy``), shared by serve.py, the
+    fleet CLI, and the loadgen drills. ``knobs`` is any object with
+    ``geometry_tiers``/``geometry_tier_spread`` attributes — an
+    ExperimentConfig or an argparse namespace; a missing or None
+    attribute falls back to ``base`` (the served checkpoint's stored
+    config), then to the ExperimentConfig default. Returns the
+    validated policy dict {"tiers": tuple | None, "tier_spread": int}
+    with the tier spec already parsed (None = exact-N residency)."""
+    from induction_network_on_fewrel_tpu.serving.geometry import parse_tiers
+
+    fields = {f.name: f.default for f in dataclasses.fields(ExperimentConfig)}
+
+    def knob(name):
+        v = getattr(knobs, name, None)
+        if v is None and base is not None:
+            v = getattr(base, name, None)
+        return fields[name] if v is None else v
+
+    tiers = parse_tiers(knob("geometry_tiers"))
+    spread = int(knob("geometry_tier_spread"))
+    if spread < 0:
+        raise ValueError(
+            f"geometry_tier_spread must be >= 0, got {spread}"
+        )
+    return {"tiers": tiers, "tier_spread": spread}
 
 
 def resolve_adapt_policy(knobs: Any, base: "ExperimentConfig | None" = None):

@@ -124,7 +124,11 @@ class FleetControl:
         count: a registration that would land on a replica already at
         its byte budget is refused up front — quantized (bf16/int8)
         tenants pack ~2-4x denser than f32 under the same budget."""
-        owner = self.router.placement.place(tenant)
+        # Place through the router's one tier-aware spelling so register,
+        # submit, failover, and recovery all agree on the owner.
+        owner = self.router.place_tenant(
+            tenant, _TenantEntry(None, dataset, max_classes=max_classes)
+        )
         if owner is None:
             raise RuntimeError("no live replica to place the tenant on")
         budget = self.router.resident_budget_bytes
@@ -264,7 +268,7 @@ class FleetControl:
         moved = 0
         for tenant in self.router.pending_failover():
             entry = self.router.directory[tenant]
-            target = self.router.placement.place(tenant)
+            target = self.router.place_tenant(tenant, entry)
             if target is None:
                 continue
             drive_tenant_state(

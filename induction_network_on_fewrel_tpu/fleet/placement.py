@@ -23,6 +23,18 @@ bound, while the process keeps serving whatever is still in flight) and
 the existing serving/breaker.CircuitBreaker keyed by replica id — or by
 the ``fleet.replica_kill`` chaos point). Dead/draining replicas stay in
 the table so a revive is one state flip with the same bounded remap.
+
+**N-tier-weighted placement** (ISSUE 19, optional) — with tier-blind
+rendezvous a fleet of mixed-geometry tenants sprays every N-tier onto
+every replica, so each replica warms the full tiers x buckets x dtypes
+program family. ``place(tenant, tier=..., tier_spread=s)`` first picks
+the tier's ``s`` "home" replicas by rendezvous ON THE TIER KEY, then
+rendezvous-places the tenant within that home set: each tier lands on
+at most ``s`` replicas (a replica serves ~``s·T/R`` of ``T`` tiers),
+while both levels keep rendezvous determinism and the bounded-remap
+property (replica death remaps only the dead replica's tenants/home
+slots). ``tier=None`` or ``tier_spread=0`` is exactly the tier-blind
+map.
 """
 
 from __future__ import annotations
@@ -115,21 +127,41 @@ class FleetPlacement:
 
     # --- placement --------------------------------------------------------
 
-    def place(self, tenant: str) -> str | None:
+    @staticmethod
+    def _pool(live, tier, tier_spread):
+        """The candidate replicas a tenant rendezvous-places within:
+        all live replicas when tier-blind, else the tier's top-
+        ``tier_spread`` home replicas by rendezvous on the tier key."""
+        if tier is None or tier_spread <= 0 or tier_spread >= len(live):
+            return live
+        return sorted(
+            live,
+            key=lambda r: placement_score(f"tier:{tier}", r),
+            reverse=True,
+        )[:tier_spread]
+
+    def place(self, tenant: str, tier: int | None = None,
+              tier_spread: int = 0) -> str | None:
         """The live replica owning ``tenant`` (highest rendezvous score),
         or None when no replica is up. Ties (astronomically unlikely at
         64 bits) break toward the lexically-smallest id so the map stays
-        a pure function of the inputs."""
+        a pure function of the inputs. ``tier``/``tier_spread`` opt into
+        N-tier-weighted placement (module doc): the tenant places within
+        its tier's home set instead of the whole fleet."""
         with self._lock:
             live = [r for r, s in self._states.items() if s == UP]
         if not live:
             return None
+        pool = self._pool(sorted(live), tier, tier_spread)
         return max(
-            sorted(live), key=lambda r: placement_score(tenant, r)
+            pool, key=lambda r: placement_score(tenant, r)
         )
 
-    def owners(self, tenants) -> dict[str, str | None]:
-        """Batch placement (one lock acquisition, one live-set)."""
+    def owners(self, tenants, tier_of=None,
+               tier_spread: int = 0) -> dict[str, str | None]:
+        """Batch placement (one lock acquisition, one live-set).
+        ``tier_of`` maps tenant -> N-tier (or None) for tier-weighted
+        placement; None keeps the tier-blind map."""
         with self._lock:
             live = sorted(
                 r for r, s in self._states.items() if s == UP
@@ -137,7 +169,14 @@ class FleetPlacement:
         if not live:
             return {t: None for t in tenants}
         return {
-            t: max(live, key=lambda r: placement_score(t, r))
+            t: max(
+                self._pool(
+                    live,
+                    tier_of(t) if tier_of is not None else None,
+                    tier_spread,
+                ),
+                key=lambda r: placement_score(t, r),
+            )
             for t in tenants
         }
 

@@ -63,6 +63,10 @@ from induction_network_on_fewrel_tpu.fleet.placement import (
     FleetPlacement,
 )
 from induction_network_on_fewrel_tpu.serving.batcher import Saturated
+from induction_network_on_fewrel_tpu.serving.geometry import (
+    DEFAULT_TIERS,
+    tier_for,
+)
 
 
 class ReplicaHandle:
@@ -262,6 +266,13 @@ class FleetRouter:
     f32 twin, so the same replica holds ~4x the tenants. ``None``
     (default) keeps the pre-quantization behavior: unbounded residency,
     queue depth is the only capacity signal.
+
+    ``tier_spread`` (ISSUE 19): N-tier-weighted rendezvous placement.
+    When > 0, each tier's tenants concentrate onto that many "home"
+    replicas (placement module doc) so no replica warms every tier's
+    program family; ``tiers`` is the ladder tenant class counts map
+    through (must match the replicas' engine ladder — serve.py wires
+    both from the same resolved policy). 0 (default) = tier-blind.
     """
 
     def __init__(
@@ -273,6 +284,8 @@ class FleetRouter:
         trace_sample: float = 0.0,
         queue_capacity_per_replica: int = 64,
         resident_budget_bytes: float | None = None,
+        tier_spread: int = 0,
+        tiers: tuple[int, ...] | None = DEFAULT_TIERS,
     ):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
@@ -288,6 +301,12 @@ class FleetRouter:
         self.fleet_share = fleet_share
         self._capacity_per_replica = queue_capacity_per_replica
         self.resident_budget_bytes = resident_budget_bytes
+        if tier_spread < 0:
+            raise ValueError(
+                f"tier_spread must be >= 0, got {tier_spread}"
+            )
+        self.tier_spread = tier_spread
+        self.tiers = tuple(tiers) if tiers else None
         # Per-replica circuit breaker: serving/breaker.CircuitBreaker
         # keyed by REPLICA id — consecutive forwarded-launch failures
         # open it, the open transition marks the replica dead in
@@ -337,6 +356,37 @@ class FleetRouter:
             return 0.0
         return float(snap.get("resident_bytes", 0.0))
 
+    # --- N-tier-weighted placement (ISSUE 19) ------------------------------
+
+    def tier_of_source(self, source, max_classes=None) -> int | None:
+        """The N-tier a tenant's support source lands on, or None when
+        tier-weighted placement is off / the source is unknown (a
+        params-only recovery entry places tier-blind — correct, just
+        unweighted for that tenant)."""
+        if self.tier_spread <= 0 or source is None:
+            return None
+        n = len(source.rel_names)
+        if max_classes is not None:
+            n = min(n, int(max_classes))
+        return tier_for(n, self.tiers)
+
+    def place_tenant(self, tenant: str, entry=None) -> str | None:
+        """ONE placement spelling for every router/control call site:
+        rendezvous with the tenant's N-tier weight when the directory
+        (or the caller-supplied ``entry``) knows its source. Register,
+        submit, failover, and recovery MUST all resolve through the
+        same function — two sites disagreeing on the tier weight would
+        read as a permanent pending re-placement."""
+        if entry is None:
+            entry = self.directory.get(tenant)
+        tier = (
+            self.tier_of_source(entry.source, entry.max_classes)
+            if entry is not None else None
+        )
+        return self.placement.place(
+            tenant, tier=tier, tier_spread=self.tier_spread
+        )
+
     # --- data plane -------------------------------------------------------
 
     def submit(self, instance, deadline_s=None, tenant="default") -> Future:
@@ -357,7 +407,7 @@ class FleetRouter:
                 step=self.submitted,
             ) is not None:
                 self.mark_replica_dead(owner_now, reason="chaos")
-        target = self.placement.place(tenant)
+        target = self.place_tenant(tenant, entry)
         if target is None:
             raise Saturated(1.0)   # no live replica: back off, retry
         with self._lock:
@@ -716,7 +766,14 @@ class FleetRouter:
         # dict raises mid-iteration when it grows underneath us.
         with self._lock:
             entries = list(self.directory.items())
-        owners = self.placement.owners([t for t, _ in entries])
+        by_tenant = dict(entries)
+        owners = self.placement.owners(
+            [t for t, _ in entries],
+            tier_of=lambda t: self.tier_of_source(
+                by_tenant[t].source, by_tenant[t].max_classes
+            ),
+            tier_spread=self.tier_spread,
+        )
         return tuple(sorted(
             t for t, e in entries
             if owners.get(t) is not None and owners[t] != e.owner
@@ -768,11 +825,17 @@ class FleetRouter:
         unreachable: set[str] = set()
         for tenant in sorted(state.tenants):
             meta = state.tenants[tenant]
-            owner = self.placement.place(tenant)
             source = (
                 _dataset_from_wire(meta["source"])
                 if meta.get("source") else None
             )
+            # Source BEFORE placement: the rebuilt owner must resolve
+            # with the same N-tier weight register_tenant used, or a
+            # clean recovery would read as a pending re-placement.
+            probe_entry = _TenantEntry(
+                None, source, max_classes=meta.get("max_classes")
+            )
+            owner = self.place_tenant(tenant, probe_entry)
             entry = _TenantEntry(
                 owner, source, max_classes=meta.get("max_classes")
             )

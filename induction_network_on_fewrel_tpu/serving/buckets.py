@@ -12,7 +12,18 @@ The TPU static-shape discipline is the same one the training stack lives by
 to the request axis. Compiles are COUNTED — the acceptance gate for the
 engine is "zero recompiles after warmup", and ``tools/loadgen.py`` asserts
 it — so this module owns the executables explicitly (jax AOT: lower ->
-compile keyed by (n_classes, bucket)) instead of hiding them in jit's cache.
+compile keyed by (n_classes, bucket, resident dtype)) instead of hiding
+them in jit's cache.
+
+Geometry plane (ISSUE 19): the cache derives its class axis from the
+resident matrix's ROW COUNT, so the key is whatever geometry the registry
+publishes. Under N-tier residency (serving/geometry.py) the registry pads
+every [N, C] stack up to a small fixed tier ladder before it becomes
+resident — the key here becomes ``(n_tier, bucket, resident dtype)`` with
+no cache-side changes, and the compiled-program count is bounded by
+tiers x buckets x dtypes regardless of how many distinct relation counts
+the fleet's tenants carry (``geometry.program_bound``, asserted by the
+tier-1 gate).
 """
 
 from __future__ import annotations

@@ -254,6 +254,22 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
                         "f32 and feed verdict agreement + margin drift "
                         "into the drift detector's parity bands "
                         "(0 = off; the --grad_probe_every of serving)")
+    # Geometry plane (ISSUE 19): knobs resolved in ONE home
+    # (config.resolve_geometry_policy) — None inherits the checkpoint
+    # config, same discipline as the quant knobs.
+    p.add_argument("--geometry_tiers", default=None,
+                   help="N-tier ladder resident class stacks pad up to "
+                        "(comma-separated ascending ints, e.g. "
+                        "'4,8,16,32,64'), bounding compiled query "
+                        "programs by tiers x buckets x dtypes; 'off' = "
+                        "exact-N residency (default: the checkpoint "
+                        "config, then 4,8,16,32,64)")
+    p.add_argument("--tier_spread", type=int, default=None,
+                   dest="geometry_tier_spread",
+                   help="fleet mode: concentrate each N-tier's tenants "
+                        "onto this many rendezvous 'home' replicas so "
+                        "no replica compiles every tier's programs "
+                        "(0 = tier-blind placement)")
     p.add_argument("--seed", type=int, default=0)
     return p
 
@@ -295,6 +311,7 @@ def _build_engine(args, buckets, logger=None, watchdog=None, slo=None,
             trace_sample=trace_sample,
             resident_dtype=args.resident_dtype,
             quant_probe_every=args.quant_probe_every,
+            geometry_tiers=args.geometry_tiers,
         )
     return _fresh_engine(args, buckets, logger=logger, watchdog=watchdog,
                          slo=slo, drift=drift, breaker=breaker,
@@ -342,6 +359,7 @@ def _fresh_engine(args, buckets, logger=None, watchdog=None, slo=None,
         trace_sample=trace_sample,
         resident_dtype=args.resident_dtype,
         quant_probe_every=args.quant_probe_every,
+        geometry_tiers=args.geometry_tiers,
     )
 
 
@@ -451,11 +469,31 @@ def _build_adapt(args, policy, *, drift, model, cfg, tok, src_ds, tgt_ds,
         # would fail every candidate at the first drift CRITICAL — the
         # same quarantine-by-typo outcome).
         legs = {"in_domain": src_ds, "target": tgt_ds}
+        # Geometry grid legs (ISSUE 19): a floor named grid_<N>w<K>s
+        # evaluates the in-domain corpus at THAT episode geometry
+        # (run_canary parses the name) — an adaptation that recovers
+        # the flagship 5w5s but regresses 10w1s must not publish.
+        from induction_network_on_fewrel_tpu.serving.geometry import (
+            parse_grid_key,
+        )
+
+        for name in floors:
+            g = parse_grid_key(name) if name.startswith("grid_") else None
+            if g is None:
+                continue
+            if g[0] > len(src_ds.rel_names):
+                raise SystemExit(
+                    f"--adapt_canary leg {name!r} needs {g[0]} relations "
+                    f"but the in-domain corpus has "
+                    f"{len(src_ds.rel_names)}"
+                )
+            legs[name] = src_ds
         unknown = sorted(set(floors) - set(legs))
         if unknown:
             raise SystemExit(
                 f"--adapt_canary names unknown leg(s) {unknown}: this "
-                f"deployment wires legs {sorted(legs)}"
+                f"deployment wires legs {sorted(legs)} plus "
+                f"grid_<N>w<K>s geometry legs"
             )
         # Evaluate ONLY the legs the plan floors: a floorless leg is
         # recorded-not-judged by canary_verdict, so evaluating it would

@@ -118,6 +118,16 @@ class _QuantKnobs:
         self.quant_probe_every = quant_probe_every
 
 
+class _GeomKnobs:
+    """Adapter handing the engine's geometry kwarg to the one-home
+    ``config.resolve_geometry_policy`` resolver (None = inherit the
+    served config's stored tier ladder)."""
+
+    def __init__(self, geometry_tiers):
+        self.geometry_tiers = geometry_tiers
+        self.geometry_tier_spread = None
+
+
 def degraded_verdict(tenant: str, *, snapshot_version: int = -1,
                      latency_ms: float = 0.0,
                      failover: bool = False) -> dict:
@@ -169,6 +179,7 @@ class InferenceEngine:
         start: bool = True,
         resident_dtype: str | None = None,
         quant_probe_every: int | None = None,
+        geometry_tiers: str | None = None,
     ):
         if cfg.model != "induction":
             raise ValueError(
@@ -233,12 +244,17 @@ class InferenceEngine:
         # config's stored values through the one-home resolver — a train
         # run that stamped resident_dtype serves quantized with no flag.
         from induction_network_on_fewrel_tpu.config import (
+            resolve_geometry_policy,
             resolve_quant_policy,
         )
 
         quant = resolve_quant_policy(
             _QuantKnobs(resident_dtype, quant_probe_every), base=cfg
         )
+        # Geometry plane (ISSUE 19): the N-tier ladder resident class
+        # stacks pad to. None inherits the served config's stored spec
+        # through the one-home resolver, exactly like the quant knobs.
+        geom = resolve_geometry_policy(_GeomKnobs(geometry_tiers), base=cfg)
         self.quant_probe_every = quant["probe_every"]
         # Parity-probe cadence counter: only the single batcher worker
         # thread touches it (_run_group), so a plain int is race-free.
@@ -253,7 +269,13 @@ class InferenceEngine:
             model, params, tokenizer,
             k=k if k is not None else cfg.k, logger=logger,
             resident_dtype=quant["resident_dtype"],
+            tiers=geom["tiers"],
         )
+        # Read the ladder BACK from the registry: a stats-head NOTA
+        # checkpoint forces exact-N there (supports_tiering), and the
+        # engine's tier-crossing warmup must agree with what the
+        # registry actually publishes.
+        self.tiers = self.registry.tiers
         # Capacity accounting (ISSUE 18): the density denominator. The
         # stats object exposes chip-resident bytes per tenant through
         # the same snapshot/registry-gauge spine as every other serving
@@ -372,6 +394,7 @@ class InferenceEngine:
     def register_class(
         self, name: str, instances, tenant: str = DEFAULT_TENANT
     ) -> None:
+        self._warm_tier_crossing(tenant, (name,))
         self.registry.register(name, instances, tenant=tenant)
         self._drift_rearm(tenant, f"register_class {name!r}")
 
@@ -379,11 +402,42 @@ class InferenceEngine:
         self, dataset, max_classes: int | None = None,
         tenant: str = DEFAULT_TENANT,
     ) -> list[str]:
+        adding = list(dataset.rel_names)
+        if max_classes is not None:
+            adding = adding[:max_classes]
+        self._warm_tier_crossing(tenant, adding)
         names = self.registry.register_dataset(
             dataset, max_classes=max_classes, tenant=tenant
         )
         self._drift_rearm(tenant, f"register_dataset ({len(names)} classes)")
         return names
+
+    def _warm_tier_crossing(self, tenant: str, adding) -> int:
+        """Warm-before-swap on N-tier crossings (ISSUE 19): when a
+        registration will push a LIVE tenant across a tier boundary
+        (its 9th relation migrates the 8-tier stack to 16), compile the
+        new tier's bucket programs FIRST — counted as warmup, exactly
+        like ``set_resident_dtype`` warms a dtype roll — so the
+        tenant's next batch after the republish hits a ready
+        executable and the zero-steady-state-recompile gate holds
+        across the crossing. First registrations are untouched: setup
+        flows call ``warmup()`` after registering, the existing
+        discipline. Returns the programs compiled (0 = no crossing)."""
+        if self.tiers is None or not self.registry.has_tenant(tenant):
+            return 0
+        snap = self.registry.snapshot(tenant)
+        cur_tier, c = snap.matrix.shape
+        new_names = set(snap.names) | set(adding)
+        new_tier = self.registry.tier_of(len(new_names))
+        if new_tier <= cur_tier:
+            return 0
+        dtypes = [snap.resident_dtype]
+        if self.quant_probe_every > 0 and snap.resident_dtype != "f32":
+            dtypes.append("f32")
+        return self.programs.warmup(
+            snap.params, new_tier, c, self.batcher.buckets,
+            self.max_length, dtypes=tuple(dtypes),
+        )
 
     def set_nota_threshold(
         self, threshold: float | None, tenant: str = DEFAULT_TENANT
@@ -891,13 +945,22 @@ class InferenceEngine:
         no-relation logit (0.0 = the head's own calibration, the
         pre-fleet behavior); without one, a set threshold is an open-set
         floor on the best class logit. Ties resolve toward the class —
-        matching the plain-argmax convention the pre-tenant engine had."""
+        matching the plain-argmax convention the pre-tenant engine had.
+
+        N-tier residency (ISSUE 19): ``row`` carries ``n_tier`` class
+        scores (+1 NOTA) but only the first ``n_classes`` are real —
+        the argmax, quality features, logits dict, and NOTA comparison
+        all slice to the real columns (the pad "mask" is never reading
+        them), and the NOTA logit is appended AFTER the matrix rows so
+        it lives at ``row[-1]`` for every tier (== ``row[n]`` under
+        exact-N). A pad class can therefore never win a verdict at any
+        threshold — pinned in tests/test_geometry.py."""
         names = snap.names
         n = len(names)
         best = int(np.argmax(row[:n]))
         thr = snap.nota_threshold
         if self.nota:
-            is_nota = float(row[n]) + (thr or 0.0) > float(row[best])
+            is_nota = float(row[-1]) + (thr or 0.0) > float(row[best])
         else:
             is_nota = thr is not None and float(row[best]) < thr
         # Quality features (ISSUE 10): shared formula home in
@@ -917,7 +980,7 @@ class InferenceEngine:
             "logits": {nm: float(row[i]) for i, nm in enumerate(names)},
         }
         if self.nota:
-            verdict["logits"][NO_RELATION] = float(row[n])
+            verdict["logits"][NO_RELATION] = float(row[-1])
         return verdict
 
     # --- observability / lifecycle ---------------------------------------

@@ -1,0 +1,178 @@
+"""Geometry plane (ISSUE 19): (N, K) episode geometry as a first-class
+serving + evaluation axis.
+
+Serving half — **N-tier shape bucketing**. The query-program cache keys
+on the resident class matrix's row count (serving/buckets.py): a fleet
+whose tenants range from 3 to 40 relations would compile one program
+set per distinct N — an unbounded family, defeating the
+zero-steady-state-recompile discipline the stack is built on. The fix
+mirrors what ``select_bucket`` already does for batch rows: resident
+``[N, C]`` class stacks pad UP to a small fixed tier set (default
+4/8/16/32/64) with all-zero pad rows, so the cache key becomes
+``(n_tier, bucket, resident dtype)`` and the compiled-program count is
+bounded by tiers x buckets x dtypes regardless of tenant count.
+
+Why zero pad rows are safe end to end:
+
+* The NTN relation scorer treats the class axis as a BATCH axis (both
+  einsums in models/induction.RelationNTN contract over feature dims
+  only), so pad rows cannot perturb real-row logits — tiered and
+  exact-N programs agree bitwise in f32 (pinned in
+  tests/test_geometry.py).
+* Verdicts argmax ``row[:n_classes]`` and the NOTA logit is appended
+  AFTER the matrix rows, i.e. at ``row[-1]`` for any tier — pad logits
+  are structurally outside every verdict, margin, entropy, and NOTA
+  calibration read (engine._verdict slices; the -inf mask the design
+  calls for is realized as never reading the pad columns at all).
+* int8 quantization: zero rows leave the tenant-wide max-abs scale
+  unchanged and pass both degenerate-artifact gates (an all-zero pad
+  row is not a COLLAPSED row, and its ``|q|.min()`` is 0, not 127) —
+  the tiered int8 matrix is exactly the exact-N int8 matrix plus zero
+  rows, same scale.
+
+The one model family tiering must refuse: the ``nota_head="stats"``
+NOTA head computes max/mean/std over the WHOLE class axis inside the
+compiled program, so pad rows would shift its logit. ``supports_tiering``
+gates it — such models fall back to exact-N residency (logged).
+
+Eval half — **the paper grid**. Geng et al. 2019 and FewRel 2.0 report
+across C-way K-shot, not one point: ``GRID`` names the headline
+geometries (5w1s / 5w5s / 10w1s / 10w5s; 1-shot stresses the dynamic
+routing hardest — K=1 collapses routing to a single support vector).
+tools/scenarios.py evaluates its grid legs through ``grid_key`` /
+``parse_grid_key`` so canary floors like ``grid_10w1s`` round-trip the
+same spelling.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# The default tier ladder: powers of two from the smallest useful
+# episode (FewRel's 3-relation toy tenants pad to 4) up past the
+# paper's 10-way grid with headroom for production relation inventories
+# (a 40-relation tenant lands on 64). Five tiers x five buckets x
+# three resident dtypes bounds the whole fleet at 75 compiled query
+# programs — vs one family per distinct N unbounded.
+DEFAULT_TIERS: tuple[int, ...] = (4, 8, 16, 32, 64)
+
+# The paper's headline (N, K) evaluation grid (PAPER.md pillar 7):
+# 5-way 1-shot, the 5w5s flagship, and the 10-way pair FewRel 2.0
+# reports. Order is presentation order, not difficulty.
+GRID: tuple[tuple[int, int], ...] = ((5, 1), (5, 5), (10, 1), (10, 5))
+
+
+def parse_tiers(spec) -> tuple[int, ...] | None:
+    """Parse a tier-set spec ("4,8,16,32,64") into a validated ascending
+    tuple. "off" / "" / None disable tiering (exact-N residency — the
+    pre-ISSUE-19 behavior, kept as the loadgen A/B arm). An already-
+    parsed tuple/list passes through validation unchanged."""
+    if spec is None:
+        return None
+    if isinstance(spec, (tuple, list)):
+        tiers = tuple(int(t) for t in spec)
+    else:
+        s = str(spec).strip().lower()
+        if s in ("", "off", "none"):
+            return None
+        try:
+            tiers = tuple(int(t) for t in s.split(","))
+        except ValueError:
+            raise ValueError(
+                f"geometry_tiers must be comma-separated ints or 'off', "
+                f"got {spec!r}"
+            ) from None
+    if not tiers:
+        return None
+    if any(t < 1 for t in tiers):
+        raise ValueError(f"geometry tiers must be >= 1, got {tiers}")
+    if list(tiers) != sorted(set(tiers)):
+        raise ValueError(
+            f"geometry tiers must be strictly increasing, got {tiers}"
+        )
+    return tiers
+
+
+def tiers_spec(tiers: tuple[int, ...] | None) -> str:
+    """Inverse of ``parse_tiers`` — the loggable knob spelling."""
+    return "off" if not tiers else ",".join(str(t) for t in tiers)
+
+
+def select_tier(n: int, tiers: tuple[int, ...] = DEFAULT_TIERS) -> int:
+    """Smallest tier >= n — the class-axis twin of ``select_bucket``.
+    Monotone in n by construction (pinned in tests); raises on n <= 0
+    and on overflow past the largest tier (serving callers that want
+    the exact-N fallback use ``tier_for``)."""
+    if n <= 0:
+        raise ValueError(f"class count must be >= 1, got {n}")
+    for t in tiers:
+        if n <= t:
+            return t
+    raise ValueError(
+        f"{n} classes exceed the largest geometry tier {max(tiers)} — "
+        f"extend the tier set or serve this tenant exact-N"
+    )
+
+
+def tier_for(n: int, tiers: tuple[int, ...] | None) -> int:
+    """The serving spelling: the tier ``n`` classes pad to, or ``n``
+    itself when tiering is off or the tenant overflows the ladder (an
+    oversize tenant serves exact-N — correct, just unbounded for that
+    one N; callers log it)."""
+    if not tiers or n > tiers[-1]:
+        return n
+    return select_tier(n, tiers)
+
+
+def pad_class_stack(stack: np.ndarray, tier: int) -> np.ndarray:
+    """[N, C] f32 host stack -> [tier, C] with all-zero pad rows
+    appended. Zero rows (not repeats, unlike ``pad_rows`` for query
+    batches) on purpose: they are invisible to the per-class NTN score,
+    leave the int8 tenant scale unchanged, and pass the degenerate-
+    artifact gates — see the module doc."""
+    n = stack.shape[0]
+    if n == tier:
+        return stack
+    if n > tier:
+        raise ValueError(f"cannot pad {n} class rows down to tier {tier}")
+    pad = np.zeros((tier - n,) + stack.shape[1:], dtype=stack.dtype)
+    return np.concatenate([stack, pad], axis=0)
+
+
+def program_bound(
+    tiers: tuple[int, ...], buckets: tuple[int, ...], n_dtypes: int = 1
+) -> int:
+    """The compiled-query-program ceiling a tiered fleet can reach:
+    tiers x buckets x resident dtypes — the invariant the tier-1 gate
+    asserts in-process (a cache exceeding it means some matrix reached
+    the data plane un-tiered)."""
+    return len(tiers) * len(buckets) * n_dtypes
+
+
+def supports_tiering(model) -> bool:
+    """False for models whose NOTA head reads statistics across the
+    class axis inside the compiled program (``nota_head="stats"`` —
+    max/mean/std over ALL rows, pads included): padding would shift
+    the NOTA logit, so such checkpoints serve exact-N."""
+    return getattr(model, "nota_head", "scalar") != "stats"
+
+
+def grid_key(n: int, k: int) -> str:
+    """(5, 1) -> "5w1s" — the paper's C-way K-shot spelling, used for
+    scenario leg names, canary floors ("grid_5w1s"), and artifact keys."""
+    return f"{n}w{k}s"
+
+
+def parse_grid_key(name: str) -> tuple[int, int] | None:
+    """Inverse of ``grid_key``; accepts the bare ("10w5s") and floor
+    ("grid_10w5s") spellings. None when ``name`` is not a geometry leg
+    — callers fall through to their default-geometry path."""
+    s = name[5:] if name.startswith("grid_") else name
+    if "w" not in s or not s.endswith("s"):
+        return None
+    left, right = s.split("w", 1)
+    try:
+        n, k = int(left), int(right[:-1])
+    except ValueError:
+        return None
+    return (n, k) if n >= 1 and k >= 1 else None

@@ -63,6 +63,12 @@ from induction_network_on_fewrel_tpu.serving.buckets import (
     QUERY_DTYPES,
     RESIDENT_DTYPES,
 )
+from induction_network_on_fewrel_tpu.serving.geometry import (
+    pad_class_stack,
+    supports_tiering,
+    tier_for,
+    tiers_spec,
+)
 
 DEFAULT_TENANT = "default"
 
@@ -148,6 +154,15 @@ class Snapshot:
     def n_classes(self) -> int:
         return len(self.names)
 
+    @property
+    def n_tier(self) -> int:
+        """Row count of the RESIDENT matrix — the tier ``n_classes``
+        padded up to (ISSUE 19), or ``n_classes`` itself under exact-N
+        residency. The program-cache key's class axis; the NOTA logit
+        sits at row index ``n_tier`` in every scored row (i.e. at
+        ``row[-1]``)."""
+        return int(self.matrix.shape[0])
+
     def index_of(self, name: str) -> int:
         return self.names.index(name)
 
@@ -188,7 +203,8 @@ class TenantRegistry:
     """
 
     def __init__(self, model, params, tokenizer, k: int = 5, logger=None,
-                 resident_dtype: str = "f32"):
+                 resident_dtype: str = "f32",
+                 tiers: tuple[int, ...] | None = None):
         import jax
 
         if k < 1:
@@ -200,6 +216,20 @@ class TenantRegistry:
             )
         self._model, self.params, self._tok, self.k = model, params, tokenizer, k
         self._logger = logger
+        # Geometry plane (ISSUE 19): the N-tier ladder published class
+        # matrices pad up to, or None for exact-N residency. A model
+        # whose NOTA head reads stats across the class axis would see
+        # pad rows shift its logit — such checkpoints force exact-N
+        # (serving/geometry.supports_tiering), logged once here.
+        self.tiers = tuple(tiers) if tiers else None
+        if self.tiers is not None and not supports_tiering(model):
+            if logger is not None:
+                logger.log(
+                    0, kind="serve", event="geometry_tiers_disabled",
+                    reason="nota_head=stats reads class-axis statistics",
+                    requested=tiers_spec(self.tiers),
+                )
+            self.tiers = None
         # Quantized residency (ISSUE 18): the registry-wide default dtype
         # for published class matrices plus per-tenant overrides (the
         # parity-alarm rollback path pins a single tenant back to f32
@@ -876,7 +906,10 @@ class TenantRegistry:
         [N, C] matrix in its resident dtype plus the f32 dequant scale.
         Host-side copies (slot pool, parity shadow) spend host RAM, not
         HBM, and are deliberately excluded — this gauge is the density
-        denominator the capacity accounting divides by. GIL-atomic."""
+        denominator the capacity accounting divides by. GIL-atomic.
+        Under N-tier residency (ISSUE 19) the matrix shape IS the
+        padded [n_tier, C] stack, so capacity accounting prices the
+        padding waste honestly by construction."""
         out: dict[str, float] = {}
         for tenant, snap in list(self._tenants.items()):
             nbytes = int(np.dtype(snap.matrix.dtype).itemsize)
@@ -900,6 +933,13 @@ class TenantRegistry:
         self._tenant_dtype.pop(tenant, None)
         self._gc_slots_locked()
 
+    def tier_of(self, n: int) -> int:
+        """The N-tier ``n`` class rows pad to on THIS registry — ``n``
+        itself when tiering is off or ``n`` overflows the ladder (the
+        oversize tenant serves exact-N: correct, just unbounded for
+        that one N)."""
+        return tier_for(n, self.tiers)
+
     def _residency(self, stack: np.ndarray, tenant: str):
         """Stage the RESIDENT form of a stacked [N, C] f32 class matrix
         (ISSUE 18): device_put in the tenant's resident dtype. Returns
@@ -909,7 +949,19 @@ class TenantRegistry:
         quantization degenerates: a registration refuses, a publish
         rolls back, an operator re-quantization quarantines — a
         degenerate matrix never becomes resident, exactly like the
-        NaN'd-artifact gate."""
+        NaN'd-artifact gate.
+
+        Geometry plane (ISSUE 19): THE tier-padding insertion point.
+        The stack pads to its N-tier with all-zero rows BEFORE any
+        dtype conversion — zero rows leave the int8 tenant scale
+        unchanged (same real-row quantized values as exact-N) and pass
+        both degenerate-artifact gates — so every resident form
+        (matrix AND shadow) is tier-shaped and the program cache,
+        warmup, parity probe, and resident-bytes accounting all see
+        the padded geometry with no further plumbing."""
+        tier = self.tier_of(stack.shape[0])
+        if tier != stack.shape[0]:
+            stack = pad_class_stack(stack, tier)
         dtype = self.dtype_for(tenant)
         if dtype == "f32":
             return self._jax.device_put(stack), None, None

@@ -1,0 +1,524 @@
+"""Geometry plane (ISSUE 19): first-class (N, K) episode geometry.
+
+Covers: the pure tier ladder (select_tier monotonicity/minimality,
+spec grammar roundtrip, pad math, the bounded-program-count arithmetic),
+tier-weighted rendezvous placement (per-tier home-set bound, tier-blind
+equivalence), the grid-leg canary verdict (a candidate recovering the
+flagship but regressing 10w1s is NOT published), and the served data
+plane on a BRIEFLY-TRAINED model: padded-tier logits equal the exact-N
+program on real rows (f32 bitwise, bf16/int8 in-band), pad classes never
+win a verdict even at NOTA threshold 0, mixed-N tenant co-residency with
+zero steady-state recompiles under the tiers x buckets x dtypes program
+bound, warm-before-swap tier crossings, and the stats-NOTA-head refusal.
+"""
+
+import dataclasses
+import json
+import sys
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from induction_network_on_fewrel_tpu.config import (
+    ExperimentConfig,
+    resolve_geometry_policy,
+)
+from induction_network_on_fewrel_tpu.data import (
+    make_synthetic_fewrel,
+    make_synthetic_glove,
+)
+from induction_network_on_fewrel_tpu.data.tokenizer import GloveTokenizer
+from induction_network_on_fewrel_tpu.fleet.placement import FleetPlacement
+from induction_network_on_fewrel_tpu.models import build_model
+from induction_network_on_fewrel_tpu.sampling import EpisodeSampler
+from induction_network_on_fewrel_tpu.serving.buckets import zero_batch
+from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
+from induction_network_on_fewrel_tpu.serving.geometry import (
+    DEFAULT_TIERS,
+    GRID,
+    grid_key,
+    pad_class_stack,
+    parse_grid_key,
+    parse_tiers,
+    program_bound,
+    select_tier,
+    supports_tiering,
+    tier_for,
+    tiers_spec,
+)
+from induction_network_on_fewrel_tpu.train import FewShotTrainer
+from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
+
+_REPO = Path(__file__).resolve().parent.parent
+_TOOLS = str(_REPO / "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+from scenarios import canary_verdict, floors_from_headline  # noqa: E402
+
+# Tiny flagship-shaped config (the tests/test_serving.py world) + the
+# training fields the parity fixture needs.
+CFG = ExperimentConfig(
+    model="induction", encoder="cnn", hidden_size=16,
+    vocab_size=122, word_dim=8, pos_dim=2, max_length=16,
+    induction_dim=8, ntn_slices=4, routing_iters=2,
+    n=3, train_n=3, k=2, q=2, batch_size=2, lr=5e-3, val_step=0,
+    device="cpu",
+)
+
+
+@pytest.fixture(scope="module")
+def trained_world():
+    """(vocab, tok, model, params, ds): ~150 optimizer steps on the
+    synthetic corpus — real verdict margins, so tiered-vs-exact parity
+    measures the padding, not tie-breaking noise."""
+    vocab = make_synthetic_glove(vocab_size=CFG.vocab_size - 2,
+                                 word_dim=CFG.word_dim)
+    tok = GloveTokenizer(vocab, max_length=CFG.max_length)
+    ds = make_synthetic_fewrel(
+        num_relations=5, instances_per_relation=12,
+        vocab_size=CFG.vocab_size - 2, seed=7,
+    )
+    model = build_model(CFG, glove_init=vocab.vectors)
+    trainer = FewShotTrainer(
+        model, CFG,
+        EpisodeSampler(ds, tok, n=CFG.n, k=CFG.k, q=CFG.q,
+                       batch_size=CFG.batch_size, seed=3),
+        logger=MetricsLogger(quiet=True),
+    )
+    state = trainer.train(num_iters=150)
+    return vocab, tok, model, state.params, ds
+
+
+def _engine(trained_world, **kw):
+    _, tok, model, params, ds = trained_world
+    eng = InferenceEngine(
+        model, params, CFG, tok, k=CFG.k,
+        buckets=kw.pop("buckets", (1, 2, 4)),
+        start=kw.pop("start", True), **kw,
+    )
+    return eng, ds
+
+
+def _held_out(ds):
+    return [i for r in ds.rel_names for i in ds.instances[r][CFG.k:]]
+
+
+def _wait_for(cond, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return cond()
+
+
+# --- tier ladder (pure) ---------------------------------------------------
+
+
+def test_select_tier_monotone_minimal():
+    """The tier-1 gate ISSUE 19 names: select_tier is monotone in n,
+    always covers n, and is minimal over the ladder."""
+    prev = 0
+    for n in range(1, DEFAULT_TIERS[-1] + 1):
+        t = select_tier(n, DEFAULT_TIERS)
+        assert t in DEFAULT_TIERS
+        assert t >= n, f"tier {t} cannot hold {n} classes"
+        # Minimality: every smaller rung is too small for n.
+        assert all(r < n for r in DEFAULT_TIERS if r < t)
+        assert t >= prev, "select_tier must be monotone in n"
+        prev = t
+    with pytest.raises(ValueError):
+        select_tier(0, DEFAULT_TIERS)
+    with pytest.raises(ValueError):
+        select_tier(DEFAULT_TIERS[-1] + 1, DEFAULT_TIERS)
+
+
+def test_tier_for_overflow_and_off():
+    # Exact-N passthrough when tiering is off...
+    assert tier_for(7, None) == 7
+    assert tier_for(7, ()) == 7
+    # ...and graceful overflow past the ladder top (served exact-N).
+    assert tier_for(DEFAULT_TIERS[-1] + 6, DEFAULT_TIERS) \
+        == DEFAULT_TIERS[-1] + 6
+    assert tier_for(5, DEFAULT_TIERS) == 8
+    assert tier_for(8, DEFAULT_TIERS) == 8
+
+
+def test_parse_tiers_grammar_roundtrip():
+    assert parse_tiers("4,8,16,32,64") == (4, 8, 16, 32, 64)
+    assert parse_tiers(" 4, 8 ") == (4, 8)
+    for off in (None, "", "off", "none", "OFF"):
+        assert parse_tiers(off) is None
+    # Roundtrip through the spec spelling (the config/CLI knob).
+    assert parse_tiers(tiers_spec(DEFAULT_TIERS)) == DEFAULT_TIERS
+    assert tiers_spec(None) == "off"
+    for bad in ("8,4", "4,4", "0,8", "-1", "4,x"):
+        with pytest.raises(ValueError):
+            parse_tiers(bad)
+
+
+def test_pad_class_stack_zero_rows():
+    rng = np.random.default_rng(0)
+    stack = rng.normal(size=(5, 16)).astype(np.float32)
+    padded = pad_class_stack(stack, 8)
+    assert padded.shape == (8, 16)
+    # Real rows bitwise-preserved; pad rows exactly zero.
+    assert np.array_equal(padded[:5], stack)
+    assert not padded[5:].any()
+    # Already at tier: no copy games, just the same rows back.
+    assert np.array_equal(pad_class_stack(stack, 5), stack)
+    with pytest.raises(ValueError):
+        pad_class_stack(stack, 4)
+
+
+def test_program_bound_arithmetic():
+    assert program_bound(DEFAULT_TIERS, (1, 2, 4), n_dtypes=1) == 15
+    assert program_bound(DEFAULT_TIERS, (1, 2, 4), n_dtypes=2) == 30
+    assert program_bound((4, 8), (1,), n_dtypes=3) == 6
+
+
+def test_grid_key_roundtrip():
+    assert grid_key(5, 1) == "5w1s"
+    assert [grid_key(n, k) for n, k in GRID] \
+        == ["5w1s", "5w5s", "10w1s", "10w5s"]
+    assert parse_grid_key("5w1s") == (5, 1)
+    assert parse_grid_key("grid_10w5s") == (10, 5)
+    assert parse_grid_key("in_domain") is None
+    assert parse_grid_key("grid_w1s") is None
+
+
+def test_resolve_geometry_policy_one_home():
+    base = dataclasses.replace(CFG, geometry_tiers="4,8",
+                               geometry_tier_spread=2)
+    # None inherits the served config; an explicit knob overrides it.
+    assert resolve_geometry_policy(
+        type("K", (), {"geometry_tiers": None})(), base=base
+    ) == {"tiers": (4, 8), "tier_spread": 2}
+    assert resolve_geometry_policy(
+        type("K", (), {"geometry_tiers": "off"})(), base=base
+    )["tiers"] is None
+    assert resolve_geometry_policy(
+        type("K", (), {"geometry_tiers": "16,32"})(), base=base
+    )["tiers"] == (16, 32)
+
+
+# --- tier-weighted placement ----------------------------------------------
+
+
+def test_tier_weighted_placement_home_set_bound():
+    """With tier_spread=s, every tenant of one N-tier lands on at most s
+    replicas (the tier's rendezvous home set), and tier-blind placement
+    is unchanged from the plain rendezvous map."""
+    fp = FleetPlacement([f"replica-{i}" for i in range(8)])
+    tenants = [f"tenant-{i}" for i in range(48)]
+    tier_by_tenant = {t: DEFAULT_TIERS[i % 3] for i, t in enumerate(tenants)}
+
+    owners = fp.owners(tenants, tier_of=tier_by_tenant.get, tier_spread=2)
+    by_tier = {}
+    for t, owner in owners.items():
+        assert owner is not None
+        by_tier.setdefault(tier_by_tenant[t], set()).add(owner)
+    for tier, homes in by_tier.items():
+        assert len(homes) <= 2, f"tier {tier} spread over {homes}"
+    # Same map from the single-tenant spelling.
+    for t in tenants:
+        assert fp.place(t, tier=tier_by_tenant[t], tier_spread=2) \
+            == owners[t]
+
+    # Tier-blind (tier_of=None / tier=None / spread=0) == plain map.
+    blind = fp.owners(tenants)
+    assert fp.owners(tenants, tier_of=lambda t: None, tier_spread=2) \
+        == blind
+    assert fp.owners(tenants, tier_of=tier_by_tenant.get,
+                     tier_spread=0) == blind
+    for t in tenants[:8]:
+        assert fp.place(t) == blind[t]
+
+
+# --- grid canary verdict --------------------------------------------------
+
+
+def test_canary_grid_regression_blocks_publish():
+    """ISSUE 19's adaptation gate: a candidate recovering the flagship
+    5w5s leg but regressing 10w1s must NOT publish."""
+    headline = {
+        "in_domain_accuracy": 0.90,
+        "grid": {"5w5s": 0.90, "10w1s": 0.70},
+    }
+    floors = floors_from_headline(headline, band={"accuracy_abs": 0.05})
+    assert floors["grid_10w1s"] == 0.65
+
+    regressed = canary_verdict(
+        {
+            "in_domain_accuracy": {"accuracy": 0.95},
+            "grid_5w5s": {"accuracy": 0.92},
+            "grid_10w1s": {"accuracy": 0.20},
+        },
+        floors,
+    )
+    assert not regressed["passed"]
+    assert any("grid_10w1s" in f for f in regressed["failures"])
+
+    healthy = canary_verdict(
+        {
+            "in_domain_accuracy": {"accuracy": 0.95},
+            "grid_5w5s": {"accuracy": 0.92},
+            "grid_10w1s": {"accuracy": 0.71},
+        },
+        floors,
+    )
+    assert healthy["passed"], healthy["failures"]
+
+    # A floor whose leg was never evaluated fails loudly, not silently.
+    missing = canary_verdict({"in_domain": {"accuracy": 0.95}}, floors)
+    assert not missing["passed"]
+    assert any("no evaluated leg" in f for f in missing["failures"])
+
+
+# --- served data plane: parity --------------------------------------------
+
+
+def test_tiered_parity_f32_bitwise(trained_world):
+    """Padded-tier logits equal the exact-N program on real rows,
+    bitwise: the class axis is a batch axis in the NTN einsums, so zero
+    pad rows cannot perturb real-row arithmetic."""
+    tiered, ds = _engine(trained_world, geometry_tiers="4,8,16,32,64")
+    exact, _ = _engine(trained_world, geometry_tiers="off")
+    try:
+        for eng in (tiered, exact):
+            eng.register_dataset(ds)
+            eng.warmup()
+        assert tiered.registry.snapshot().n_tier == 8
+        assert exact.registry.snapshot().n_tier == len(ds.rel_names)
+        for inst in _held_out(ds):
+            vt = tiered.classify(inst)
+            ve = exact.classify(inst)
+            assert set(vt["logits"]) == set(ve["logits"])
+            for name, logit in vt["logits"].items():
+                assert logit == ve["logits"][name], (
+                    f"{name}: tiered {logit!r} != exact "
+                    f"{ve['logits'][name]!r}"
+                )
+            assert vt["label"] == ve["label"]
+            assert vt["nota"] == ve["nota"]
+    finally:
+        tiered.close()
+        exact.close()
+
+
+@pytest.mark.parametrize("dtype", ["bf16", "int8"])
+def test_tiered_parity_quantized_in_band(trained_world, dtype):
+    """Same pin for the quantized residents: zero pad rows leave the
+    int8 max-abs scale (and every bf16 real row) untouched, so tiered
+    vs exact-N stays inside the quant parity band."""
+    tiered, ds = _engine(trained_world, geometry_tiers="4,8,16,32,64",
+                         resident_dtype=dtype)
+    exact, _ = _engine(trained_world, geometry_tiers="off",
+                       resident_dtype=dtype)
+    try:
+        for eng in (tiered, exact):
+            eng.register_dataset(ds)
+            eng.warmup()
+        agree, delta = 0, 0.0
+        queries = _held_out(ds)
+        for inst in queries:
+            vt = tiered.classify(inst)
+            ve = exact.classify(inst)
+            agree += vt["label"] == ve["label"]
+            delta = max(delta, max(
+                abs(vt["logits"][name] - ve["logits"][name])
+                for name in ve["logits"]
+            ))
+        assert agree >= 0.99 * len(queries)
+        assert delta <= 0.25, f"{dtype} tiered-vs-exact drift {delta}"
+    finally:
+        tiered.close()
+        exact.close()
+
+
+# --- served data plane: pads and NOTA -------------------------------------
+
+
+def test_pad_classes_never_win_verdict(trained_world):
+    """Even at NOTA threshold 0 (the most NOTA-favorable calibration),
+    a verdict is always a REAL class or no_relation — pad columns are
+    sliced out before argmax and excluded from the logits dict."""
+    tiered, ds = _engine(trained_world, geometry_tiers="4,8,16,32,64")
+    try:
+        tiered.register_dataset(ds)
+        tiered.warmup()
+        tiered.set_nota_threshold(0.0)
+        real = set(ds.rel_names)
+        for inst in _held_out(ds):
+            v = tiered.classify(inst)
+            assert v["label"] in real | {"no_relation"}
+            assert -1 <= v["class_index"] < len(ds.rel_names)
+            # Logits expose exactly the real classes (+ the NOTA row
+            # when the head exists) — never a pad column.
+            assert set(v["logits"]) - {"no_relation"} == real
+    finally:
+        tiered.close()
+
+
+def test_pad_never_wins_with_forced_nota_head(trained_world):
+    """The adversarial spelling: a scalar-NOTA checkpoint whose NOTA
+    logit is forced sky-high. Under tiering the NOTA row rides BEHIND
+    the pad rows (row[-1]), so the verdict must still be no_relation —
+    a pad column absorbing the argmax would break this."""
+    vocab, tok, _, _, ds = trained_world
+    cfg = CFG.replace(na_rate=1)
+    model = build_model(cfg, glove_init=vocab.vectors)
+    params = model.init(
+        jax.random.key(0),
+        zero_batch(cfg.max_length, (1, cfg.n, cfg.k)),
+        zero_batch(cfg.max_length, (1, 2)),
+    )
+    inner = dict(params["params"])
+    inner["nota_logit"] = jnp.full((1,), 50.0)
+    params = {"params": inner}
+    eng = InferenceEngine(model, params, cfg, tok, k=cfg.k,
+                          buckets=(1, 2), start=False,
+                          geometry_tiers="4,8,16,32,64")
+    try:
+        eng.register_dataset(ds)
+        assert eng.registry.snapshot().n_tier == 8
+        fut = eng.submit(ds.instances[ds.rel_names[0]][-1], deadline_s=30.0)
+        eng.batcher.drain_once()
+        v = fut.result(timeout=10.0)
+        assert v["nota"] and v["label"] == "no_relation"
+        assert v["class_index"] == -1
+        assert set(v["logits"]) == set(ds.rel_names) | {"no_relation"}
+    finally:
+        eng.close()
+
+
+def test_stats_nota_head_refuses_tiering(trained_world):
+    """nota_head='stats' reads class-axis statistics — pad rows WOULD
+    shift its calibration, so the registry must force exact-N."""
+    vocab, tok, _, _, _ = trained_world
+    cfg = CFG.replace(na_rate=1, nota_head="stats")
+    model = build_model(cfg, glove_init=vocab.vectors)
+    assert not supports_tiering(model)
+    params = model.init(
+        jax.random.key(0),
+        zero_batch(cfg.max_length, (1, cfg.n, cfg.k)),
+        zero_batch(cfg.max_length, (1, 2)),
+    )
+    eng = InferenceEngine(model, params, cfg, tok, k=cfg.k,
+                          buckets=(1, 2), start=False,
+                          geometry_tiers="4,8,16,32,64")
+    try:
+        assert eng.registry.tiers is None
+        assert eng.tiers is None
+    finally:
+        eng.close()
+
+
+# --- served data plane: recompiles and the program bound ------------------
+
+
+def test_mixed_n_soak_zero_recompiles_bounded(trained_world):
+    """Mixed-N tenants co-resident on one engine: zero steady-state
+    recompiles through serving, tier crossings, and a dtype flip, with
+    the compiled-program count held under tiers x buckets x dtypes."""
+    eng, ds = _engine(trained_world, geometry_tiers="4,8,16,32,64")
+    try:
+        worlds = {}
+        for t, n in (("small", 3), ("mid", 5), ("wide", 14)):
+            tds = make_synthetic_fewrel(
+                num_relations=n, instances_per_relation=CFG.k + 3,
+                vocab_size=CFG.vocab_size - 2, seed=100 + n,
+            )
+            eng.register_dataset(tds, tenant=t)
+            worlds[t] = tds
+        assert {t: eng.registry.snapshot(t).n_tier for t in worlds} \
+            == {"small": 4, "mid": 8, "wide": 16}
+        eng.warmup()
+
+        def soak():
+            for t, tds in worlds.items():
+                for r in tds.rel_names:
+                    v = eng.classify(tds.instances[r][-1], tenant=t)
+                    assert v["label"] in tds.rel_names \
+                        or v["label"] == "no_relation"
+
+        soak()
+        # Tier crossing mid-soak: "mid" grows 5 -> 9 classes (tier
+        # 8 -> 16). Warm-before-swap compiles the 16-tier programs
+        # BEFORE the registry publishes, so nothing lands on the
+        # query path.
+        grown = make_synthetic_fewrel(
+            num_relations=9, instances_per_relation=CFG.k + 3,
+            vocab_size=CFG.vocab_size - 2, seed=105,
+        )
+        eng.register_dataset(grown, tenant="mid")
+        worlds["mid"] = grown
+        assert eng.registry.snapshot("mid").n_tier == 16
+        soak()
+        # Dtype flip mid-soak (warm-first too).
+        eng.set_resident_dtype("small", "bf16")
+        soak()
+
+        snap = eng.stats.snapshot()
+        assert snap["steady_recompiles"] == 0, snap
+        bound = program_bound(DEFAULT_TIERS, (1, 2, 4), n_dtypes=2)
+        assert len(eng.programs._exe) <= bound, (
+            f"{len(eng.programs._exe)} programs exceed bound {bound}"
+        )
+    finally:
+        eng.close()
+
+
+def test_tier_crossing_reregistration_no_steady_recompile(trained_world):
+    """The ISSUE's named drill: a tenant registering past its tier
+    boundary (here 3 -> 5 classes, tier 4 -> 8) migrates without a
+    steady-state recompile."""
+    eng, ds = _engine(trained_world, geometry_tiers="4,8,16,32,64")
+    try:
+        eng.register_dataset(ds, max_classes=3)
+        assert eng.registry.snapshot().n_tier == 4
+        eng.warmup()
+        for inst in _held_out(ds)[:4]:
+            eng.classify(inst)
+        eng.register_dataset(ds)  # now all 5 relations: crosses to 8
+        assert eng.registry.snapshot().n_tier == 8
+        for inst in _held_out(ds):
+            eng.classify(inst)
+        assert eng.stats.snapshot()["steady_recompiles"] == 0
+    finally:
+        eng.close()
+
+
+# --- committed GEOM artifact ----------------------------------------------
+
+
+def test_geom_artifact_gate():
+    """The committed tiered-vs-exact A/B holds its zero bands, the
+    program bound, and carries the paper grid with CIs."""
+    data = json.loads((_REPO / "GEOM_r01.json").read_text())
+    assert data["passed"] is True and not data["check_failures"]
+    assert all(v == 0 for v in data["zero_bands"].values())
+    arms = data["arms"]
+    assert set(arms) == {"tiered", "exact"}
+    assert arms["tiered"]["steady_recompiles"] == 0
+    assert arms["tiered"]["program_cache_keys"] \
+        <= data["program_bound_tiered"]
+    # The tax the A/B documents: exact-N pays crossing recompiles and
+    # holds MORE distinct programs than the tier ladder.
+    assert arms["exact"]["steady_recompiles"] >= 1
+    assert arms["tiered"]["program_cache_keys"] \
+        < arms["exact"]["program_cache_keys"]
+    for arm in arms.values():
+        assert arm["parity_max_delta"] <= arm["parity_tol"]
+        flip = arm["dtype_flip"]
+        assert flip["parity_max_delta"] <= flip["parity_tol"]
+    assert data["grid"], "grid legs missing from GEOM artifact"
+    for key, leg in data["grid"].items():
+        assert parse_grid_key(key) == (leg["n"], leg["k"])
+        assert 0.0 <= leg["accuracy"] <= 1.0
+        assert leg["acc_ci95"] >= 0.0

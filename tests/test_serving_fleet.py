@@ -547,15 +547,19 @@ def test_shed_load_fairness():
 
 def test_continuous_engine_zero_recompiles(world):
     """The acceptance gate on the continuous path: warmup compiles each
-    bucket once per distinct class count; steady multi-tenant traffic of
-    every size then recompiles NOTHING."""
+    bucket once per distinct N-TIER (ISSUE 19 — the 4- and 3-class
+    tenants share the 4-tier programs, halving the old per-class-count
+    set); steady multi-tenant traffic of every size then recompiles
+    NOTHING."""
     _, _, _, _, ds_a, ds_b = world
     eng = _engine(world)
     try:
-        eng.register_dataset(ds_a, tenant="a")   # 4 classes
-        eng.register_dataset(ds_b, tenant="b")   # 3 classes
+        eng.register_dataset(ds_a, tenant="a")   # 4 classes -> tier 4
+        eng.register_dataset(ds_b, tenant="b")   # 3 classes -> tier 4
+        assert eng.registry.snapshot("a").n_tier == 4
+        assert eng.registry.snapshot("b").n_tier == 4
         compiled = eng.warmup()
-        assert compiled == 6                      # 3 buckets x 2 class counts
+        assert compiled == 3                      # 3 buckets x 1 shared tier
         insts = {
             "a": ds_a.instances[ds_a.rel_names[0]][-1],
             "b": ds_b.instances[ds_b.rel_names[0]][-1],
@@ -573,7 +577,7 @@ def test_continuous_engine_zero_recompiles(world):
             for f in futs:
                 assert f.result(timeout=10.0)["label"]
         assert eng.stats.steady_compiles == 0
-        assert eng.programs.compiles == 6
+        assert eng.programs.compiles == 3
     finally:
         eng.close()
 
@@ -735,6 +739,26 @@ def test_loadgen_parity_and_zero_recompile_gate(world):
         assert eng.stats.steady_compiles == 0, (
             "the continuous query path recompiled after warmup"
         )
+    finally:
+        eng.close()
+
+    # Mixed-GEOMETRY parity (ISSUE 19): the same gate with the N-tier
+    # ladder on and the tenants landing on DIFFERENT tiers (4 classes
+    # pad to tier 6 with two pad rows, 3 classes sit at tier 3) — the
+    # served tier-padded program must still match the exact-N direct
+    # forward.
+    eng = _engine(world, geometry_tiers="3,6")
+    try:
+        eng.register_dataset(ds_a, tenant="a")   # 4 classes -> tier 6
+        eng.register_dataset(ds_b, tenant="b")   # 3 classes -> tier 3
+        eng.warmup()
+        assert eng.registry.snapshot("a").n_tier == 6
+        assert eng.registry.snapshot("b").n_tier == 3
+        for tenant, ds in (("a", ds_a), ("b", ds_b)):
+            delta = check_registry_parity(eng, ds, tenant=tenant)
+            assert delta < 1e-4, (
+                f"tiered parity[{tenant}] broke: {delta}"
+            )
     finally:
         eng.close()
 

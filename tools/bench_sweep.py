@@ -452,7 +452,11 @@ def main() -> int:
         [("3: 10w5s bilstm", ExperimentConfig(
             encoder="bilstm", train_n=10, n=10, k=5, q=5, **base), False),
          ("3t: 10w5s bilstm token_cache",
-          tc(encoder="bilstm", train_n=10, n=10, k=5, q=5), False)],
+          tc(encoder="bilstm", train_n=10, n=10, k=5, q=5), False),
+         # 10w1s completes the paper's eval grid (ISSUE 19): the
+         # hardest corner — widest class axis, thinnest support.
+         ("3o: 10w1s bilstm token_cache",
+          tc(encoder="bilstm", train_n=10, n=10, k=1, q=5), False)],
         [("4: 5w5s bert-base frozen", ExperimentConfig(
             encoder="bert", n=5, k=5, q=5, bert_frozen=True,
             **{**base, "batch_size": 2, "steps_per_call": 8}), False),

@@ -179,6 +179,20 @@ BANDS: dict[str, tuple[str, float]] = {
     "quant.agreement.bf16": ("floor", 0.99),
     "quant.agreement.int8": ("floor", 0.99),
     "quant.bytes_ratio_f32_over_int8": ("floor", 3.5),
+    # Mixed-geometry A/B (ISSUE 19, GEOM_r*.json): the tiered serving
+    # arm must drop nothing and recompile nothing through a tier-
+    # crossing re-registration AND a resident-dtype flip (the exact-N
+    # arm's recompile tax is recorded unbanded — it's the documented
+    # cost the tiers remove), and the per-(N, K) scenario-grid
+    # accuracies are banded per point via the prefix rule below (same
+    # episode-sampling tolerance as the scenario harness's tier-1
+    # band). Absolute qps/p99 recorded unbanded (sandbox policy).
+    "geom.tiered_dropped": ("zero", 0.0),
+    "geom.tiered_steady_recompiles": ("zero", 0.0),
+    "geom.steady_recompiles.tiered": ("zero", 0.0),
+    "geom.passed": ("floor", 1.0),
+    "geom.program_ratio_exact_over_tiered": ("floor", 1.0),
+    "geom.grid_acc.": ("higher", 0.15),
 }
 
 
@@ -186,7 +200,9 @@ def _band_rule(series: str) -> tuple[str, float] | None:
     if series in BANDS:
         return BANDS[series]
     for prefix, rule in BANDS.items():
-        if prefix.endswith("[") and series.startswith(prefix):
+        # Keys ending in "[" (config-bracket families) or "." (dotted
+        # families like geom.grid_acc.<N>w<K>s) are PREFIX rules.
+        if prefix.endswith(("[", ".")) and series.startswith(prefix):
             return rule
     return None
 
@@ -501,6 +517,42 @@ def _quant_points(points: dict, path: str, data: dict) -> int:
     return sum(len(v) for v in points.values()) - before
 
 
+def _geom_points(points: dict, path: str, data: dict) -> int:
+    """GEOM_r*.json (tools/loadgen.py --geom_ab): the mixed-geometry
+    A/B — zero-bands (tiered arm dropped / steady recompiles through a
+    tier crossing and a dtype flip), the pass floor, per-arm program
+    counts and qps (the compiled-program win recorded as a ratio), and
+    the (N, K) scenario grid accuracies with their CIs — one banded
+    floor per grid point."""
+    rnd, src = _round_of(path), os.path.basename(path)
+    before = sum(len(v) for v in points.values())
+    zero = data.get("zero_bands") or {}
+    for key in ("tiered_dropped", "tiered_steady_recompiles"):
+        _point(points, f"geom.{key}", rnd, src, zero.get(key))
+    _point(points, "geom.passed", rnd, src,
+           1.0 if data.get("passed") else 0.0)
+    arms = data.get("arms") or {}
+    for label, arm in sorted(arms.items()):
+        _point(points, f"geom.programs.{label}", rnd, src,
+               arm.get("program_cache_keys"))
+        _point(points, f"geom.qps.{label}", rnd, src, arm.get("qps"))
+        _point(points, f"geom.p99_ms.{label}", rnd, src,
+               arm.get("p99_ms"))
+        _point(points, f"geom.steady_recompiles.{label}", rnd, src,
+               arm.get("steady_recompiles"))
+    t = (arms.get("tiered") or {}).get("program_cache_keys")
+    e = (arms.get("exact") or {}).get("program_cache_keys")
+    if t and e:
+        _point(points, "geom.program_ratio_exact_over_tiered", rnd, src,
+               round(e / t, 3))
+    for key, leg in sorted((data.get("grid") or {}).items()):
+        _point(points, f"geom.grid_acc.{key}", rnd, src,
+               leg.get("accuracy"))
+        _point(points, f"geom.grid_ci95.{key}", rnd, src,
+               leg.get("acc_ci95"))
+    return sum(len(v) for v in points.values()) - before
+
+
 _EXTRACTORS = (
     ("BENCH_r*.json", _bench_points),
     ("ROOFLINE_r*.json", _roofline_points),
@@ -513,6 +565,7 @@ _EXTRACTORS = (
     ("ELASTIC_r*.json", _elastic_points),
     ("OBSFLEET_r*.json", _obsfleet_points),
     ("QUANT_r*.json", _quant_points),
+    ("GEOM_r*.json", _geom_points),
 )
 
 

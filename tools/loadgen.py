@@ -241,6 +241,18 @@ def parse_args(argv=None):
                         "density into QUANT_r*.json")
     p.add_argument("--quant_artifact", default=None, metavar="PATH",
                    help="write the QUANT_r*.json drill artifact here")
+    p.add_argument("--geom_ab", action="store_true",
+                   help="standalone mixed-geometry A/B drill (ISSUE 19): "
+                        "two arms — N-tier bucketed vs exact-N resident "
+                        "class stacks — serving the same mixed-N tenant "
+                        "set (N spanning 3..40) under the same open-loop "
+                        "arrivals, with a mid-drill tier-crossing "
+                        "re-registration and a resident-dtype flip; "
+                        "stamps per-arm program count, qps, parity and "
+                        "steady recompiles plus the (N, K) scenario grid "
+                        "legs into GEOM_r*.json")
+    p.add_argument("--geom_artifact", default=None, metavar="PATH",
+                   help="write the GEOM_r*.json drill artifact here")
     p.add_argument("--slo_profile", action="store_true",
                    help="also attempt a jax.profiler trace in the SLO "
                         "auto-capture (default off: on this image a "
@@ -333,7 +345,7 @@ def make_synthetic_checkpoint(args, tmpdir: str, train_iters: int = 0) -> str:
 
 def build_engine(args, ckpt: str, scheduler: str, logger=None, slo=None,
                  drift=None, breaker=None, resident_dtype=None,
-                 quant_probe_every=None):
+                 quant_probe_every=None, geometry_tiers=None):
     from induction_network_on_fewrel_tpu.serving.engine import InferenceEngine
 
     return InferenceEngine.from_checkpoint(
@@ -348,6 +360,7 @@ def build_engine(args, ckpt: str, scheduler: str, logger=None, slo=None,
         trace_sample=args.trace_sample,
         resident_dtype=resident_dtype,
         quant_probe_every=quant_probe_every,
+        geometry_tiers=geometry_tiers,
     )
 
 
@@ -406,11 +419,15 @@ def check_registry_parity(engine, ds, tenant: str = "default") -> float:
             for key, dt in QUERY_DTYPES.items()
         }
 
+    # One query per class, capped at the largest query bucket (a
+    # wide-N tenant — the geom drill goes to 40 classes — still parity-
+    # checks on its full support stack; only the query rows are capped).
+    qcap = min(len(names), max(engine.batcher.buckets))
     sup = stack(
         [i for r in names for i in (list(ds.instances[r]) * k)[:k]],
         (len(names), k),
     )
-    qry = stack([ds.instances[r][-1] for r in names], (len(names),))
+    qry = stack([ds.instances[r][-1] for r in names[:qcap]], (qcap,))
     direct = np.asarray(
         engine.model.apply(snap.params, sup, qry)
     )[0]
@@ -422,7 +439,7 @@ def check_registry_parity(engine, ds, tenant: str = "default") -> float:
         select_bucket,
     )
 
-    bucket = select_bucket(len(names), engine.batcher.buckets)
+    bucket = select_bucket(qcap, engine.batcher.buckets)
     # snap.scale is the per-tenant int8 dequant scale (None for f32/bf16
     # residents) — a quantized tenant's parity is checked on its REAL
     # serving path, quantization error and all; the caller picks the
@@ -431,7 +448,17 @@ def check_registry_parity(engine, ds, tenant: str = "default") -> float:
         snap.params, snap.matrix,
         {key: pad_rows(qry[key][0], bucket) for key in qry},
         scale=snap.scale,
-    )[: len(names)]
+    )[:qcap]
+    # N-tier residency (ISSUE 19): the served row carries n_tier class
+    # columns (only the first n real) with the NOTA logit appended LAST;
+    # the direct episodic forward is exact-N. Compare the real class
+    # columns plus — when the head exists — the NOTA column, i.e.
+    # exactly the columns verdicts read.
+    n = len(names)
+    if direct.shape[-1] == n:          # no NOTA head
+        served = served[:, :n]
+    else:                              # [real classes..., NOTA]
+        served = np.concatenate([served[:, :n], served[:, -1:]], axis=1)
     return float(np.max(np.abs(direct - served)))
 
 
@@ -1300,7 +1327,13 @@ def check_chaos_drill(drill: dict) -> bool:
 ADAPT_WORLD = dict(
     num_relations=5, instances_per_relation=20,
     train_iters=140, finetune_steps=100,
-    canary_floors={"in_domain": 0.6, "target": 0.5},
+    # grid_5w2s (ISSUE 19): the canary also runs an (N, K) grid point at
+    # a DIFFERENT geometry than the fine-tune's (5-way vs the 2-way
+    # training geometry) — an adaptation that recovers the flagship
+    # geometry but regresses another grid point must not publish. Floor
+    # sits well above 5-way chance (0.2) but far below the source-trained
+    # model's measured 5w2s accuracy (0.95 at canary seed, 48 episodes).
+    canary_floors={"in_domain": 0.6, "target": 0.5, "grid_5w2s": 0.3},
     canary_episodes=48,
     drift=dict(window=32, baseline_n=24, min_count=16),
     cfg=dict(
@@ -1412,10 +1445,18 @@ def _build_adapt_controller(model, cfg, tok, src, tgt, ckpt, drift,
     )
 
     def canary_fn(candidate):
+        # Geometry legs (ISSUE 19): every grid_<N>w<K>s floor spawns a
+        # source-corpus leg at THAT episode geometry (run_canary parses
+        # the name) — the publish gate holds the candidate to the whole
+        # grid, not just the fine-tune's own geometry.
+        floors = dict(ADAPT_WORLD["canary_floors"])
+        legs = {"in_domain": src, "target": tgt}
+        for name in floors:
+            if name.startswith("grid_"):
+                legs[name] = src
         return run_canary(
             model, load_params(candidate), cfg, tok,
-            legs={"in_domain": src, "target": tgt},
-            floors=dict(ADAPT_WORLD["canary_floors"]),
+            legs=legs, floors=floors,
             episodes=ADAPT_WORLD["canary_episodes"], seed=cfg.seed + 7,
         )
 
@@ -3552,6 +3593,238 @@ def check_quant_ab(out: dict) -> list:
     return fails
 
 
+# --- mixed-geometry A/B drill (ISSUE 19) ------------------------------------
+#
+# Two arms against the same checkpoint and the same seeded arrivals:
+# **tiered** (N-tier bucketed resident stacks, the serving default) vs
+# **exact-N** (geometry_tiers="off" — one program family per distinct
+# class count). Both arms serve the same mixed-N tenant set spanning the
+# 3..40 range, then take a tier-crossing re-registration (a tenant that
+# registered 7 of its 9 relations registers the rest, crossing the 8->16
+# tier) and a resident-dtype flip mid-drill. The tiered arm must hold
+# zero steady recompiles through BOTH (warm-before-swap) with its
+# program count bounded by tiers x buckets x dtypes; the exact arm
+# documents the recompile tax the tiers exist to remove.
+
+# Class counts per co-resident tenant — the 3..40 tenant range from the
+# ISSUE acceptance. Under DEFAULT_TIERS they collapse to 5 tiers; the
+# exact arm compiles one family per distinct N (plus one more when the
+# crosser grows 7 -> 9).
+GEOM_TENANT_N = (3, 5, 14, 24, 40)
+GEOM_CROSSER_DS_N = 9     # the crosser's full relation set
+GEOM_CROSSER_START = 7    # registered first (tier 8); +2 crosses to 16
+GEOM_PARITY_TOL_F32 = 1e-4    # both arms serve f32 residents at parity
+GEOM_PARITY_TOL_BF16 = 0.25   # the flipped tenant, after the flip
+
+
+def register_geom_tenants(engine, args) -> dict:
+    """The mixed-geometry tenant set: one synthetic relation corpus per
+    entry of ``GEOM_TENANT_N`` plus the crosser at its starting class
+    count; returns {tenant: dataset} (the crosser's ds carries all
+    ``GEOM_CROSSER_DS_N`` relations — re-registering it later IS the
+    tier crossing)."""
+    from induction_network_on_fewrel_tpu.data import make_synthetic_fewrel
+
+    tenants = {}
+    for t, n in enumerate(GEOM_TENANT_N):
+        name = f"geo{t}_n{n}"
+        ds = make_synthetic_fewrel(
+            num_relations=n, instances_per_relation=args.K + 10,
+            vocab_size=2000, seed=args.seed + 101 * t,
+        )
+        engine.register_dataset(ds, tenant=name)
+        tenants[name] = ds
+    ds = make_synthetic_fewrel(
+        num_relations=GEOM_CROSSER_DS_N,
+        instances_per_relation=args.K + 10,
+        vocab_size=2000, seed=args.seed + 977,
+    )
+    engine.register_dataset(ds, tenant="crosser",
+                            max_classes=GEOM_CROSSER_START)
+    tenants["crosser"] = ds
+    return tenants
+
+
+def run_geom_arm(args, ckpt, tiers_spec: str, label: str,
+                 logger=None) -> dict:
+    """One geometry arm: mixed-N tenants, warmup, parity, open-loop
+    phase 1, tier-crossing re-registration + dtype flip, open-loop
+    phase 2, stats. Returns the arm record."""
+    import numpy as np
+
+    engine = build_engine(
+        args, ckpt, "continuous", logger=logger,
+        geometry_tiers=tiers_spec,
+    )
+    try:
+        tenants = register_geom_tenants(engine, args)
+        compiled = engine.warmup()
+        parity = max(
+            check_registry_parity(engine, ds, tenant=t)
+            for t, ds in tenants.items()
+        )
+        tier_by_tenant = {
+            t: engine.registry.snapshot(t).n_tier for t in tenants
+        }
+        print(f"[geom ab/{label}] warmup {compiled} programs, "
+              f"tiers {sorted(set(tier_by_tenant.values()))}, parity "
+              f"max|delta| = {parity:.2e}", file=sys.stderr)
+        pools = _pools(tenants, args.K)
+        rng = np.random.default_rng(args.seed)  # same arrivals per arm
+        lat1, rej1, miss1, drop1, wall1, off1, _ = run_open(
+            engine, pools, args.rate, args.duration, rng,
+        )
+        # -- mid-drill geometry churn --------------------------------------
+        # Tier crossing: the crosser registers its remaining relations
+        # (7 -> 9 classes; under DEFAULT_TIERS that crosses 8 -> 16 and
+        # the engine warms the new tier BEFORE the registry swap).
+        engine.register_dataset(tenants["crosser"], tenant="crosser")
+        cross_tier = engine.registry.snapshot("crosser").n_tier
+        # Dtype flip: the smallest tenant rolls to bf16 (warm-first,
+        # same contract as the quant rollback path).
+        flip_tenant = f"geo0_n{GEOM_TENANT_N[0]}"
+        engine.set_resident_dtype(flip_tenant, "bf16")
+        flip_parity = check_registry_parity(
+            engine, tenants[flip_tenant], tenant=flip_tenant
+        )
+        lat2, rej2, miss2, drop2, wall2, off2, _ = run_open(
+            engine, pools, args.rate, args.duration, rng,
+        )
+        flat = _flat(lat1) + _flat(lat2)
+        wall = wall1 + wall2
+        snap = engine.stats.snapshot(queue_depth=engine.batcher.queue_depth)
+        return {
+            "arm": label,
+            "geometry_tiers": tiers_spec,
+            "tenants": len(tenants),
+            "tenant_classes": {
+                t: len(engine.registry.snapshot(t).names) for t in tenants
+            },
+            "tier_by_tenant": tier_by_tenant,
+            "warmup_compiles": compiled,
+            "programs_compiled": engine.programs.compiles,
+            "program_cache_keys": len(engine.programs._exe),
+            "parity_max_delta": parity,
+            "parity_tol": GEOM_PARITY_TOL_F32,
+            "tier_crossing": {
+                "tenant": "crosser",
+                "classes": f"{GEOM_CROSSER_START}->{GEOM_CROSSER_DS_N}",
+                "tier_after": cross_tier,
+            },
+            "dtype_flip": {
+                "tenant": flip_tenant, "dtype": "bf16",
+                "parity_max_delta": flip_parity,
+                "parity_tol": GEOM_PARITY_TOL_BF16,
+            },
+            "offered_qps": round((off1 + off2) / wall, 1),
+            "qps": round(len(flat) / wall, 1),
+            "p50_ms": pct_ms(flat, 50),
+            "p99_ms": pct_ms(flat, 99),
+            "served": snap["served"],
+            "rejected": rej1 + rej2,
+            "deadline_miss": miss1 + miss2,
+            "dropped": drop1 + drop2,
+            "steady_recompiles": snap["steady_recompiles"],
+            "resident_bytes": snap["resident_bytes"],
+        }
+    finally:
+        engine.close()
+
+
+def run_geom_ab(args, ckpt, logger=None) -> dict:
+    """Tiered vs exact-N arms + the scenario (N, K) grid leg + gates."""
+    from induction_network_on_fewrel_tpu.serving.geometry import (
+        DEFAULT_TIERS,
+        program_bound,
+        tiers_spec,
+    )
+
+    tiered_spec = tiers_spec(DEFAULT_TIERS)
+    arms = {
+        "tiered": run_geom_arm(args, ckpt, tiered_spec, "tiered",
+                               logger=logger),
+        "exact": run_geom_arm(args, ckpt, "off", "exact", logger=logger),
+    }
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    # Bound for the whole drill: f32 everywhere plus the one bf16 flip.
+    bound = program_bound(DEFAULT_TIERS, buckets, n_dtypes=2)
+    # The paper's (N, K) eval grid, from the scenario harness's
+    # miniature leg (same world tests/test_scenarios.py replays): each
+    # point carries accuracy + acc_ci95 for bench_trend's bands.
+    import scenarios
+
+    grid_res = scenarios.run_tier1(seed=args.seed + 1)
+    grid = {
+        key: {
+            "n": leg["n"], "k": leg["k"],
+            "accuracy": leg["accuracy"], "acc_ci95": leg["acc_ci95"],
+        }
+        for key, leg in grid_res["grid"].items()
+    }
+    out = {
+        "arms": arms,
+        "program_bound_tiered": bound,
+        "grid": grid,
+        "zero_bands": {
+            "tiered_dropped": arms["tiered"]["dropped"],
+            "tiered_steady_recompiles":
+                arms["tiered"]["steady_recompiles"],
+        },
+        # The tax the tiers remove: the exact arm recompiles ON the
+        # query path when the crosser re-registers (7 -> 9 has no
+        # warmed program family), the tiered arm must not.
+        "exact_arm_steady_recompiles": arms["exact"]["steady_recompiles"],
+    }
+    out["check_failures"] = check_geom_ab(out)
+    out["passed"] = not out["check_failures"]
+    return out
+
+
+def check_geom_ab(out: dict) -> list:
+    """Gate the drill: every failure is a named string (stamped into
+    the artifact so a red run says WHICH invariant broke)."""
+    fails = []
+    for name, v in out["zero_bands"].items():
+        if v != 0:
+            fails.append(f"zero_band:{name}={v}")
+    t, e = out["arms"]["tiered"], out["arms"]["exact"]
+    if not (t["parity_max_delta"] < t["parity_tol"]):
+        fails.append(
+            f"parity:tiered={t['parity_max_delta']:.3g}"
+            f">={t['parity_tol']}"
+        )
+    if not (e["parity_max_delta"] < e["parity_tol"]):
+        fails.append(
+            f"parity:exact={e['parity_max_delta']:.3g}"
+            f">={e['parity_tol']}"
+        )
+    for label, arm in out["arms"].items():
+        fp = arm["dtype_flip"]
+        if not (fp["parity_max_delta"] < fp["parity_tol"]):
+            fails.append(
+                f"flip_parity:{label}={fp['parity_max_delta']:.3g}"
+                f">={fp['parity_tol']}"
+            )
+    if t["program_cache_keys"] > out["program_bound_tiered"]:
+        fails.append(
+            f"program_bound:tiered={t['program_cache_keys']}"
+            f">{out['program_bound_tiered']}"
+        )
+    if t["program_cache_keys"] >= e["program_cache_keys"]:
+        fails.append(
+            f"no_program_win:tiered={t['program_cache_keys']}"
+            f">=exact={e['program_cache_keys']}"
+        )
+    if e["steady_recompiles"] == 0:
+        fails.append(
+            "exact_arm_recompile_tax_missing: the exact arm's tier "
+            "crossing should recompile on the query path"
+        )
+    if not out["grid"]:
+        fails.append("grid:empty")
+    return fails
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     import numpy as np
@@ -3567,8 +3840,10 @@ def main(argv=None) -> int:
     # CLI --resume and round-10 profiler teardown crashes; BASELINE
     # round 15). serve.py --adapt deployments on this image should pass
     # --compile_cache off likewise (RUNBOOK §19).
+    # --geom_ab also both serves and trains (the scenario-grid leg) in
+    # one process, so it gets the same compile-cache opt-out.
     select_device(ExperimentConfig(device=args.device),
-                  "off" if args.adapt_drill else "auto")
+                  "off" if (args.adapt_drill or args.geom_ab) else "auto")
 
     tmp = None
     ckpt = args.ckpt
@@ -3924,6 +4199,54 @@ def main(argv=None) -> int:
                 with open(args.quant_artifact, "w") as fh:
                     json.dump(report, fh, indent=1)
                 print(f"wrote {args.quant_artifact}", file=sys.stderr)
+            if args.run_dir:
+                print(f"telemetry in {args.run_dir} — render with "
+                      f"'python tools/obs_report.py {args.run_dir}'",
+                      file=sys.stderr)
+            return rc
+        if args.geom_ab:
+            # Standalone mode (like --quant_ab): the geometry plane is
+            # the system under test — the scheduler arms are skipped.
+            drill = run_geom_ab(args, ckpt, logger=logger)
+            for label, a in drill["arms"].items():
+                print(f"[geom ab/{label}] programs="
+                      f"{a['program_cache_keys']} "
+                      f"(compiled {a['programs_compiled']}) "
+                      f"qps={a['qps']} p50={a['p50_ms']}ms "
+                      f"p99={a['p99_ms']}ms "
+                      f"parity={a['parity_max_delta']:.2e} "
+                      f"dropped={a['dropped']} "
+                      f"recompiles={a['steady_recompiles']}")
+            print(f"[geom ab/grid] " + " ".join(
+                f"{k}={v['accuracy']}±{v['acc_ci95']}"
+                for k, v in drill["grid"].items()
+            ))
+            if not drill["passed"]:
+                print(f"FAIL[geom ab]: {drill['check_failures']}",
+                      file=sys.stderr)
+                rc = 1
+            report = {
+                "round": 1,
+                "generated_by": "tools/loadgen.py --geom_ab",
+                "config": {
+                    "tenant_classes": list(GEOM_TENANT_N)
+                    + [GEOM_CROSSER_START],
+                    "K": args.K, "buckets": args.buckets,
+                    "rate": args.rate, "duration": args.duration,
+                    "device": args.device, "seed": args.seed,
+                },
+                **drill,
+            }
+            print(json.dumps({
+                k: report[k] for k in
+                ("config", "program_bound_tiered", "zero_bands",
+                 "exact_arm_steady_recompiles", "passed")
+                if k in report
+            }))
+            if args.geom_artifact:
+                with open(args.geom_artifact, "w") as fh:
+                    json.dump(report, fh, indent=1)
+                print(f"wrote {args.geom_artifact}", file=sys.stderr)
             if args.run_dir:
                 print(f"telemetry in {args.run_dir} — render with "
                       f"'python tools/obs_report.py {args.run_dir}'",

@@ -49,12 +49,18 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from induction_network_on_fewrel_tpu.serving.geometry import (  # noqa: E402
+    grid_key,
+    parse_grid_key,
+)
 
 # The tier-1 regression band: one-sided quality floors (a LOWER number
 # than recorded-minus-band fails; improvements never do). Abs tolerances
@@ -75,6 +81,10 @@ TIER1 = dict(
     num_relations=5, instances_per_relation=20, iters=150,
     eval_episodes=48, shifts=(1.0,), na_grid=(1,),
     adversarial=("token_noise:0.4", "blank:1.0"),
+    # Miniature (N, K) eval grid (ISSUE 19): the paper's grid scaled to
+    # the 5-relation world (10-way is unsamplable here; 5-way uses every
+    # relation). Same trained params, fresh samplers per point.
+    grid=((2, 1), (2, 2), (5, 1), (5, 2)),
     cfg=dict(
         model="induction", encoder="cnn", hidden_size=64,
         induction_dim=32, ntn_slices=32, routing_iters=2,
@@ -94,6 +104,9 @@ FULL = dict(
     adversarial=(
         "token_noise:0.3", "token_noise:0.6", "mask_drop:0.5", "blank:1.0",
     ),
+    # The paper's full eval grid (PAPER.md pillar 7): 5w1s and 10w5s
+    # next to the 5w5s flagship, plus 10w1s — the hardest corner.
+    grid=((5, 1), (5, 5), (10, 1), (10, 5)),
     cfg=dict(
         model="induction", encoder="cnn", hidden_size=64,
         induction_dim=32, ntn_slices=32, routing_iters=2,
@@ -299,6 +312,12 @@ def floors_from_headline(headline: dict,
                 "da_mixture_accuracy"):
         if isinstance(headline.get(key), (int, float)):
             floors[key] = round(max(headline[key] - tol, 0.0), 4)
+    # Per-geometry grid floors (ISSUE 19): one bar per recorded (N, K)
+    # point, named grid_<N>w<K>s — run_canary parses the geometry back
+    # out of the leg name. Headlines predating the grid produce none.
+    for key, acc in (headline.get("grid") or {}).items():
+        if isinstance(acc, (int, float)):
+            floors[f"grid_{key}"] = round(max(acc - tol, 0.0), 4)
     return floors
 
 
@@ -331,7 +350,13 @@ def run_canary(model, params, cfg, tok, legs: dict, floors: dict,
     """Evaluate candidate ``params`` on each leg's dataset and hold it
     to the floors. ``legs``: {name: FewRel-schema dataset} (episode
     geometry from ``cfg``); ``floors``: {name: min accuracy}. Returns
-    the ``canary_verdict`` dict with per-leg accuracy/acc_ci95."""
+    the ``canary_verdict`` dict with per-leg accuracy/acc_ci95.
+
+    Geometry legs (ISSUE 19): a leg named ``grid_<N>w<K>s`` (or bare
+    ``<N>w<K>s``) is evaluated at THAT episode geometry —
+    ``cfg.replace(n=N, k=K)`` — instead of ``cfg``'s. An adaptation
+    candidate that recovers 5w5s but regresses 10w1s fails its grid
+    floor and is never published."""
     from induction_network_on_fewrel_tpu.train import FewShotTrainer
     from induction_network_on_fewrel_tpu.utils.metrics import MetricsLogger
 
@@ -343,13 +368,22 @@ def run_canary(model, params, cfg, tok, legs: dict, floors: dict,
         logger=MetricsLogger(quiet=True),
     )
     try:
-        evaluated = {
-            name: _eval_leg(
-                trainer, params,
-                _sampler(ds, tok, cfg, seed=seed + 17 + i), episodes,
+        evaluated = {}
+        for i, (name, ds) in enumerate(sorted(legs.items())):
+            geom = parse_grid_key(name)
+            leg_cfg = (
+                dataclasses.replace(cfg, n=geom[0], k=geom[1])
+                if geom else cfg
             )
-            for i, (name, ds) in enumerate(sorted(legs.items()))
-        }
+            evaluated[name] = _eval_leg(
+                trainer, params,
+                # Grid legs score plain N-way accuracy (na_rate=0, like
+                # the scenario harness's grid): an all-relations N-way
+                # point has no spare relation to draw NOTA from.
+                _sampler(ds, tok, leg_cfg, seed=seed + 17 + i,
+                         na_rate=0 if geom else None),
+                episodes,
+            )
     finally:
         trainer.close()
     return canary_verdict(evaluated, floors)
@@ -465,6 +499,29 @@ def run(plan: dict, seed: int, logger=None, step0: int = 0,
         adversarial[spec] = r
         emit(spec, r)
 
+    # -- (N, K) eval grid (ISSUE 19) ---------------------------------------
+    # The paper's episode-geometry grid on the SAME trained params: each
+    # point re-samples source episodes at (n, k) and reports accuracy +
+    # acc_ci95. Appended after every pre-existing leg with fresh seed
+    # offsets so the committed artifact's earlier numbers replay
+    # byte-identically; jit retraces per episode shape, so each point is
+    # one extra compile, not a config change.
+    grid = {}
+    for i, (gn, gk) in enumerate(plan.get("grid", ())):
+        gcfg = dataclasses.replace(cfg, n=gn, k=gk)
+        # na_rate=0: the paper grid is plain N-way accuracy, and the
+        # N-way-over-all-relations points could not sample a NOTA
+        # distractor relation anyway (needs N+1).
+        r = _eval_leg(
+            trainer, state.params,
+            _sampler(src, tok, gcfg, seed=seed + 400 + i, na_rate=0),
+            plan["eval_episodes"],
+        )
+        r["n"], r["k"] = gn, gk
+        key = grid_key(gn, gk)
+        grid[key] = r
+        emit(f"grid_{key}", r)
+
     cross_worst = min(c["accuracy"] for c in cross.values())
     return {
         "config": dict(plan["cfg"]),
@@ -482,6 +539,7 @@ def run(plan: dict, seed: int, logger=None, step0: int = 0,
         },
         "nota": nota,
         "adversarial": adversarial,
+        "grid": grid,
     }
 
 
@@ -511,6 +569,13 @@ def tier1_headline(res: dict) -> dict:
         "da_mixture_accuracy": res["cross_domain"]["da_mixture"]["accuracy"],
         "nota_best_f1": res["nota"][na0]["best"]["f1"],
         "adversarial_accuracy": adv,
+        # Per-(N, K) grid accuracies (ISSUE 19) — canary floors derive
+        # grid_<key> bars from these, so an adaptation that recovers the
+        # flagship geometry but regresses another grid point cannot ship.
+        "grid": {
+            key: leg["accuracy"]
+            for key, leg in res.get("grid", {}).items()
+        },
         "band": dict(TIER1_BAND),
     }
 
