@@ -394,6 +394,16 @@ def _run_bench(jax, cfg, model, sampler, table, table_np, backend, n_chips) -> i
             int(comms_wire_bytes(comms_cfg, corpus_rows=comms_u))
             if cfg.embed_optimizer == "lazy" else None
         ),
+        # Round 10: measured whole-step overlap headline + per-bucket AR
+        # bytes, republished from the newest committed comms-ledger
+        # artifact (the bench itself may run single-chip; the ledger's
+        # dp=8 compile is where overlap is actually measured). Same
+        # lazy-leg gating as the projections above.
+        **(_comms_overlap_stamp()
+           if cfg.embed_optimizer == "lazy"
+           else {"comms_overlap_frac": None,
+                 "comms_unoverlapped_frac": None,
+                 "comms_bucket_bytes": None}),
         "allin_over_windowed": allin_over_windowed,
         "ring_save_bytes": ring_bytes,
         "datapipe": datapipe_leg,
@@ -432,6 +442,52 @@ def _append_trend_input(summary: dict, backend: str) -> None:
         print(f"bench: appended run summary to {path}", file=sys.stderr)
     except OSError as e:
         print(f"bench: trend-input append failed: {e!r}", file=sys.stderr)
+
+
+def _comms_overlap_stamp() -> dict:
+    """Measured comms-overlap headline for the bench stamp (ISSUE 20).
+
+    The overlap fraction is a property of the sharded dp=8 compile, which
+    tools/comms_ledger.py measures (round 10+: every leg carries an
+    ``overlap`` section — per-collective dataflow windows priced at the
+    v5e HBM:ICI ratio). The bench itself may run single-chip, so this
+    does NOT re-measure: it republishes the flagship leg's committed
+    measurement — overlap_frac / unoverlapped_frac plus the per-bucket
+    all-reduce payload bytes grouped from the attributed rows — so every
+    bench artifact carries the comms headline next to the wire-byte
+    projection and TREND.json folds both. Nulls when no round-10+
+    artifact is present (old checkouts), never a wrong number."""
+    import glob
+    import re as _re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    flag = None
+    for path in sorted(glob.glob(os.path.join(here, "COMMS_r*.json"))):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            continue
+        leg = (data.get("dp8_tokencache_lazy_flagship") or {}) \
+            if isinstance(data, dict) else {}
+        if isinstance(leg.get("overlap"), dict):
+            flag = leg  # newest round wins (sorted r05 < r10 < ...)
+    if flag is None:
+        return {"comms_overlap_frac": None,
+                "comms_unoverlapped_frac": None,
+                "comms_bucket_bytes": None}
+    ov = flag["overlap"]
+    buckets: dict[str, int] = {}
+    for row in ov.get("collectives") or []:
+        m = _re.search(r"grad/bucket_(\d+)", str(row.get("source") or ""))
+        if m:
+            key = f"bucket_{m.group(1)}"
+            buckets[key] = buckets.get(key, 0) + int(row.get("bytes") or 0)
+    return {
+        "comms_overlap_frac": ov.get("overlap_frac"),
+        "comms_unoverlapped_frac": ov.get("unoverlapped_frac"),
+        "comms_bucket_bytes": dict(sorted(buckets.items())) or None,
+    }
 
 
 def _geometry_rows(cfg, corpus_rows=None) -> dict:

@@ -344,6 +344,24 @@ def build_arg_parser(train: bool = True) -> argparse.ArgumentParser:
              "the flagship shape — COMMS_r07). 'off' restores the dense "
              "behavior for A/Bs; identical params/checkpoints either way",
     )
+    p.add_argument(
+        "--grad_bucketing", default="auto", choices=["auto", "on", "off"],
+        help="bucketed gradient collectives on pure-dp meshes: per-shard "
+             "fwd+bwd in shard_map, then one named, hoisted all-reduce per "
+             "reverse-topological bucket (grad/bucket_0 = relation head "
+             "... last = embedding) so each bucket's reduction can fly "
+             "while earlier layers' backward computes (COMMS_r10). "
+             "'auto' = TPU only; 'on' forces the bucketed arm anywhere; "
+             "'off' = monolithic GSPMD psums. Identical params either way",
+    )
+    p.add_argument("--grad_bucket_count", type=int, default=4,
+                   help="bucket count when --grad_bucketing resolves on")
+    p.add_argument(
+        "--async_collectives", default="auto", choices=["auto", "on", "off"],
+        help="async-collective / latency-hiding-scheduler spelling "
+             "(resolved on TPU like --lstm_backend auto; CPU records the "
+             "projection only — chip A/B queued in BASELINE.md round 21)",
+    )
     p.add_argument("--dp", type=int, default=0, help="data-parallel mesh axis (0 = all devices)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis")
     p.add_argument("--sp", type=int, default=1,
@@ -501,6 +519,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         perf=getattr(args, "perf", False),
         zero_opt=getattr(args, "zero_opt", False),
         compact_demb=getattr(args, "compact_demb", "auto"),
+        grad_bucketing=getattr(args, "grad_bucketing", "auto"),
+        grad_bucket_count=getattr(args, "grad_bucket_count", 4),
+        async_collectives=getattr(args, "async_collectives", "auto"),
         device=args.device, compute_dtype=compute, seed=args.seed,
         dp=args.dp, tp=args.tp, sp=args.sp, pp=args.pp, ep=args.ep,
         pp_microbatches=args.pp_microbatches,

@@ -289,6 +289,28 @@ class ExperimentConfig:
     # pre-round-7 dense behavior, kept for the chip A/B. Not an
     # architecture field: params/checkpoints are identical either way.
     compact_demb: str = "auto"
+    # Bucketed gradient collectives (parallel/grad_buckets.py, ISSUE 20):
+    # on pure-dp meshes, spell the dense-param gradient psums explicitly —
+    # fwd+bwd per shard in shard_map (partials, no collective), then one
+    # free-floating, named mean per reverse-topological bucket
+    # (grad/bucket_0 = relation head ... last = embedding table), each
+    # lowering to its own all-reduce that can fly while earlier layers'
+    # backward still computes (the PR 6 compact-demb hoist generalized).
+    # "auto" = on TPU only (numerics-neutral anywhere, but the default
+    # flip is the chip A/B's call — resolve_runtime_backends records the
+    # projection); "on" forces the bucketed arm (CPU-mesh parity tests,
+    # ledger legs); "off" = monolithic partitioner-inserted psums, the
+    # baseline arm. Not an architecture field: identical params either
+    # way. Refused (resolves off) on tp/sp/pp/ep meshes and under MoE.
+    grad_bucketing: str = "auto"
+    grad_bucket_count: int = 4  # buckets when grad_bucketing resolves on
+    # Async-collective / latency-hiding-scheduler spelling (resolved in
+    # models/build.resolve_runtime_backends, one home): "auto" = on for
+    # TPU backends (XLA's async pass splits hoisted collectives into
+    # start/done pairs it latency-hides), "off" = synchronous lowering.
+    # CPU runs record the projection only — the wall-clock A/B rides the
+    # chip backlog (BASELINE.md round 21).
+    async_collectives: str = "auto"
     dp: int = 1               # data-parallel mesh axis (episodes sharded)
     tp: int = 1               # tensor-parallel mesh axis (NTN slices / hidden)
     sp: int = 1               # sequence-parallel mesh axis (ring attention)

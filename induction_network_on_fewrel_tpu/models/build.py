@@ -47,6 +47,22 @@ def resolve_runtime_backends(cfg: ExperimentConfig) -> dict:
                                    flagship) on the kernel paths; "f32"/
                                    "bf16" force the storage dtype, carries
                                    stay f32 either way
+    --grad_bucketing    auto       "on" on a real TPU backend (the
+                                   DDP-style bucketed gradient psums,
+                                   parallel/grad_buckets), "off"
+                                   elsewhere — the CPU ledger/tests force
+                                   "on" explicitly; mesh-shape refusals
+                                   (tp/sp/pp/ep, MoE) stay in
+                                   grad_buckets_for, which takes the mesh
+    --async_collectives auto       "on" on a real TPU backend — the
+                                   latency-hiding scheduler's async
+                                   start/done collective spelling, the
+                                   runtime half of the dataflow windows
+                                   the comms ledger measures; "off"
+                                   elsewhere (CPU emits sync collectives;
+                                   the ledger's windows are the
+                                   projection, wall-clock A/B queued in
+                                   BASELINE round 21)
     ==================  =========  ========================================
 
     ``--attn_backend auto`` resolves to the two-pass XLA form on every
@@ -55,7 +71,11 @@ def resolve_runtime_backends(cfg: ExperimentConfig) -> dict:
     other silicon).
 
     Returns {lstm_backend, attn_backend, lstm_cs_window,
-    lstm_residual_dtype} with every "auto" resolved.
+    lstm_residual_dtype, grad_bucketing, grad_bucket_count,
+    async_collectives} with every "auto" resolved. grad_bucketing here is
+    the BACKEND half only ("on"/"off" for this process's default
+    backend); the mesh-shape half lives in
+    parallel/grad_buckets.grad_buckets_for, which composes both.
     """
     import jax
 
@@ -88,11 +108,34 @@ def resolve_runtime_backends(cfg: ExperimentConfig) -> dict:
         {"f32": jnp.float32, "bf16": jnp.bfloat16}.get(residuals)
         if kernel_lstm else None
     )  # None = follow the compute dtype
+    bucketing = getattr(cfg, "grad_bucketing", "auto")
+    if bucketing not in ("auto", "on", "off"):
+        raise ValueError(
+            f"unknown grad_bucketing {bucketing!r} (auto | on | off)"
+        )
+    if bucketing == "auto":
+        # TPU + lazy-embed only: the dense word-table arms keep the
+        # compact-demb spelling (grad_buckets_for docstring has the
+        # full rationale — the two are mutually exclusive).
+        lazy = getattr(cfg, "embed_optimizer", "shared") == "lazy"
+        bucketing = "on" if (on_tpu and lazy) else "off"
+    async_coll = getattr(cfg, "async_collectives", "auto")
+    if async_coll not in ("auto", "on", "off"):
+        raise ValueError(
+            f"unknown async_collectives {async_coll!r} (auto | on | off)"
+        )
+    if async_coll == "auto":
+        async_coll = "on" if on_tpu else "off"
     return {
         "lstm_backend": backend,
         "attn_backend": attn,
         "lstm_cs_window": cs_window,
         "lstm_residual_dtype": residual_dtype,
+        "grad_bucketing": bucketing,
+        "grad_bucket_count": max(
+            1, int(getattr(cfg, "grad_bucket_count", 4))
+        ),
+        "async_collectives": async_coll,
     }
 
 

@@ -921,6 +921,29 @@ class SLOEngine:
 
     # --- evaluation -------------------------------------------------------
 
+    def _rates_locked(self, tenant: str, bucket: int) -> dict | None:
+        """burn_rates' body, caller holds the lock — ONE source for the
+        public per-tenant read and evaluate()'s all-tenant sweep, so the
+        sweep acquires the lock once instead of re-entering the RLock per
+        tenant (the last O(tenants) lock cost in the sweep after the
+        round-10 running-sum windows; re-entrant acquires are cheap but
+        not free, and a thousand-tenant sweep paid two per tenant)."""
+        wins = self._windows.get(tenant)
+        if wins is None:
+            return None
+        obj = self.objective_for(tenant)
+        out = {"budget": obj.budget}
+        for label in ("fast", "slow"):
+            good, bad = wins[label].counts(bucket)
+            total = good + bad
+            frac = bad / total if total else 0.0
+            out[f"total_{label}"] = int(total)
+            out[f"bad_{label}"] = int(bad)
+            out[f"burn_{label}"] = (
+                round(frac / obj.budget, 3) if obj.budget > 0 else 0.0
+            )
+        return out
+
     def burn_rates(
         self, tenant: str, now: float | None = None
     ) -> dict | None:
@@ -929,22 +952,7 @@ class SLOEngine:
         — the running sums are maintained at record time."""
         now = time.monotonic() if now is None else now
         with self._lock:
-            wins = self._windows.get(tenant)
-            if wins is None:
-                return None
-            bucket = self._bucket_index(now)
-            obj = self.objective_for(tenant)
-            out = {"budget": obj.budget}
-            for label in ("fast", "slow"):
-                good, bad = wins[label].counts(bucket)
-                total = good + bad
-                frac = bad / total if total else 0.0
-                out[f"total_{label}"] = int(total)
-                out[f"bad_{label}"] = int(bad)
-                out[f"burn_{label}"] = (
-                    round(frac / obj.budget, 3) if obj.budget > 0 else 0.0
-                )
-            return out
+            return self._rates_locked(tenant, self._bucket_index(now))
 
     def tenants(self) -> tuple[str, ...]:
         """Tenants with recorded traffic, sorted — the autoscaler sweeps
@@ -969,8 +977,12 @@ class SLOEngine:
         now = time.monotonic() if now is None else now
         pending: list[tuple[HealthEvent, str]] = []
         with self._lock:
+            # One lock acquisition and one bucket-index computation for
+            # the WHOLE sweep (_rates_locked) — not two re-entrant
+            # acquires and a clock quantization per tenant.
+            bucket = self._bucket_index(now)
             for tenant in list(self._windows):
-                rates = self.burn_rates(tenant, now=now)
+                rates = self._rates_locked(tenant, bucket)
                 if rates is None:
                     continue
                 pending.extend(self._judge(tenant, "fast", rates, CRITICAL,

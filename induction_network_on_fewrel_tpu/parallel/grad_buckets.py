@@ -1,0 +1,213 @@
+"""Bucketed gradient collectives: explicit, hoisted, named dp psums.
+
+Under plain GSPMD the dense-param gradient all-reduces are partitioner-
+inserted at each dot-general transpose: metadata-bearing but scattered,
+and printed wherever the partitioner leaves them. COMMS_r09's whole-step
+window walk showed their dependent tails (the global-norm clip couples
+EVERY update op to EVERY gradient reduction through the norm scalar), so
+the only real lever is the other side of the window: make each reduction
+*ready* — and printed — while earlier layers' backward is still
+computing, the way DDP-style bucketed overlap works and the way PR 6
+hoisted the compact-demb psum out of its shard_map body.
+
+This module is that hoist, generalized:
+
+* the fwd+bwd runs per-shard inside ``shard_map`` (no collective inside
+  — the body emits partial gradients stacked on a dp-sharded leading
+  axis, exactly the compact-demb "partials" half);
+* the cross-shard reductions are free-floating means over the stacked
+  axis OUTSIDE the body, grouped into reverse-topological buckets, each
+  under its own ``jax.named_scope("grad/bucket_k")`` — GSPMD lowers each
+  bucket to its own psum whose only consumer is the clip/update chain,
+  so the scheduler (and XLA's async-collective pass on TPU) can fly
+  bucket 0's all-reduce while bucket 3's backward still computes.
+
+Reverse-topological means output-to-input: the relation/NTN head's
+gradients are ready first in the backward, the word-embedding rows last
+— so bucket 0 is the head and the last bucket is the table, mirroring
+the model graph (models/induction.py: embedding -> encoder ->
+induction/query_proj -> relation).
+
+Numerics: the global gradient is the mean over shards of per-shard
+means (equal shard sizes — shard_map enforces divisibility), identical
+to the GSPMD global mean up to float reassociation; parity is pinned at
+1e-5 in tests/test_comms.py, the same band as the compact-demb path.
+The MoE balance aux is a product of GLOBAL-batch statistics, so the
+resolution refuses MoE configs (same reason as the explicit shard_map
+step). Lives in its own module (not parallel/sharding.py) because both
+train/steps.py and parallel/sharding.py need it and sharding already
+imports steps.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from induction_network_on_fewrel_tpu.parallel.compat import (
+    shard_map as compat_shard_map,
+)
+
+# Leaf-path fragment -> backward stage, output-to-input (reverse
+# topological): grads for stage-0 leaves are ready first in the backward,
+# so their bucket's all-reduce can fly earliest. Unmatched paths land in
+# the middle stage. "lazy_embed" is the compact [U, D] rows collection
+# leaf the token-cache lazy step grafts in (train/lazy_embed.py) — input
+# side, last stage, same as the dense table.
+_STAGES: tuple[tuple[str, int], ...] = (
+    ("relation", 0),
+    ("induction", 1),
+    ("query_proj", 1),
+    ("att_", 2),
+    ("encoder", 3),
+    ("embedding", 4),
+    ("lazy_embed", 4),
+)
+_N_STAGES = 5
+_DEFAULT_STAGE = 2
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
+        for p in path
+    )
+
+
+def bucket_index(path: str, n_buckets: int) -> int:
+    """Bucket for a param-leaf path: stage scaled into [0, n_buckets)."""
+    stage = _DEFAULT_STAGE
+    for frag, s in _STAGES:
+        if frag in path:
+            stage = s
+            break
+    return min(stage * n_buckets // _N_STAGES, n_buckets - 1)
+
+
+def grad_buckets_for(cfg, mesh: Mesh | None) -> int:
+    """Resolve ``cfg.grad_bucketing`` against the mesh: the bucket count
+    when the explicit bucketed-psum spelling applies, else 0 (monolithic
+    partitioner-inserted psums — the A/B baseline arm).
+
+    Applies only on pure-dp meshes (tp/sp/pp/ep params stay sharded and
+    the shard_map's replicated param specs would force reshards), never
+    under MoE (per-shard balance aux diverges from the global objective,
+    same refusal as the explicit shard_map step), and "auto" resolves ON
+    only on TPU AND only for the lazy-embed production path — the dense
+    word-table arms keep the compact-demb spelling
+    (parallel/sharding.demb_impl_for), which is mutually exclusive with
+    the outer shard_map here and which bucketing cannot replicate for a
+    genuinely dense table cotangent (its per-leaf mean would all-reduce
+    the full [M, D] table: 80 MB/step at the flagship vocab, the exact
+    round-6 regression). The bucket restructure is numerics-neutral
+    anywhere, but flipping the default spelling is the chip A/B's call
+    (models/build.resolve_runtime_backends records the projection;
+    BASELINE.md round 21 queues the wall-clock arm). "on" forces it on
+    any backend and any embed_optimizer — the CPU-mesh parity tests and
+    the ledger's bucketed legs use that arm.
+    """
+    knob = getattr(cfg, "grad_bucketing", "off")
+    if knob == "off" or mesh is None:
+        return 0
+    if "dp" not in mesh.axis_names or mesh.shape["dp"] <= 1:
+        return 0
+    if any(mesh.shape.get(ax, 1) > 1 for ax in ("tp", "sp", "pp", "ep")):
+        return 0
+    if getattr(cfg, "moe_experts", 0) > 0:
+        return 0
+    if knob == "auto" and (
+        jax.default_backend() != "tpu"
+        or getattr(cfg, "embed_optimizer", "shared") != "lazy"
+    ):
+        return 0
+    return max(1, int(getattr(cfg, "grad_bucket_count", 4)))
+
+
+def make_bucketed_value_and_grad(
+    loss_fn_of, mesh: Mesh, n_buckets: int, frozen=None
+):
+    """The bucketed explicit spelling of a dp ``value_and_grad``.
+
+    ``loss_fn_of(params, batch) -> (loss, aux)`` must be the LOCAL-shard
+    objective (mean over its own examples — the standard per-example
+    loss). Returns ``fn(params, batch) -> (grads, aux)`` taking the
+    GLOBAL dp-sharded batch pytree (every array leaf's leading axis is
+    the episode axis) and replicated params; grads/aux match what
+    ``jax.grad(..., has_aux=True)`` returns on the global batch, up to
+    float reassociation.
+
+    ``frozen(path_str) -> bool`` marks param leaves the forward never
+    reads (the dense word table riding the lazy compact step's p_fwd so
+    flax finds the declared param). Their gradient is identically zero,
+    and ``jax.grad`` would prove it — but only AFTER this wrapper stacked
+    the zeros per shard and bucket-meaned them, which GSPMD lowers to a
+    real all-reduce of the full leaf (80 MB/step at the flagship vocab).
+    Frozen leaves are excluded from differentiation inside the shard_map
+    and get exact ``zeros_like`` outside it: same gradient tree, no
+    stacking, no collective.
+    """
+
+    def _split(params):
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        frz = [bool(frozen and frozen(_path_str(p))) for p, _ in flat]
+        return flat, treedef, frz
+
+    def local_grads(params, batch):
+        flat, treedef, frz = _split(params)
+        static = [v for (_, v), f in zip(flat, frz) if f]
+        diff = [v for (_, v), f in zip(flat, frz) if not f]
+
+        def lf(diff_leaves):
+            it_d, it_s = iter(diff_leaves), iter(static)
+            leaves = [next(it_s) if f else next(it_d) for f in frz]
+            return loss_fn_of(
+                jax.tree_util.tree_unflatten(treedef, leaves), batch
+            )
+
+        grads_diff, aux = jax.grad(lf, has_aux=True)(diff)
+        # [1, ...] per shard -> stacked [dp, ...] on a dp-sharded leading
+        # axis: the "partials" half, no collective in the body.
+        return (
+            [g[None] for g in grads_diff],
+            jax.tree.map(lambda m: jnp.asarray(m)[None], aux),
+        )
+
+    # in/out specs are tree PREFIXES: P() replicates the whole params
+    # tree, P("dp") shards every batch/output leaf's leading axis.
+    sharded = compat_shard_map(
+        local_grads, mesh=mesh,
+        in_specs=(P(), P("dp")),
+        out_specs=(P("dp"), P("dp")),
+        check_vma=False,
+    )
+
+    def fn(params, batch):
+        flat, treedef, frz = _split(params)
+        with jax.named_scope("grad/bucket_partials"):
+            stacked, aux_s = sharded(params, batch)
+        paths = [
+            _path_str(path) for (path, _), f in zip(flat, frz) if not f
+        ]
+        buckets = [bucket_index(p, n_buckets) for p in paths]
+        reduced: list = [None] * len(stacked)
+        for k in range(n_buckets):
+            members = [i for i, b in enumerate(buckets) if b == k]
+            if not members:
+                continue
+            # Free-floating mean over the dp-stacked axis: GSPMD lowers
+            # it to this bucket's all-reduce, metadata-named here so
+            # tools/comms_ledger.py attributes it per bucket.
+            with jax.named_scope(f"grad/bucket_{k}"):
+                for i in members:
+                    reduced[i] = jnp.mean(stacked[i], axis=0)
+        it = iter(reduced)
+        leaves = [
+            jnp.zeros_like(v) if f else next(it)
+            for (_, v), f in zip(flat, frz)
+        ]
+        grads = jax.tree_util.tree_unflatten(treedef, leaves)
+        aux = jax.tree.map(lambda m: jnp.mean(m, axis=0), aux_s)
+        return grads, aux
+
+    return fn

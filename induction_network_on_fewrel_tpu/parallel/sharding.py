@@ -33,6 +33,10 @@ from induction_network_on_fewrel_tpu.models.losses import (
     episode_metrics,
     metric_keys,
 )
+from induction_network_on_fewrel_tpu.parallel.grad_buckets import (
+    grad_buckets_for,
+    make_bucketed_value_and_grad,
+)
 from induction_network_on_fewrel_tpu.train.steps import (
     LOSS_FNS,
     loss_and_metrics,
@@ -315,6 +319,13 @@ def demb_impl_for(cfg: ExperimentConfig, mesh: Mesh | None):
     on any backend including the 8-virtual-device CPU mesh."""
     if mesh is None or getattr(cfg, "compact_demb", "auto") == "off":
         return None
+    if grad_buckets_for(cfg, mesh) > 0:
+        # The bucketed explicit backward (parallel/grad_buckets.py) runs
+        # the WHOLE fwd+bwd per shard, so the demb segment-sum is local
+        # by construction and its [U, D] row gradient reduces in the last
+        # bucket's named psum — the compact wrapper's shard_map would
+        # nest inside the outer one (illegal) and is redundant there.
+        return None
     if "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
         # Sequence parallelism shards the TOKEN axis of ids/cotangent; the
         # compact path's shard_map declares only the dp sharding and would
@@ -354,7 +365,8 @@ def make_sharded_train_step(model, cfg: ExperimentConfig, mesh: Mesh, state_exam
     repl = NamedSharding(mesh, P())
     sup_sh, qry_sh, lab_sh = episode_batch_shardings(mesh)
     body = make_update_body(
-        model, cfg, update_shardings=_zero1_update_shardings(cfg, st_sh)
+        model, cfg, update_shardings=_zero1_update_shardings(cfg, st_sh),
+        mesh=mesh,
     )
 
     def step(state, support, query, label):
@@ -388,7 +400,8 @@ def make_sharded_multi_train_step(
         is_leaf=lambda x: isinstance(x, NamedSharding),
     )
     body = make_update_body(
-        model, cfg, update_shardings=_zero1_update_shardings(cfg, st_sh)
+        model, cfg, update_shardings=_zero1_update_shardings(cfg, st_sh),
+        mesh=mesh,
     )
 
     def multi_step(state, support_s, query_s, label_s):
@@ -426,9 +439,21 @@ def make_sharded_eval_step(model, cfg: ExperimentConfig, mesh: Mesh, state_examp
 
 def make_shard_map_train_step(model, cfg: ExperimentConfig, mesh: Mesh):
     """Pure-dp explicit-collective step: each device computes grads on its
-    episode shard, then ``lax.pmean`` over 'dp' — the literal TPU analog of
+    episode shard, then reduces over 'dp' — the literal TPU analog of
     DataParallel's gradient reduction. Params replicated; updates identical
-    on every device by construction."""
+    on every device by construction.
+
+    Two spellings of the reduction. With ``cfg.grad_bucketing`` resolved
+    OFF: the legacy in-body ``lax.pmean`` — the all-reduce executes
+    inline at backward time with its result bound to the region's
+    output, zero scheduling freedom (the round-7 demb shape of the same
+    problem). Resolved ON: the psums are HOISTED out of the shard_map
+    body — the body emits per-shard partials and the cross-shard means
+    run outside, one named reverse-topological bucket at a time
+    (parallel/grad_buckets.py), with the optimizer update also outside —
+    so each bucket's all-reduce is a free-floating op the scheduler can
+    fly while later buckets' backward computes. Identical updates either
+    way (1e-5 parity, tests/test_comms.py)."""
     if cfg.moe_experts > 0:
         # The MoE balance aux is a product of GLOBAL-batch statistics
         # (E·Σ f_e·p_e); a per-shard product pmean'd over dp is a different
@@ -439,6 +464,24 @@ def make_shard_map_train_step(model, cfg: ExperimentConfig, mesh: Mesh):
             "(per-shard load-balance aux diverges from the global "
             "objective); use the GSPMD sharded step"
         )
+
+    n_buckets = grad_buckets_for(cfg, mesh)
+    if n_buckets:
+        def loss_fn_of(params, batch):
+            support, query, label = batch
+            return loss_and_metrics(
+                model, params, support, query, label, cfg.loss
+            )
+
+        bucketed = make_bucketed_value_and_grad(loss_fn_of, mesh, n_buckets)
+
+        def hoisted(state, support, query, label):
+            grads, metrics = bucketed(
+                state.params, (support, query, label)
+            )
+            return state.apply_gradients(grads=grads), metrics
+
+        return jax.jit(hoisted, donate_argnums=(0,))
 
     @partial(
         compat_shard_map,

@@ -351,16 +351,50 @@ def _require_adam(cfg: ExperimentConfig):
         )
 
 
-def _make_compact_step(model, cfg: ExperimentConfig, hp: LazyHyper):
+def _make_compact_step(model, cfg: ExperimentConfig, hp: LazyHyper,
+                       mesh=None):
     """One fwd/bwd/update on the COMPACT [U, D] leaf: ``(state, rows,
     (support, query, label)) -> (state, rows, metrics)`` where rows =
     (W_r, m_r, v_r) are the caught-up corpus rows and support/query carry
     the precomputed ``winv`` remap. The single source of the cached lazy
     step math — the per-step body and the hoisted fused scan both wrap it,
-    so they cannot diverge."""
+    so they cannot diverge.
+
+    ``mesh`` (token-cache factories thread theirs): lets
+    ``cfg.grad_bucketing`` resolve — the fwd+bwd then runs per shard in
+    shard_map and every gradient (the compact [U, D] rows leaf included,
+    last bucket) reduces in an explicit, named, reverse-topological
+    bucket psum (parallel/grad_buckets.py) instead of the partitioner's
+    monolithic inserts. The clip/update math below is untouched: it
+    consumes the same reduced tree either way."""
+    from induction_network_on_fewrel_tpu.parallel.grad_buckets import (
+        grad_buckets_for,
+        make_bucketed_value_and_grad,
+    )
     from induction_network_on_fewrel_tpu.train.steps import loss_and_metrics
 
     aux_w = cfg.moe_aux_weight if cfg.moe_experts > 0 else 0.0
+
+    def loss_fn_of(p, batch):
+        sup2, qry2, label = batch
+        return loss_and_metrics(model, p, sup2, qry2, label, cfg.loss, aux_w)
+
+    n_buckets = grad_buckets_for(cfg, mesh)
+    # The dense [M, D] word table rides p_fwd only so flax finds the
+    # declared param — the forward reads the compact lazy_embed rows.
+    # Freeze it: its cotangent is identically zero, and letting the
+    # bucketed wrapper stack/psum a full-table zeros leaf is an
+    # 80 MB/step flagship all-reduce (the round-6 regression, re-measured
+    # and caught by check_flagship's projection band in round 10).
+    bucketed = (
+        make_bucketed_value_and_grad(
+            loss_fn_of, mesh, n_buckets,
+            frozen=lambda p: (
+                p.endswith("word_embedding") and "lazy_embed" not in p
+            ),
+        )
+        if n_buckets else None
+    )
 
     def compact_step(state, rows, batch):
         support, query, label = batch
@@ -380,7 +414,10 @@ def _make_compact_step(model, cfg: ExperimentConfig, hp: LazyHyper):
                 model, p, sup2, qry2, label, cfg.loss, aux_w
             )
 
-        grads, metrics = jax.grad(loss_fn, has_aux=True)(p_fwd)
+        if bucketed is not None:
+            grads, metrics = bucketed(p_fwd, (sup2, qry2, label))
+        else:
+            grads, metrics = jax.grad(loss_fn, has_aux=True)(p_fwd)
         grads = clip_grads_like_optax(grads, hp.clip)
 
         g_r = tree_get(grads["lazy_embed"], tuple(path[1:-1]) + ("rows",))
@@ -393,7 +430,7 @@ def _make_compact_step(model, cfg: ExperimentConfig, hp: LazyHyper):
     return compact_step
 
 
-def make_lazy_cached_scan_fns(model, cfg: ExperimentConfig):
+def make_lazy_cached_scan_fns(model, cfg: ExperimentConfig, mesh=None):
     """(prologue, compact_step, epilogue) for HOISTED fused token-cache
     scans. ``uids`` is static across a fused call, so the dense-table
     work moves to the call boundary: ``prologue(state, uids) -> rows``
@@ -409,7 +446,7 @@ def make_lazy_cached_scan_fns(model, cfg: ExperimentConfig):
     """
     _require_adam(cfg)
     hp = make_hyper(cfg)
-    compact = _make_compact_step(model, cfg, hp)
+    compact = _make_compact_step(model, cfg, hp, mesh=mesh)
 
     def prologue(state, uids):
         path = find_emb_path(state.params)
@@ -435,7 +472,7 @@ def make_lazy_cached_scan_fns(model, cfg: ExperimentConfig):
     return prologue, compact, epilogue
 
 
-def make_lazy_cached_update_body(model, cfg: ExperimentConfig):
+def make_lazy_cached_update_body(model, cfg: ExperimentConfig, mesh=None):
     """Token-cache twin of make_lazy_update_body: batch =
     ``(support, query, label, uids)`` where support/query carry the
     precomputed ``winv`` remapped ids and ``uids [U]`` is the STATIC
@@ -453,7 +490,9 @@ def make_lazy_cached_update_body(model, cfg: ExperimentConfig):
     callers should prefer make_lazy_cached_scan_fns, which hoists it to
     the call boundary (identical trajectory).
     """
-    prologue, compact, epilogue = make_lazy_cached_scan_fns(model, cfg)
+    prologue, compact, epilogue = make_lazy_cached_scan_fns(
+        model, cfg, mesh=mesh
+    )
 
     def body(state, batch):
         support, query, label, uids = batch

@@ -146,7 +146,8 @@ def loss_and_metrics(
     return loss, {"loss": task_loss, "accuracy": accuracy(logits, label)}
 
 
-def make_update_body(model, cfg: ExperimentConfig, update_shardings=None):
+def make_update_body(model, cfg: ExperimentConfig, update_shardings=None,
+                     mesh=None):
     """The one fwd+bwd+update body every step factory wraps: single-device
     jit, GSPMD-sharded jit, and the lax.scan fused variants of both all call
     this — one source of truth for the update math, so the per-step and
@@ -167,19 +168,47 @@ def make_update_body(model, cfg: ExperimentConfig, update_shardings=None):
     partitioner-inserted collectives — they are not traced ops, which is
     how the zero1 leg's 232 KB of all-gathers stayed metadata-less
     through rounds 5-7, RUNBOOK §11 attribution debt).
+
+    ``mesh``: the device mesh when the caller shards this body (the
+    GSPMD step factories pass theirs). Lets ``cfg.grad_bucketing``
+    resolve: on pure-dp meshes the gradient psums are spelled as
+    explicit, named, reverse-topological bucket reductions hoisted out
+    of a per-shard shard_map (parallel/grad_buckets.py) instead of the
+    partitioner-inserted monolithic scatter — identical math, scheduler-
+    visible collectives (COMMS_r10 overlap rows).
     """
 
     if cfg.embed_optimizer == "lazy":
         # The lazy table body has its own update spelling; zero1's
         # explicit-gather attribution covers the plain-TrainState path
-        # only (remaining-debt note in BASELINE round 8).
+        # only (remaining-debt note in BASELINE round 8). No mesh is
+        # passed: the LIVE lazy path is single-device by CLI contract
+        # (the token-cache factories thread their mesh to the cached
+        # lazy body themselves).
         from induction_network_on_fewrel_tpu.train.lazy_embed import (
             make_lazy_update_body,
         )
 
         return make_lazy_update_body(model, cfg)
 
+    from induction_network_on_fewrel_tpu.parallel.grad_buckets import (
+        grad_buckets_for,
+        make_bucketed_value_and_grad,
+    )
+
     aux_w = cfg.moe_aux_weight if cfg.moe_experts > 0 else 0.0
+
+    def loss_fn_of(params, batch):
+        support, query, label = batch
+        return loss_and_metrics(
+            model, params, support, query, label, cfg.loss, aux_w
+        )
+
+    n_buckets = grad_buckets_for(cfg, mesh)
+    bucketed = (
+        make_bucketed_value_and_grad(loss_fn_of, mesh, n_buckets)
+        if n_buckets else None
+    )
 
     def body(state: TrainState, batch):
         support, query, label = batch
@@ -189,7 +218,10 @@ def make_update_body(model, cfg: ExperimentConfig, update_shardings=None):
                 model, params, support, query, label, cfg.loss, aux_w
             )
 
-        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+        if bucketed is not None:
+            grads, metrics = bucketed(state.params, batch)
+        else:
+            grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
         if update_shardings is None:
             return state.apply_gradients(grads=grads), metrics
         # flax TrainState.apply_gradients, spelled out so the re-gather
@@ -203,9 +235,41 @@ def make_update_body(model, cfg: ExperimentConfig, update_shardings=None):
                 grads, state.opt_state, state.params
             )
             with jax.named_scope("gather"):
-                updates = jax.lax.with_sharding_constraint(
-                    updates, update_shardings
-                )
+                if n_buckets:
+                    # Same hoisted, named spelling as the grad psums: the
+                    # dp-sharded-delta -> replicated-params re-gathers pin
+                    # per reverse-topological bucket, so each bucket's
+                    # all-gather is its own attributed, schedulable op
+                    # (opt/zero1_update/gather/bucket_k rows in the
+                    # ledger) instead of one fused re-shard.
+                    from induction_network_on_fewrel_tpu.parallel import (
+                        grad_buckets as _gb,
+                    )
+
+                    flat_u, td = jax.tree_util.tree_flatten_with_path(
+                        updates
+                    )
+                    flat_s = jax.tree_util.tree_leaves(
+                        update_shardings,
+                        is_leaf=lambda x: hasattr(x, "spec"),
+                    )
+                    gathered: list = [None] * len(flat_u)
+                    for k in range(n_buckets):
+                        with jax.named_scope(f"bucket_{k}"):
+                            for i, (path, leaf) in enumerate(flat_u):
+                                if _gb.bucket_index(
+                                    _gb._path_str(path), n_buckets
+                                ) == k:
+                                    gathered[i] = (
+                                        jax.lax.with_sharding_constraint(
+                                            leaf, flat_s[i]
+                                        )
+                                    )
+                    updates = jax.tree_util.tree_unflatten(td, gathered)
+                else:
+                    updates = jax.lax.with_sharding_constraint(
+                        updates, update_shardings
+                    )
             new_params = optax.apply_updates(state.params, updates)
         return (
             state.replace(
@@ -340,7 +404,14 @@ def make_grad_probe(model, cfg: ExperimentConfig):
         # explicitly so the reference stays exact if the backend pin
         # ever changes — this probe is the run-time police for
         # --lstm_residuals bf16 drift.
+        # Bucketing off too: the probe's reference gradient must be the
+        # plain monolithic jax.grad — a bucketed reference would compare
+        # one restructured backward against another and mask drift in
+        # the bucket spelling itself (probe runs meshless, where the
+        # knob is inert anyway, but the pin keeps that true if the
+        # probe ever gains a mesh).
         remat_attn=False, lstm_cs_window=0, lstm_residuals="f32",
+        grad_bucketing="off",
     )
     ref_model = build_model(ref_cfg)
     aux_w = cfg.moe_aux_weight if cfg.moe_experts > 0 else 0.0

@@ -87,7 +87,7 @@ def _gather(table: dict[str, Any], idx):
     return {k: v[idx] for k, v in table.items() if k != "uids"}
 
 
-def _lazy_cached(model, cfg):
+def _lazy_cached(model, cfg, mesh=None):
     """The token-cache lazy-embed body, or None when cfg doesn't use it."""
     if getattr(cfg, "embed_optimizer", "shared") != "lazy":
         return None
@@ -95,7 +95,7 @@ def _lazy_cached(model, cfg):
         make_lazy_cached_update_body,
     )
 
-    return make_lazy_cached_update_body(model, cfg)
+    return make_lazy_cached_update_body(model, cfg, mesh=mesh)
 
 
 def make_token_cached_train_step(model, cfg, mesh=None, state_example=None):
@@ -109,8 +109,10 @@ def make_token_cached_train_step(model, cfg, mesh=None, state_example=None):
 
     from induction_network_on_fewrel_tpu.train.steps import make_update_body
 
-    lazy = _lazy_cached(model, cfg)
-    body = make_update_body(model, cfg) if lazy is None else None
+    lazy = _lazy_cached(model, cfg, mesh=mesh)
+    body = (
+        make_update_body(model, cfg, mesh=mesh) if lazy is None else None
+    )
 
     def step(state, table, sup_idx, qry_idx, label):
         sup, qry = _gather(table, sup_idx), _gather(table, qry_idx)
@@ -143,7 +145,9 @@ def make_token_cached_multi_train_step(model, cfg, mesh=None, state_example=None
             make_lazy_cached_scan_fns,
         )
 
-        prologue, compact, epilogue = make_lazy_cached_scan_fns(model, cfg)
+        prologue, compact, epilogue = make_lazy_cached_scan_fns(
+            model, cfg, mesh=mesh
+        )
 
         def multi_step(state, table, sup_idx_s, qry_idx_s, label_s):
             uids = table["uids"]
@@ -169,7 +173,7 @@ def make_token_cached_multi_train_step(model, cfg, mesh=None, state_example=None
             zero_opt=getattr(cfg, "zero_opt", False),
         )
 
-    body = make_update_body(model, cfg)
+    body = make_update_body(model, cfg, mesh=mesh)
 
     def multi_step(state, table, sup_idx_s, qry_idx_s, label_s):
         def scan_body(st, xs):

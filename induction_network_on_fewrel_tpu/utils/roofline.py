@@ -237,6 +237,12 @@ def step_bytes(
 # aliases these.
 NOMINAL_V5E_BW = 819e9      # HBM bytes/s
 NOMINAL_V5E_MXU = 197e12    # bf16 FLOP/s
+# Inter-chip interconnect: public v5e spec, 1600 Gbps/chip = 200 GB/s.
+# The comms ledger's dataflow-window overlap measure (round 10) prices a
+# collective's wire time against the HBM time of the independent compute
+# scheduled after it using THIS ratio — one home, same nominal-silicon
+# convention as the floor projection above.
+NOMINAL_V5E_ICI = 200e9     # ICI bytes/s per chip
 
 
 def projected_floor_ms(
@@ -315,6 +321,7 @@ def comms_components(
     dp: int | None = None,
     compact: bool | None = None,
     corpus_rows: int | None = None,
+    bucketed: bool | None = None,
 ) -> list[tuple[str, float]]:
     """[(term, payload bytes/step/device)] for a dp-sharded train step.
     Empty when nothing is sharded (dp <= 1: no collectives).
@@ -328,12 +335,21 @@ def comms_components(
     the exact run whose purpose is comparing the two (COMMS_r06 measured
     the dense flagship at 33.7 MB payload; this arithmetic must agree).
     ``corpus_rows``: the real distinct-row count (len(uids)) when known;
-    default is the synthetic-fixture bound ``SYNTHETIC_CORPUS_ROWS``."""
+    default is the synthetic-fixture bound ``SYNTHETIC_CORPUS_ROWS``.
+    ``bucketed``: the round-10 bucketed-psum arm (grad_bucketing "on",
+    parallel/grad_buckets) — fwd+bwd run shard-local inside shard_map,
+    so the partitioner inserts NO resharding collectives and the slack
+    term drops; the grad/row terms are byte-identical (same payloads,
+    explicit named psums). Defaults from cfg.grad_bucketing == "on" (the
+    forced arm — "auto" resolution is backend/mesh-dependent and belongs
+    to the caller)."""
     dp = cfg.dp if dp is None else dp
     if dp <= 1:
         return []
     if compact is None:
         compact = getattr(cfg, "compact_demb", "auto") != "off"
+    if bucketed is None:
+        bucketed = getattr(cfg, "grad_bucketing", "auto") == "on"
     f32 = 4
     rows = [
         # dp gradient all-reduce over the non-embedding params, f32.
@@ -369,10 +385,11 @@ def comms_components(
             "demb row all-reduce ([U, D] rows, f32)",
             u_rows * cfg.word_dim * f32,
         ))
-    rows.append((
-        "resharding (permutes + id reshards, calibrated)",
-        RESHARD_SLACK_BYTES,
-    ))
+    if not bucketed:
+        rows.append((
+            "resharding (permutes + id reshards, calibrated)",
+            RESHARD_SLACK_BYTES,
+        ))
     return rows
 
 
@@ -381,23 +398,41 @@ def comms_payload_bytes(
     dp: int | None = None,
     compact: bool | None = None,
     corpus_rows: int | None = None,
+    bucketed: bool | None = None,
 ) -> float:
     """Total collective payload bytes/step/device (ledger convention)."""
     return sum(
-        b for _, b in comms_components(cfg, dp, compact, corpus_rows)
+        b for _, b in comms_components(cfg, dp, compact, corpus_rows,
+                                       bucketed)
     )
 
 
+def ring_factor(kind: str, d: int) -> float:
+    """Wire bytes per payload byte for ring algorithms at d participants:
+    all-reduce moves 2(d-1)/d of its payload, all-gather/reduce-scatter
+    (d-1)/d of the gathered/scattered size, permutes and all-to-all ~1x.
+    ONE home for the algorithm factor — wire_bytes aggregates with it and
+    tools/comms_ledger.py prices individual collectives with it."""
+    if d <= 1:
+        return 0.0
+    if kind == "all-reduce":
+        return 2 * (d - 1) / d
+    if kind in ("all-gather", "reduce-scatter"):
+        return (d - 1) / d
+    return 1.0
+
+
 def wire_bytes(payload_by_kind: dict[str, float], d: int) -> float:
-    """Payload -> wire bytes for ring algorithms at d participants:
-    all-reduce moves 2(d-1)/d of its payload, all-gather (d-1)/d of the
-    gathered size, permutes ~1x. Keys: 'all-reduce' (incl.
-    reduce-scatter), 'all-gather', everything else summed under 'other'.
+    """Payload -> wire bytes for ring algorithms at d participants (see
+    ring_factor). Keys: 'all-reduce' (incl. reduce-scatter), 'all-gather',
+    everything else summed under 'other'.
     """
     ar = payload_by_kind.get("all-reduce", 0.0)
     ag = payload_by_kind.get("all-gather", 0.0)
     other = payload_by_kind.get("other", 0.0)
-    return 2 * (d - 1) / d * ar + (d - 1) / d * ag + other
+    return (ring_factor("all-reduce", d) * ar
+            + ring_factor("all-gather", d) * ag
+            + ring_factor("other", d) * other)
 
 
 def comms_wire_bytes(

@@ -514,6 +514,7 @@ def _latest_adapt_artifact() -> dict:
         return json.load(f)
 
 
+@pytest.mark.slow
 def test_adapt_drill_both_arms(tmp_path):
     """The committed drill replayed in-process: every structural flag on
     both arms must hold (wall times excepted — sandbox-unstable), the

@@ -43,12 +43,19 @@ def test_trend_covers_every_committed_artifact():
     # The round-6 -> round-8 byte diet is IN the trajectory.
     sb = [p["value"] for p in series["roofline.step_bytes"]["points"]]
     assert sb == [798687980, 634847980]
-    # The comms diet (round-6 dense flagship -> compact) likewise.
+    # The comms diet (round-6 dense flagship -> round-7 compact ->
+    # round-10 bucketed shard_map, which also deletes the partitioner's
+    # resharding permutes) likewise.
     comms = [
         p["value"]
         for p in series["comms.flagship_payload_bytes"]["points"]
     ]
-    assert comms[0] == 33719548 and comms[-1] == 7746548
+    assert comms[0] == 33719548 and comms[-1] == 5188148
+    assert 7746548 in comms
+    # And the round-10 measured overlap headline is banded at its floor.
+    ovf = series["comms.flagship_overlap_frac"]
+    assert [p["value"] for p in ovf["points"]] == [1.0]
+    assert ovf["band"] == {"rule": "floor", "tol": 0.92}
     # Scheduler-A/B ratio present for both SERVE rounds.
     assert len(series["serve.closed_qps_ratio"]["points"]) == 2
 

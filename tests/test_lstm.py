@@ -581,3 +581,35 @@ def test_resolver_windowed_knobs():
     assert r["lstm_residual_dtype"] == jnp.float32
     with pytest.raises(ValueError):
         resolve_runtime_backends(cfg.replace(lstm_residuals="fp8"))
+
+
+def test_resolver_comms_knobs():
+    """Round-10 additions to the same one home: async_collectives auto
+    resolves off on CPU (on would claim latency hiding the backend can't
+    deliver); grad_bucketing auto resolves off on CPU for ANY embed arm
+    (TPU+lazy is the only auto-on combination — a dense table arm keeps
+    compact demb, which is mutually exclusive with the outer shard_map);
+    both force with \"on\"; bad spellings raise."""
+    from induction_network_on_fewrel_tpu.config import ExperimentConfig
+    from induction_network_on_fewrel_tpu.models.build import (
+        resolve_runtime_backends,
+    )
+
+    cfg = ExperimentConfig(encoder="bilstm")
+    r = resolve_runtime_backends(cfg)
+    assert r["async_collectives"] == "off"
+    assert r["grad_bucketing"] == "off"
+    assert r["grad_bucket_count"] == 4
+
+    r = resolve_runtime_backends(
+        cfg.replace(grad_bucketing="on", async_collectives="on",
+                    grad_bucket_count=2)
+    )
+    assert r["grad_bucketing"] == "on"
+    assert r["async_collectives"] == "on"
+    assert r["grad_bucket_count"] == 2
+
+    with pytest.raises(ValueError):
+        resolve_runtime_backends(cfg.replace(grad_bucketing="yes"))
+    with pytest.raises(ValueError):
+        resolve_runtime_backends(cfg.replace(async_collectives="maybe"))

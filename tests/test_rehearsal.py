@@ -127,6 +127,7 @@ def real_format_corpus(tmp_path):
     return files
 
 
+@pytest.mark.slow
 def test_real_data_rehearsal(real_format_corpus, tmp_path):
     f = real_format_corpus
     common = ["--device", "cpu", "--sampler", "python", "--dp", "1"]

@@ -224,6 +224,7 @@ def test_engine_rate_zero_short_circuits(tmp_path, world):
     assert not [r for r in recs if r["kind"] == "trace"]
 
 
+@pytest.mark.slow
 def test_tracing_tax_under_2pct_of_p50_exec(tmp_path):
     """The tier-1 overhead gate (ISSUE 9 satellite): the SAME engine and
     programs driven with tracing off vs on; the per-batch wall-time
@@ -502,6 +503,37 @@ def test_slo_min_count_guards_thin_windows():
     assert [e.event for e in slo.evaluate(now=t + 9)] == [
         "slo_fast_burn", "slo_slow_burn"
     ]
+
+
+def test_slo_sweep_trip_equivalence():
+    """Round-10 regression pin for the single-lock sweep: evaluate()
+    (one lock acquisition, one bucket index, _rates_locked per tenant)
+    must trip EXACTLY the (tenant, window) pairs the public per-tenant
+    burn_rates() read predicts against the engine thresholds, on the
+    burn-drill tenant mix — clean, thin (< MIN_COUNT, burning hard),
+    fast+slow burning, and slow-only burning."""
+    slo = SLOEngine(SLOObjective(availability=0.99, latency_ms=10.0))
+    t = 100.0
+    _fill(slo, "clean", n=40, bad=0, t=t)
+    _fill(slo, "thin", n=SLOEngine.MIN_COUNT - 1,
+          bad=SLOEngine.MIN_COUNT - 1, t=t)
+    _fill(slo, "hot", n=40, bad=20, t=t)    # 50% bad: fast AND slow trip
+    _fill(slo, "warm", n=40, bad=4, t=t)    # 10% bad: slow-only trip
+    now = t + 1
+    expected = set()
+    for tenant in slo.tenants():
+        rates = slo.burn_rates(tenant, now=now)
+        for label, threshold in (("fast", slo.fast_burn),
+                                 ("slow", slo.slow_burn)):
+            if (rates[f"burn_{label}"] >= threshold
+                    and rates[f"total_{label}"] >= slo.MIN_COUNT):
+                expected.add((tenant, f"slo_{label}_burn"))
+    assert expected == {("hot", "slo_fast_burn"), ("hot", "slo_slow_burn"),
+                        ("warm", "slo_slow_burn")}
+    evs = slo.evaluate(now=now)
+    assert {(e.data["tenant"], e.event) for e in evs} == expected
+    # Latch equivalence: a second sweep of the same state emits nothing.
+    assert slo.evaluate(now=now + 1) == []
 
 
 def test_slo_per_tenant_objectives_and_isolation():

@@ -90,6 +90,12 @@ BANDS: dict[str, tuple[str, float]] = {
     "comms.flagship_payload_bytes": ("lower", 0.15),
     "comms.flagship_unattributed_bytes": ("zero", 0.0),
     "comms.dp8_lazy_payload_bytes": ("lower", 0.15),
+    # Round 10: the measured whole-step overlap headline (ledger dataflow
+    # windows, wire-weighted). Floor mirrors check_flagship's <= 8%
+    # un-overlapped acceptance; wire bytes recorded unbanded (the ring
+    # factor makes them a deterministic function of the payload diet).
+    "comms.flagship_overlap_frac": ("floor", 0.92),
+    "comms.dp8_lazy_bucketed_payload_bytes": ("lower", 0.15),
     # Serving: the scheduler-A/B ratio plus the hot-swap drill's zero-
     # drop invariant (absolute qps/p99 recorded, not gated).
     "serve.closed_qps_ratio": ("floor", 1.0),
@@ -253,8 +259,15 @@ def _bench_summary_points(points: dict, rnd, source: str, parsed: dict) -> int:
         _point(points, f"bench.step_ms{bracket}", rnd, source,
                round(int(mb.group(1)) / parsed["value"] * 1e3, 4))
     for key in ("step_bytes", "step_bytes_windowed", "lstm_residual_bytes",
-                "comms_bytes_per_step", "comms_wire_bytes_per_step"):
+                "comms_bytes_per_step", "comms_wire_bytes_per_step",
+                "comms_overlap_frac", "comms_unoverlapped_frac"):
         _point(points, f"bench.{key}", rnd, source, parsed.get(key))
+    # Round 10: per-bucket all-reduce payload (grouped from the ledger's
+    # attributed flagship rows — see bench.py::_comms_overlap_stamp).
+    for bucket, nbytes in sorted(
+            (parsed.get("comms_bucket_bytes") or {}).items()):
+        _point(points, f"bench.comms_bucket_bytes.{bucket}", rnd, source,
+               nbytes)
     serving = parsed.get("serving") or {}
     _point(points, "bench.serving_continuous_over_microbatch", rnd, source,
            serving.get("continuous_over_microbatch"))
@@ -284,6 +297,18 @@ def _comms_points(points: dict, path: str, data: dict) -> int:
     lazy = data.get("dp8_tokencache_lazy") or {}
     _point(points, "comms.dp8_lazy_payload_bytes", rnd, src,
            lazy.get("total_bytes_per_step_per_device"))
+    # Round 10+: measured whole-step overlap on the flagship leg (the
+    # ledger's per-collective dataflow windows priced at the v5e HBM:ICI
+    # ratio, wire-weighted) plus the bucketed lazy leg's payload — the
+    # bucketed restructure's byte win gets its own diet band.
+    ov = flag.get("overlap") or {}
+    _point(points, "comms.flagship_overlap_frac", rnd, src,
+           ov.get("overlap_frac"))
+    _point(points, "comms.flagship_wire_bytes", rnd, src,
+           ov.get("total_wire_bytes"))
+    bucketed = data.get("dp8_lazy_bucketed") or {}
+    _point(points, "comms.dp8_lazy_bucketed_payload_bytes", rnd, src,
+           bucketed.get("total_bytes_per_step_per_device"))
     return sum(len(v) for v in points.values()) - before
 
 
